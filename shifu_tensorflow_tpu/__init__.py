@@ -1,0 +1,29 @@
+"""shifu_tensorflow_tpu — a TPU-native distributed training framework for tabular ML.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of
+ShifuML/shifu-tensorflow (distributed TensorFlow-on-YARN for the Shifu
+tabular pipeline).  Where the reference runs TF-1.x parameter-server
+training inside YARN containers coordinated by an embedded ZooKeeper
+(reference: shifu-tensorflow-on-yarn/.../TensorflowSession.java), this
+framework runs SPMD data-parallel training over a `jax.sharding.Mesh`
+with gradient all-reduce over ICI, streams normalized column shards
+into device infeed, and exports the same serving artifact contract
+(`shifu_input_0` -> `shifu_output_0` SavedModel + GenericModelConfig.json,
+reference: ssgd_monitor.py:457-490) so downstream Java batch scoring
+is unchanged.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  L6  export/   - serving-artifact export + scoring parity (Python + C++)
+  L5  train/    - jitted train step, epoch loop, checkpointing
+  L4  models/   - config-driven model zoo (DNN, Wide&Deep, multi-task, embeddings)
+  L3  parallel/ - mesh, shardings, collectives, multi-host init
+  L2  coordinator/ - job submitter / coordinator / worker lifecycle
+  L1  data/     - sharded streaming input pipeline (PSV+gzip, ZSCALE)
+  L0  config/ + utils/ - layered configuration, typed keys, fs helpers
+"""
+
+__version__ = "0.1.0"
+
+from shifu_tensorflow_tpu.config.conf import Conf  # noqa: F401
+from shifu_tensorflow_tpu.config.model_config import ModelConfig  # noqa: F401

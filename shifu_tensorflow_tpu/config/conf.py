@@ -1,0 +1,209 @@
+"""Layered configuration.
+
+Parity surface: the reference merges Hadoop-``Configuration`` XML resources in
+order — packaged ``global-default.xml`` → user ``-globalconfig`` file →
+programmatic additions — then serializes the merge to ``global-final.xml``
+which is localized into every container (reference:
+TensorflowClient.java:212-224,389-403; Constants.java:34-39).
+
+``Conf`` keeps that three-layer model (defaults → files → programmatic) and
+the Hadoop XML wire format so existing Shifu config files load unchanged,
+but is a plain ordered dict underneath — no Hadoop dependency — and adds
+JSON resources and typed getters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import xml.etree.ElementTree as ET
+from typing import Any, Iterable, Mapping
+
+from shifu_tensorflow_tpu.config import keys as K
+
+_MEMORY_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([gGmMkK]?)[bB]?\s*$")
+_MEMORY_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+def parse_memory_string(value: str | int) -> int:
+    """Parse ``"2g"`` / ``"1536m"`` / ``"4096"`` into bytes.
+
+    Parity: CommonUtils.parseMemoryString (CommonUtils.java:118-140) parsed
+    YARN memory strings into MB rounded up to the scheduler minimum; here the
+    value is informational (host memory budget), so no rounding is applied.
+    """
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _MEMORY_RE.match(str(value))
+    if not m:
+        raise ValueError(f"unparseable memory string: {value!r}")
+    num, unit = float(m.group(1)), m.group(2).lower()
+    return int(num * _MEMORY_MULT[unit])
+
+
+class Conf:
+    """Ordered, layered key→string configuration with typed getters."""
+
+    def __init__(self, initial: Mapping[str, Any] | None = None):
+        self._values: dict[str, str] = {}
+        self._sources: dict[str, str] = {}
+        if initial:
+            self.update(initial, source="<init>")
+
+    # ---- resource layering ----
+    def add_resource(self, resource: str | os.PathLike | Mapping[str, Any]) -> "Conf":
+        """Merge a resource on top of current values (later wins)."""
+        if isinstance(resource, Mapping):
+            self.update(resource, source="<dict>")
+            return self
+        path = os.fspath(resource)
+        text = _read_text(path)
+        if path.endswith(".json"):
+            self.update(json.loads(text), source=path)
+        else:
+            self.update(_parse_hadoop_xml(text), source=path)
+        return self
+
+    def update(self, mapping: Mapping[str, Any], source: str = "<set>") -> None:
+        for k, v in mapping.items():
+            self._values[str(k)] = _to_str(v)
+            self._sources[str(k)] = source
+
+    def set(self, key: str, value: Any) -> None:
+        self._values[key] = _to_str(value)
+        self._sources[key] = "<set>"
+
+    def set_if_unset(self, key: str, value: Any) -> None:
+        if key not in self._values:
+            self.set(key, value)
+
+    # ---- typed getters ----
+    def get(self, key: str, default: Any = None) -> str | None:
+        v = self._values.get(key)
+        return v if v is not None else (None if default is None else _to_str(default))
+
+    def get_int(self, key: str, default: int | None = None) -> int | None:
+        v = self._values.get(key)
+        return int(v) if v is not None else default
+
+    def get_float(self, key: str, default: float | None = None) -> float | None:
+        v = self._values.get(key)
+        return float(v) if v is not None else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._values.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("true", "1", "yes", "on")
+
+    def get_ints(self, key: str, default: Iterable[int] = ()) -> list[int]:
+        """Space- or comma-separated int list (reference passes
+        SELECTED_COLUMN_NUMS space-separated, ssgd_monitor.py:43)."""
+        v = self._values.get(key)
+        if v is None or not v.strip():
+            return list(default)
+        return [int(s) for s in re.split(r"[,\s]+", v.strip()) if s]
+
+    def get_memory(self, key: str, default: str | None = None) -> int | None:
+        v = self.get(key, default)
+        return None if v is None else parse_memory_string(v)
+
+    def source_of(self, key: str) -> str | None:
+        return self._sources.get(key)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def items(self):
+        return self._values.items()
+
+    def as_dict(self) -> dict[str, str]:
+        return dict(self._values)
+
+    # ---- role templating (reference: GlobalConfigurationKeys.java:123-150) ----
+    def num_instances(self, job_name: str = K.WORKER_JOB_NAME) -> int:
+        return self.get_int(K.instances_key(job_name), K.DEFAULT_WORKER_INSTANCES)
+
+    def num_backup_instances(self, job_name: str = K.WORKER_JOB_NAME) -> int:
+        return self.get_int(K.backup_instances_key(job_name), K.DEFAULT_BACKUP_INSTANCES)
+
+    # ---- serialization ("global-final" parity) ----
+    def write_final(self, path: str | os.PathLike) -> None:
+        path = os.fspath(path)
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(self._values, f, indent=2, sort_keys=True)
+        else:
+            root = ET.Element("configuration")
+            for k in sorted(self._values):
+                prop = ET.SubElement(root, "property")
+                ET.SubElement(prop, "name").text = k
+                ET.SubElement(prop, "value").text = self._values[k]
+            ET.indent(root)
+            ET.ElementTree(root).write(path, encoding="unicode", xml_declaration=True)
+
+    @classmethod
+    def load_layered(cls, *resources: str | os.PathLike | Mapping[str, Any]) -> "Conf":
+        """defaults → user file(s) → programmatic, in call order."""
+        conf = cls(_BUILTIN_DEFAULTS)
+        for r in resources:
+            if r is not None:
+                conf.add_resource(r)
+        return conf
+
+
+_BUILTIN_DEFAULTS: dict[str, Any] = {
+    K.APPLICATION_NAME: K.DEFAULT_APPLICATION_NAME,
+    K.APPLICATION_TIMEOUT: K.DEFAULT_APPLICATION_TIMEOUT,
+    K.WEIGHT_COLUMN_NUM: K.DEFAULT_WEIGHT_COLUMN_NUM,
+    K.TARGET_COLUMN_NUM: K.DEFAULT_TARGET_COLUMN_NUM,
+    K.TASK_HEARTBEAT_INTERVAL_MS: K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS,
+    K.TASK_MAX_MISSED_HEARTBEATS: K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS,
+    K.instances_key(K.WORKER_JOB_NAME): K.DEFAULT_WORKER_INSTANCES,
+    K.backup_instances_key(K.WORKER_JOB_NAME): K.DEFAULT_BACKUP_INSTANCES,
+    K.MESH_SHAPE: K.DEFAULT_MESH_SHAPE,
+    K.BATCH_SIZE: K.DEFAULT_BATCH_SIZE,
+    K.DTYPE: K.DEFAULT_DTYPE,
+    K.PREFETCH_DEPTH: K.DEFAULT_PREFETCH_DEPTH,
+    K.CHECKPOINT_EVERY_EPOCHS: K.DEFAULT_CHECKPOINT_EVERY_EPOCHS,
+}
+
+
+def _to_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (list, tuple)):
+        return ",".join(str(x) for x in v)
+    return str(v)
+
+
+def _read_text(path: str) -> str:
+    from shifu_tensorflow_tpu.utils import fs
+
+    return fs.read_text(path)
+
+
+def _parse_hadoop_xml(text: str) -> dict[str, str]:
+    """Parse ``<configuration><property><name>/<value>`` XML.
+
+    The reference's default config file contains *two* concatenated
+    ``<configuration>`` documents (global-default-bk.xml); Hadoop tolerates
+    only one, but we accept multiple roots with later documents winning, so
+    that file (and any similar user file) loads.
+    """
+    out: dict[str, str] = {}
+    docs = re.findall(r"<configuration>.*?</configuration>", text, flags=re.S)
+    if not docs:
+        docs = [text]
+    for doc in docs:
+        root = ET.fromstring(doc)
+        for prop in root.iter("property"):
+            name = prop.findtext("name")
+            value = prop.findtext("value")
+            if name is not None:
+                out[name.strip()] = (value or "").strip()
+    return out

@@ -1,0 +1,103 @@
+"""Typed configuration keys and defaults.
+
+Parity surface: the reference keeps every tunable under a flat ``shifu.*``
+namespace with per-role templating (reference:
+shifu-tensorflow-on-yarn/.../util/GlobalConfigurationKeys.java:113-154 and
+util/Constants.java:87-94).  We keep the same namespace so existing Shifu
+``global.xml`` files parse unchanged, and add a ``shifu.tpu.*`` sub-namespace
+for mesh/topology keys that have no YARN analogue.
+
+Unlike the reference — where role resources were matched to containers by
+*exact* (memory, vcores) equality, an implicit invariant
+(TensorflowSession.java:300-318) — roles here are explicit: a worker is a
+host process addressing TPU chips, and the topology is declared, not
+inferred from container shapes.
+"""
+
+from __future__ import annotations
+
+SHIFU_PREFIX = "shifu."
+APP_PREFIX = SHIFU_PREFIX + "application."
+
+# ---- application-level keys (names shared with the reference) ----
+APPLICATION_NAME = APP_PREFIX + "name"
+DEFAULT_APPLICATION_NAME = "ShifuTpuApplication"
+APPLICATION_TIMEOUT = APP_PREFIX + "timeout"  # ms; 0 = no timeout
+DEFAULT_APPLICATION_TIMEOUT = 0
+
+TRAINING_DATA_PATH = APP_PREFIX + "training-data-path"
+WEIGHT_COLUMN_NUM = APP_PREFIX + "weight-column-number"
+TARGET_COLUMN_NUM = APP_PREFIX + "target-column-number"
+SELECTED_COLUMN_NUMS = APP_PREFIX + "selected-column-numbers"
+SELECTED_NUMERIC_COLUMN_NUMS = APP_PREFIX + "selected-numeric-column-numbers"
+SELECTED_CATEGORY_COLUMN_NUMS = APP_PREFIX + "selected-category-column-numbers"
+TOTAL_TRAINING_DATA_NUM = APP_PREFIX + "total-training-data-number"
+DEFAULT_WEIGHT_COLUMN_NUM = -1
+DEFAULT_TARGET_COLUMN_NUM = 0
+TMP_MODEL_PATH = APP_PREFIX + "tmp-model-path"
+FINAL_MODEL_PATH = APP_PREFIX + "final-model-path"
+TMP_LOG_PATH = APP_PREFIX + "tmp-log-path"
+MODEL_CONF = APP_PREFIX + "model-conf"
+COLUMN_CONF = APP_PREFIX + "column-conf"
+EPOCHS = APP_PREFIX + "epochs"
+
+# ---- task / liveness keys (reference: GlobalConfigurationKeys.java:75-79) ----
+TASK_PREFIX = SHIFU_PREFIX + "task."
+TASK_HEARTBEAT_INTERVAL_MS = TASK_PREFIX + "heartbeat-interval"
+DEFAULT_TASK_HEARTBEAT_INTERVAL_MS = 1000
+TASK_MAX_MISSED_HEARTBEATS = TASK_PREFIX + "max-missed-heartbeats"
+DEFAULT_TASK_MAX_MISSED_HEARTBEATS = 25
+
+# ---- role templating (reference: getInstancesKey etc. :123-150) ----
+WORKER_JOB_NAME = "worker"
+PS_JOB_NAME = "ps"  # accepted in configs for compat; there is no PS on TPU
+
+
+def instances_key(job_name: str) -> str:
+    return f"{SHIFU_PREFIX}{job_name}.instances"
+
+
+def backup_instances_key(job_name: str) -> str:
+    return f"{SHIFU_PREFIX}{job_name}.instances.backup"
+
+
+def memory_key(job_name: str) -> str:
+    return f"{SHIFU_PREFIX}{job_name}.memory"
+
+
+def vcores_key(job_name: str) -> str:
+    return f"{SHIFU_PREFIX}{job_name}.vcores"
+
+
+DEFAULT_WORKER_INSTANCES = 1
+DEFAULT_BACKUP_INSTANCES = 0
+
+# ---- TPU-native topology keys (no YARN analogue) ----
+TPU_PREFIX = SHIFU_PREFIX + "tpu."
+MESH_SHAPE = TPU_PREFIX + "mesh-shape"  # e.g. "data:8" or "data:4,model:2"
+DEFAULT_MESH_SHAPE = "data:-1"  # -1 = all local devices on the data axis
+NUM_PROCESSES = TPU_PREFIX + "num-processes"
+COORDINATOR_ADDRESS = TPU_PREFIX + "coordinator-address"
+PROCESS_ID = TPU_PREFIX + "process-id"
+BATCH_SIZE = TPU_PREFIX + "batch-size"  # global batch size
+DEFAULT_BATCH_SIZE = 100  # parity with reference BATCH_SIZE (ssgd_monitor.py:33)
+DTYPE = TPU_PREFIX + "dtype"
+DEFAULT_DTYPE = "float32"  # tabular nets are tiny; bf16 is opt-in
+PREFETCH_DEPTH = TPU_PREFIX + "prefetch-depth"
+DEFAULT_PREFETCH_DEPTH = 2
+CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
+DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
+
+# ---- fault-tolerance envelope (reference: Constants.java:87-94) ----
+WORKER_FAULT_TOLERANCE_THRESHOLD = 0.1
+PS_FAULT_TOLERANCE_THRESHOLD = 0.9
+MIN_WORKERS_START_TRAINING_THRESHOLD = 0.95
+REGISTRATION_SOFT_TIMEOUT_S = 6 * 60  # partial-start wait
+REGISTRATION_HARD_TIMEOUT_S = 20 * 60  # hard abort
+
+# ---- file-name constants (reference: Constants.java:34-39) ----
+GLOBAL_DEFAULT_FILE = "global-default.xml"
+GLOBAL_FINAL_FILE = "global-final.xml"
+MODEL_CONFIG_FILE = "ModelConfig.json"
+COLUMN_CONFIG_FILE = "ColumnConfig.json"
+GENERIC_MODEL_CONFIG_FILE = "GenericModelConfig.json"

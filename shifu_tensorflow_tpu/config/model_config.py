@@ -1,0 +1,204 @@
+"""ModelConfig.json / ColumnConfig.json ingestion.
+
+Parity surface: the reference builds its network **dynamically** from Shifu's
+``ModelConfig.json`` — ``train.numTrainEpochs``, ``train.validSetRate`` and
+``train.params.{NumHiddenLayers, NumHiddenNodes, ActivationFunc,
+LearningRate}`` (reference: ssgd_monitor.py:91-107,177-183) — and receives the
+selected/target/weight column numbers through env vars that the Java client
+derives from ``ColumnConfig.json`` (TensorflowClient.java:378-382,
+TensorflowTaskExecutor.java:200-238).
+
+Here both files are first-class typed objects.  ``ModelConfig`` additionally
+understands the model families this framework adds beyond the reference's
+plain DNN (Wide & Deep, multi-task heads, hashed embeddings — the
+BASELINE.json config matrix) via optional ``train.params`` fields, all with
+defaults that reproduce the reference behavior when absent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class TrainParams:
+    """``train.params`` — network-shape hyperparameters."""
+
+    num_hidden_layers: int = 2
+    num_hidden_nodes: tuple[int, ...] = (50, 50)
+    activation_funcs: tuple[str, ...] = ("tanh", "tanh")
+    learning_rate: float = 0.1
+    # reference optimizer is Adadelta (ssgd_monitor.py:136-142); older script
+    # used Adam (ssgd.py:56-62) — selectable here.
+    optimizer: str = "adadelta"
+    l2_reg: float = 0.1  # reference l2_regularizer scale (ssgd_monitor.py:58)
+    # ---- extensions beyond the reference (BASELINE.json configs) ----
+    model_type: str = "dnn"  # dnn | wide_deep | multi_task
+    wide_column_nums: tuple[int, ...] = ()  # crossed/categorical cols for wide part
+    num_tasks: int = 1  # >1 => multi-task sigmoid heads sharing the trunk
+    embedding_columns: tuple[int, ...] = ()  # high-cardinality hashed cols
+    embedding_hash_size: int = 0  # rows per hashed table (0 = disabled)
+    embedding_dim: int = 8
+    # local-update DP: >1 reproduces SAGN's communication window of local
+    # steps before the global update (reference: SAGN.py:110-176)
+    update_window: int = 1
+
+    @classmethod
+    def from_json(cls, params: Mapping[str, Any]) -> "TrainParams":
+        n_layers = int(params.get("NumHiddenLayers", 2))
+        nodes = tuple(int(s) for s in params.get("NumHiddenNodes", [50, 50]))
+        acts = tuple(str(s) for s in params.get("ActivationFunc", ["tanh"] * n_layers))
+        if len(nodes) < n_layers or len(acts) < n_layers:
+            raise ValueError(
+                f"NumHiddenNodes/ActivationFunc shorter than NumHiddenLayers={n_layers}"
+            )
+        return cls(
+            num_hidden_layers=n_layers,
+            num_hidden_nodes=nodes,
+            activation_funcs=acts,
+            learning_rate=float(params.get("LearningRate", 0.1)),
+            optimizer=str(params.get("Optimizer", "adadelta")).lower(),
+            l2_reg=float(params.get("L2Reg", 0.1)),
+            model_type=str(params.get("ModelType", "dnn")).lower(),
+            wide_column_nums=tuple(int(c) for c in params.get("WideColumnNums", [])),
+            num_tasks=int(params.get("NumTasks", 1)),
+            embedding_columns=tuple(int(c) for c in params.get("EmbeddingColumnNums", [])),
+            embedding_hash_size=int(params.get("EmbeddingHashSize", 0)),
+            embedding_dim=int(params.get("EmbeddingDim", 8)),
+            update_window=int(params.get("UpdateWindow", 1)),
+        )
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Typed view of Shifu's ``ModelConfig.json`` (the fields the trainer uses)."""
+
+    num_train_epochs: int = 100
+    valid_set_rate: float = 0.1  # reference VALID_TRAINING_DATA_RATIO default
+    params: TrainParams = field(default_factory=TrainParams)
+    batch_size: int = 100  # reference BATCH_SIZE (ssgd_monitor.py:33)
+    delimiter: str = "|"  # reference DELIMITER (ssgd_monitor.py:32)
+    model_set_name: str = "shifu_tpu_model"
+    raw: Mapping[str, Any] = field(default_factory=dict, repr=False, compare=False)
+
+    @classmethod
+    def from_json(cls, obj: Mapping[str, Any]) -> "ModelConfig":
+        train = obj.get("train", {})
+        dataset = obj.get("dataSet", {})
+        basic = obj.get("basic", {})
+        return cls(
+            num_train_epochs=int(train.get("numTrainEpochs", 100)),
+            valid_set_rate=float(train.get("validSetRate", 0.1)),
+            params=TrainParams.from_json(train.get("params", {})),
+            batch_size=int(train.get("params", {}).get("MiniBatchs", 100)),
+            delimiter=_decode_delimiter(dataset.get("dataDelimiter", "|")),
+            model_set_name=str(basic.get("name", "shifu_tpu_model")),
+            raw=dict(obj),
+        )
+
+    @classmethod
+    def load(cls, path: str | os.PathLike) -> "ModelConfig":
+        from shifu_tensorflow_tpu.utils import fs
+
+        return cls.from_json(json.loads(fs.read_text(os.fspath(path))))
+
+
+@dataclass(frozen=True)
+class Column:
+    """One entry of ``ColumnConfig.json``."""
+
+    column_num: int
+    column_name: str
+    column_flag: str | None = None  # Target | ForceSelect | Meta | Weight | None
+    final_select: bool = False
+    column_type: str = "N"  # N numeric | C categorical
+    mean: float = 0.0
+    stddev: float = 1.0
+
+    @property
+    def is_target(self) -> bool:
+        return (self.column_flag or "").lower() == "target"
+
+    @property
+    def is_weight(self) -> bool:
+        return (self.column_flag or "").lower() == "weight"
+
+
+@dataclass(frozen=True)
+class ColumnConfig:
+    """Typed view of ``ColumnConfig.json`` — drives column selection and the
+    ZSCALE normalization constants used by the streaming input pipeline."""
+
+    columns: tuple[Column, ...]
+
+    @classmethod
+    def from_json(cls, arr: Sequence[Mapping[str, Any]]) -> "ColumnConfig":
+        cols = []
+        for c in arr:
+            stats = c.get("columnStats", {}) or {}
+            cols.append(
+                Column(
+                    column_num=int(c["columnNum"]),
+                    column_name=str(c.get("columnName", f"col_{c['columnNum']}")),
+                    column_flag=c.get("columnFlag"),
+                    final_select=bool(c.get("finalSelect", False)),
+                    column_type=str(c.get("columnType", "N")),
+                    mean=float(stats.get("mean") or 0.0),
+                    stddev=float(stats.get("stdDev") or 1.0),
+                )
+            )
+        return cls(columns=tuple(cols))
+
+    @classmethod
+    def load(cls, path: str | os.PathLike) -> "ColumnConfig":
+        from shifu_tensorflow_tpu.utils import fs
+
+        return cls.from_json(json.loads(fs.read_text(os.fspath(path))))
+
+    # ---- derived selections (what the Java client computed into env vars) ----
+    @property
+    def target_column_num(self) -> int:
+        for c in self.columns:
+            if c.is_target:
+                return c.column_num
+        return -1
+
+    @property
+    def weight_column_num(self) -> int:
+        for c in self.columns:
+            if c.is_weight:
+                return c.column_num
+        return -1
+
+    @property
+    def selected_column_nums(self) -> list[int]:
+        sel = [
+            c.column_num
+            for c in self.columns
+            if c.final_select and not c.is_target and not c.is_weight
+        ]
+        if sel:
+            return sel
+        # fallback parity: with no explicit selection, every non-target,
+        # non-weight column is a feature (ssgd_monitor.py:390-394)
+        return [
+            c.column_num
+            for c in self.columns
+            if not c.is_target and not c.is_weight
+        ]
+
+    def zscale_stats(self, column_nums: Sequence[int]) -> tuple[list[float], list[float]]:
+        by_num = {c.column_num: c for c in self.columns}
+        means = [by_num[n].mean if n in by_num else 0.0 for n in column_nums]
+        stds = [
+            (by_num[n].stddev if n in by_num and by_num[n].stddev else 1.0)
+            for n in column_nums
+        ]
+        return means, stds
+
+
+def _decode_delimiter(d: str) -> str:
+    return {"\\|": "|", "\\t": "\t"}.get(d, d) or "|"

@@ -1,0 +1,192 @@
+"""Filesystem abstraction: local, HDFS (webhdfs), and GCS paths.
+
+Parity surface: the reference reads/writes through Hadoop's ``FileSystem``
+(shifu-core HDFSUtils, used at TensorflowClient.java:80, Constants.java:96)
+and TF's ``gfile`` in Python (ssgd_monitor.py:380).  Here a minimal scheme
+dispatch covers the same call sites: ``open_read``, ``read_text``,
+``write_text``, ``listdir_recursive``, ``exists``, ``mkdirs``.
+
+Only the local backend is implemented in-process; ``hdfs://`` and ``gs://``
+resolve through optional handlers registered at runtime (fsspec-style), so
+cluster deployments can plug in a real client without this module importing
+one.  Everything else in the framework goes through this seam.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from typing import BinaryIO, Callable, Iterator
+
+_SCHEME_HANDLERS: dict[str, "FileSystem"] = {}
+
+
+class FileSystem:
+    """Interface; local implementation below."""
+
+    def open_read(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+    def open_write(self, path: str) -> BinaryIO:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def listdir_recursive(self, path: str) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def open_read(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def open_write(self, path: str) -> BinaryIO:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        return open(path, "wb")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def listdir_recursive(self, path: str) -> list[str]:
+        if os.path.isfile(path):
+            return [path]
+        out: list[str] = []
+        for root, _dirs, files in os.walk(path):
+            for f in files:
+                out.append(os.path.join(root, f))
+        return sorted(out)
+
+
+_LOCAL = LocalFileSystem()
+
+
+def register_filesystem(scheme: str, fs_impl: FileSystem) -> None:
+    _SCHEME_HANDLERS[scheme] = fs_impl
+
+
+def _scheme(path: str) -> str:
+    if "://" in path:
+        return path.split("://", 1)[0]
+    return ""
+
+
+def filesystem_for(path: str) -> FileSystem:
+    scheme = _scheme(path)
+    if scheme in ("", "file"):
+        return _LOCAL
+    fs_impl = _SCHEME_HANDLERS.get(scheme)
+    if fs_impl is None:
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} "
+            f"(register one via shifu_tensorflow_tpu.utils.fs.register_filesystem)"
+        )
+    return fs_impl
+
+
+def strip_scheme(path: str) -> str:
+    return path.split("://", 1)[1] if "://" in path else path
+
+
+def open_read(path: str) -> BinaryIO:
+    return filesystem_for(path).open_read(strip_local(path))
+
+
+class _OwningGzipFile(gzip.GzipFile):
+    """GzipFile that closes the underlying stream on close (plain
+    ``GzipFile(fileobj=...)`` leaves it open)."""
+
+    def close(self) -> None:
+        raw = self.fileobj
+        try:
+            super().close()
+        finally:
+            if raw is not None:
+                raw.close()
+
+
+def open_maybe_gzip(path: str) -> BinaryIO:
+    """Open transparently decompressing ``.gz`` — the reference's shards are
+    gzip PSV (ssgd_monitor.py:380-381)."""
+    raw = open_read(path)
+    if path.endswith(".gz"):
+        return _OwningGzipFile(fileobj=raw)  # type: ignore[return-value]
+    return raw
+
+
+def read_text(path: str) -> str:
+    with open_read(path) as f:
+        return f.read().decode("utf-8")
+
+
+def write_text(path: str, text: str) -> None:
+    with filesystem_for(path).open_write(strip_local(path)) as f:
+        f.write(text.encode("utf-8"))
+
+
+def append_text(path: str, text: str) -> None:
+    """Append — the reference's HDFS 'console board' appends per-epoch stat
+    lines (CommonUtils.ClientConsoleBoard:426-458)."""
+    fs_impl = filesystem_for(path)
+    if isinstance(fs_impl, LocalFileSystem):
+        p = strip_local(path)
+        os.makedirs(os.path.dirname(os.path.abspath(p)) or ".", exist_ok=True)
+        with open(p, "ab") as f:
+            f.write(text.encode("utf-8"))
+    else:  # read-modify-write for object stores
+        old = read_text(path) if fs_impl.exists(strip_local(path)) else ""
+        write_text(path, old + text)
+
+
+def exists(path: str) -> bool:
+    return filesystem_for(path).exists(strip_local(path))
+
+
+def size(path: str) -> int:
+    return filesystem_for(path).size(strip_local(path))
+
+
+def mkdirs(path: str) -> None:
+    filesystem_for(path).mkdirs(strip_local(path))
+
+
+def listdir_recursive(path: str) -> list[str]:
+    return filesystem_for(path).listdir_recursive(strip_local(path))
+
+
+def strip_local(path: str) -> str:
+    """file:///x -> /x; other schemes keep the full path for their handler."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+def iter_lines(path: str) -> Iterator[bytes]:
+    with open_maybe_gzip(path) as f:
+        for line in f:
+            yield line
+
+
+def count_lines(path: str) -> int:
+    """Line count for plain and ``.gz`` files.
+
+    Parity: HdfsUtils.getFileLineCount (HdfsUtils.java:143-175) — used to
+    compute TOTAL_TRAINING_DATA_NUMBER.
+    """
+    n = 0
+    with open_maybe_gzip(path) as f:
+        for _ in f:
+            n += 1
+    return n

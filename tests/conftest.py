@@ -1,0 +1,78 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+SURVEY.md §4 item 3: JAX multi-device simulation via
+``xla_force_host_platform_device_count`` lets pjit sharding and all-reduce be
+tested without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# keep XLA/CPU math deterministic-ish and quiet in tests
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)
+
+
+@pytest.fixture(scope="session")
+def psv_dataset(tmp_path_factory, rng):
+    """A small synthetic PSV+gzip tabular dataset in the reference's shard
+    layout: ``target|f0|...|f9|weight`` rows split over several .gz files."""
+    import gzip
+
+    root = tmp_path_factory.mktemp("psvdata")
+    n_files, rows_per_file, n_feats = 4, 250, 10
+    w_true = rng.normal(size=n_feats)
+    paths = []
+    for i in range(n_files):
+        path = root / f"part-{i:05d}.gz"
+        with gzip.open(path, "wt") as f:
+            for _ in range(rows_per_file):
+                x = rng.normal(size=n_feats)
+                logit = float(x @ w_true)
+                y = 1 if rng.random() < 1.0 / (1.0 + np.exp(-logit)) else 0
+                w = round(float(rng.uniform(0.5, 2.0)), 4)
+                cols = [str(y)] + [f"{v:.5f}" for v in x] + [str(w)]
+                f.write("|".join(cols) + "\n")
+        paths.append(str(path))
+    return {
+        "root": str(root),
+        "paths": paths,
+        "n_rows": n_files * rows_per_file,
+        "n_features": n_feats,
+        "target_col": 0,
+        "weight_col": n_feats + 1,
+        "feature_cols": list(range(1, n_feats + 1)),
+    }
+
+
+@pytest.fixture(scope="session")
+def model_config_json():
+    return {
+        "basic": {"name": "unit_test_model"},
+        "dataSet": {"dataDelimiter": "|"},
+        "train": {
+            "numTrainEpochs": 3,
+            "validSetRate": 0.2,
+            "params": {
+                "NumHiddenLayers": 2,
+                "NumHiddenNodes": [16, 8],
+                "ActivationFunc": ["relu", "tanh"],
+                "LearningRate": 0.05,
+            },
+        },
+    }

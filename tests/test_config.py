@@ -1,0 +1,157 @@
+"""Config-system tests: layered merge, XML/JSON parity, typed getters,
+ModelConfig/ColumnConfig ingestion (SURVEY.md §5.6 parity surface)."""
+
+import json
+
+import pytest
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf, parse_memory_string
+from shifu_tensorflow_tpu.config.model_config import (
+    ColumnConfig,
+    ModelConfig,
+    TrainParams,
+)
+
+HADOOP_XML = """<?xml version="1.0"?>
+<configuration>
+  <property><name>shifu.application.name</name><value>myapp</value></property>
+  <property><name>shifu.worker.instances</name><value>3</value></property>
+  <property><name>shifu.worker.instances.backup</name><value>2</value></property>
+  <property><name>shifu.worker.memory</name><value>10g</value></property>
+</configuration>
+"""
+
+# the reference's global-default-bk.xml is two concatenated XML documents
+DOUBLE_XML = HADOOP_XML + """<configuration>
+  <property><name>shifu.worker.instances</name><value>5</value></property>
+</configuration>
+"""
+
+
+def test_layered_merge_order(tmp_path):
+    user = tmp_path / "global.xml"
+    user.write_text(HADOOP_XML)
+    conf = Conf.load_layered(str(user), {"shifu.worker.instances": 7})
+    # builtin default overridden by file, file overridden by dict
+    assert conf.get(K.APPLICATION_NAME) == "myapp"
+    assert conf.num_instances() == 7
+    assert conf.num_backup_instances() == 2
+
+
+def test_double_document_xml(tmp_path):
+    p = tmp_path / "global-default.xml"
+    p.write_text(DOUBLE_XML)
+    conf = Conf().add_resource(str(p))
+    assert conf.num_instances() == 5  # later document wins
+
+
+def test_json_resource_and_final_roundtrip(tmp_path):
+    p = tmp_path / "conf.json"
+    p.write_text(json.dumps({"shifu.tpu.batch-size": 512, "flag": True}))
+    conf = Conf.load_layered(str(p))
+    assert conf.get_int(K.BATCH_SIZE) == 512
+    assert conf.get_bool("flag")
+
+    final_xml = tmp_path / "global-final.xml"
+    conf.write_final(str(final_xml))
+    reread = Conf().add_resource(str(final_xml))
+    assert reread.as_dict() == conf.as_dict()
+
+    final_json = tmp_path / "global-final.json"
+    conf.write_final(str(final_json))
+    assert json.loads(final_json.read_text())["shifu.tpu.batch-size"] == "512"
+
+
+def test_typed_getters():
+    conf = Conf({"a": "1 2 3", "b": "4,5,6", "mem": "2g", "f": "0.25"})
+    assert conf.get_ints("a") == [1, 2, 3]
+    assert conf.get_ints("b") == [4, 5, 6]
+    assert conf.get_ints("missing", [9]) == [9]
+    assert conf.get_memory("mem") == 2 << 30
+    assert conf.get_float("f") == 0.25
+    assert conf.get_int("missing") is None
+
+
+def test_parse_memory_string():
+    assert parse_memory_string("1536m") == 1536 << 20
+    assert parse_memory_string("2G") == 2 << 30
+    assert parse_memory_string(4096) == 4096
+    with pytest.raises(ValueError):
+        parse_memory_string("abc")
+
+
+def test_defaults_match_reference_envelope():
+    conf = Conf.load_layered()
+    assert conf.get_int(K.TASK_HEARTBEAT_INTERVAL_MS) == 1000
+    assert conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS) == 25
+    assert conf.get_int(K.BATCH_SIZE) == 100
+    assert conf.get_int(K.TARGET_COLUMN_NUM) == 0
+    assert conf.get_int(K.WEIGHT_COLUMN_NUM) == -1
+
+
+def test_model_config_ingestion(model_config_json):
+    mc = ModelConfig.from_json(model_config_json)
+    assert mc.num_train_epochs == 3
+    assert mc.valid_set_rate == 0.2
+    assert mc.params.num_hidden_layers == 2
+    assert mc.params.num_hidden_nodes == (16, 8)
+    assert mc.params.activation_funcs == ("relu", "tanh")
+    assert mc.params.learning_rate == 0.05
+    assert mc.params.optimizer == "adadelta"  # reference default
+    assert mc.params.model_type == "dnn"
+    assert mc.delimiter == "|"
+
+
+def test_model_config_validates_layer_mismatch():
+    with pytest.raises(ValueError):
+        TrainParams.from_json(
+            {"NumHiddenLayers": 3, "NumHiddenNodes": [4], "ActivationFunc": ["tanh"]}
+        )
+
+
+def test_model_config_extensions_default_off(model_config_json):
+    mc = ModelConfig.from_json(model_config_json)
+    assert mc.params.num_tasks == 1
+    assert mc.params.embedding_hash_size == 0
+    assert mc.params.update_window == 1
+
+
+COLUMN_CONF = [
+    {"columnNum": 0, "columnName": "diagnosis", "columnFlag": "Target",
+     "finalSelect": False, "columnType": "N"},
+    {"columnNum": 1, "columnName": "radius", "finalSelect": True, "columnType": "N",
+     "columnStats": {"mean": 14.1, "stdDev": 3.5}},
+    {"columnNum": 2, "columnName": "texture", "finalSelect": True, "columnType": "N",
+     "columnStats": {"mean": 19.3, "stdDev": 4.3}},
+    {"columnNum": 3, "columnName": "wgt", "columnFlag": "Weight", "finalSelect": False},
+    {"columnNum": 4, "columnName": "unused", "finalSelect": False},
+]
+
+
+def test_column_config_selection():
+    cc = ColumnConfig.from_json(COLUMN_CONF)
+    assert cc.target_column_num == 0
+    assert cc.weight_column_num == 3
+    assert cc.selected_column_nums == [1, 2]
+    means, stds = cc.zscale_stats([1, 2])
+    assert means == [14.1, 19.3]
+    assert stds == [3.5, 4.3]
+
+
+def test_column_config_fallback_all_columns():
+    # parity: with no finalSelect, every non-target/non-weight column is a
+    # feature (ssgd_monitor.py:390-394)
+    cc = ColumnConfig.from_json(
+        [dict(c, finalSelect=False) for c in COLUMN_CONF]
+    )
+    assert cc.selected_column_nums == [1, 2, 4]
+
+
+def test_zscale_stats_zero_std_guard():
+    cc = ColumnConfig.from_json(
+        [{"columnNum": 0, "columnName": "c", "finalSelect": True,
+          "columnStats": {"mean": 1.0, "stdDev": 0.0}}]
+    )
+    _, stds = cc.zscale_stats([0])
+    assert stds == [1.0]
