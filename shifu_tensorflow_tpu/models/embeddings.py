@@ -1,0 +1,95 @@
+"""Hashed embedding tables, shardable over the mesh 'model' axis.
+
+Beyond-reference capability (BASELINE.json config #4): high-cardinality
+hashed embedding columns with the table sharded over ICI.  The reference has
+no model parallelism at all (SURVEY.md §2.5); this module is the one place
+the new framework adds a model-parallel axis.
+
+Design: feature values are hashed on-device with an affine-multiplicative
+integer hash (no host round-trip), then gathered from a ``(hash_size, dim)``
+table.  The table's leading axis carries a ``nn.partitioning`` annotation so
+under pjit the table shards across the 'model' axis and XLA turns the gather
+into an all-gather-free collective lookup; sharding is annotation-only, so
+the same module runs unsharded on one chip.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# large odd multipliers for a cheap multiplicative hash (fibonacci hashing)
+_HASH_MULT = jnp.uint32(2654435761)
+_HASH_MULT2 = jnp.uint32(40503)
+
+
+def _mix(bits: jax.Array) -> jax.Array:
+    """Shared finalizer of the multiplicative hash: uint32 bits -> uint32."""
+    h = bits * _HASH_MULT
+    h = h ^ (h >> 16)
+    return h * _HASH_MULT2
+
+
+def _float_bits(values: jax.Array) -> jax.Array:
+    """Bit-cast floats so distinct raw category codes (e.g. 3.0 vs 4.0)
+    hash apart; elementwise and fusable."""
+    return jax.lax.bitcast_convert_type(values.astype(jnp.float32), jnp.uint32)
+
+
+def hash_to_buckets(values: jax.Array, hash_size: int) -> jax.Array:
+    """Hash float feature values into [0, hash_size) on device."""
+    return (_mix(_float_bits(values)) % jnp.uint32(hash_size)).astype(jnp.int32)
+
+
+class HashedEmbedding(nn.Module):
+    """Per-column hashed lookup: (B, C) float categories -> (B, C*dim)."""
+
+    hash_size: int
+    features: int  # embedding dim per column
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        table = self.param(
+            "table",
+            nn.with_partitioning(
+                nn.initializers.normal(stddev=0.05), ("model", None)
+            ),
+            (self.hash_size, self.features),
+            self.dtype,
+        )
+        # salt per column position so the same value in different columns
+        # lands in different buckets
+        cols = jnp.arange(x.shape[-1], dtype=jnp.uint32)
+        salted = _float_bits(x) ^ (cols * jnp.uint32(0x9E3779B9))
+        ids = (_mix(salted) % jnp.uint32(self.hash_size)).astype(jnp.int32)
+        emb = jnp.take(table, ids, axis=0)  # (B, C, dim)
+        return emb.reshape(x.shape[0], -1)
+
+
+class HashedCross(nn.Module):
+    """Joint hash of all columns into one id per row -> (B, features).
+    The 'crossed column' of classic wide&deep."""
+
+    hash_size: int
+    features: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        table = self.param(
+            "table",
+            nn.with_partitioning(
+                nn.initializers.zeros_init(), ("model", None)
+            ),
+            (self.hash_size, self.features),
+            self.dtype,
+        )
+        bits = _float_bits(x)
+        h = jnp.zeros(x.shape[:1], jnp.uint32)
+        for c in range(x.shape[-1]):
+            h = (h ^ bits[:, c]) * _HASH_MULT
+            h = h ^ (h >> 13)
+        ids = (h % jnp.uint32(self.hash_size)).astype(jnp.int32)
+        return jnp.take(table, ids, axis=0)

@@ -1,0 +1,93 @@
+"""Model factory: ModelConfig.json → flax module.
+
+Parity surface: the reference's ``generate_from_modelconf`` builds the net
+from ``train.params`` at graph-construction time (ssgd_monitor.py:91-127);
+here the same JSON contract selects and parameterizes a module from the
+model zoo.  ``model_type`` extends the contract to the BASELINE.json
+families; absent, it defaults to the reference's plain DNN.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig, TrainParams
+from shifu_tensorflow_tpu.models.dnn import ShifuDNN
+from shifu_tensorflow_tpu.models.embeddings import HashedEmbedding
+from shifu_tensorflow_tpu.models.multi_task import MultiTaskDNN
+from shifu_tensorflow_tpu.models.wide_deep import WideDeep
+
+
+class EmbeddingAugmented(nn.Module):
+    """Wraps a base model: hashed-embeds designated columns and concatenates
+    the embeddings to the raw features before the base net (BASELINE.json
+    config #4)."""
+
+    base: nn.Module
+    embed_indices: tuple[int, ...]
+    hash_size: int
+    embed_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        emb = HashedEmbedding(
+            hash_size=self.hash_size, features=self.embed_dim,
+            dtype=self.dtype, name="hashed_columns",
+        )(x[:, jnp.asarray(self.embed_indices)])
+        return self.base(jnp.concatenate([x, emb], axis=-1))
+
+
+def _column_positions(column_nums, feature_columns) -> tuple[int, ...]:
+    """Map absolute column numbers to positions within the selected feature
+    vector (features arrive already projected to feature_columns order)."""
+    pos = {c: i for i, c in enumerate(feature_columns)}
+    out = []
+    for c in column_nums:
+        if c in pos:
+            out.append(pos[c])
+    return tuple(out)
+
+
+def build_model(
+    model_config: ModelConfig,
+    feature_columns: tuple[int, ...] | None = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> nn.Module:
+    p: TrainParams = model_config.params
+    nodes = p.num_hidden_nodes[: p.num_hidden_layers]
+    acts = p.activation_funcs[: p.num_hidden_layers]
+
+    if p.model_type == "wide_deep":
+        wide_idx = (
+            _column_positions(p.wide_column_nums, feature_columns)
+            if feature_columns and p.wide_column_nums
+            else tuple()
+        )
+        base: nn.Module = WideDeep(
+            hidden_nodes=nodes, activations=acts, wide_indices=wide_idx,
+            cross_hash_size=p.cross_hash_size if p.wide_column_nums else 0,
+            dtype=dtype,
+        )
+    elif p.model_type == "multi_task":
+        base = MultiTaskDNN(
+            hidden_nodes=nodes, activations=acts, num_tasks=p.num_tasks,
+            dtype=dtype,
+        )
+    else:
+        base = ShifuDNN(hidden_nodes=nodes, activations=acts, dtype=dtype)
+
+    if p.embedding_columns and p.embedding_hash_size > 0:
+        embed_idx = (
+            _column_positions(p.embedding_columns, feature_columns)
+            if feature_columns
+            else tuple(range(len(p.embedding_columns)))
+        )
+        if embed_idx:
+            return EmbeddingAugmented(
+                base=base, embed_indices=embed_idx,
+                hash_size=p.embedding_hash_size, embed_dim=p.embedding_dim,
+                dtype=dtype,
+            )
+    return base

@@ -1,0 +1,37 @@
+"""Multi-task DNN: shared trunk, per-target sigmoid heads
+(BASELINE.json config #3 — beyond-reference capability)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from shifu_tensorflow_tpu.models.dnn import DenseTower, _xavier_bias_init
+
+
+class MultiTaskDNN(nn.Module):
+    """Shared DenseTower trunk + ``num_tasks`` independent 1-unit sigmoid
+    heads.  Output is (B, num_tasks); one fused (trunk_dim, num_tasks)
+    matmul implements all heads, so adding tasks costs one matmul column
+    each — MXU-friendly, no per-head kernels."""
+
+    hidden_nodes: Sequence[int]
+    activations: Sequence[str]
+    num_tasks: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = DenseTower(self.hidden_nodes, self.activations, self.dtype,
+                       name="trunk")(x)
+        logits = nn.Dense(
+            self.num_tasks,
+            kernel_init=nn.initializers.xavier_uniform(),
+            bias_init=_xavier_bias_init,
+            dtype=self.dtype,
+            name="task_heads",
+        )(h)
+        return nn.sigmoid(logits)

@@ -1,0 +1,53 @@
+"""Loss functions.
+
+Parity surface: the reference trains with
+``tf.losses.mean_squared_error(predictions, labels, weights)`` whose default
+TF-1.x reduction is SUM_BY_NONZERO_WEIGHTS — sum(w·(y−p)²) divided by the
+*count of nonzero weights*, not the weight sum (ssgd_monitor.py:129).
+``weighted_mse`` reproduces that exactly; it also makes zero-weight padding
+rows free (they join neither numerator nor denominator), which is what the
+fixed-shape batching relies on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def weighted_mse(pred: jax.Array, target: jax.Array, weight: jax.Array) -> jax.Array:
+    """sum(w * (t - p)^2) / count(w != 0)  (TF1 SUM_BY_NONZERO_WEIGHTS)."""
+    sq = weight * jnp.square(target - pred)
+    nonzero = jnp.sum((weight != 0.0).astype(sq.dtype))
+    return jnp.sum(sq) / jnp.maximum(nonzero, 1.0)
+
+
+def weighted_bce(pred: jax.Array, target: jax.Array, weight: jax.Array,
+                 eps: float = 1e-7) -> jax.Array:
+    """Weighted binary cross-entropy on probabilities (model outputs are
+    post-sigmoid, matching the reference's output head), same
+    nonzero-weight normalization as weighted_mse."""
+    p = jnp.clip(pred, eps, 1.0 - eps)
+    ll = target * jnp.log(p) + (1.0 - target) * jnp.log(1.0 - p)
+    nonzero = jnp.sum((weight != 0.0).astype(ll.dtype))
+    return -jnp.sum(weight * ll) / jnp.maximum(nonzero, 1.0)
+
+
+def l2_penalty(params, scale: float) -> jax.Array:
+    """Real L2 over all kernel/bias leaves.  The reference declared
+    l2_regularizer(0.1) but never added it to the loss (dead config —
+    ssgd_monitor.py:58 vs :129); enable via TrainParams.l2_reg."""
+    if scale == 0.0:
+        return jnp.asarray(0.0)
+    leaves = jax.tree_util.tree_leaves(params)
+    return scale * sum(jnp.sum(jnp.square(p)) for p in leaves)
+
+
+LOSSES = {"mse": weighted_mse, "bce": weighted_bce}
+
+
+def get_loss(name: str):
+    try:
+        return LOSSES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; known: {sorted(LOSSES)}")

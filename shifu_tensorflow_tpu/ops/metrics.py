@@ -1,0 +1,82 @@
+"""Evaluation metrics for binary tabular models.
+
+The reference reports only train/valid loss through its metrics plane
+(SocketServer.java:71-89); the framework's north-star quality metric is the
+KS statistic (BASELINE.json: "wall-clock to KS>=0.45"), so KS and AUC are
+first-class here.  Implementations are vectorized numpy over host-gathered
+scores — eval sets are the small side of the workload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _prep(scores, labels, weights=None):
+    s = np.asarray(scores, np.float64).ravel()
+    y = np.asarray(labels, np.float64).ravel()
+    w = (
+        np.ones_like(s)
+        if weights is None
+        else np.asarray(weights, np.float64).ravel()
+    )
+    keep = w > 0
+    return s[keep], y[keep], w[keep]
+
+
+def _grouped(s, y, w):
+    """Sort descending and collapse tied scores: returns per-unique-score
+    positive/negative weight sums (ties must share one ROC point)."""
+    order = np.argsort(-s, kind="stable")
+    s, y, w = s[order], y[order], w[order]
+    # boundaries of tie groups in the descending-sorted scores
+    is_last = np.empty(s.size, bool)
+    is_last[-1] = True
+    is_last[:-1] = s[1:] != s[:-1]
+    group_id = np.cumsum(np.concatenate([[0], is_last[:-1].astype(np.int64)]))
+    n_groups = group_id[-1] + 1
+    pos = np.bincount(group_id, w * (y > 0.5), minlength=n_groups)
+    neg = np.bincount(group_id, w * (y <= 0.5), minlength=n_groups)
+    return pos, neg
+
+
+def ks_statistic(scores, labels, weights=None) -> float:
+    """Kolmogorov–Smirnov: max |cum-pos-rate − cum-neg-rate| over score
+    thresholds (the standard scorecard KS).  Tie-correct: the curve is
+    evaluated only at unique-score boundaries."""
+    s, y, w = _prep(scores, labels, weights)
+    if s.size == 0:
+        return 0.0
+    pos, neg = _grouped(s, y, w)
+    tot_pos, tot_neg = pos.sum(), neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    tpr = np.cumsum(pos) / tot_pos
+    fpr = np.cumsum(neg) / tot_neg
+    return float(np.max(np.abs(tpr - fpr)))
+
+
+def auc(scores, labels, weights=None) -> float:
+    """Weighted ROC AUC = P(score_pos > score_neg) + 0.5·P(tie), computed
+    over tie groups so constant scores give exactly 0.5."""
+    s, y, w = _prep(scores, labels, weights)
+    if s.size == 0:
+        return 0.5
+    pos, neg = _grouped(s, y, w)
+    tot_pos, tot_neg = pos.sum(), neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    # scanning descending: negatives strictly below group g plus half the
+    # tied negatives
+    neg_above_incl = np.cumsum(neg)
+    neg_below = tot_neg - neg_above_incl
+    num = np.sum(pos * (neg_below + 0.5 * neg))
+    return float(num / (tot_pos * tot_neg))
+
+
+def accuracy(scores, labels, weights=None, threshold: float = 0.5) -> float:
+    s, y, w = _prep(scores, labels, weights)
+    if s.size == 0:
+        return 0.0
+    correct = ((s >= threshold) == (y > 0.5)).astype(np.float64)
+    return float((correct * w).sum() / w.sum())

@@ -1,0 +1,67 @@
+"""Device-mesh construction.
+
+The reference's "topology" was YARN container counts per job type
+(shifu.worker.instances etc., GlobalConfigurationKeys.java:123-150); the
+TPU-native topology is a named `jax.sharding.Mesh` over devices.  Axes:
+
+- ``data``  — batch sharding / gradient all-reduce (the reference's entire
+  sync-DP capability maps here, SURVEY.md §2.5);
+- ``model`` — embedding-table sharding (the one model-parallel axis this
+  framework adds, BASELINE.json config #4).
+
+Mesh shape comes from the ``shifu.tpu.mesh-shape`` config key, e.g.
+``"data:8"`` or ``"data:4,model:2"``; ``-1`` on one axis absorbs the
+remaining devices.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def parse_mesh_shape(spec: str, num_devices: int) -> dict[str, int]:
+    """``"data:4,model:2"`` -> {"data": 4, "model": 2}; one -1 allowed."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition(":")
+        axes[name.strip()] = int(size) if size else -1
+    if not axes:
+        axes = {DATA_AXIS: -1}
+    unknown = [n for n, s in axes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one -1 axis allowed in mesh shape {spec!r}")
+    fixed = int(np.prod([s for s in axes.values() if s != -1])) if axes else 1
+    if unknown:
+        if num_devices % max(fixed, 1) != 0:
+            raise ValueError(
+                f"mesh shape {spec!r} does not divide {num_devices} devices"
+            )
+        axes[unknown[0]] = num_devices // max(fixed, 1)
+    total = int(np.prod(list(axes.values())))
+    if total != num_devices:
+        raise ValueError(
+            f"mesh shape {spec!r} uses {total} devices but {num_devices} present"
+        )
+    return axes
+
+
+def make_mesh(
+    spec: str = "data:-1", devices: list | None = None
+) -> jax.sharding.Mesh:
+    devices = devices if devices is not None else jax.devices()
+    axes = parse_mesh_shape(spec, len(devices))
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    dev_array = np.asarray(devices).reshape(shape)
+    return jax.sharding.Mesh(dev_array, names)
+
+
+def data_axis_size(mesh: jax.sharding.Mesh) -> int:
+    return mesh.shape.get(DATA_AXIS, 1)

@@ -1,0 +1,78 @@
+"""Sharding placement rules.
+
+Replaces the reference's ``tf.train.replica_device_setter`` — variables
+pinned to PS tasks, activations on workers (ssgd_monitor.py:203-206) — with
+declarative JAX shardings:
+
+- batches shard along ``data`` (leading batch dim);
+- parameters replicate, EXCEPT leaves annotated with
+  ``nn.with_partitioning`` (embedding tables carry a ``('model', None)``
+  spec, models/embeddings.py) which shard over ``model``;
+- the optimizer state inherits its parameter's sharding automatically
+  (optax states mirror the param pytree).
+
+Everything is expressed as NamedSharding so the same step function runs
+unsharded on one chip and sharded on a pod without code changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (rows) across the data axis; features replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _spec_for_leaf(leaf, mesh: Mesh) -> NamedSharding:
+    """flax Partitioned boxes carry their axis names; plain arrays
+    replicate."""
+    import flax.linen as nn
+
+    if isinstance(leaf, nn.Partitioned):
+        names = tuple(n if n in mesh.shape else None for n in leaf.names)
+        return NamedSharding(mesh, P(*names))
+    return replicate(mesh)
+
+
+def params_shardings(params, mesh: Mesh):
+    """Pytree of NamedShardings matching a (possibly Partitioned-annotated)
+    param tree."""
+    import flax.linen as nn
+
+    def spec(leaf):
+        return _spec_for_leaf(leaf, mesh)
+
+    return jax.tree_util.tree_map(
+        spec, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def shard_params(state, mesh: Mesh):
+    """Place a TrainState on the mesh: annotated leaves sharded, everything
+    else replicated."""
+    import flax.linen as nn
+
+    def place(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            sh = _spec_for_leaf(leaf, mesh)
+            return leaf.replace(value=jax.device_put(leaf.value, sh))
+        return jax.device_put(leaf, replicate(mesh))
+
+    return jax.tree_util.tree_map(
+        place, state, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    sh = batch_sharding(mesh)
+    return {k: jax.device_put(v, sh) for k, v in batch.items()}

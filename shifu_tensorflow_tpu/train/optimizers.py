@@ -1,0 +1,42 @@
+"""Optimizer construction from config.
+
+Parity surface: the production reference wraps ``AdadeltaOptimizer`` in
+``SyncReplicasOptimizer`` (ssgd_monitor.py:136-142); the older script used
+Adam (ssgd.py:56-62) and a commented GradientDescent.  On TPU the
+SyncReplicas machinery (token queue, chief init, replicas_to_aggregate)
+disappears entirely — synchronous aggregation is the all-reduce XLA inserts
+for the sharded-batch gradient, deterministic by construction (SURVEY.md
+§7.0 translation table).  What remains is the inner optimizer, built here
+with optax.
+
+Local-update DP (the reference's SAGN communication window,
+SAGN.py:110-176) is expressed as ``optax.MultiSteps`` gradient accumulation:
+``update_window`` micro-steps accumulate before one apply — same averaging
+semantics, no local/global variable mirroring.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from shifu_tensorflow_tpu.config.model_config import TrainParams
+
+
+def make_optimizer(params: TrainParams) -> optax.GradientTransformation:
+    name = params.optimizer.lower()
+    lr = params.learning_rate
+    if name in ("adadelta",):
+        # TF1 AdadeltaOptimizer defaults: rho=0.95, eps=1e-8
+        tx = optax.adadelta(learning_rate=lr, rho=0.95, eps=1e-8)
+    elif name in ("adam",):
+        tx = optax.adam(learning_rate=lr)
+    elif name in ("sgd", "gd", "gradientdescent"):
+        tx = optax.sgd(learning_rate=lr)
+    elif name in ("rmsprop",):
+        tx = optax.rmsprop(learning_rate=lr)
+    else:
+        raise ValueError(f"unknown optimizer {params.optimizer!r}")
+
+    if params.update_window > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=params.update_window)
+    return tx

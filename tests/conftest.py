@@ -8,7 +8,9 @@ tested without TPU hardware.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the ambient environment points JAX_PLATFORMS at the tunneled
+# TPU ("axon"); tests must run on the virtual 8-device CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +20,22 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Plugins (jaxtyping) may import jax before this conftest runs, and the
+# environment's sitecustomize registers a TPU PJRT plugin ("axon") whose
+# initialization blocks when the platform is forced to cpu.  Re-pin the
+# platform on the already-imported module and drop the axon factory before
+# the first backend query.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # jax-internal, best-effort
+    import jax._src.xla_bridge as _xb  # noqa: E402
+
+    for _reg in ("_backend_factories",):
+        getattr(_xb, _reg, {}).pop("axon", None)
+except Exception:  # pragma: no cover
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

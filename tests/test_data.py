@@ -1,0 +1,228 @@
+"""Data-layer tests: splitter parity + size-aware upgrade, PSV parsing,
+deterministic split, fixed-shape batching, streaming (SURVEY.md §7.1 step 2)."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.data import splitter
+from shifu_tensorflow_tpu.data.dataset import (
+    InMemoryDataset,
+    ShardStream,
+    iter_batches,
+    pad_to_batch,
+    prefetch_to_device,
+)
+from shifu_tensorflow_tpu.data.reader import (
+    ParsedBlock,
+    RecordSchema,
+    parse_block,
+    split_train_valid,
+)
+
+
+def _schema(ds):
+    return RecordSchema(
+        feature_columns=tuple(ds["feature_cols"]),
+        target_column=ds["target_col"],
+        weight_column=ds["weight_col"],
+    )
+
+
+# ---- splitter ----
+
+def test_list_data_files_skips_hidden(tmp_path):
+    (tmp_path / "part-0").write_text("a\n")
+    (tmp_path / "_SUCCESS").write_text("")
+    (tmp_path / ".hidden").write_text("")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "part-1").write_text("b\n")
+    files = splitter.list_data_files(str(tmp_path))
+    assert [f.rsplit("/", 1)[-1] for f in files] == ["part-0", "part-1"]
+
+
+def test_round_robin_parity(tmp_path):
+    paths = []
+    for i in range(5):
+        p = tmp_path / f"f{i}"
+        p.write_text("x\n" * (i + 1))
+        paths.append(str(p))
+    shards = splitter.split_round_robin(paths, 2)
+    # reference round-robins by listing order (TrainingDataSet.java:66-82)
+    assert list(shards[0].paths) == [paths[0], paths[2], paths[4]]
+    assert list(shards[1].paths) == [paths[1], paths[3]]
+    assert shards[0].joined() == ",".join([paths[0], paths[2], paths[4]])
+
+
+def test_not_enough_files_raises(tmp_path):
+    p = tmp_path / "only"
+    p.write_text("x\n")
+    with pytest.raises(splitter.NotEnoughFilesError):
+        splitter.split_round_robin([str(p)], 2)
+
+
+def test_size_aware_balances(tmp_path):
+    sizes = [100, 1, 1, 1, 99, 2]
+    paths = []
+    for i, s in enumerate(sizes):
+        p = tmp_path / f"f{i}"
+        p.write_bytes(b"x" * s)
+        paths.append(str(p))
+    shards = splitter.split_size_aware(paths, 2)
+    loads = sorted(s.total_bytes for s in shards)
+    assert loads == [102, 102]  # LPT balances perfectly here
+    # every file assigned exactly once
+    assigned = sorted(p for s in shards for p in s.paths)
+    assert assigned == sorted(paths)
+
+
+def test_total_line_count_gz_and_plain(tmp_path):
+    plain = tmp_path / "a.txt"
+    plain.write_text("1\n2\n3\n")
+    gz = tmp_path / "b.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write("1\n2\n")
+    assert splitter.total_line_count([str(plain), str(gz)]) == 5
+
+
+# ---- reader ----
+
+def test_parse_block_basic():
+    schema = RecordSchema(feature_columns=(1, 2), target_column=0, weight_column=3)
+    lines = [b"1|0.5|-0.25|2.0\n", b"0|1.5|0.75|-3.0\n"]
+    blk = parse_block(lines, schema)
+    assert blk.features.shape == (2, 2)
+    np.testing.assert_allclose(blk.features, [[0.5, -0.25], [1.5, 0.75]])
+    np.testing.assert_allclose(blk.targets[:, 0], [1.0, 0.0])
+    # negative weight clamped to 1.0 (ssgd_monitor.py:412-415)
+    np.testing.assert_allclose(blk.weights[:, 0], [2.0, 1.0])
+
+
+def test_parse_block_drops_bad_rows():
+    schema = RecordSchema(feature_columns=(1,), target_column=0)
+    lines = [b"1|2.0\n", b"1|abc\n", b"1\n", b"0|3.0\n"]
+    blk = parse_block(lines, schema)
+    assert len(blk) == 2
+    np.testing.assert_allclose(blk.features[:, 0], [2.0, 3.0])
+    np.testing.assert_allclose(blk.weights[:, 0], [1.0, 1.0])  # no weight col
+
+
+def test_parse_block_zscale():
+    schema = RecordSchema(feature_columns=(1, 2), target_column=0).with_zscale(
+        [1.0, 2.0], [2.0, 0.0]  # zero std guarded to 1.0
+    )
+    blk = parse_block([b"1|3.0|5.0\n"], schema)
+    np.testing.assert_allclose(blk.features, [[1.0, 3.0]])
+
+
+def test_split_train_valid_deterministic():
+    lines = [f"{i}|{i*0.1}\n".encode() for i in range(1000)]
+    tr1, va1 = split_train_valid(lines, 0.2)
+    tr2, va2 = split_train_valid(lines, 0.2)
+    assert tr1 == tr2 and va1 == va2
+    assert len(va1) + len(tr1) == 1000
+    assert 120 < len(va1) < 280  # ~20%
+    # different salt → different membership
+    _, va3 = split_train_valid(lines, 0.2, salt=7)
+    assert va3 != va1
+    # zero rate → everything trains
+    tr4, va4 = split_train_valid(lines, 0.0)
+    assert len(tr4) == 1000 and va4 == []
+
+
+# ---- batching ----
+
+def test_pad_to_batch_weights_zero():
+    blk = ParsedBlock(
+        np.ones((5, 3), np.float32), np.ones((5, 1), np.float32),
+        np.ones((5, 1), np.float32),
+    )
+    padded = pad_to_batch(blk, 4)
+    assert len(padded) == 8
+    assert padded.weights[5:].sum() == 0.0  # padded rows can't affect loss
+
+
+def test_iter_batches_fixed_shape():
+    blk = ParsedBlock(
+        np.arange(30, dtype=np.float32).reshape(10, 3),
+        np.zeros((10, 1), np.float32), np.ones((10, 1), np.float32),
+    )
+    batches = list(iter_batches(blk, 4))
+    assert len(batches) == 3
+    assert all(b["x"].shape == (4, 3) for b in batches)
+    # shuffle is deterministic per epoch seed
+    a = [b["x"] for b in iter_batches(blk, 4, shuffle=True, seed=1)]
+    b = [b["x"] for b in iter_batches(blk, 4, shuffle=True, seed=1)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---- datasets ----
+
+def test_in_memory_dataset(psv_dataset):
+    schema = _schema(psv_dataset)
+    ds = InMemoryDataset.load(psv_dataset["paths"], schema, valid_rate=0.2)
+    total = len(ds.train) + len(ds.valid)
+    assert total == psv_dataset["n_rows"]
+    assert 0.1 < len(ds.valid) / total < 0.3
+    assert ds.train.features.shape[1] == psv_dataset["n_features"]
+    # all batches fixed-shape
+    shapes = {b["x"].shape for b in ds.train_batches(32)}
+    assert shapes == {(32, psv_dataset["n_features"])}
+
+
+def test_shard_stream_matches_in_memory(psv_dataset):
+    schema = _schema(psv_dataset)
+    ds = InMemoryDataset.load(psv_dataset["paths"], schema, valid_rate=0.2)
+    stream = ShardStream(
+        psv_dataset["paths"], schema, batch_size=32, valid_rate=0.2,
+        block_lines=100,
+    )
+    rows = sum(int(b["w"].sum() > 0) * int((b["w"] > 0).sum()) for b in stream)
+    assert rows == len(ds.train)  # same rows stream as load (weights>0 = real)
+
+
+def test_shard_stream_propagates_errors(tmp_path):
+    schema = RecordSchema(feature_columns=(1,), target_column=0)
+    with pytest.raises(FileNotFoundError):
+        list(ShardStream([str(tmp_path / "missing")], schema, batch_size=4))
+
+
+def test_prefetch_to_device_order():
+    batches = [{"x": np.full((2, 2), i)} for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), put=lambda b: b, depth=2))
+    assert [int(b["x"][0, 0]) for b in out] == [0, 1, 2, 3, 4]
+
+
+def test_size_aware_zero_size_files(tmp_path):
+    # zero-byte part files must still spread across workers (review finding)
+    paths = []
+    for i in range(4):
+        p = tmp_path / f"z{i}"
+        p.write_bytes(b"")
+        paths.append(str(p))
+    shards = splitter.split_size_aware(paths, 2)
+    assert [len(s.paths) for s in shards] == [2, 2]
+
+
+def test_shard_stream_abandoned_consumer_unblocks(psv_dataset):
+    import time
+
+    schema = _schema(psv_dataset)
+    stream = ShardStream(psv_dataset["paths"], schema, batch_size=8,
+                         queue_depth=2, block_lines=32)
+    it = iter(stream)
+    next(it)  # start the producer, then abandon the iterator
+    it.close()
+    deadline = time.time() + 5.0
+    import threading
+
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.daemon and t.is_alive() and "Thread-" in t.name]
+        if not alive:
+            break
+        time.sleep(0.1)
+    # producer must not be stuck on a full queue
+    assert time.time() < deadline

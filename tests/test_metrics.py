@@ -1,0 +1,67 @@
+"""Metric tests: KS/AUC correctness incl. ties and weights."""
+
+import numpy as np
+
+from shifu_tensorflow_tpu.ops.metrics import accuracy, auc, ks_statistic
+
+
+def test_auc_perfect_and_inverse():
+    y = np.array([0, 0, 1, 1])
+    assert auc([0.1, 0.2, 0.8, 0.9], y) == 1.0
+    assert auc([0.9, 0.8, 0.2, 0.1], y) == 0.0
+
+
+def test_auc_constant_scores_is_half():
+    y = np.array([0, 1, 0, 1, 1])
+    assert auc(np.full(5, 0.5), y) == 0.5
+
+
+def test_auc_matches_rank_formula():
+    rng = np.random.default_rng(0)
+    s = rng.random(200)
+    y = (rng.random(200) < 0.4).astype(float)
+    # brute-force pairwise
+    pos_s, neg_s = s[y > 0.5], s[y <= 0.5]
+    wins = (pos_s[:, None] > neg_s[None, :]).sum()
+    ties = (pos_s[:, None] == neg_s[None, :]).sum()
+    expected = (wins + 0.5 * ties) / (len(pos_s) * len(neg_s))
+    assert np.isclose(auc(s, y), expected)
+
+
+def test_auc_weighted():
+    # one heavily weighted correct pair dominates
+    s = np.array([0.9, 0.1, 0.6])
+    y = np.array([1.0, 0.0, 0.0])
+    w = np.array([1.0, 100.0, 1.0])
+    assert auc(s, y, w) == 1.0  # positive outranks all negatives regardless
+
+
+def test_ks_separable():
+    y = np.array([0] * 50 + [1] * 50)
+    s = np.concatenate([np.linspace(0, 0.4, 50), np.linspace(0.6, 1.0, 50)])
+    assert ks_statistic(s, y) == 1.0
+
+
+def test_ks_constant_zero():
+    y = np.array([0, 1, 0, 1])
+    assert ks_statistic(np.full(4, 0.3), y) == 0.0
+
+
+def test_ks_degenerate_classes():
+    assert ks_statistic([0.5, 0.6], [1, 1]) == 0.0
+    assert ks_statistic([], []) == 0.0
+
+
+def test_zero_weight_rows_excluded():
+    s = np.array([0.9, 0.1, 0.99])
+    y = np.array([1.0, 0.0, 0.0])
+    w = np.array([1.0, 1.0, 0.0])  # the misranked negative has weight 0
+    assert auc(s, y, w) == 1.0
+    assert ks_statistic(s, y, w) == 1.0
+
+
+def test_accuracy_weighted():
+    s = np.array([0.9, 0.2, 0.7])
+    y = np.array([1.0, 0.0, 0.0])
+    w = np.array([1.0, 1.0, 2.0])
+    assert np.isclose(accuracy(s, y, w), 2.0 / 4.0)
