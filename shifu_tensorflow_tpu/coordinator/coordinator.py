@@ -1,0 +1,355 @@
+"""Job coordinator — the control plane.
+
+Replaces the reference's L1-L3 stack: YARN client + ApplicationMaster +
+embedded ZooKeeper (TensorflowClient.java, TensorflowApplicationMaster.java,
+TensorflowSession.java) with one process owning worker registration, shard
+assignment, the start barrier, liveness, metrics aggregation, and the
+failure policy.  The znode contract (/tensorflow_cluster/<id>, /final,
+backup wake-up, /worker_intermediate_result — Constants.java:72-80) becomes
+a newline-delimited-JSON TCP protocol served here.
+
+Design translations (SURVEY.md §7.0):
+- partial-cluster start (95% + 6-min compaction) → **wait-for-all with
+  timeout → abort**: SPMD needs every participant, so the coordinator
+  barriers all workers with a hard registration deadline instead of
+  compacting a partial cluster;
+- backup hot-swap (weakupBackup, TensorflowSession.java:748-781) →
+  **checkpoint-restart**: a failed worker is relaunched by the submitter
+  and resumes from the latest sharded checkpoint (its shard assignment is
+  sticky by worker_id);
+- chief short-circuit (TensorflowSession.java:434-452): worker 0 failing
+  permanently fails the job;
+- fault tolerance envelope: at most ``floor(0.1 * n_workers) + spares``
+  worker restarts (Constants.java:87-89) before the job fails.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.coordinator.heartbeat import LivenessMonitor
+from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
+from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+
+class JobState(str, Enum):
+    """SessionState parity (TensorflowSession.java:837-839) plus terminal
+    success/failure."""
+
+    REGISTERING = "registering"
+    TRAINING = "training"
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+@dataclass
+class WorkerRecord:
+    worker_id: str
+    worker_index: int
+    shard_paths: tuple[str, ...] = ()
+    registered_at: float = 0.0
+    completed: bool = False
+    exit_code: int | None = None
+    restarts: int = 0
+
+
+@dataclass
+class JobSpec:
+    n_workers: int
+    shards: list  # list[Shard] from data.splitter (index-aligned to workers)
+    total_rows: int = 0
+    epochs: int = 1
+    registration_timeout_s: float = K.REGISTRATION_HARD_TIMEOUT_S
+    max_worker_failure_ratio: float = K.WORKER_FAULT_TOLERANCE_THRESHOLD
+    spare_restarts: int = 0  # analogue of backup instances
+    heartbeat_interval_ms: int = K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS
+    max_missed_heartbeats: int = K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
+    board_path: str | None = None
+
+
+class Coordinator:
+    """Thread-safe job state machine + TCP server."""
+
+    def __init__(self, spec: JobSpec):
+        self.spec = spec
+        self.state = JobState.REGISTERING
+        self.workers: dict[str, WorkerRecord] = {}
+        self._by_index: dict[int, str] = {}
+        self._next_index = 0
+        self._lock = threading.RLock()
+        self._start_barrier = threading.Event()
+        self._created_at = time.monotonic()
+        self.failure_reason: str | None = None
+        self.aggregator = EpochAggregator(
+            spec.n_workers, board_path=spec.board_path
+        )
+        self.liveness = LivenessMonitor(
+            interval_ms=spec.heartbeat_interval_ms,
+            max_missed=spec.max_missed_heartbeats,
+            on_expired=self._on_worker_expired,
+        )
+        self._failed_restarts = 0
+        self._server: "_Server | None" = None
+
+    # ---- policy ----
+    @property
+    def max_restarts(self) -> int:
+        return (
+            int(self.spec.max_worker_failure_ratio * self.spec.n_workers)
+            + self.spec.spare_restarts
+        )
+
+    def _fail(self, reason: str) -> None:
+        self.state = JobState.FAILED
+        self.failure_reason = reason
+        self._start_barrier.set()  # release anyone waiting
+
+    # ---- worker lifecycle (all called under the TCP handlers) ----
+    def register(self, worker_id: str) -> dict[str, Any]:
+        with self._lock:
+            if self.state == JobState.FAILED:
+                return {"ok": False, "error": self.failure_reason}
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                if self._next_index >= self.spec.n_workers:
+                    return {"ok": False, "error": "cluster full"}
+                rec = WorkerRecord(
+                    worker_id=worker_id,
+                    worker_index=self._next_index,
+                    shard_paths=tuple(self.spec.shards[self._next_index].paths),
+                    registered_at=time.monotonic(),
+                )
+                self.workers[worker_id] = rec
+                self._by_index[rec.worker_index] = worker_id
+                self._next_index += 1
+            else:
+                # sticky re-registration after restart: same index + shard
+                # (replaces the backup worker inheriting the failed worker's
+                # shard, TensorflowSession.java:748-781)
+                rec.completed = False
+                rec.exit_code = None
+            self.liveness.register(worker_id)
+            if len(self.workers) == self.spec.n_workers:
+                if self.state == JobState.REGISTERING:
+                    self.state = JobState.TRAINING
+                    self.liveness.start()
+                self._start_barrier.set()
+            return {
+                "ok": True,
+                "worker_index": rec.worker_index,
+                "shard": list(rec.shard_paths),
+                "n_workers": self.spec.n_workers,
+                "total_rows": self.spec.total_rows,
+                "epochs": self.spec.epochs,
+                "state": self.state.value,
+            }
+
+    def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
+        # registration deadline is absolute (measured from job creation),
+        # not per-call — late callers can't extend the window, and a
+        # short-timeout status probe can't kill the job
+        remaining = self.spec.registration_timeout_s - (
+            time.monotonic() - self._created_at
+        )
+        wait = max(0.0, remaining)
+        if timeout_s is not None:
+            wait = min(wait, timeout_s)
+        ok = self._start_barrier.wait(timeout=wait)
+        with self._lock:
+            if self.state == JobState.FAILED:
+                return {"ok": False, "error": self.failure_reason}
+            if ok:
+                return {"ok": True, "state": self.state.value}
+            if time.monotonic() - self._created_at >= self.spec.registration_timeout_s:
+                self._fail(
+                    f"registration timeout: {len(self.workers)}/"
+                    f"{self.spec.n_workers} workers after "
+                    f"{self.spec.registration_timeout_s:.0f}s"
+                )
+                return {"ok": False, "error": self.failure_reason}
+            # caller's own (shorter) timeout expired; job still registering
+            return {"ok": False, "error": "await timeout", "retryable": True}
+
+    def heartbeat(self, worker_id: str) -> dict[str, Any]:
+        self.liveness.beat(worker_id)
+        return {"ok": True, "abort": self.state == JobState.FAILED}
+
+    def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
+        stats = EpochStats(**stats_dict)
+        self.aggregator.report(stats)
+        return {"ok": True, "abort": self.state == JobState.FAILED}
+
+    def complete(self, worker_id: str, exit_code: int) -> dict[str, Any]:
+        with self._lock:
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return {"ok": False, "error": f"unknown worker {worker_id}"}
+            rec.completed = True
+            rec.exit_code = exit_code
+            self.liveness.unregister(worker_id)
+            if exit_code != 0:
+                # only a failure during an active job consumes budget: after
+                # FINISHED the model is already exported, and after FAILED
+                # workers exit cooperatively (code 42) — counting those (or
+                # letting a chief abort overwrite failure_reason) would mask
+                # the root cause
+                if self.state in (JobState.REGISTERING, JobState.TRAINING):
+                    self._on_worker_failed(rec, f"exit code {exit_code}")
+            else:
+                # success when the chief completes cleanly (parity:
+                # TensorflowApplicationMaster.java:373-376)
+                if rec.worker_index == 0 and self.state == JobState.TRAINING:
+                    self.state = JobState.FINISHED
+            return {"ok": True, "state": self.state.value}
+
+    # ---- failure handling ----
+    def _on_worker_expired(self, worker_id: str) -> None:
+        with self._lock:
+            rec = self.workers.get(worker_id)
+            if rec is not None and not rec.completed:
+                self._on_worker_failed(rec, "missed heartbeats")
+
+    def _on_worker_failed(self, rec: WorkerRecord, why: str) -> None:
+        if rec.worker_index == 0:
+            # chief short-circuit (TensorflowSession.java:434-452)
+            self._fail(f"chief worker failed: {why}")
+            return
+        self._failed_restarts += 1
+        if self._failed_restarts > self.max_restarts:
+            self._fail(
+                f"worker {rec.worker_index} failed ({why}); restart budget "
+                f"{self.max_restarts} exhausted"
+            )
+        else:
+            rec.restarts += 1  # submitter polls status and relaunches
+
+    def restartable_workers(self) -> list[WorkerRecord]:
+        """Workers that failed within budget and await relaunch: both clean
+        failures (nonzero exit) and hung workers expired by the liveness
+        monitor (which never call complete())."""
+        expired = self.liveness.expired()
+        with self._lock:
+            if self.state == JobState.FAILED:
+                return []
+            return [
+                r
+                for r in self.workers.values()
+                if (r.completed and (r.exit_code or 0) != 0)
+                or (not r.completed and r.worker_id in expired)
+            ]
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "ok": True,
+                "state": self.state.value,
+                "registered": len(self.workers),
+                "n_workers": self.spec.n_workers,
+                "failure_reason": self.failure_reason,
+                "restarts_used": self._failed_restarts,
+                "restart_budget": self.max_restarts,
+                "epochs_published": len(self.aggregator.summaries),
+                "pending_epochs": self.aggregator.pending_epochs(),
+            }
+
+    # ---- TCP plumbing ----
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        """Start the TCP server; returns (host, bound_port)."""
+        coord = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                for raw in self.rfile:
+                    try:
+                        msg = json.loads(raw)
+                        resp = coord.dispatch(msg)
+                    except Exception as e:  # malformed input must not kill the server
+                        resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        self._server = _Server((host, port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address[:2]
+
+    def dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
+        op = msg.get("op")
+        if op == "register":
+            return self.register(msg["worker_id"])
+        if op == "await_start":
+            return self.await_start(msg.get("timeout_s"))
+        if op == "heartbeat":
+            return self.heartbeat(msg["worker_id"])
+        if op == "epoch":
+            return self.report_epoch(msg["stats"])
+        if op == "complete":
+            return self.complete(msg["worker_id"], int(msg.get("exit_code", 0)))
+        if op == "status":
+            return self.status()
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def shutdown(self) -> None:
+        self.liveness.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class CoordinatorClient:
+    """Worker-side client: one JSON-line request per short connection."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 600.0):
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+
+    def call(
+        self, msg: dict[str, Any], timeout_s: float | str = "default"
+    ) -> dict[str, Any]:
+        timeout = self.timeout_s if timeout_s == "default" else timeout_s
+        with socket.create_connection(self.addr, timeout=timeout) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps(msg) + "\n").encode())
+            f.flush()
+            line = f.readline()
+            if not line:
+                raise ConnectionError("coordinator closed connection")
+            return json.loads(line)
+
+    def register(self, worker_id: str) -> dict[str, Any]:
+        return self.call({"op": "register", "worker_id": worker_id})
+
+    def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
+        # no socket timeout: the server responds by its own registration
+        # deadline, which may exceed the default RPC timeout
+        return self.call(
+            {"op": "await_start", "timeout_s": timeout_s}, timeout_s=None
+        )
+
+    def heartbeat(self, worker_id: str) -> dict[str, Any]:
+        return self.call({"op": "heartbeat", "worker_id": worker_id})
+
+    def report_epoch(self, stats: EpochStats) -> dict[str, Any]:
+        return self.call({"op": "epoch", "stats": stats.__dict__})
+
+    def complete(self, worker_id: str, exit_code: int = 0) -> dict[str, Any]:
+        return self.call(
+            {"op": "complete", "worker_id": worker_id, "exit_code": exit_code}
+        )
+
+    def status(self) -> dict[str, Any]:
+        return self.call({"op": "status"})

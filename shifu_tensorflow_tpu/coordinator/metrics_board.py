@@ -1,0 +1,124 @@
+"""Per-epoch metrics aggregation and the console board.
+
+Parity surface: the reference's 4-hop metrics plane — worker Python →
+localhost socket → per-container Java parser → ZK znode → AM aggregation —
+ends in ``doStatistic``: when every worker has reported an epoch, compute
+mean train/valid error, mean epoch/valid wall times, sort out the slowest
+worker, and append a line to an HDFS "console board" file the client tails
+(SocketServer.java:56-95, TensorflowSession.java:515-549,595-626,
+CommonUtils.ClientConsoleBoard:426-458).
+
+Design fix over the reference (SURVEY.md §7.3 last item): the reference
+drops stale epochs and races across workers' epoch boundaries; here records
+are keyed by (epoch, worker_index) so late arrivals land in their own epoch
+bucket and an epoch is published exactly once, when its quorum completes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from shifu_tensorflow_tpu.train.trainer import EpochStats
+from shifu_tensorflow_tpu.utils import fs
+
+
+@dataclass
+class EpochSummary:
+    epoch: int
+    n_workers: int
+    mean_training_loss: float
+    mean_valid_loss: float
+    mean_training_time_s: float
+    mean_valid_time_s: float
+    slowest_worker: int
+    slowest_time_s: float
+    ks: float = 0.0
+    auc: float = 0.0
+
+    def board_line(self) -> str:
+        return (
+            f"epoch {self.epoch}: avg train err {self.mean_training_loss:.6f}, "
+            f"avg valid err {self.mean_valid_loss:.6f}, "
+            f"avg epoch time {self.mean_training_time_s:.2f}s, "
+            f"avg valid time {self.mean_valid_time_s:.2f}s, "
+            f"ks {self.ks:.4f}, auc {self.auc:.4f}, "
+            f"slowest worker {self.slowest_worker} "
+            f"({self.slowest_time_s:.2f}s)\n"
+        )
+
+
+class EpochAggregator:
+    def __init__(
+        self,
+        n_workers: int,
+        board_path: str | None = None,
+        on_epoch_complete: Callable[[EpochSummary], None] | None = None,
+    ):
+        self.n_workers = n_workers
+        self.board_path = board_path
+        self.on_epoch_complete = on_epoch_complete
+        self._records: dict[int, dict[int, EpochStats]] = {}
+        self._published: set[int] = set()
+        self._lock = threading.Lock()
+        self.summaries: list[EpochSummary] = []
+
+    def report(self, stats: EpochStats) -> EpochSummary | None:
+        """Record one worker's epoch stats; returns the summary if this
+        report completes the epoch's quorum.  When an epoch completes, any
+        earlier epoch still unpublished is flushed with partial quorum
+        first — a restarted worker that resumed past it would otherwise
+        leave a permanent hole (its skipped epochs can never reach
+        quorum)."""
+        to_publish: list[EpochSummary] = []
+        with self._lock:
+            epoch = stats.current_epoch
+            bucket = self._records.setdefault(epoch, {})
+            bucket[stats.worker_index] = stats
+            if epoch in self._published or len(bucket) < self.n_workers:
+                return None
+            for earlier in sorted(self._records):
+                if earlier >= epoch:
+                    break
+                if earlier not in self._published and self._records[earlier]:
+                    self._published.add(earlier)
+                    to_publish.append(
+                        self._summarize(earlier, self._records[earlier])
+                    )
+            self._published.add(epoch)
+            summary = self._summarize(epoch, bucket)
+            to_publish.append(summary)
+            self.summaries.extend(to_publish)
+        for s in to_publish:
+            if self.board_path:
+                fs.append_text(self.board_path, s.board_line())
+            if self.on_epoch_complete:
+                self.on_epoch_complete(s)
+        return summary
+
+    def _summarize(self, epoch: int, bucket: dict[int, EpochStats]) -> EpochSummary:
+        stats = list(bucket.values())
+        n = len(stats)
+        slowest = max(stats, key=lambda s: s.training_time_s)
+        return EpochSummary(
+            epoch=epoch,
+            n_workers=n,
+            mean_training_loss=sum(s.training_loss for s in stats) / n,
+            mean_valid_loss=sum(s.valid_loss for s in stats) / n,
+            mean_training_time_s=sum(s.training_time_s for s in stats) / n,
+            mean_valid_time_s=sum(s.valid_time_s for s in stats) / n,
+            slowest_worker=slowest.worker_index,
+            slowest_time_s=slowest.training_time_s,
+            ks=sum(s.ks for s in stats) / n,
+            auc=sum(s.auc for s in stats) / n,
+        )
+
+    def pending_epochs(self) -> dict[int, int]:
+        """epoch -> number of workers still missing (for stall diagnosis)."""
+        with self._lock:
+            return {
+                e: self.n_workers - len(b)
+                for e, b in self._records.items()
+                if e not in self._published
+            }

@@ -1,0 +1,133 @@
+"""Job submitter — launches the coordinator and the worker fleet.
+
+Parity surface: the reference's client submits the AM and polls every 10 s
+until a terminal state (TensorflowClient.run/monitorApplication,
+TensorflowClient.java:333,625-658); the AM requests containers and the NM
+starts executors.  Here the submitter owns both halves directly: it starts
+the Coordinator, launches N workers (in-process threads for tests and
+single-host jobs; a ``spawn`` hook for real multi-host deployments), polls
+status, and relaunches failed workers within the fault budget — the
+checkpoint-restart replacement for backup containers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from shifu_tensorflow_tpu.coordinator.coordinator import (
+    Coordinator,
+    JobSpec,
+    JobState,
+)
+from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig, run_worker
+from shifu_tensorflow_tpu.data.splitter import split_training_data, total_line_count
+
+
+@dataclass
+class JobResult:
+    state: JobState
+    failure_reason: str | None
+    epoch_summaries: list
+    restarts_used: int
+    wall_time_s: float
+
+
+class JobSubmitter:
+    def __init__(
+        self,
+        spec: JobSpec,
+        make_worker_config: Callable[[str, tuple[str, int]], WorkerConfig],
+        *,
+        worker_runner: Callable[..., int] = run_worker,
+        poll_interval_s: float = 0.2,
+        fault_injections: dict[str, int] | None = None,
+    ):
+        """``make_worker_config(worker_id, (host, port))`` builds each
+        worker's config; ``fault_injections`` maps worker_id -> epoch to
+        fail at (first launch only) for testing recovery."""
+        self.spec = spec
+        self.make_worker_config = make_worker_config
+        self.worker_runner = worker_runner
+        self.poll_interval_s = poll_interval_s
+        self.fault_injections = dict(fault_injections or {})
+        self.coordinator = Coordinator(spec)
+        self._threads: dict[str, threading.Thread] = {}
+        self._launch_counts: dict[str, int] = {}
+
+    def _launch(self, worker_id: str, addr: tuple[str, int]) -> None:
+        cfg = self.make_worker_config(worker_id, addr)
+        first_launch = self._launch_counts.get(worker_id, 0) == 0
+        fail_at = self.fault_injections.get(worker_id) if first_launch else None
+        self._launch_counts[worker_id] = self._launch_counts.get(worker_id, 0) + 1
+
+        def target() -> None:
+            self.worker_runner(cfg, fail_at_epoch=fail_at)
+
+        t = threading.Thread(target=target, daemon=True, name=f"worker-{worker_id}")
+        self._threads[worker_id] = t
+        t.start()
+
+    def run(self, timeout_s: float = 600.0) -> JobResult:
+        t0 = time.monotonic()
+        addr = self.coordinator.serve()
+        worker_ids = [f"worker-{i}" for i in range(self.spec.n_workers)]
+        for wid in worker_ids:
+            self._launch(wid, addr)
+
+        relaunched: set[str] = set()
+        try:
+            while time.monotonic() - t0 < timeout_s:
+                state = self.coordinator.state
+                if state in (JobState.FINISHED, JobState.FAILED):
+                    break
+                # checkpoint-restart recovery: relaunch failed workers that
+                # are within budget (coordinator keeps them restartable)
+                for rec in self.coordinator.restartable_workers():
+                    key = (rec.worker_id, rec.restarts)
+                    if key not in relaunched:
+                        relaunched.add(key)
+                        self._launch(rec.worker_id, addr)
+                time.sleep(self.poll_interval_s)
+            else:
+                self.coordinator._fail(f"job timeout after {timeout_s:.0f}s")
+        finally:
+            wall = time.monotonic() - t0
+            result = JobResult(
+                state=self.coordinator.state,
+                failure_reason=self.coordinator.failure_reason,
+                epoch_summaries=list(self.coordinator.aggregator.summaries),
+                restarts_used=self.coordinator._failed_restarts,
+                wall_time_s=wall,
+            )
+            self.coordinator.shutdown()
+        return result
+
+
+def make_job_spec(
+    training_data_path: str,
+    n_workers: int,
+    *,
+    epochs: int = 1,
+    split_strategy: str = "size_aware",
+    count_rows: bool = False,
+    **spec_kwargs: Any,
+) -> JobSpec:
+    """Build a JobSpec from a data directory: split shards (parity with the
+    AM's TrainingDataSet bootstrap, TensorflowSession.java:174-183) and
+    optionally count rows (TOTAL_TRAINING_DATA_NUMBER parity)."""
+    shards = split_training_data(training_data_path, n_workers, split_strategy)
+    total = (
+        total_line_count([p for s in shards for p in s.paths])
+        if count_rows
+        else 0
+    )
+    return JobSpec(
+        n_workers=n_workers,
+        shards=shards,
+        total_rows=total,
+        epochs=epochs,
+        **spec_kwargs,
+    )

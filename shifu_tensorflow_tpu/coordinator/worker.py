@@ -1,0 +1,166 @@
+"""Worker-side runtime: register → barrier → train → report → complete.
+
+Parity surface: the reference's in-container executor chain —
+``TensorflowTaskExecutor`` registering on ZK, awaiting the final cluster,
+then exec'ing the Python trainer whose epoch loop pushes metrics to the
+local socket server (TensorflowTaskExecutor.java:93-111,300-317,
+ssgd_monitor.py:268-293).  Here the whole chain is one process: the worker
+registers with the coordinator, blocks on the start barrier, streams its
+shard into the Trainer, reports per-epoch stats and heartbeats in-band, and
+completes with an exit code the coordinator's failure policy consumes.
+
+Recovery: on start the worker always tries to restore the shared
+checkpoint; a relaunched worker therefore resumes at the right epoch with
+its sticky shard (replaces backup wake-up, and fixes the epoch-budget gap
+acknowledged at backup.py:30).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.coordinator.coordinator import CoordinatorClient
+from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+
+@dataclass
+class WorkerConfig:
+    worker_id: str
+    coordinator_host: str
+    coordinator_port: int
+    model_config: ModelConfig
+    schema: RecordSchema
+    batch_size: int = 100
+    checkpoint_dir: str | None = None
+    checkpoint_every_epochs: int = 1
+    valid_rate: float | None = None  # None -> model_config.valid_set_rate
+    heartbeat_interval_s: float = 0.5
+    mesh_spec: str | None = None
+    seed: int = 0
+
+
+class _HeartbeatThread(threading.Thread):
+    def __init__(self, client: CoordinatorClient, worker_id: str, interval_s: float):
+        super().__init__(daemon=True)
+        self.client = client
+        self.worker_id = worker_id
+        self.interval_s = interval_s
+        self.abort = threading.Event()
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                resp = self.client.heartbeat(self.worker_id)
+                if resp.get("abort"):
+                    self.abort.set()
+                    return
+            except Exception:
+                # coordinator unreachable: keep trying; the trainer decides
+                # nothing — the coordinator's liveness policy decides for us
+                continue
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run_worker(cfg: WorkerConfig, *,
+               fail_at_epoch: int | None = None) -> int:
+    """Full worker lifecycle; returns the exit code it reported.
+
+    ``fail_at_epoch`` is the built-in fault-injection hook (the reference
+    only had a commented-out kill-PS-after-80s hack,
+    CommonUtils.java:265-273): the worker aborts mid-job at that epoch.
+    """
+    client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
+    reg = client.register(cfg.worker_id)
+    if not reg.get("ok"):
+        return 1  # never registered; the coordinator doesn't know us
+    worker_index = reg["worker_index"]
+    shard_paths = reg["shard"]
+    epochs = reg.get("epochs") or cfg.model_config.num_train_epochs
+
+    hb = _HeartbeatThread(client, cfg.worker_id, cfg.heartbeat_interval_s)
+    hb.start()
+    exit_code = 0
+    checkpointer = None
+    try:
+        started = client.await_start()
+        if not started.get("ok"):
+            raise _JobAborted()
+        valid_rate = (
+            cfg.valid_rate
+            if cfg.valid_rate is not None
+            else cfg.model_config.valid_set_rate
+        )
+        dataset = InMemoryDataset.load(shard_paths, cfg.schema, valid_rate)
+
+        mesh = None
+        if cfg.mesh_spec:
+            from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(cfg.mesh_spec)
+        trainer = Trainer(
+            cfg.model_config,
+            cfg.schema.num_features,
+            mesh=mesh,
+            worker_index=worker_index,
+            seed=cfg.seed,
+        )
+
+        start_epoch = 0
+        if cfg.checkpoint_dir:
+            checkpointer = Checkpointer(
+                cfg.checkpoint_dir, every_epochs=cfg.checkpoint_every_epochs
+            )
+            start_epoch = trainer.restore(checkpointer)
+
+        def on_epoch(stats) -> None:
+            if hb.abort.is_set():
+                raise _JobAborted()
+            if fail_at_epoch is not None and stats.current_epoch >= fail_at_epoch:
+                raise _InjectedFault()
+            client.report_epoch(stats)
+
+        trainer.fit(
+            dataset,
+            epochs=epochs,
+            batch_size=cfg.batch_size,
+            on_epoch=on_epoch,
+            checkpointer=checkpointer if worker_index == 0 else None,
+            start_epoch=start_epoch,
+        )
+    except _InjectedFault:
+        exit_code = 43
+    except _JobAborted:
+        exit_code = 42
+    except Exception:
+        exit_code = 1
+    finally:
+        # always release the orbax manager: leaked async writer threads
+        # abort the interpreter at teardown
+        if checkpointer is not None:
+            try:
+                checkpointer.close()
+            except Exception:
+                pass
+        hb.stop()
+        try:
+            client.complete(cfg.worker_id, exit_code)
+        except Exception:
+            pass
+    return exit_code
+
+
+class _InjectedFault(RuntimeError):
+    pass
+
+
+class _JobAborted(RuntimeError):
+    pass

@@ -1,0 +1,126 @@
+"""Batch scoring against an exported artifact.
+
+Parity surface: the reference's Java ``TensorflowModel implements
+Computable`` — ``init(GenericModelConfig)`` loads the SavedModel bundle,
+``compute(MLData)`` converts a row of doubles to a float tensor, feeds
+``shifu_input_0``, fetches ``shifu_output_0``, returns the scalar
+(TensorflowModel.java:32,53-94,112-172).  ``EvalModel`` mirrors that
+lifecycle (init → compute/compute_batch → release) with two backends:
+
+- ``native``: rebuilds the flax model from ``shifu_tpu_model.json`` and
+  loads ``shifu_tpu_weights.npz`` — zero TF dependency;
+- ``saved_model``: loads the TF SavedModel through TensorFlow when
+  available, scoring through the exact signature the Java evaluator uses —
+  this is the cross-check that the exported artifact honors the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.export.saved_model import (
+    GENERIC_CONFIG,
+    INPUT_NAME,
+    NATIVE_ARCH,
+    NATIVE_WEIGHTS,
+    OUTPUT_NAME,
+    _unflatten_params,
+)
+from shifu_tensorflow_tpu.utils import fs
+
+
+class EvalModel:
+    """init/compute/release lifecycle over an exported model dir."""
+
+    def __init__(self, model_dir: str, backend: str = "native"):
+        self.model_dir = model_dir
+        self.backend = backend
+        self.generic_config = json.loads(
+            fs.read_text(os.path.join(model_dir, GENERIC_CONFIG))
+        )
+        assert INPUT_NAME in self.generic_config["inputnames"]
+        if backend == "native":
+            self._init_native()
+        elif backend == "saved_model":
+            self._init_saved_model()
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+
+    # ---- native backend ----
+    def _init_native(self) -> None:
+        from shifu_tensorflow_tpu.models.factory import build_model
+
+        arch = json.loads(fs.read_text(os.path.join(self.model_dir, NATIVE_ARCH)))
+        self.num_features = int(arch["num_features"])
+        mc = ModelConfig.from_json(arch["model_config"])
+        feature_columns = tuple(arch.get("feature_columns") or ())
+        self._model = build_model(mc, feature_columns or None)
+        with fs.open_read(os.path.join(self.model_dir, NATIVE_WEIGHTS)) as f:
+            npz = np.load(f)
+            flat = {k: npz[k] for k in npz.files}
+        self._params = _unflatten_params(flat)
+        norm = arch.get("normalization") or {}
+        self._means = np.asarray(norm["means"], np.float32) if norm.get("means") else None
+        self._stds = np.asarray(norm["stds"], np.float32) if norm.get("stds") else None
+
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+
+    def _init_saved_model(self) -> None:
+        import tensorflow as tf
+
+        self._tf = tf
+        loaded = tf.saved_model.load(self.model_dir, tags=None)
+        self._infer = loaded.signatures["serving_default"]
+        # feature count from the signature input spec
+        spec = self._infer.structured_input_signature[1]
+        (only,) = spec.values()
+        self.num_features = int(only.shape[1])
+        # normalization stats live in the native arch file alongside the
+        # SavedModel; both backends must apply identical ZSCALE
+        self._means = self._stds = None
+        arch_path = os.path.join(self.model_dir, NATIVE_ARCH)
+        if fs.exists(arch_path):
+            norm = json.loads(fs.read_text(arch_path)).get("normalization") or {}
+            if norm.get("means"):
+                self._means = np.asarray(norm["means"], np.float32)
+                self._stds = np.asarray(norm["stds"], np.float32)
+
+    # ---- scoring ----
+    def compute(self, row) -> float:
+        """Score one row of raw doubles (Computable.compute parity)."""
+        out = self.compute_batch(np.asarray(row, np.float32)[None, :])
+        return float(out[0, 0])
+
+    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows, np.float32)
+        if rows.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} features, got {rows.shape[1]}"
+            )
+        if self._means is not None:
+            rows = (rows - self._means) / np.where(self._stds == 0, 1, self._stds)
+        if self.backend == "native":
+            out = self._model.apply({"params": self._params}, self._jnp.asarray(rows))
+            return np.asarray(out)
+        result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
+        return result[OUTPUT_NAME].numpy()
+
+    def release(self) -> None:
+        """Explicit resource release (closeTensors parity,
+        TensorflowModel.java:97-109) — backends hold no leaked handles, so
+        this just drops references."""
+        for attr in ("_model", "_params", "_infer", "_tf", "_jnp"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

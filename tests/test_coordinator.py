@@ -1,0 +1,340 @@
+"""Coordinator/control-plane tests — the multi-worker single-host harness
+SURVEY.md §4 item 2 calls for: registration barrier, sticky shard
+assignment, heartbeat liveness, metrics quorum aggregation, chief
+short-circuit, fault-injected recovery via checkpoint-restart."""
+
+import threading
+import time
+
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.coordinator.coordinator import (
+    Coordinator,
+    CoordinatorClient,
+    JobSpec,
+    JobState,
+)
+from shifu_tensorflow_tpu.coordinator.heartbeat import LivenessMonitor
+from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
+from shifu_tensorflow_tpu.coordinator.submitter import JobSubmitter, make_job_spec
+from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.data.splitter import Shard
+from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+
+def _stats(worker, epoch, loss=0.5):
+    return EpochStats(
+        worker_index=worker, current_epoch=epoch, training_loss=loss,
+        valid_loss=loss, training_time_s=1.0 + worker, valid_time_s=0.1,
+        global_step=epoch + 1,
+    )
+
+
+def _spec(n=2, **kw):
+    shards = [Shard(i, (f"/data/part-{i}",), 1) for i in range(n)]
+    kw.setdefault("registration_timeout_s", 5.0)
+    return JobSpec(n_workers=n, shards=shards, epochs=2, **kw)
+
+
+# ---- liveness ----
+
+def test_liveness_expiry_and_recovery():
+    now = [0.0]
+    expired = []
+    mon = LivenessMonitor(interval_ms=1000, max_missed=3,
+                          on_expired=expired.append, clock=lambda: now[0])
+    mon.register("w0")
+    mon.register("w1")
+    now[0] = 2.0
+    mon.beat("w0")
+    now[0] = 4.0  # w1 last beat at 0, deadline 3s -> expired
+    assert mon.check() == ["w1"]
+    assert expired == ["w1"]
+    assert mon.alive() == {"w0"}
+    # re-registration clears expiry (restart case)
+    mon.register("w1")
+    assert mon.alive() == {"w0", "w1"}
+
+
+def test_liveness_unknown_beat_ignored():
+    mon = LivenessMonitor()
+    mon.beat("ghost")  # must not implicitly register
+    assert mon.alive() == set()
+
+
+# ---- metrics aggregation ----
+
+def test_epoch_aggregator_quorum(tmp_path):
+    board = tmp_path / "board.log"
+    agg = EpochAggregator(2, board_path=str(board))
+    assert agg.report(_stats(0, 0, 0.4)) is None
+    assert agg.pending_epochs() == {0: 1}
+    summary = agg.report(_stats(1, 0, 0.6))
+    assert summary is not None
+    assert summary.mean_training_loss == pytest.approx(0.5)
+    assert summary.slowest_worker == 1  # training_time = 1 + worker_index
+    assert "epoch 0" in board.read_text()
+    # duplicate/stale report does not re-publish
+    assert agg.report(_stats(0, 0, 0.9)) is None
+    assert len(agg.summaries) == 1
+
+
+def test_epoch_aggregator_out_of_order_epochs():
+    agg = EpochAggregator(2)
+    # worker 1 races ahead to epoch 1 before worker 0 finishes epoch 0
+    agg.report(_stats(1, 1))
+    agg.report(_stats(0, 0))
+    agg.report(_stats(1, 0))  # completes epoch 0
+    agg.report(_stats(0, 1))  # completes epoch 1
+    assert [s.epoch for s in agg.summaries] == [0, 1]
+
+
+# ---- coordinator state machine over TCP ----
+
+def test_register_barrier_and_sticky_assignment():
+    coord = Coordinator(_spec(2))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        r0 = c.register("a")
+        assert r0["ok"] and r0["worker_index"] == 0
+        assert r0["state"] == JobState.REGISTERING.value
+        assert coord.status()["registered"] == 1
+
+        r1 = c.register("b")
+        assert r1["worker_index"] == 1
+        assert r1["state"] == JobState.TRAINING.value
+        assert c.await_start()["ok"]
+
+        # re-registration (restart) keeps index + shard
+        r0b = c.register("a")
+        assert r0b["worker_index"] == 0
+        assert r0b["shard"] == r0["shard"]
+
+        # third distinct worker rejected
+        assert not c.register("c")["ok"]
+    finally:
+        coord.shutdown()
+
+
+def test_registration_timeout_fails_job():
+    coord = Coordinator(_spec(2, registration_timeout_s=0.3))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        c.register("only-one")
+        resp = c.await_start()
+        assert not resp["ok"]
+        assert "registration timeout" in resp["error"]
+        assert coord.state == JobState.FAILED
+    finally:
+        coord.shutdown()
+
+
+def test_chief_failure_short_circuits():
+    coord = Coordinator(_spec(2))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        c.register("a")  # index 0 = chief
+        c.register("b")
+        c.complete("a", exit_code=1)
+        assert coord.state == JobState.FAILED
+        assert "chief" in coord.failure_reason
+    finally:
+        coord.shutdown()
+
+
+def test_chief_success_finishes_job():
+    coord = Coordinator(_spec(2))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        c.register("a")
+        c.register("b")
+        c.complete("a", exit_code=0)
+        assert coord.state == JobState.FINISHED
+    finally:
+        coord.shutdown()
+
+
+def test_non_chief_failure_within_budget_restartable():
+    coord = Coordinator(_spec(3, spare_restarts=1))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        for wid in ("a", "b", "c"):
+            c.register(wid)
+        c.complete("b", exit_code=7)
+        assert coord.state == JobState.TRAINING  # tolerated
+        restartable = coord.restartable_workers()
+        assert [r.worker_id for r in restartable] == ["b"]
+        # budget: floor(0.1*3) + 1 spare = 1 -> second failure fails the job
+        c.complete("c", exit_code=7)
+        assert coord.state == JobState.FAILED
+        assert "budget" in coord.failure_reason
+    finally:
+        coord.shutdown()
+
+
+def test_malformed_request_does_not_kill_server():
+    coord = Coordinator(_spec(1))
+    host, port = coord.serve()
+    try:
+        import json
+        import socket
+
+        with socket.create_connection((host, port)) as s:
+            f = s.makefile("rwb")
+            f.write(b"this is not json\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert not resp["ok"]
+        # server still serves
+        c = CoordinatorClient(host, port)
+        assert c.status()["ok"]
+    finally:
+        coord.shutdown()
+
+
+# ---- end-to-end job with real training + fault injection ----
+
+def _worker_config_factory(psv_dataset, model_config, tmp_path):
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+
+    def make(worker_id, addr):
+        return WorkerConfig(
+            worker_id=worker_id,
+            coordinator_host=addr[0],
+            coordinator_port=addr[1],
+            model_config=model_config,
+            schema=schema,
+            batch_size=100,
+            checkpoint_dir=str(tmp_path / "job-ckpt"),
+            heartbeat_interval_s=0.1,
+        )
+
+    return make
+
+
+@pytest.fixture
+def job_model_config():
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 2, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"], "LearningRate": 0.05,
+                              "Optimizer": "adam"}}}
+    )
+
+
+def test_submitter_end_to_end_success(psv_dataset, tmp_path, job_model_config):
+    spec = make_job_spec(psv_dataset["root"], 2, epochs=2,
+                         registration_timeout_s=10.0)
+    sub = JobSubmitter(
+        spec, _worker_config_factory(psv_dataset, job_model_config, tmp_path)
+    )
+    result = sub.run(timeout_s=120.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 0
+    # both epochs aggregated across both workers
+    assert [s.epoch for s in result.epoch_summaries] == [0, 1]
+    assert all(s.n_workers == 2 for s in result.epoch_summaries)
+
+
+def test_submitter_recovers_injected_worker_fault(
+    psv_dataset, tmp_path, job_model_config
+):
+    """A non-chief worker dies mid-job; the submitter relaunches it and the
+    job completes — checkpoint-restart recovery semantics (SURVEY.md §5.3
+    replacement)."""
+    spec = make_job_spec(psv_dataset["root"], 2, epochs=2,
+                         registration_timeout_s=10.0, spare_restarts=1)
+    sub = JobSubmitter(
+        spec,
+        _worker_config_factory(psv_dataset, job_model_config, tmp_path),
+        fault_injections={"worker-1": 0},  # dies at epoch 0 on first launch
+    )
+    result = sub.run(timeout_s=120.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 1
+
+
+def test_submitter_chief_fault_fails_job(psv_dataset, tmp_path, job_model_config):
+    spec = make_job_spec(psv_dataset["root"], 2, epochs=2,
+                         registration_timeout_s=10.0, spare_restarts=5)
+    sub = JobSubmitter(
+        spec,
+        _worker_config_factory(psv_dataset, job_model_config, tmp_path),
+        fault_injections={"worker-0": 1},  # chief dies
+    )
+    result = sub.run(timeout_s=120.0)
+    assert result.state == JobState.FAILED
+    assert "chief" in result.failure_reason
+
+
+def test_epoch_aggregator_partial_flush_on_resume_hole():
+    # worker 1 died before reporting epoch 0; after restart it resumed at
+    # epoch 1 — epoch 0 must flush with partial quorum when epoch 1 closes
+    agg = EpochAggregator(2)
+    agg.report(_stats(0, 0))          # only worker 0 reports epoch 0
+    agg.report(_stats(0, 1))
+    summary = agg.report(_stats(1, 1))  # epoch 1 completes
+    assert summary is not None and summary.epoch == 1
+    assert [s.epoch for s in agg.summaries] == [0, 1]
+    assert agg.summaries[0].n_workers == 1  # partial quorum recorded
+    assert agg.pending_epochs() == {}
+
+
+def test_hung_worker_is_restartable():
+    spec = _spec(3, spare_restarts=1)
+    coord = Coordinator(spec)
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        for wid in ("a", "b", "c"):
+            c.register(wid)
+        # "b" hangs: no heartbeat, no complete. Force liveness expiry.
+        coord.liveness._last["b"] -= coord.liveness.deadline_s + 1
+        coord.liveness.check()
+        restartable = coord.restartable_workers()
+        assert [r.worker_id for r in restartable] == ["b"]
+        assert coord.state == JobState.TRAINING  # within budget
+    finally:
+        coord.shutdown()
+
+
+def test_await_start_short_probe_does_not_kill_job():
+    coord = Coordinator(_spec(2, registration_timeout_s=30.0))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        c.register("a")  # 1 of 2 — still registering
+        resp = c.await_start(timeout_s=0.1)
+        assert not resp["ok"] and resp.get("retryable")
+        assert coord.state == JobState.REGISTERING  # job unharmed
+    finally:
+        coord.shutdown()
+
+
+def test_abort_exit_codes_do_not_mask_failure_reason():
+    coord = Coordinator(_spec(3, spare_restarts=0))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        for wid in ("a", "b", "c"):
+            c.register(wid)
+        c.complete("b", exit_code=7)  # budget 0 -> job fails
+        assert coord.state == JobState.FAILED
+        reason = coord.failure_reason
+        # chief aborts cooperatively afterwards; reason must be preserved
+        c.complete("a", exit_code=42)
+        assert coord.failure_reason == reason
+        assert "budget" in coord.failure_reason
+    finally:
+        coord.shutdown()

@@ -1,0 +1,125 @@
+"""Artifact-contract tests — the TPU-side mirror of the reference's only
+real test, TensorflowModelTest.testCompute (SURVEY.md §4 item 4): exported
+model must carry shifu_input_0/shifu_output_0, the serve tag, and a
+GenericModelConfig.json with normtype ZSCALE; scores must be in [0,1] and
+the scoring path must agree with in-process inference."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.export.eval_model import EvalModel
+from shifu_tensorflow_tpu.export.saved_model import (
+    GENERIC_CONFIG,
+    export_model,
+    generic_model_config_json,
+)
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+
+def _trained(psv_dataset, tmp_path, epochs=1):
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    ds = InMemoryDataset.load(psv_dataset["paths"], schema, 0.2)
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": epochs, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam"}}}
+    )
+    t = Trainer(mc, ds.schema.num_features)
+    t.fit(ds, batch_size=100)
+    export_dir = str(tmp_path / "model")
+    status = export_model(export_dir, t,
+                          feature_columns=psv_dataset["feature_cols"])
+    return t, ds, export_dir, status
+
+
+def test_generic_model_config_exact_reference_content():
+    cfg = json.loads(generic_model_config_json())
+    assert cfg["inputnames"] == ["shifu_input_0"]
+    assert cfg["properties"]["outputnames"] == "shifu_output_0"
+    assert cfg["properties"]["tags"] == ["serve"]
+    assert cfg["properties"]["normtype"] == "ZSCALE"
+    assert cfg["properties"]["algorithm"] == "tensorflow"
+
+
+def test_native_bundle_roundtrip(psv_dataset, tmp_path):
+    t, ds, export_dir, status = _trained(psv_dataset, tmp_path)
+    assert status["native"]
+    assert os.path.exists(os.path.join(export_dir, GENERIC_CONFIG))
+    with EvalModel(export_dir, backend="native") as em:
+        x = ds.valid.features[:50]
+        got = em.compute_batch(x)
+        want = t.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # single-row Computable parity + output range contract
+        score = em.compute(x[0])
+        assert 0.0 <= score <= 1.0
+        # 1522 random rows like TensorflowModelTest (shrunk to 200 for speed)
+        rand = np.random.default_rng(0).random((200, ds.schema.num_features))
+        out = em.compute_batch(rand.astype(np.float32))
+        assert ((out >= 0) & (out <= 1)).all()
+
+
+def test_eval_model_feature_width_check(psv_dataset, tmp_path):
+    _, _, export_dir, _ = _trained(psv_dataset, tmp_path)
+    with EvalModel(export_dir, backend="native") as em:
+        with pytest.raises(ValueError, match="features"):
+            em.compute_batch(np.zeros((2, 3), np.float32))
+
+
+def test_saved_model_contract(psv_dataset, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    t, ds, export_dir, status = _trained(psv_dataset, tmp_path)
+    assert status["saved_model"], "TF available but SavedModel export failed"
+    # the artifact itself carries the serve tag + signature names
+    from tensorflow.python.tools import saved_model_utils
+
+    meta = saved_model_utils.get_meta_graph_def(export_dir, "serve")
+    sig = meta.signature_def["serving_default"]
+    assert list(sig.inputs.keys()) == ["shifu_input_0"]
+    assert list(sig.outputs.keys()) == ["shifu_output_0"]
+    # scoring through the TF signature matches in-process inference
+    with EvalModel(export_dir, backend="saved_model") as em:
+        x = ds.valid.features[:32]
+        got = em.compute_batch(x)
+        want = t.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_with_zscale_stats(psv_dataset, tmp_path):
+    t, ds, export_dir, _ = _trained(psv_dataset, tmp_path)
+    means = [0.1] * ds.schema.num_features
+    stds = [2.0] * ds.schema.num_features
+    export_dir2 = str(tmp_path / "model-z")
+    export_model(export_dir2, t, feature_columns=psv_dataset["feature_cols"],
+                 zscale_means=means, zscale_stds=stds)
+    with EvalModel(export_dir2, backend="native") as em:
+        raw = ds.valid.features[:10]
+        got = em.compute_batch(raw)
+        want = t.predict((raw - 0.1) / 2.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_saved_model_backend_applies_zscale(psv_dataset, tmp_path):
+    pytest.importorskip("tensorflow")
+    t, ds, _, _ = _trained(psv_dataset, tmp_path)
+    means = [0.5] * ds.schema.num_features
+    stds = [3.0] * ds.schema.num_features
+    export_dir = str(tmp_path / "model-z2")
+    export_model(export_dir, t, feature_columns=psv_dataset["feature_cols"],
+                 zscale_means=means, zscale_stds=stds)
+    raw = ds.valid.features[:8]
+    with EvalModel(export_dir, backend="native") as a, \
+         EvalModel(export_dir, backend="saved_model") as b:
+        np.testing.assert_allclose(a.compute_batch(raw), b.compute_batch(raw),
+                                   rtol=1e-4, atol=1e-5)
