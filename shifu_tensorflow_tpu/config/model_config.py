@@ -50,6 +50,10 @@ class TrainParams:
     # local-update DP: >1 reproduces SAGN's communication window of local
     # steps before the global update (reference: SAGN.py:110-176)
     update_window: int = 1
+    # training algorithm: "ssgd" (ssgd_monitor.py, plain sync-DP) or "sagn"
+    # (SAGN.py local-SGD windows) — the reference selected between them by
+    # swapping the python script path in global-default.xml
+    algorithm: str = "ssgd"
 
     @classmethod
     def from_json(cls, params: Mapping[str, Any]) -> "TrainParams":
@@ -75,6 +79,7 @@ class TrainParams:
             embedding_hash_size=int(params.get("EmbeddingHashSize", 0)),
             embedding_dim=int(params.get("EmbeddingDim", 8)),
             update_window=int(params.get("UpdateWindow", 1)),
+            algorithm=str(params.get("Algorithm", "ssgd")).lower(),
         )
 
 

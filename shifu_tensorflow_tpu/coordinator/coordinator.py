@@ -73,6 +73,13 @@ class JobSpec:
     heartbeat_interval_ms: int = K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS
     max_missed_heartbeats: int = K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
     board_path: str | None = None
+    # epoch synchronization: workers barrier after each epoch until every
+    # worker has reported it — the coordinator-level analogue of the
+    # reference's SyncReplicasOptimizer per-step synchronization
+    # (ssgd_monitor.py:136-142); a dead worker holds the barrier until its
+    # relaunch catches up, so recovery is deterministic, not racy
+    sync_epochs: bool = False
+    epoch_barrier_timeout_s: float = 300.0
 
 
 class Coordinator:
@@ -86,6 +93,8 @@ class Coordinator:
         self._next_index = 0
         self._lock = threading.RLock()
         self._start_barrier = threading.Event()
+        self._epoch_cond = threading.Condition(self._lock)
+        self._last_epoch: dict[int, int] = {}  # worker_index -> max epoch reported
         self._created_at = time.monotonic()
         self.failure_reason: str | None = None
         self.aggregator = EpochAggregator(
@@ -108,9 +117,11 @@ class Coordinator:
         )
 
     def _fail(self, reason: str) -> None:
-        self.state = JobState.FAILED
-        self.failure_reason = reason
-        self._start_barrier.set()  # release anyone waiting
+        with self._lock:
+            self.state = JobState.FAILED
+            self.failure_reason = reason
+            self._start_barrier.set()  # release anyone waiting
+            self._epoch_cond.notify_all()
 
     # ---- worker lifecycle (all called under the TCP handlers) ----
     def register(self, worker_id: str) -> dict[str, Any]:
@@ -150,6 +161,7 @@ class Coordinator:
                 "total_rows": self.spec.total_rows,
                 "epochs": self.spec.epochs,
                 "state": self.state.value,
+                "sync_epochs": self.spec.sync_epochs,
             }
 
     def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
@@ -185,7 +197,41 @@ class Coordinator:
     def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
         stats = EpochStats(**stats_dict)
         self.aggregator.report(stats)
+        with self._epoch_cond:
+            prev = self._last_epoch.get(stats.worker_index, -1)
+            self._last_epoch[stats.worker_index] = max(prev, stats.current_epoch)
+            self._epoch_cond.notify_all()
         return {"ok": True, "abort": self.state == JobState.FAILED}
+
+    def epoch_barrier(
+        self, worker_id: str, epoch: int, timeout_s: float | None = None
+    ) -> dict[str, Any]:
+        """Block until every worker index has reported ``epoch`` (or the job
+        reaches a terminal state).  A failed worker holds the barrier; its
+        relaunch re-reports the epoch and releases everyone — sync-SGD
+        semantics at epoch granularity."""
+        deadline = time.monotonic() + (
+            timeout_s
+            if timeout_s is not None
+            else self.spec.epoch_barrier_timeout_s
+        )
+        with self._epoch_cond:
+            while True:
+                if self.state == JobState.FAILED:
+                    return {"ok": False, "abort": True, "error": self.failure_reason}
+                if self.state == JobState.FINISHED:
+                    return {"ok": True, "state": self.state.value}
+                if all(
+                    self._last_epoch.get(i, -1) >= epoch
+                    for i in range(self.spec.n_workers)
+                ):
+                    return {"ok": True, "state": self.state.value}
+                if time.monotonic() >= deadline:
+                    return {
+                        "ok": False,
+                        "error": f"epoch barrier timeout (epoch {epoch})",
+                    }
+                self._epoch_cond.wait(timeout=0.2)
 
     def complete(self, worker_id: str, exit_code: int) -> dict[str, Any]:
         with self._lock:
@@ -208,6 +254,7 @@ class Coordinator:
                 # TensorflowApplicationMaster.java:373-376)
                 if rec.worker_index == 0 and self.state == JobState.TRAINING:
                     self.state = JobState.FINISHED
+                    self._epoch_cond.notify_all()
             return {"ok": True, "state": self.state.value}
 
     # ---- failure handling ----
@@ -291,6 +338,10 @@ class Coordinator:
             return self.heartbeat(msg["worker_id"])
         if op == "epoch":
             return self.report_epoch(msg["stats"])
+        if op == "epoch_barrier":
+            return self.epoch_barrier(
+                msg["worker_id"], int(msg["epoch"]), msg.get("timeout_s")
+            )
         if op == "complete":
             return self.complete(msg["worker_id"], int(msg.get("exit_code", 0)))
         if op == "status":
@@ -345,6 +396,13 @@ class CoordinatorClient:
 
     def report_epoch(self, stats: EpochStats) -> dict[str, Any]:
         return self.call({"op": "epoch", "stats": stats.__dict__})
+
+    def epoch_barrier(self, worker_id: str, epoch: int) -> dict[str, Any]:
+        # no socket timeout: the server enforces its own barrier deadline
+        return self.call(
+            {"op": "epoch_barrier", "worker_id": worker_id, "epoch": epoch},
+            timeout_s=None,
+        )
 
     def complete(self, worker_id: str, exit_code: int = 0) -> dict[str, Any]:
         return self.call(

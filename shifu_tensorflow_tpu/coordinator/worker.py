@@ -25,8 +25,8 @@ from shifu_tensorflow_tpu.config.model_config import ModelConfig
 from shifu_tensorflow_tpu.coordinator.coordinator import CoordinatorClient
 from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
 from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.train import make_trainer
 from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
-from shifu_tensorflow_tpu.train.trainer import Trainer
 
 
 @dataclass
@@ -85,6 +85,7 @@ def run_worker(cfg: WorkerConfig, *,
     worker_index = reg["worker_index"]
     shard_paths = reg["shard"]
     epochs = reg.get("epochs") or cfg.model_config.num_train_epochs
+    sync_epochs = bool(reg.get("sync_epochs", False))
 
     hb = _HeartbeatThread(client, cfg.worker_id, cfg.heartbeat_interval_s)
     hb.start()
@@ -106,7 +107,7 @@ def run_worker(cfg: WorkerConfig, *,
             from shifu_tensorflow_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(cfg.mesh_spec)
-        trainer = Trainer(
+        trainer = make_trainer(
             cfg.model_config,
             cfg.schema.num_features,
             mesh=mesh,
@@ -127,6 +128,12 @@ def run_worker(cfg: WorkerConfig, *,
             if fail_at_epoch is not None and stats.current_epoch >= fail_at_epoch:
                 raise _InjectedFault()
             client.report_epoch(stats)
+            if sync_epochs:
+                resp = client.epoch_barrier(cfg.worker_id, stats.current_epoch)
+                if resp.get("abort"):
+                    raise _JobAborted()
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error", "epoch barrier failed"))
 
         trainer.fit(
             dataset,

@@ -1,0 +1,23 @@
+"""Training engines.
+
+``make_trainer`` dispatches on ``train.params.Algorithm`` — the reference
+chose between its ssgd and SAGN programs by swapping the python script path
+in global-default.xml (global-default-bk.xml:234-237); here it is a typed
+config field.
+"""
+
+from __future__ import annotations
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+
+def make_trainer(model_config: ModelConfig, num_features: int, **kw) -> Trainer:
+    algo = model_config.params.algorithm
+    if algo == "sagn":
+        from shifu_tensorflow_tpu.train.sagn import SAGNTrainer
+
+        return SAGNTrainer(model_config, num_features, **kw)
+    if algo in ("ssgd", "sgd", ""):
+        return Trainer(model_config, num_features, **kw)
+    raise ValueError(f"unknown training algorithm {algo!r} (ssgd | sagn)")

@@ -22,21 +22,30 @@ import optax
 from shifu_tensorflow_tpu.config.model_config import TrainParams
 
 
-def make_optimizer(params: TrainParams) -> optax.GradientTransformation:
-    name = params.optimizer.lower()
-    lr = params.learning_rate
+def make_base_optimizer(
+    name: str, lr: float
+) -> optax.GradientTransformation:
+    """The inner optimizer, unwrapped — shared by the plain trainer, the
+    MultiSteps accumulation wrapper, and SAGN's local/global pair."""
+    name = name.lower()
     if name in ("adadelta",):
         # TF1 AdadeltaOptimizer defaults: rho=0.95, eps=1e-8
-        tx = optax.adadelta(learning_rate=lr, rho=0.95, eps=1e-8)
-    elif name in ("adam",):
-        tx = optax.adam(learning_rate=lr)
-    elif name in ("sgd", "gd", "gradientdescent"):
-        tx = optax.sgd(learning_rate=lr)
-    elif name in ("rmsprop",):
-        tx = optax.rmsprop(learning_rate=lr)
-    else:
-        raise ValueError(f"unknown optimizer {params.optimizer!r}")
+        return optax.adadelta(learning_rate=lr, rho=0.95, eps=1e-8)
+    if name in ("adam",):
+        return optax.adam(learning_rate=lr)
+    if name in ("sgd", "gd", "gradientdescent"):
+        return optax.sgd(learning_rate=lr)
+    if name in ("rmsprop",):
+        return optax.rmsprop(learning_rate=lr)
+    raise ValueError(f"unknown optimizer {name!r}")
 
-    if params.update_window > 1:
+
+def make_optimizer(params: TrainParams) -> optax.GradientTransformation:
+    tx = make_base_optimizer(params.optimizer, params.learning_rate)
+    if params.update_window > 1 and params.algorithm != "sagn":
+        # plain trainer: the window is optax-level gradient accumulation.
+        # SAGN handles the window inside its own step (local drifting
+        # iterates + one apply per window) — wrapping there would turn the
+        # per-window apply into a k-step no-op accumulation.
         tx = optax.MultiSteps(tx, every_k_schedule=params.update_window)
     return tx

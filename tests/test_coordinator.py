@@ -253,8 +253,12 @@ def test_submitter_recovers_injected_worker_fault(
     """A non-chief worker dies mid-job; the submitter relaunches it and the
     job completes — checkpoint-restart recovery semantics (SURVEY.md §5.3
     replacement)."""
+    # sync_epochs makes recovery deterministic: the chief holds at the
+    # epoch-0 barrier until the relaunched worker-1 catches up, so the job
+    # cannot finish before the failure is processed
     spec = make_job_spec(psv_dataset["root"], 2, epochs=2,
-                         registration_timeout_s=10.0, spare_restarts=1)
+                         registration_timeout_s=10.0, spare_restarts=1,
+                         sync_epochs=True, epoch_barrier_timeout_s=60.0)
     sub = JobSubmitter(
         spec,
         _worker_config_factory(psv_dataset, job_model_config, tmp_path),
@@ -263,6 +267,8 @@ def test_submitter_recovers_injected_worker_fault(
     result = sub.run(timeout_s=120.0)
     assert result.state == JobState.FINISHED, result.failure_reason
     assert result.restarts_used == 1
+    # with the barrier, every epoch reaches full quorum
+    assert [s.epoch for s in result.epoch_summaries] == [0, 1]
 
 
 def test_submitter_chief_fault_fails_job(psv_dataset, tmp_path, job_model_config):
