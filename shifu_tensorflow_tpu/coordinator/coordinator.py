@@ -90,7 +90,6 @@ class Coordinator:
         self.state = JobState.REGISTERING
         self.workers: dict[str, WorkerRecord] = {}
         self._by_index: dict[int, str] = {}
-        self._next_index = 0
         self._lock = threading.RLock()
         self._start_barrier = threading.Event()
         self._epoch_cond = threading.Condition(self._lock)
@@ -124,23 +123,49 @@ class Coordinator:
             self._epoch_cond.notify_all()
 
     # ---- worker lifecycle (all called under the TCP handlers) ----
-    def register(self, worker_id: str) -> dict[str, Any]:
+    def register(
+        self, worker_id: str, worker_index: int | None = None
+    ) -> dict[str, Any]:
+        """``worker_index`` pins the caller to a specific slot (the submitter
+        launches worker i with index i, so chief identity is deterministic,
+        not registration-order — unlike the reference, where backups/PS
+        re-derive indices by string-splitting the final cluster JSON,
+        TensorflowTaskExecutor.java:122-148).  Without a pin, the lowest
+        free index is assigned first-come."""
         with self._lock:
             if self.state == JobState.FAILED:
                 return {"ok": False, "error": self.failure_reason}
             rec = self.workers.get(worker_id)
             if rec is None:
-                if self._next_index >= self.spec.n_workers:
+                if len(self.workers) >= self.spec.n_workers:
                     return {"ok": False, "error": "cluster full"}
+                if worker_index is None:
+                    worker_index = min(
+                        i
+                        for i in range(self.spec.n_workers)
+                        if i not in self._by_index
+                    )
+                elif not 0 <= worker_index < self.spec.n_workers:
+                    return {
+                        "ok": False,
+                        "error": f"worker_index {worker_index} out of range",
+                    }
+                elif worker_index in self._by_index:
+                    return {
+                        "ok": False,
+                        "error": (
+                            f"worker_index {worker_index} already taken by "
+                            f"{self._by_index[worker_index]!r}"
+                        ),
+                    }
                 rec = WorkerRecord(
                     worker_id=worker_id,
-                    worker_index=self._next_index,
-                    shard_paths=tuple(self.spec.shards[self._next_index].paths),
+                    worker_index=worker_index,
+                    shard_paths=tuple(self.spec.shards[worker_index].paths),
                     registered_at=time.monotonic(),
                 )
                 self.workers[worker_id] = rec
                 self._by_index[rec.worker_index] = worker_id
-                self._next_index += 1
             else:
                 # sticky re-registration after restart: same index + shard
                 # (replaces the backup worker inheriting the failed worker's
@@ -227,9 +252,17 @@ class Coordinator:
                 ):
                     return {"ok": True, "state": self.state.value}
                 if time.monotonic() >= deadline:
+                    missing = [
+                        i
+                        for i in range(self.spec.n_workers)
+                        if self._last_epoch.get(i, -1) < epoch
+                    ]
                     return {
                         "ok": False,
-                        "error": f"epoch barrier timeout (epoch {epoch})",
+                        "error": (
+                            f"epoch barrier timeout for {worker_id!r} "
+                            f"(epoch {epoch}; workers missing: {missing})"
+                        ),
                     }
                 self._epoch_cond.wait(timeout=0.2)
 
@@ -331,7 +364,7 @@ class Coordinator:
     def dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
         op = msg.get("op")
         if op == "register":
-            return self.register(msg["worker_id"])
+            return self.register(msg["worker_id"], msg.get("worker_index"))
         if op == "await_start":
             return self.await_start(msg.get("timeout_s"))
         if op == "heartbeat":
@@ -381,8 +414,16 @@ class CoordinatorClient:
                 raise ConnectionError("coordinator closed connection")
             return json.loads(line)
 
-    def register(self, worker_id: str) -> dict[str, Any]:
-        return self.call({"op": "register", "worker_id": worker_id})
+    def register(
+        self, worker_id: str, worker_index: int | None = None
+    ) -> dict[str, Any]:
+        return self.call(
+            {
+                "op": "register",
+                "worker_id": worker_id,
+                "worker_index": worker_index,
+            }
+        )
 
     def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
         # no socket timeout: the server responds by its own registration

@@ -57,8 +57,12 @@ class JobSubmitter:
         self._threads: dict[str, threading.Thread] = {}
         self._launch_counts: dict[str, int] = {}
 
-    def _launch(self, worker_id: str, addr: tuple[str, int]) -> None:
+    def _launch(
+        self, worker_id: str, addr: tuple[str, int], index: int | None = None
+    ) -> None:
         cfg = self.make_worker_config(worker_id, addr)
+        if cfg.worker_index is None:
+            cfg.worker_index = index
         first_launch = self._launch_counts.get(worker_id, 0) == 0
         fail_at = self.fault_injections.get(worker_id) if first_launch else None
         self._launch_counts[worker_id] = self._launch_counts.get(worker_id, 0) + 1
@@ -74,8 +78,8 @@ class JobSubmitter:
         t0 = time.monotonic()
         addr = self.coordinator.serve()
         worker_ids = [f"worker-{i}" for i in range(self.spec.n_workers)]
-        for wid in worker_ids:
-            self._launch(wid, addr)
+        for i, wid in enumerate(worker_ids):
+            self._launch(wid, addr, index=i)
 
         relaunched: set[str] = set()
         try:

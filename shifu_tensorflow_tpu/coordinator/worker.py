@@ -36,6 +36,8 @@ class WorkerConfig:
     coordinator_port: int
     model_config: ModelConfig
     schema: RecordSchema
+    # pins this worker to a cluster slot; None lets the coordinator pick
+    worker_index: int | None = None
     batch_size: int = 100
     checkpoint_dir: str | None = None
     checkpoint_every_epochs: int = 1
@@ -79,7 +81,7 @@ def run_worker(cfg: WorkerConfig, *,
     CommonUtils.java:265-273): the worker aborts mid-job at that epoch.
     """
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
-    reg = client.register(cfg.worker_id)
+    reg = client.register(cfg.worker_id, cfg.worker_index)
     if not reg.get("ok"):
         return 1  # never registered; the coordinator doesn't know us
     worker_index = reg["worker_index"]

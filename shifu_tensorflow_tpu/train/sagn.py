@@ -69,13 +69,13 @@ def make_sagn_step(
     *,
     loss_name: str = "mse",
     l2: float = 0.0,
-    update_window: int = 5,
     mesh: jax.sharding.Mesh | None = None,
 ):
     """Build the jitted SAGN window step.
 
     Takes ``(state, window_batch)`` where window_batch leaves are
-    ``(K, B, ...)``; returns ``(state, mean_window_loss)``.
+    ``(K, B, ...)``; the window size K is whatever the stacked batch
+    carries.  Returns ``(state, mean_window_loss)``.
     """
     loss_fn = get_loss(loss_name)
 
@@ -187,7 +187,6 @@ class SAGNTrainer(Trainer):
             local_tx,
             loss_name=self.loss_name,
             l2=p.l2_reg,
-            update_window=self.update_window,
             mesh=self.mesh,
         )
         self._window_sharding = (

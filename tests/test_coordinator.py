@@ -119,6 +119,28 @@ def test_register_barrier_and_sticky_assignment():
         coord.shutdown()
 
 
+def test_register_pinned_index_is_deterministic():
+    """Chief identity must not depend on registration order: a worker that
+    pins index 0 gets it even when it registers last, and a conflicting pin
+    is rejected rather than silently reassigned."""
+    coord = Coordinator(_spec(3))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        r2 = c.register("w2", worker_index=2)
+        assert r2["ok"] and r2["worker_index"] == 2
+        # unpinned registration takes the lowest free slot (1 is still free)
+        ru = c.register("wu")
+        assert ru["ok"] and ru["worker_index"] == 0
+        r0 = c.register("w0", worker_index=1)
+        assert r0["ok"] and r0["worker_index"] == 1
+        # conflicting pin from a distinct worker is an error
+        assert not c.register("dup", worker_index=2)["ok"]
+        assert not c.register("oob", worker_index=3)["ok"]
+    finally:
+        coord.shutdown()
+
+
 def test_registration_timeout_fails_job():
     coord = Coordinator(_spec(2, registration_timeout_s=0.3))
     host, port = coord.serve()
