@@ -1,0 +1,281 @@
+// Native data plane: block parser for delimited (PSV) tabular shards.
+//
+// Replaces the hot half of the reference's load_data (ssgd_monitor.py:348-454
+// — per-row Python split/float loop) with a multi-threaded C++ parser the
+// Python layer calls through ctypes on buffers of decompressed shard bytes.
+// ctypes releases the GIL for the duration of the call, so parsing overlaps
+// with the training step and with other reader threads — the ingredient the
+// 1B-row streaming target needs (SURVEY.md §7.2 item 1).
+//
+// Contract mirrored from the Python fallback (data/reader.py):
+//   - a row is one delimiter-separated line; rows with too few columns or
+//     non-numeric wanted cells are dropped whole;
+//   - each kept row also carries crc32(line_bytes_incl_newline, salt), the
+//     deterministic train/valid routing hash (reader.split_train_valid);
+//   - negative weights / ZSCALE are applied by the (vectorized) numpy side.
+
+#include <algorithm>
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// Parse one float cell [p, end).  The accepted grammar is deliberately
+// exact — optional space/tab padding, optional sign, from_chars decimal
+// (digits, '.', exponent), or inf/infinity/nan — and the Python fallback
+// (reader._CELL_RE) enforces the identical grammar, so a row is kept or
+// dropped the same way regardless of which parser ran.  In particular no
+// strtof here: it accepts hex floats Python rejects.
+inline bool ieq(const char* p, const char* end, const char* lower) {
+  for (; *lower; ++p, ++lower) {
+    if (p >= end || (*p | 0x20) != *lower) return false;
+  }
+  return p == end;
+}
+
+inline bool parse_cell(const char* p, const char* end, float* out) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  while (end > p && (end[-1] == ' ' || end[-1] == '\t')) --end;
+  if (p >= end) return false;
+  bool neg = false;
+  if (*p == '+' || *p == '-') {
+    neg = (*p == '-');
+    ++p;
+    if (p >= end) return false;
+  }
+  if ((*p >= '0' && *p <= '9') || *p == '.') {
+    // digits-only path: from_chars never sees a sign or inf/nan spellings
+    auto res = std::from_chars(p, end, *out);
+    if (res.ec != std::errc() || res.ptr != end) return false;
+    if (neg) *out = -*out;
+    return true;
+  }
+  if (ieq(p, end, "inf") || ieq(p, end, "infinity")) {
+    *out = neg ? -HUGE_VALF : HUGE_VALF;
+    return true;
+  }
+  if (ieq(p, end, "nan")) {
+    *out = NAN;  // sign of NaN is unobservable downstream
+    return true;
+  }
+  return false;
+}
+
+struct Range {
+  const char* begin;
+  const char* end;
+  float* out;          // slab: cap_rows * n_wanted
+  unsigned* out_hash;  // slab: cap_rows (may be null)
+  long cap_rows;
+  long produced = 0;
+};
+
+void parse_range(const Range& r, char delim, const int* slot_of_col,
+                 int max_col, int n_wanted, unsigned salt) {
+  const char* p = r.begin;
+  float* out = r.out;
+  unsigned* oh = r.out_hash;
+  long rows = 0;
+  while (p < r.end && rows < r.cap_rows) {
+    const char* line_start = p;
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(r.end - p)));
+    const char* line_end_incl = nl ? nl + 1 : r.end;  // hash includes '\n'
+    const char* content_end = nl ? nl : r.end;
+    // strip all trailing '\r' from content (but not from the hash) — the
+    // Python path's rstrip(b"\r\n") removes every trailing CR
+    while (content_end > line_start && content_end[-1] == '\r') --content_end;
+    p = line_end_incl;
+
+    // hop cell to cell with memchr (SIMD-backed) rather than scanning
+    // char-by-char; parse straight into the output slab — a bad row simply
+    // doesn't advance `rows`, so partial writes are overwritten
+    float* row = out + rows * n_wanted;
+    int filled = 0, col = 0;
+    bool bad = false;
+    const char* cell = line_start;
+    while (true) {
+      const char* q = static_cast<const char*>(
+          std::memchr(cell, delim, static_cast<size_t>(content_end - cell)));
+      const char* cend = q ? q : content_end;
+      if (col <= max_col) {
+        int slot = slot_of_col[col];
+        if (slot >= 0) {
+          if (!parse_cell(cell, cend, row + slot)) {
+            bad = true;
+            break;
+          }
+          ++filled;
+        }
+      }
+      ++col;
+      if (!q) break;
+      cell = q + 1;
+      if (col > max_col) {
+        // remaining cells are unwanted; count them for the column check
+        const char* rest = cell;
+        while ((rest = static_cast<const char*>(std::memchr(
+                    rest, delim,
+                    static_cast<size_t>(content_end - rest)))) != nullptr) {
+          ++col;
+          ++rest;
+        }
+        ++col;  // the final cell after the last delimiter
+        break;
+      }
+    }
+    // a row must reach past max_col: columns found = col; the Python path
+    // requires len(cols) > max_col (reader.parse_block)
+    if (bad || filled != n_wanted || col <= max_col) continue;
+    if (oh) {
+      oh[rows] = static_cast<unsigned>(
+          crc32(salt, reinterpret_cast<const Bytef*>(line_start),
+                static_cast<uInt>(line_end_incl - line_start)));
+    }
+    ++rows;
+  }
+  const_cast<Range&>(r).produced = rows;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count lines in buf (a trailing unterminated line counts).
+long stpu_count_lines(const char* buf, long len) {
+  long n = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!nl) {
+      ++n;
+      break;
+    }
+    ++n;
+    p = nl + 1;
+  }
+  return n;
+}
+
+// Parse a text buffer of delimited rows into a row-major float32 matrix.
+//   wanted:   column indices to extract, output order (features..., target,
+//             [weight]); duplicates allowed.
+//   out:      cap_rows * n_wanted floats.
+//   out_hash: cap_rows crc32 routing hashes (nullptr to skip).
+//   n_threads <= 1 parses serially.
+// Returns rows produced, or -1 on argument errors.
+long stpu_parse_buffer(const char* buf, long len, char delim,
+                       const int* wanted, int n_wanted, unsigned salt,
+                       float* out, unsigned* out_hash, long cap_rows,
+                       int n_threads) {
+  if (!buf || len < 0 || !wanted || n_wanted <= 0 || !out || cap_rows < 0)
+    return -1;
+  int max_col = 0;
+  for (int i = 0; i < n_wanted; ++i) max_col = std::max(max_col, wanted[i]);
+  // slot_of_col[c] = output slot for column c (last wins for duplicates;
+  // duplicate wanted columns get copied below)
+  std::vector<int> slot_of_col(static_cast<size_t>(max_col) + 1, -1);
+  bool dups = false;
+  for (int i = 0; i < n_wanted; ++i) {
+    if (slot_of_col[static_cast<size_t>(wanted[i])] >= 0) dups = true;
+    slot_of_col[static_cast<size_t>(wanted[i])] = i;
+  }
+  if (dups) return -2;  // caller falls back to the Python path
+
+  long n_lines = stpu_count_lines(buf, len);
+  if (n_lines == 0 || cap_rows == 0) return 0;
+
+  int nt = std::max(1, n_threads);
+  nt = static_cast<int>(std::min<long>(nt, (n_lines + 4095) / 4096));
+  if (nt <= 1) {
+    Range r{buf, buf + len, out, out_hash, cap_rows};
+    parse_range(r, delim, slot_of_col.data(), max_col, n_wanted, salt);
+    return r.produced;
+  }
+
+  // split the buffer into nt line-aligned chunks; each thread fills its own
+  // slab of the output (ranges can only shrink, never grow), then compact.
+  std::vector<Range> ranges;
+  const char* p = buf;
+  const char* end = buf + len;
+  long lines_per = (n_lines + nt - 1) / nt;
+  long rows_offset = 0;
+  while (p < end && static_cast<long>(ranges.size()) < nt) {
+    const char* q = p;
+    long seen = 0;
+    while (q < end && seen < lines_per) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(q, '\n', static_cast<size_t>(end - q)));
+      if (!nl) {
+        q = end;
+        ++seen;
+        break;
+      }
+      q = nl + 1;
+      ++seen;
+    }
+    long cap = std::min(seen, cap_rows - rows_offset);
+    if (cap <= 0) break;
+    ranges.push_back(Range{p, q, out + rows_offset * n_wanted,
+                           out_hash ? out_hash + rows_offset : nullptr, cap});
+    rows_offset += cap;
+    p = q;
+  }
+
+  std::vector<std::thread> threads;
+  threads.reserve(ranges.size());
+  for (auto& r : ranges) {
+    threads.emplace_back([&r, delim, &slot_of_col, max_col, n_wanted, salt] {
+      parse_range(r, delim, slot_of_col.data(), max_col, n_wanted, salt);
+    });
+  }
+  for (auto& t : threads) t.join();
+
+  // compact dropped-row holes between slabs
+  long total = ranges.empty() ? 0 : ranges[0].produced;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    const Range& r = ranges[i];
+    if (r.produced == 0) continue;
+    float* dst = out + total * n_wanted;
+    if (dst != r.out) {
+      std::memmove(dst, r.out,
+                   sizeof(float) * static_cast<size_t>(r.produced) *
+                       static_cast<size_t>(n_wanted));
+      if (out_hash && r.out_hash) {
+        std::memmove(out_hash + total, r.out_hash,
+                     sizeof(unsigned) * static_cast<size_t>(r.produced));
+      }
+    }
+    total += r.produced;
+  }
+  return total;
+}
+
+// crc32 of each line (incl. its newline) in buf — the routing hash alone,
+// for callers that only need the split.
+long stpu_line_hashes(const char* buf, long len, unsigned salt,
+                      unsigned* out_hash, long cap) {
+  if (!buf || len < 0 || !out_hash) return -1;
+  const char* p = buf;
+  const char* end = buf + len;
+  long n = 0;
+  while (p < end && n < cap) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* stop = nl ? nl + 1 : end;
+    out_hash[n++] = static_cast<unsigned>(crc32(
+        salt, reinterpret_cast<const Bytef*>(p), static_cast<uInt>(stop - p)));
+    p = stop;
+  }
+  return n;
+}
+
+}  // extern "C"

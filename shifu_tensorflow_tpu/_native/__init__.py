@@ -1,0 +1,52 @@
+"""Lazy loader for the native (C++) pieces.
+
+The shared objects are built by ``make -C cpp`` into this directory.  If a
+library is missing, the loader attempts one quiet in-tree build, then gives
+up and returns None — callers keep their pure-Python fallback, so the
+framework works (slower) without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(_DIR)), "cpp")
+
+_lock = threading.Lock()
+_cache: dict[str, "ctypes.CDLL | None"] = {}
+
+
+def _try_build() -> None:
+    if not os.path.isdir(_CPP_DIR):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", _CPP_DIR],
+            capture_output=True,
+            timeout=120,
+            check=False,
+        )
+    except Exception:
+        pass
+
+
+def load(name: str) -> "ctypes.CDLL | None":
+    """Load ``lib<name>.so`` from this directory, building once if absent."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        path = os.path.join(_DIR, f"lib{name}.so")
+        if not os.path.exists(path):
+            _try_build()
+        lib: "ctypes.CDLL | None" = None
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                lib = None
+        _cache[name] = lib
+        return lib

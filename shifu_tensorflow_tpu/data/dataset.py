@@ -25,7 +25,11 @@ from typing import Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
-from shifu_tensorflow_tpu.data.reader import ParsedBlock, RecordSchema, parse_block, split_train_valid
+from shifu_tensorflow_tpu.data.reader import (
+    ParsedBlock,
+    RecordSchema,
+    parse_buffer_split,
+)
 from shifu_tensorflow_tpu.utils import fs
 
 Batch = dict[str, np.ndarray]  # {"x": (B,F), "y": (B,1), "w": (B,1)}
@@ -85,10 +89,11 @@ class InMemoryDataset:
     ) -> "InMemoryDataset":
         train_blocks, valid_blocks = [], []
         for path in paths:
-            lines = list(fs.iter_lines(path))
-            tr, va = split_train_valid(lines, valid_rate, salt)
-            train_blocks.append(parse_block(tr, schema))
-            valid_blocks.append(parse_block(va, schema))
+            with fs.open_maybe_gzip(path) as f:
+                buf = f.read()
+            tr, va = parse_buffer_split(buf, schema, valid_rate, salt)
+            train_blocks.append(tr)
+            valid_blocks.append(va)
         if not train_blocks:
             empty = ParsedBlock.empty(schema.num_features)
             return cls(empty, empty, schema)
@@ -124,7 +129,7 @@ class ShardStream:
         *,
         valid_rate: float = 0.0,
         emit: str = "train",  # which side of the split to emit
-        block_lines: int = 65536,
+        block_bytes: int = 4 << 20,
         queue_depth: int = 8,
         drop_remainder: bool = False,
         salt: int = 0,
@@ -134,7 +139,7 @@ class ShardStream:
         self.batch_size = batch_size
         self.valid_rate = valid_rate
         self.emit = emit
-        self.block_lines = block_lines
+        self.block_bytes = block_bytes
         self.queue_depth = queue_depth
         self.drop_remainder = drop_remainder
         self.salt = salt
@@ -155,16 +160,26 @@ class ShardStream:
         carry = ParsedBlock.empty(self.schema.num_features)
         try:
             for path in self.paths:
-                block: list[bytes] = []
-                for line in fs.iter_lines(path):
-                    block.append(line)
-                    if len(block) >= self.block_lines:
-                        carry = self._emit_batches(q, stop, carry, block)
-                        block = []
+                # read decompressed bytes in large blocks, cut at the last
+                # newline, and hand whole buffers to the (native) block
+                # parser — no per-line Python work on the hot path
+                tail = b""
+                with fs.open_maybe_gzip(path) as f:
+                    while True:
+                        chunk = f.read(self.block_bytes)
+                        if not chunk:
+                            break
+                        data = tail + chunk
+                        cut = data.rfind(b"\n")
+                        if cut < 0:
+                            tail = data
+                            continue
+                        carry = self._emit_batches(q, stop, carry, data[: cut + 1])
+                        tail = data[cut + 1 :]
                         if stop.is_set():
                             return
-                if block:
-                    carry = self._emit_batches(q, stop, carry, block)
+                if tail:
+                    carry = self._emit_batches(q, stop, carry, tail)
                 if stop.is_set():
                     return
             # flush the tail
@@ -182,9 +197,9 @@ class ShardStream:
         except Exception as e:  # surface reader errors to the consumer
             self._put_or_stop(q, stop, e)
 
-    def _emit_batches(self, q, stop, carry: ParsedBlock, lines: list[bytes]) -> ParsedBlock:
-        tr, va = split_train_valid(lines, self.valid_rate, self.salt)
-        parsed = parse_block(tr if self.emit == "train" else va, self.schema)
+    def _emit_batches(self, q, stop, carry: ParsedBlock, buf: bytes) -> ParsedBlock:
+        tr, va = parse_buffer_split(buf, self.schema, self.valid_rate, self.salt)
+        parsed = tr if self.emit == "train" else va
         merged = ParsedBlock.concat([carry, parsed]) if len(carry) else parsed
         n_full = (len(merged) // self.batch_size) * self.batch_size
         for i in range(0, n_full, self.batch_size):

@@ -1,0 +1,100 @@
+"""ctypes bindings for the native block parser (cpp/stpu_data.cc).
+
+``parse_buffer`` is the fast path under ``reader.parse_buffer_split``: one
+call parses a multi-megabyte block of decompressed shard bytes into float32
+arrays plus per-row crc32 routing hashes, with the GIL released — the
+Python fallback does the same work row-by-row in the interpreter.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from shifu_tensorflow_tpu import _native
+
+_lib = None
+_checked = False
+
+
+def _load():
+    global _lib, _checked
+    if not _checked:
+        lib = _native.load("stpu_data")
+        if lib is not None:
+            try:
+                lib.stpu_parse_buffer.restype = ctypes.c_long
+                lib.stpu_parse_buffer.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                    ctypes.c_char,
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.c_int,
+                    ctypes.c_uint,
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_uint),
+                    ctypes.c_long,
+                    ctypes.c_int,
+                ]
+                lib.stpu_count_lines.restype = ctypes.c_long
+                lib.stpu_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_long]
+            except AttributeError:
+                lib = None
+        _lib = lib
+        _checked = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_buffer(
+    buf: bytes,
+    wanted_columns: tuple[int, ...],
+    delimiter: str,
+    *,
+    salt: int = 0,
+    want_hashes: bool = True,
+    n_threads: int | None = None,
+) -> "tuple[np.ndarray, np.ndarray | None] | None":
+    """Parse delimited text ``buf`` into ``(rows x len(wanted_columns))``
+    float32 plus per-row routing hashes.  Returns None when the native
+    library is unavailable or declines (e.g. duplicate wanted columns) —
+    caller falls back to Python."""
+    lib = _load()
+    if lib is None or len(delimiter) != 1:
+        return None
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+
+    n_wanted = len(wanted_columns)
+    cap = int(lib.stpu_count_lines(buf, len(buf)))
+    if cap == 0:
+        out = np.empty((0, n_wanted), np.float32)
+        return out, (np.empty((0,), np.uint32) if want_hashes else None)
+
+    out = np.empty((cap, n_wanted), np.float32)
+    hashes = np.empty((cap,), np.uint32) if want_hashes else None
+    cols = (ctypes.c_int * n_wanted)(*wanted_columns)
+    n = lib.stpu_parse_buffer(
+        buf,
+        len(buf),
+        delimiter.encode()[0:1],
+        cols,
+        n_wanted,
+        ctypes.c_uint(salt & 0xFFFFFFFF),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        (
+            hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint))
+            if hashes is not None
+            else None
+        ),
+        cap,
+        n_threads,
+    )
+    if n < 0:
+        return None
+    return out[:n], (hashes[:n] if hashes is not None else None)

@@ -20,10 +20,21 @@ Differences by design:
 
 from __future__ import annotations
 
+import re
 import zlib
 from dataclasses import dataclass, field
 
 import numpy as np
+
+# The one cell grammar both parsers accept: optional space/tab padding,
+# optional sign, decimal float (digits/'.'/exponent) or inf/infinity/nan.
+# Mirrors parse_cell in cpp/stpu_data.cc exactly; stricter than Python's
+# float() (which also takes hex-adjacent spellings like '1_0' underscores
+# and unicode digits) so row acceptance cannot depend on which parser ran.
+_CELL_RE = re.compile(
+    rb"^[ \t]*[+-]?((\d+\.?\d*|\.\d+)(e[+-]?\d+)?|inf(inity)?|nan)[ \t]*$",
+    re.IGNORECASE,
+)
 
 
 @dataclass(frozen=True)
@@ -83,6 +94,10 @@ class ParsedBlock:
         )
 
 
+def _reject():
+    raise ValueError("cell outside the shared parser grammar")
+
+
 def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
     """Parse a block of raw delimited lines into arrays.
 
@@ -94,9 +109,7 @@ def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
         return ParsedBlock.empty(schema.num_features)
 
     delim = schema.delimiter.encode()
-    wanted = list(schema.feature_columns) + [schema.target_column]
-    if schema.weight_column >= 0:
-        wanted.append(schema.weight_column)
+    wanted = wanted_columns(schema)
     max_col = max(wanted)
 
     rows: list[list[float]] = []
@@ -105,17 +118,27 @@ def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
         if len(cols) <= max_col:
             continue
         try:
-            rows.append([float(cols[c]) for c in wanted])
+            rows.append(
+                [
+                    float(cols[c]) if _CELL_RE.match(cols[c]) else _reject()
+                    for c in wanted
+                ]
+            )
         except ValueError:
             continue
 
     if not rows:
         return ParsedBlock.empty(schema.num_features)
 
-    arr = np.asarray(rows, dtype=np.float32)
+    return _finalize(np.asarray(rows, dtype=np.float32), schema)
+
+
+def _finalize(arr: np.ndarray, schema: RecordSchema) -> ParsedBlock:
+    """(n, F+1[+1]) wanted-column matrix -> ParsedBlock with the weight
+    clamp and optional ZSCALE applied."""
     nf = schema.num_features
     feats = arr[:, :nf]
-    targets = arr[:, nf : nf + 1]
+    targets = np.ascontiguousarray(arr[:, nf : nf + 1])
     if schema.weight_column >= 0:
         weights = arr[:, nf + 1 : nf + 2].copy()
         # negative weights clamped to 1.0 (parity: ssgd_monitor.py:412-415)
@@ -130,6 +153,61 @@ def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
         feats = (feats - mu) / sd
 
     return ParsedBlock(np.ascontiguousarray(feats), targets, weights)
+
+
+def wanted_columns(schema: RecordSchema) -> tuple[int, ...]:
+    """Column extraction order shared by the Python and native parsers."""
+    wanted = list(schema.feature_columns) + [schema.target_column]
+    if schema.weight_column >= 0:
+        wanted.append(schema.weight_column)
+    return tuple(wanted)
+
+
+def parse_buffer_split(
+    buf: bytes,
+    schema: RecordSchema,
+    valid_rate: float,
+    salt: int = 0,
+) -> tuple[ParsedBlock, ParsedBlock]:
+    """Parse a block of decompressed shard bytes and route rows into
+    (train, valid) by the deterministic crc32 hash.
+
+    The native path (cpp/stpu_data.cc via data.native) parses the whole
+    buffer with the GIL released and returns per-row hashes; the fallback
+    splits lines in Python and reuses ``parse_block``.  Both route by
+    crc32 of the raw line bytes (newline included), so the split membership
+    is identical regardless of which path ran.
+    """
+    from shifu_tensorflow_tpu.data import native
+
+    parsed = native.parse_buffer(
+        buf,
+        wanted_columns(schema),
+        schema.delimiter,
+        salt=salt,
+        want_hashes=valid_rate > 0.0,
+    )
+    if parsed is not None:
+        arr, hashes = parsed
+        if valid_rate <= 0.0 or hashes is None:
+            return _finalize(arr, schema), ParsedBlock.empty(schema.num_features)
+        threshold = np.uint64(int(valid_rate * 0x100000000))
+        is_valid = hashes.astype(np.uint64) < threshold
+        return (
+            _finalize(arr[~is_valid], schema),
+            _finalize(arr[is_valid], schema),
+        )
+
+    # split strictly on '\n' (keeping it), matching file iteration — unlike
+    # bytes.splitlines, which also breaks on \r/\v/\f and would change both
+    # row boundaries and routing hashes
+    lines = [chunk + b"\n" for chunk in buf.split(b"\n")]
+    if lines:
+        lines[-1] = lines[-1][:-1]  # last line keeps no invented newline
+        if not lines[-1]:
+            lines.pop()
+    tr, va = split_train_valid(lines, valid_rate, salt)
+    return parse_block(tr, schema), parse_block(va, schema)
 
 
 def split_train_valid(

@@ -177,7 +177,7 @@ def test_shard_stream_matches_in_memory(psv_dataset):
     ds = InMemoryDataset.load(psv_dataset["paths"], schema, valid_rate=0.2)
     stream = ShardStream(
         psv_dataset["paths"], schema, batch_size=32, valid_rate=0.2,
-        block_lines=100,
+        block_bytes=1024,
     )
     rows = sum(int(b["w"].sum() > 0) * int((b["w"] > 0).sum()) for b in stream)
     assert rows == len(ds.train)  # same rows stream as load (weights>0 = real)
@@ -211,7 +211,7 @@ def test_shard_stream_abandoned_consumer_unblocks(psv_dataset):
 
     schema = _schema(psv_dataset)
     stream = ShardStream(psv_dataset["paths"], schema, batch_size=8,
-                         queue_depth=2, block_lines=32)
+                         queue_depth=2, block_bytes=256)
     it = iter(stream)
     next(it)  # start the producer, then abandon the iterator
     it.close()

@@ -1,0 +1,187 @@
+"""Native block parser (cpp/stpu_data.cc) — parity with the Python path.
+
+The contract under test: for any input buffer, the native parse + hash
+routing must produce byte-identical train/valid membership and float-equal
+parsed values to the pure-Python fallback (reader.parse_block +
+reader.split_train_valid), because a worker may run either path depending
+on toolchain availability and both must resume into the same split.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.data import native
+from shifu_tensorflow_tpu.data.reader import (
+    ParsedBlock,
+    RecordSchema,
+    parse_block,
+    parse_buffer_split,
+    split_train_valid,
+    wanted_columns,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+SCHEMA = RecordSchema(
+    feature_columns=(1, 2, 3), target_column=0, weight_column=4
+)
+
+
+def _python_reference(buf: bytes, schema, valid_rate, salt=0):
+    lines = [c + b"\n" for c in buf.split(b"\n")]
+    lines[-1] = lines[-1][:-1]
+    if not lines[-1]:
+        lines.pop()
+    tr, va = split_train_valid(lines, valid_rate, salt)
+    return parse_block(tr, schema), parse_block(va, schema)
+
+
+def _assert_blocks_equal(a: ParsedBlock, b: ParsedBlock):
+    np.testing.assert_array_equal(a.features, b.features)
+    np.testing.assert_array_equal(a.targets, b.targets)
+    np.testing.assert_array_equal(a.weights, b.weights)
+
+
+@needs_native
+def test_native_available():
+    assert native.available()
+
+
+@needs_native
+@pytest.mark.parametrize("valid_rate", [0.0, 0.3, 1.0])
+def test_parity_clean_input(valid_rate):
+    rng = np.random.default_rng(7)
+    rows = []
+    for _ in range(500):
+        vals = rng.normal(size=5)
+        rows.append("|".join(f"{v:.6f}" for v in vals))
+    buf = ("\n".join(rows) + "\n").encode()
+    tr_n, va_n = parse_buffer_split(buf, SCHEMA, valid_rate, salt=3)
+    tr_p, va_p = _python_reference(buf, SCHEMA, valid_rate, salt=3)
+    _assert_blocks_equal(tr_n, tr_p)
+    _assert_blocks_equal(va_n, va_p)
+    assert len(tr_n) + len(va_n) == 500
+
+
+@needs_native
+def test_parity_adversarial_rows():
+    buf = b"".join(
+        [
+            b"1|2|3|4|5\n",  # ok
+            b"\n",  # blank -> dropped
+            b"1|2|3\n",  # too few columns -> dropped
+            b"1|x|3|4|5\n",  # non-numeric wanted cell -> dropped
+            b"0|-1.5|2e3|.5|-2\n",  # negative weight -> clamped to 1.0
+            b"1| 2 |3|4|5\r\n",  # spaces + CRLF -> ok
+            b"1|2|3|4|5|6|7\n",  # extra columns -> ok
+            b"nan|inf|-inf|1|1\n",  # nan/inf spellings float() accepts
+            b"1|+2|3.|4|5",  # plus sign, trailing dot, no trailing newline
+        ]
+    )
+    for rate in (0.0, 0.5):
+        tr_n, va_n = parse_buffer_split(buf, SCHEMA, rate, salt=1)
+        tr_p, va_p = _python_reference(buf, SCHEMA, rate, salt=1)
+        _assert_blocks_equal(tr_n, tr_p)
+        _assert_blocks_equal(va_n, va_p)
+    # sanity on the content itself (rate 0 -> all rows in train): the ok,
+    # clamped-weight, CRLF, extra-column, nan/inf, and no-newline rows
+    tr, _ = parse_buffer_split(buf, SCHEMA, 0.0)
+    assert len(tr) == 6
+    assert tr.weights.min() >= 0.0  # clamp applied
+
+
+@needs_native
+def test_parity_routing_hash_is_crc32_of_line_bytes():
+    lines = [b"0|1|2|3|4\n", b"1|5|6|7|8\n"]
+    buf = b"".join(lines)
+    arr, hashes = native.parse_buffer(
+        buf, wanted_columns(SCHEMA), "|", salt=9, want_hashes=True
+    )
+    assert arr.shape == (2, 5)
+    expect = [zlib.crc32(l, 9) & 0xFFFFFFFF for l in lines]
+    assert list(hashes) == expect
+
+
+@needs_native
+def test_parity_zscale_and_no_weight_column():
+    schema = RecordSchema(
+        feature_columns=(1, 2), target_column=0
+    ).with_zscale([1.0, -2.0], [2.0, 0.0])  # zero std -> treated as 1.0
+    buf = b"1|3|4\n0|5|6\n"
+    tr_n, _ = parse_buffer_split(buf, schema, 0.0)
+    tr_p = parse_block([b"1|3|4\n", b"0|5|6\n"], schema)
+    _assert_blocks_equal(tr_n, tr_p)
+    np.testing.assert_allclose(tr_n.features[0], [(3 - 1) / 2, 4 + 2])
+    assert tr_n.weights.flatten().tolist() == [1.0, 1.0]
+
+
+@needs_native
+def test_multithreaded_parse_matches_serial():
+    rng = np.random.default_rng(11)
+    rows = []
+    for i in range(20000):
+        vals = rng.normal(size=5)
+        row = "|".join(f"{v:.4f}" for v in vals)
+        if i % 997 == 0:
+            row = "bad|row"  # scattered bad rows exercise hole compaction
+        rows.append(row)
+    buf = ("\n".join(rows) + "\n").encode()
+    cols = wanted_columns(SCHEMA)
+    serial = native.parse_buffer(buf, cols, "|", salt=5, n_threads=1)
+    threaded = native.parse_buffer(buf, cols, "|", salt=5, n_threads=8)
+    assert serial is not None and threaded is not None
+    np.testing.assert_array_equal(serial[0], threaded[0])
+    np.testing.assert_array_equal(serial[1], threaded[1])
+
+
+@needs_native
+def test_duplicate_wanted_columns_fall_back():
+    schema = RecordSchema(feature_columns=(1, 1), target_column=0)
+    # native declines duplicates (returns None) and the wrapper falls back —
+    # parse_buffer_split must still produce the right duplicated values
+    assert native.parse_buffer(b"1|2\n", wanted_columns(schema), "|") is None
+    tr, _ = parse_buffer_split(b"1|2\n", schema, 0.0)
+    assert tr.features.tolist() == [[2.0, 2.0]]
+
+
+def test_parse_buffer_split_python_fallback(monkeypatch):
+    """With the native library masked off the same API must work."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_checked", True)
+    buf = b"1|2|3|4|5\n0|6|7|8|-1\n"
+    tr, va = parse_buffer_split(buf, SCHEMA, 0.0)
+    assert len(tr) == 2 and len(va) == 0
+    assert tr.weights.flatten().tolist() == [5.0, 1.0]
+
+
+@needs_native
+@pytest.mark.parametrize("valid_rate", [0.0, 0.5])
+def test_grammar_divergence_cells_agree_across_paths(monkeypatch, valid_rate):
+    """Cells where C's strtof-family and Python's float() historically
+    disagree: hex floats ('0x1p3'), underscore literals ('1_0'), 'nan(tag)',
+    multiple trailing CRs, unicode digits.  Both parsers must keep/drop the
+    SAME rows with the SAME values — the shared grammar is the contract."""
+    buf = b"".join(
+        [
+            b"0x1p3|2|3|4|5\n",  # hex float: rejected by both
+            b"1_0|2|3|4|5\n",  # underscore literal: rejected by both
+            b"nan(tag)|2|3|4|5\n",  # nan with payload: rejected by both
+            b"1|2|3|4|5\r\r\n",  # multiple trailing CRs: kept by both
+            "１|2|3|4|5\n".encode(),  # unicode digit: rejected by both
+            b"-inf|INFINITY|nan|1|1\n",  # spellings accepted by both
+            b"+.5|1.|2e3|4|5\n",  # sign/edge decimals accepted by both
+        ]
+    )
+    tr_native, va_native = parse_buffer_split(buf, SCHEMA, valid_rate, salt=2)
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_checked", True)
+    tr_py, va_py = parse_buffer_split(buf, SCHEMA, valid_rate, salt=2)
+
+    _assert_blocks_equal(tr_native, tr_py)
+    _assert_blocks_equal(va_native, va_py)
+    assert len(tr_native) + len(va_native) == 3
