@@ -39,6 +39,47 @@ inline bool ieq(const char* p, const char* end, const char* lower) {
   return p == end;
 }
 
+// Decide overflow vs underflow for a decimal from_chars flagged
+// out-of-range: returns true when the value's magnitude is huge.  Computes
+// the decimal exponent of the first significant digit; out-of-range doubles
+// sit at |exp| ≥ ~300, so the sign is unambiguous.
+inline bool decimal_is_huge(const char* p, const char* end) {
+  constexpr long kCap = 1000000000;
+  long exp = 0;
+  const char* mant_end = end;
+  for (const char* q = p; q < end; ++q) {
+    if ((*q | 0x20) == 'e') {
+      mant_end = q;
+      ++q;
+      bool eneg = false;
+      if (q < end && (*q == '+' || *q == '-')) {
+        eneg = (*q == '-');
+        ++q;
+      }
+      for (; q < end; ++q)
+        if (exp < kCap) exp = exp * 10 + (*q - '0');
+      if (eneg) exp = -exp;
+      break;
+    }
+  }
+  bool seen_point = false, seen_sig = false;
+  long int_digits = 0, frac_zeros = 0;
+  for (const char* q = p; q < mant_end; ++q) {
+    if (*q == '.') {
+      seen_point = true;
+      continue;
+    }
+    if (!seen_sig && *q == '0') {
+      if (seen_point && frac_zeros < kCap) ++frac_zeros;
+      continue;
+    }
+    seen_sig = true;
+    if (!seen_point && int_digits < kCap) ++int_digits;
+  }
+  long mag = exp + (int_digits > 0 ? int_digits - 1 : -(frac_zeros + 1));
+  return mag >= 0;
+}
+
 inline bool parse_cell(const char* p, const char* end, float* out) {
   while (p < end && (*p == ' ' || *p == '\t')) ++p;
   while (end > p && (end[-1] == ' ' || end[-1] == '\t')) --end;
@@ -50,9 +91,21 @@ inline bool parse_cell(const char* p, const char* end, float* out) {
     if (p >= end) return false;
   }
   if ((*p >= '0' && *p <= '9') || *p == '.') {
-    // digits-only path: from_chars never sees a sign or inf/nan spellings
-    auto res = std::from_chars(p, end, *out);
-    if (res.ec != std::errc() || res.ptr != end) return false;
+    // digits-only path: from_chars never sees a sign or inf/nan spellings.
+    // Parse as double then narrow — the Python path is float() (a double)
+    // followed by a float32 cast, so parsing straight to float would both
+    // double-round differently and reject float32-range overflows
+    // ('4e38') the Python path keeps as ±inf.
+    double d;
+    auto res = std::from_chars(p, end, d);
+    if (res.ptr != end) return false;
+    if (res.ec == std::errc::result_out_of_range) {
+      // float() parity: overflow → ±inf, underflow → 0.0
+      d = decimal_is_huge(p, end) ? HUGE_VAL : 0.0;
+    } else if (res.ec != std::errc()) {
+      return false;
+    }
+    *out = static_cast<float>(d);
     if (neg) *out = -*out;
     return true;
   }
@@ -171,15 +224,21 @@ long stpu_count_lines(const char* buf, long len) {
 //   out:      cap_rows * n_wanted floats.
 //   out_hash: cap_rows crc32 routing hashes (nullptr to skip).
 //   n_threads <= 1 parses serially.
+//   n_lines:  line count of buf if the caller already knows it (callers size
+//             cap_rows with stpu_count_lines); <= 0 recounts here.
 // Returns rows produced, or -1 on argument errors.
 long stpu_parse_buffer(const char* buf, long len, char delim,
                        const int* wanted, int n_wanted, unsigned salt,
                        float* out, unsigned* out_hash, long cap_rows,
-                       int n_threads) {
+                       int n_threads, long n_lines) {
   if (!buf || len < 0 || !wanted || n_wanted <= 0 || !out || cap_rows < 0)
     return -1;
   int max_col = 0;
-  for (int i = 0; i < n_wanted; ++i) max_col = std::max(max_col, wanted[i]);
+  for (int i = 0; i < n_wanted; ++i) {
+    if (wanted[i] < 0) return -1;  // Python-side negative indexing never
+                                   // reaches here; guard the raw ABI anyway
+    max_col = std::max(max_col, wanted[i]);
+  }
   // slot_of_col[c] = output slot for column c (last wins for duplicates;
   // duplicate wanted columns get copied below)
   std::vector<int> slot_of_col(static_cast<size_t>(max_col) + 1, -1);
@@ -190,7 +249,7 @@ long stpu_parse_buffer(const char* buf, long len, char delim,
   }
   if (dups) return -2;  // caller falls back to the Python path
 
-  long n_lines = stpu_count_lines(buf, len);
+  if (n_lines <= 0) n_lines = stpu_count_lines(buf, len);
   if (n_lines == 0 || cap_rows == 0) return 0;
 
   int nt = std::max(1, n_threads);
@@ -257,25 +316,6 @@ long stpu_parse_buffer(const char* buf, long len, char delim,
     total += r.produced;
   }
   return total;
-}
-
-// crc32 of each line (incl. its newline) in buf — the routing hash alone,
-// for callers that only need the split.
-long stpu_line_hashes(const char* buf, long len, unsigned salt,
-                      unsigned* out_hash, long cap) {
-  if (!buf || len < 0 || !out_hash) return -1;
-  const char* p = buf;
-  const char* end = buf + len;
-  long n = 0;
-  while (p < end && n < cap) {
-    const char* nl = static_cast<const char*>(
-        std::memchr(p, '\n', static_cast<size_t>(end - p)));
-    const char* stop = nl ? nl + 1 : end;
-    out_hash[n++] = static_cast<unsigned>(crc32(
-        salt, reinterpret_cast<const Bytef*>(p), static_cast<uInt>(stop - p)));
-    p = stop;
-  }
-  return n;
 }
 
 }  // extern "C"

@@ -35,13 +35,15 @@ def _try_build() -> None:
 
 
 def load(name: str) -> "ctypes.CDLL | None":
-    """Load ``lib<name>.so`` from this directory, building once if absent."""
+    """Load ``lib<name>.so`` from this directory, (re)building first."""
     with _lock:
         if name in _cache:
             return _cache[name]
         path = os.path.join(_DIR, f"lib{name}.so")
-        if not os.path.exists(path):
-            _try_build()
+        # always run make, not just when the .so is missing: it is
+        # mtime-aware (a fast no-op when fresh) and a stale binary from
+        # older sources would silently break Python/native parity
+        _try_build()
         lib: "ctypes.CDLL | None" = None
         if os.path.exists(path):
             try:

@@ -71,30 +71,19 @@ class EpochAggregator:
         first — a restarted worker that resumed past it would otherwise
         leave a permanent hole (its skipped epochs can never reach
         quorum)."""
-        to_publish: list[EpochSummary] = []
         with self._lock:
             epoch = stats.current_epoch
             bucket = self._records.setdefault(epoch, {})
             bucket[stats.worker_index] = stats
             if epoch in self._published or len(bucket) < self.n_workers:
                 return None
-            for earlier in sorted(self._records):
-                if earlier >= epoch:
-                    break
-                if earlier not in self._published and self._records[earlier]:
-                    self._published.add(earlier)
-                    to_publish.append(
-                        self._summarize(earlier, self._records[earlier])
-                    )
+            # publish any earlier partial epochs first, then this one
+            to_publish = self._collect_unpublished(before=epoch)
             self._published.add(epoch)
             summary = self._summarize(epoch, bucket)
             to_publish.append(summary)
             self.summaries.extend(to_publish)
-        for s in to_publish:
-            if self.board_path:
-                fs.append_text(self.board_path, s.board_line())
-            if self.on_epoch_complete:
-                self.on_epoch_complete(s)
+        self._emit(to_publish)
         return summary
 
     def _summarize(self, epoch: int, bucket: dict[int, EpochStats]) -> EpochSummary:
@@ -113,6 +102,35 @@ class EpochAggregator:
             ks=sum(s.ks for s in stats) / n,
             auc=sum(s.auc for s in stats) / n,
         )
+
+    def _collect_unpublished(self, before: int | None = None) -> list[EpochSummary]:
+        """Mark-published + summarize every reported-but-unpublished epoch
+        (optionally only those ``< before``).  Caller holds the lock."""
+        out: list[EpochSummary] = []
+        for epoch in sorted(self._records):
+            if before is not None and epoch >= before:
+                break
+            if epoch not in self._published and self._records[epoch]:
+                self._published.add(epoch)
+                out.append(self._summarize(epoch, self._records[epoch]))
+        return out
+
+    def _emit(self, summaries: list[EpochSummary]) -> None:
+        for s in summaries:
+            if self.board_path:
+                fs.append_text(self.board_path, s.board_line())
+            if self.on_epoch_complete:
+                self.on_epoch_complete(s)
+
+    def flush(self) -> list[EpochSummary]:
+        """Publish every epoch that has at least one report but never
+        reached quorum — called at job end so a worker that died without
+        reporting doesn't leave its epochs permanently unpublished."""
+        with self._lock:
+            to_publish = self._collect_unpublished()
+            self.summaries.extend(to_publish)
+        self._emit(to_publish)
+        return to_publish
 
     def pending_epochs(self) -> dict[int, int]:
         """epoch -> number of workers still missing (for stall diagnosis)."""

@@ -43,6 +43,7 @@ class JobSubmitter:
         *,
         worker_runner: Callable[..., int] = run_worker,
         poll_interval_s: float = 0.2,
+        drain_grace_s: float = 30.0,
         fault_injections: dict[str, int] | None = None,
     ):
         """``make_worker_config(worker_id, (host, port))`` builds each
@@ -52,6 +53,7 @@ class JobSubmitter:
         self.make_worker_config = make_worker_config
         self.worker_runner = worker_runner
         self.poll_interval_s = poll_interval_s
+        self.drain_grace_s = drain_grace_s
         self.fault_injections = dict(fault_injections or {})
         self.coordinator = Coordinator(spec)
         self._threads: dict[str, threading.Thread] = {}
@@ -97,6 +99,17 @@ class JobSubmitter:
                 time.sleep(self.poll_interval_s)
             else:
                 self.coordinator._fail(f"job timeout after {timeout_s:.0f}s")
+            # Drain: the chief finishing flips the job to FINISHED while
+            # non-chief workers may still be mid-epoch; join them so their
+            # in-flight epoch reports land before the result is snapshotted
+            # (otherwise epoch_summaries races the last workers).  Skipped
+            # for FAILED/timed-out jobs — those workers are known stuck and
+            # the grace would just delay the error.
+            if self.coordinator.state == JobState.FINISHED:
+                drain_deadline = time.monotonic() + self.drain_grace_s
+                for t in self._threads.values():
+                    t.join(timeout=max(0.0, drain_deadline - time.monotonic()))
+            self.coordinator.aggregator.flush()
         finally:
             wall = time.monotonic() - t0
             result = JobResult(

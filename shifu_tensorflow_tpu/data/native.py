@@ -37,6 +37,7 @@ def _load():
                     ctypes.POINTER(ctypes.c_uint),
                     ctypes.c_long,
                     ctypes.c_int,
+                    ctypes.c_long,
                 ]
                 lib.stpu_count_lines.restype = ctypes.c_long
                 lib.stpu_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_long]
@@ -65,7 +66,11 @@ def parse_buffer(
     library is unavailable or declines (e.g. duplicate wanted columns) —
     caller falls back to Python."""
     lib = _load()
-    if lib is None or len(delimiter) != 1:
+    # the byte length is what matters: a non-ASCII delimiter like '¦' is one
+    # str char but multiple UTF-8 bytes — splitting on its lead byte would
+    # silently diverge from the Python path
+    delim = delimiter.encode()
+    if lib is None or len(delim) != 1 or any(c < 0 for c in wanted_columns):
         return None
     if n_threads is None:
         n_threads = min(8, os.cpu_count() or 1)
@@ -82,7 +87,7 @@ def parse_buffer(
     n = lib.stpu_parse_buffer(
         buf,
         len(buf),
-        delimiter.encode()[0:1],
+        delim,
         cols,
         n_wanted,
         ctypes.c_uint(salt & 0xFFFFFFFF),
@@ -94,6 +99,7 @@ def parse_buffer(
         ),
         cap,
         n_threads,
+        cap,  # line count already computed above; skips the recount
     )
     if n < 0:
         return None

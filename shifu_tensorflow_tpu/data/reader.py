@@ -51,6 +51,15 @@ class RecordSchema:
     means: tuple[float, ...] = field(default=())
     stds: tuple[float, ...] = field(default=())
 
+    def __post_init__(self) -> None:
+        # negative indices would mean "from the end" to Python's list
+        # indexing but are an out-of-bounds write to the native parser —
+        # reject them up front so both paths agree
+        if any(c < 0 for c in self.feature_columns) or self.target_column < 0:
+            raise ValueError("feature/target column indices must be >= 0")
+        if self.weight_column < -1:
+            raise ValueError("weight_column must be >= 0, or -1 for none")
+
     @property
     def num_features(self) -> int:
         return len(self.feature_columns)

@@ -185,3 +185,51 @@ def test_grammar_divergence_cells_agree_across_paths(monkeypatch, valid_rate):
     _assert_blocks_equal(tr_native, tr_py)
     _assert_blocks_equal(va_native, va_py)
     assert len(tr_native) + len(va_native) == 3
+
+
+@needs_native
+def test_out_of_range_cells_match_python_float_semantics(monkeypatch):
+    """float() keeps out-of-range magnitudes (overflow → ±inf, underflow →
+    0.0 after the float32 cast); the native parser must keep the same rows
+    with the same values, including beyond double range."""
+    buf = b"".join(
+        [
+            b"1|4e38|-4e38|1e-50|5\n",  # float32-range overflow/underflow
+            b"1|1e400|-1e400|1e-400|5\n",  # double-range overflow/underflow
+            (b"1|" + b"9" * 400 + b".0|2|3|5\n"),  # huge, no exponent
+            (b"1|0." + b"0" * 330 + b"1|2|3|5\n"),  # tiny, no exponent
+        ]
+    )
+    tr_n, _ = parse_buffer_split(buf, SCHEMA, 0.0)
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_checked", True)
+    tr_p, _ = parse_buffer_split(buf, SCHEMA, 0.0)
+
+    _assert_blocks_equal(tr_n, tr_p)
+    assert len(tr_n) == 4
+    assert tr_n.features[0].tolist() == [float("inf"), float("-inf"), 0.0]
+    assert tr_n.features[1].tolist() == [float("inf"), float("-inf"), 0.0]
+
+
+def test_schema_rejects_negative_columns():
+    """Negative indices would be an out-of-bounds write in the native parser
+    and implicit from-the-end indexing in Python — both paths now reject at
+    schema construction."""
+    with pytest.raises(ValueError):
+        RecordSchema(feature_columns=(1, -2), target_column=0)
+    with pytest.raises(ValueError):
+        RecordSchema(feature_columns=(1,), target_column=-1)
+    with pytest.raises(ValueError):
+        RecordSchema(feature_columns=(1,), target_column=0, weight_column=-3)
+
+
+@needs_native
+def test_multibyte_delimiter_falls_back_to_python():
+    # '¦' is one str char but two UTF-8 bytes: native must decline rather
+    # than split on the lead byte
+    schema = RecordSchema(feature_columns=(1,), target_column=0, delimiter="¦")
+    assert native.parse_buffer(b"1\xc2\xa62\n", (1, 0), "¦") is None
+    tr, _ = parse_buffer_split("1¦2\n".encode(), schema, 0.0)
+    assert tr.features.tolist() == [[2.0]]
+    assert tr.targets.tolist() == [[1.0]]
