@@ -1,9 +1,11 @@
 """Lazy loader for the native (C++) pieces.
 
-The shared objects are built by ``make -C cpp`` into this directory.  If a
-library is missing, the loader attempts one quiet in-tree build, then gives
-up and returns None — callers keep their pure-Python fallback, so the
-framework works (slower) without a toolchain.
+The shared objects are built by ``make -C cpp`` into this directory.  The
+loader runs the (mtime-aware, atomic-rename) build on every first load so a
+source change can't leave a stale binary silently diverging from the Python
+fallback; if the build fails or no toolchain exists it returns None —
+callers keep their pure-Python fallback, so the framework works (slower)
+without a toolchain.
 """
 
 from __future__ import annotations

@@ -117,6 +117,12 @@ class Coordinator:
 
     def _fail(self, reason: str) -> None:
         with self._lock:
+            # terminal states are sticky: a job that FINISHED during the
+            # caller's last poll interval must not be re-marked FAILED (e.g.
+            # the submitter's timeout branch racing the chief's completion),
+            # and the first failure reason must not be overwritten
+            if self.state in (JobState.FINISHED, JobState.FAILED):
+                return
             self.state = JobState.FAILED
             self.failure_reason = reason
             self._start_barrier.set()  # release anyone waiting

@@ -12,6 +12,7 @@ checkpoint-restart replacement for backup containers.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -109,7 +110,12 @@ class JobSubmitter:
                 drain_deadline = time.monotonic() + self.drain_grace_s
                 for t in self._threads.values():
                     t.join(timeout=max(0.0, drain_deadline - time.monotonic()))
-            self.coordinator.aggregator.flush()
+            try:
+                self.coordinator.aggregator.flush()
+            except Exception as e:
+                # board-file IO must not turn a finished job into a raise;
+                # the summaries list is already updated under the lock
+                print(f"metrics flush failed: {e}", file=sys.stderr)
         finally:
             wall = time.monotonic() - t0
             result = JobResult(

@@ -70,7 +70,7 @@ def parse_buffer(
     # str char but multiple UTF-8 bytes — splitting on its lead byte would
     # silently diverge from the Python path
     delim = delimiter.encode()
-    if lib is None or len(delim) != 1 or any(c < 0 for c in wanted_columns):
+    if lib is None or len(delim) != 1:
         return None
     if n_threads is None:
         n_threads = min(8, os.cpu_count() or 1)

@@ -1,0 +1,358 @@
+"""Training CLI — the client surface of the framework.
+
+Parity surface: the reference's entry point is ``TensorflowClient`` — parse
+``-globalconfig``/CLI args, merge the layered XML config, stage
+ModelConfig.json/ColumnConfig.json, submit the job, and tail per-epoch
+progress to the console (TensorflowClient.java:211-290,333-403,625-658).
+Here the same surface is one command:
+
+    python -m shifu_tensorflow_tpu.train \
+        --training-data-path /data/train \
+        --model-config ModelConfig.json --column-config ColumnConfig.json \
+        --workers 2 --export-dir ./model-export
+
+Config precedence (reference three-layer merge, conf.Conf): built-in
+defaults → ``--globalconfig`` file(s) → explicit CLI flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.config.model_config import ColumnConfig, ModelConfig
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shifu_tensorflow_tpu.train",
+        description="Train a config-driven tabular model on TPU (or CPU).",
+    )
+    p.add_argument("--training-data-path", help="file/dir of PSV(.gz) shards")
+    p.add_argument("--globalconfig", action="append", default=[],
+                   help="layered config file (XML or JSON); repeatable, later wins")
+    p.add_argument("--model-config", help="ModelConfig.json path")
+    p.add_argument("--column-config", help="ColumnConfig.json path")
+    # schema overrides (when no ColumnConfig.json)
+    p.add_argument("--feature-columns", help="comma-separated column indices")
+    p.add_argument("--target-column", type=int, default=None)
+    p.add_argument("--weight-column", type=int, default=None)
+    p.add_argument("--delimiter", default=None)
+    p.add_argument("--zscale", action="store_true",
+                   help="apply ZSCALE normalization from ColumnConfig stats")
+    # run shape
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker count; >1 runs the coordinator/submitter path")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--valid-rate", type=float, default=None)
+    p.add_argument("--mesh", default=None,
+                   help='mesh spec, e.g. "data:-1" or "data:4,model:2"')
+    p.add_argument("--stream", action="store_true",
+                   help="stream shards (1B-row path) instead of loading to RAM")
+    p.add_argument("--seed", type=int, default=0)
+    # artifacts
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--export-dir", default=None)
+    p.add_argument("--board-path", default=None,
+                   help="metrics board file (reference console-board parity)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write jax.profiler traces for the run here")
+    return p
+
+
+def load_conf(args: argparse.Namespace) -> Conf:
+    conf = Conf()
+    for path in args.globalconfig:
+        conf.add_resource(path)
+    # CLI flags overlay the file layers (the reference's "programmatic" layer)
+    overlay = {
+        K.TRAINING_DATA_PATH: args.training_data_path,
+        K.EPOCHS: args.epochs,
+        K.BATCH_SIZE: args.batch_size,
+        K.MESH_SHAPE: args.mesh,
+        K.instances_key(K.WORKER_JOB_NAME): args.workers,
+        K.MODEL_CONF: args.model_config,
+        K.COLUMN_CONF: args.column_config,
+        K.TMP_MODEL_PATH: args.checkpoint_dir,
+        K.FINAL_MODEL_PATH: args.export_dir,
+        K.TMP_LOG_PATH: args.board_path,
+    }
+    conf.update({k: v for k, v in overlay.items() if v is not None},
+                source="<cli>")
+    return conf
+
+
+def resolve_schema(
+    args: argparse.Namespace, model_config: ModelConfig
+) -> tuple[RecordSchema, ColumnConfig | None]:
+    """ColumnConfig.json drives column selection when given (the reference's
+    Java client derived SELECTED/TARGET/WEIGHT column env vars from it,
+    TensorflowClient.java:378-382); explicit flags override."""
+    cc = ColumnConfig.load(args.column_config) if args.column_config else None
+    if args.feature_columns:
+        features = tuple(int(c) for c in args.feature_columns.split(","))
+    elif cc is not None:
+        features = tuple(cc.selected_column_nums)
+    else:
+        raise SystemExit(
+            "need --feature-columns or --column-config to define the schema"
+        )
+    target = (
+        args.target_column
+        if args.target_column is not None
+        else (cc.target_column_num if cc else K.DEFAULT_TARGET_COLUMN_NUM)
+    )
+    weight = (
+        args.weight_column
+        if args.weight_column is not None
+        else (cc.weight_column_num if cc else K.DEFAULT_WEIGHT_COLUMN_NUM)
+    )
+    schema = RecordSchema(
+        feature_columns=features,
+        target_column=target,
+        weight_column=weight,
+        delimiter=args.delimiter or model_config.delimiter,
+    )
+    if args.zscale:
+        if cc is None:
+            raise SystemExit("--zscale needs --column-config for the stats")
+        means, stds = cc.zscale_stats(features)
+        schema = schema.with_zscale(means, stds)
+    return schema, cc
+
+
+def _print_epoch(stats) -> None:
+    print(
+        f"epoch {stats.current_epoch}: train_loss={stats.training_loss:.6f} "
+        f"valid_loss={stats.valid_loss:.6f} ks={stats.ks:.4f} "
+        f"auc={stats.auc:.4f} epoch_time={stats.training_time_s:.2f}s "
+        f"valid_time={stats.valid_time_s:.2f}s step={stats.global_step}",
+        flush=True,
+    )
+
+
+def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> int:
+    from shifu_tensorflow_tpu.data.dataset import InMemoryDataset, ShardStream
+    from shifu_tensorflow_tpu.data.splitter import list_data_files
+    from shifu_tensorflow_tpu.export.saved_model import export_model
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+    from shifu_tensorflow_tpu.utils.profiling import trace_if
+
+    data_path = conf.get(K.TRAINING_DATA_PATH)
+    paths = list_data_files(data_path)
+    if not paths:
+        print(f"no training files under {data_path}", file=sys.stderr)
+        return 2
+
+    mesh_spec = conf.get(K.MESH_SHAPE, K.DEFAULT_MESH_SHAPE)
+    mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
+    trainer = Trainer(
+        model_config,
+        schema.num_features,
+        feature_columns=schema.feature_columns,
+        mesh=mesh,
+        seed=args.seed,
+    )
+    epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
+    batch_size = trainer.align_batch_size(
+        conf.get_int(K.BATCH_SIZE, model_config.batch_size)
+    )
+    valid_rate = (
+        args.valid_rate
+        if args.valid_rate is not None
+        else model_config.valid_set_rate
+    )
+
+    checkpointer = None
+    start_epoch = 0
+    if args.checkpoint_dir:
+        checkpointer = Checkpointer(args.checkpoint_dir)
+        start_epoch = trainer.restore(checkpointer)
+        if start_epoch:
+            print(f"resuming at epoch {start_epoch}", flush=True)
+
+    t0 = time.time()
+    try:
+        with trace_if(args.profile_dir):
+            if args.stream:
+                history = trainer.fit_stream(
+                    lambda epoch: ShardStream(
+                        paths, schema, batch_size,
+                        valid_rate=valid_rate, emit="train", salt=args.seed,
+                    ),
+                    (lambda: ShardStream(
+                        paths, schema, batch_size,
+                        valid_rate=valid_rate, emit="valid", salt=args.seed,
+                    )) if valid_rate > 0 else None,
+                    epochs=epochs,
+                    on_epoch=_print_epoch,
+                    checkpointer=checkpointer,
+                    start_epoch=start_epoch,
+                )
+            else:
+                dataset = InMemoryDataset.load(
+                    paths, schema, valid_rate, salt=args.seed
+                )
+                print(
+                    f"loaded {len(dataset.train)} train / "
+                    f"{len(dataset.valid)} valid rows from {len(paths)} files",
+                    flush=True,
+                )
+                history = trainer.fit(
+                    dataset,
+                    epochs=epochs,
+                    batch_size=batch_size,
+                    on_epoch=_print_epoch,
+                    checkpointer=checkpointer,
+                    start_epoch=start_epoch,
+                )
+    finally:
+        if checkpointer is not None:
+            checkpointer.close()
+    wall = time.time() - t0
+
+    if args.export_dir:
+        wrote = export_model(
+            args.export_dir,
+            trainer,
+            feature_columns=schema.feature_columns,
+            zscale_means=schema.means or None,
+            zscale_stds=schema.stds or None,
+        )
+        print(f"exported to {args.export_dir}: {wrote}", flush=True)
+    print(
+        json.dumps(
+            {
+                "state": "finished",
+                "epochs_run": len(history),
+                "wall_time_s": round(wall, 2),
+                "final_valid_loss": history[-1].valid_loss if history else None,
+                "final_ks": history[-1].ks if history else None,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> int:
+    from shifu_tensorflow_tpu.coordinator.coordinator import JobState
+    from shifu_tensorflow_tpu.coordinator.submitter import (
+        JobSubmitter,
+        make_job_spec,
+    )
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+
+    n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)
+    epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
+    spec = make_job_spec(
+        conf.get(K.TRAINING_DATA_PATH),
+        n_workers,
+        epochs=epochs,
+        board_path=args.board_path,
+    )
+
+    def make_cfg(worker_id: str, addr) -> WorkerConfig:
+        return WorkerConfig(
+            worker_id=worker_id,
+            coordinator_host=addr[0],
+            coordinator_port=addr[1],
+            model_config=model_config,
+            schema=schema,
+            batch_size=conf.get_int(K.BATCH_SIZE, model_config.batch_size),
+            checkpoint_dir=args.checkpoint_dir,
+            valid_rate=args.valid_rate,
+            seed=args.seed,
+        )
+
+    submitter = JobSubmitter(spec, make_cfg)
+    timeout_ms = conf.get_int(K.APPLICATION_TIMEOUT, K.DEFAULT_APPLICATION_TIMEOUT)
+    result = submitter.run(
+        timeout_s=timeout_ms / 1000.0 if timeout_ms > 0 else 86400.0
+    )
+    for s in result.epoch_summaries:
+        print(s.board_line(), end="", flush=True)
+
+    def print_summary() -> None:
+        # the JSON summary is the last line of output — a stable contract
+        # for scripts wrapping the CLI
+        print(
+            json.dumps(
+                {
+                    "state": result.state.value,
+                    "failure_reason": result.failure_reason,
+                    "epochs_run": len(result.epoch_summaries),
+                    "restarts_used": result.restarts_used,
+                    "wall_time_s": round(result.wall_time_s, 2),
+                }
+            ),
+            flush=True,
+        )
+
+    if result.state != JobState.FINISHED:
+        print_summary()
+        return 1
+
+    if args.export_dir:
+        # chief-export parity: restore the latest checkpoint into a fresh
+        # trainer and export (reference: ssgd_monitor.py:304-341)
+        if not args.checkpoint_dir:
+            print("--export-dir with --workers>1 needs --checkpoint-dir",
+                  file=sys.stderr)
+            print_summary()
+            return 2
+        from shifu_tensorflow_tpu.export.saved_model import export_model
+        from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+        from shifu_tensorflow_tpu.train.trainer import Trainer
+
+        trainer = Trainer(
+            model_config,
+            schema.num_features,
+            feature_columns=schema.feature_columns,
+            seed=args.seed,
+        )
+        with Checkpointer(args.checkpoint_dir) as ckpt:
+            trainer.restore(ckpt)
+        wrote = export_model(
+            args.export_dir,
+            trainer,
+            feature_columns=schema.feature_columns,
+            zscale_means=schema.means or None,
+            zscale_stds=schema.stds or None,
+        )
+        print(f"exported to {args.export_dir}: {wrote}", flush=True)
+    print_summary()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    conf = load_conf(args)
+    if not conf.get(K.TRAINING_DATA_PATH):
+        print("--training-data-path (or a globalconfig providing "
+              f"{K.TRAINING_DATA_PATH}) is required", file=sys.stderr)
+        return 2
+
+    mc_path = conf.get(K.MODEL_CONF)
+    model_config = ModelConfig.load(mc_path) if mc_path else ModelConfig.from_json({})
+    # let the conf's column-conf key stand in for the flag
+    if not args.column_config and conf.get(K.COLUMN_CONF):
+        args.column_config = conf.get(K.COLUMN_CONF)
+    schema, _ = resolve_schema(args, model_config)
+
+    n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)
+    if n_workers > 1:
+        return run_multi(args, conf, model_config, schema)
+    return run_single(args, conf, model_config, schema)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

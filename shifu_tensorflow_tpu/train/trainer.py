@@ -159,6 +159,8 @@ class Trainer:
             self.model.apply, loss, model_config.params.l2_reg
         )
         self._eval_step = make_eval_step(self.model.apply, loss)
+        # opt-in per-step timing (utils/profiling.StepTimer); None = free
+        self.step_timer = None
 
     # ---- device placement ----
     def _put(self, batch: Batch) -> Batch:
@@ -194,6 +196,8 @@ class Trainer:
         for batch in prefetch_to_device(batches, put=self._put):
             self.state, loss = self._train_step(self.state, batch)
             losses.append(loss)
+            if self.step_timer is not None:
+                self.step_timer.step(loss, rows=batch["x"].shape[0])
         if not losses:
             return float("nan"), 0
         return float(np.mean(jax.device_get(losses))), len(losses)

@@ -1,0 +1,107 @@
+"""Tracing and per-step timing.
+
+The reference has no profiler integration at all — its only instrumentation
+is wall-clock deltas around the epoch loop shipped through the metrics plane
+(reference: ssgd_monitor.py:270-277; SURVEY.md §5.1 names this a gap to fill
+idiomatically).  This module fills it the TPU way:
+
+- ``trace_if(dir)`` wraps a region in ``jax.profiler.trace`` so the run
+  produces a TensorBoard/XPlane trace (op-level timeline, HBM usage) when a
+  directory is given, and costs nothing when not;
+- ``annotate(name)`` marks host-side regions so they show up on the trace
+  timeline next to the device ops;
+- ``StepTimer`` measures steady-state step time without serializing the
+  pipeline: host dispatch time is accumulated every step, and the device is
+  synced only every ``sync_every`` steps, so the measured rate amortizes the
+  sync instead of turning the async dispatch queue into lock-step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@contextlib.contextmanager
+def trace_if(trace_dir: str | None) -> Iterator[None]:
+    """``jax.profiler.trace`` when a directory is given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def annotate(name: str):
+    """Host-side region marker (shows on the profiler timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@dataclass
+class StepTimer:
+    """Amortized step-rate measurement.
+
+    Usage::
+
+        timer = StepTimer(sync_every=50)
+        for batch in batches:
+            state, loss = step(state, batch)
+            timer.step(loss, rows=batch["x"].shape[0])
+        print(timer.summary())
+
+    ``step`` passes the step's output so the periodic sync has something to
+    block on; between syncs only host wall-clock is read.
+    """
+
+    sync_every: int = 50
+    n_steps: int = 0
+    n_rows: int = 0
+    _t0: float | None = None
+    _elapsed: float = 0.0
+    _pending: Any = field(default=None, repr=False)
+
+    def step(self, device_out: Any = None, rows: int = 0) -> None:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        self.n_steps += 1
+        self.n_rows += rows
+        self._pending = device_out
+        if self.sync_every and self.n_steps % self.sync_every == 0:
+            self._sync()
+
+    def _sync(self) -> None:
+        if self._pending is not None:
+            import jax
+
+            jax.block_until_ready(self._pending)
+            self._pending = None
+        if self._t0 is not None:
+            self._elapsed = time.perf_counter() - self._t0
+
+    def elapsed_s(self) -> float:
+        self._sync()
+        return self._elapsed
+
+    def summary(self) -> dict[str, float]:
+        elapsed = self.elapsed_s()
+        per_step = elapsed / self.n_steps if self.n_steps else 0.0
+        return {
+            "steps": float(self.n_steps),
+            "elapsed_s": elapsed,
+            "step_time_s": per_step,
+            "steps_per_sec": (self.n_steps / elapsed) if elapsed else 0.0,
+            "rows_per_sec": (self.n_rows / elapsed) if elapsed else 0.0,
+        }
+
+    def reset(self) -> None:
+        self.n_steps = 0
+        self.n_rows = 0
+        self._t0 = None
+        self._elapsed = 0.0
+        self._pending = None

@@ -350,6 +350,24 @@ def test_await_start_short_probe_does_not_kill_job():
         coord.shutdown()
 
 
+def test_fail_is_noop_after_terminal_state():
+    """A FINISHED job must stay FINISHED even if a late timeout path calls
+    _fail (the submitter's poll loop can race the chief's completion), and
+    the first failure reason is never overwritten."""
+    coord = Coordinator(_spec(1))
+    host, port = coord.serve()
+    try:
+        c = CoordinatorClient(host, port)
+        c.register("a")
+        c.complete("a", exit_code=0)
+        assert coord.state == JobState.FINISHED
+        coord._fail("job timeout after 60s")
+        assert coord.state == JobState.FINISHED
+        assert coord.failure_reason is None
+    finally:
+        coord.shutdown()
+
+
 def test_abort_exit_codes_do_not_mask_failure_reason():
     coord = Coordinator(_spec(3, spare_restarts=0))
     host, port = coord.serve()

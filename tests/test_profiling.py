@@ -1,0 +1,59 @@
+"""Profiling utilities (SURVEY.md §5.1 — the reference had none)."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tensorflow_tpu.utils.profiling import StepTimer, annotate, trace_if
+
+
+def test_step_timer_counts_and_rates():
+    timer = StepTimer(sync_every=2)
+    x = jnp.ones((4,))
+    for _ in range(5):
+        timer.step(x * 2, rows=4)
+    s = timer.summary()
+    assert s["steps"] == 5
+    assert s["rows_per_sec"] > 0
+    assert s["elapsed_s"] > 0
+    assert abs(s["steps_per_sec"] * s["step_time_s"] - 1.0) < 1e-6
+    timer.reset()
+    assert timer.summary()["steps"] == 0
+
+
+def test_trace_if_none_is_noop():
+    with trace_if(None):
+        pass  # must not require jax import side effects
+
+
+def test_trace_if_writes_profile(tmp_path):
+    d = str(tmp_path / "trace")
+    with trace_if(d):
+        with annotate("unit-test-region"):
+            jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    # jax writes <dir>/plugins/profile/<ts>/*.xplane.pb
+    found = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+    assert found, f"no trace written under {d}"
+
+
+def test_trainer_step_timer_integration(model_config_json):
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    trainer = Trainer(ModelConfig.from_json(model_config_json), 4)
+    trainer.step_timer = StepTimer(sync_every=2)
+    rng = np.random.default_rng(0)
+    batches = [
+        {
+            "x": rng.normal(size=(8, 4)).astype(np.float32),
+            "y": np.ones((8, 1), np.float32),
+            "w": np.ones((8, 1), np.float32),
+        }
+        for _ in range(3)
+    ]
+    trainer.train_epoch(iter(batches))
+    s = trainer.step_timer.summary()
+    assert s["steps"] == 3
+    assert trainer.step_timer.n_rows == 24
