@@ -5,12 +5,20 @@ hashed embedding columns with the table sharded over ICI.  The reference has
 no model parallelism at all (SURVEY.md §2.5); this module is the one place
 the new framework adds a model-parallel axis.
 
-Design: feature values are hashed on-device with an affine-multiplicative
-integer hash (no host round-trip), then gathered from a ``(hash_size, dim)``
-table.  The table's leading axis carries a ``nn.partitioning`` annotation so
-under pjit the table shards across the 'model' axis and XLA turns the gather
-into an all-gather-free collective lookup; sharding is annotation-only, so
-the same module runs unsharded on one chip.
+Design: feature values are hashed on-device (ops/hashing.py — shared with
+the Pallas kernel so bucket assignment is bit-identical across
+implementations), then gathered from a ``(hash_size, dim)`` table.  Two
+lookup implementations:
+
+- ``xla``   — hash + ``jnp.take``; under pjit the table's
+  ``nn.partitioning`` annotation shards it over the 'model' axis and XLA
+  handles the collective lookup;
+- ``pallas`` — the fused hash/one-hot-matmul TPU kernel
+  (ops/pallas/embedding.py) for the replicated-table case, keeping the
+  gather on the MXU.
+
+``impl="auto"`` picks pallas on TPU when the table is not mesh-sharded,
+xla everywhere else.
 """
 
 from __future__ import annotations
@@ -19,27 +27,28 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-# large odd multipliers for a cheap multiplicative hash (fibonacci hashing)
-_HASH_MULT = jnp.uint32(2654435761)
-_HASH_MULT2 = jnp.uint32(40503)
+from shifu_tensorflow_tpu.ops import hashing
+
+# re-exports kept for callers that used the old locations
+hash_to_buckets = hashing.hash_to_buckets
 
 
-def _mix(bits: jax.Array) -> jax.Array:
-    """Shared finalizer of the multiplicative hash: uint32 bits -> uint32."""
-    h = bits * _HASH_MULT
-    h = h ^ (h >> 16)
-    return h * _HASH_MULT2
+# Measured on v5e (4096-row batch, C=5): the one-hot-matmul kernel sweeps
+# the whole table once per lookup (cost ∝ hash_size), so it beats XLA's
+# gather by ~1.3-1.5x for tables up to ~16K rows and loses beyond ~256K.
+PALLAS_MAX_HASH_SIZE = 16384
 
 
-def _float_bits(values: jax.Array) -> jax.Array:
-    """Bit-cast floats so distinct raw category codes (e.g. 3.0 vs 4.0)
-    hash apart; elementwise and fusable."""
-    return jax.lax.bitcast_convert_type(values.astype(jnp.float32), jnp.uint32)
-
-
-def hash_to_buckets(values: jax.Array, hash_size: int) -> jax.Array:
-    """Hash float feature values into [0, hash_size) on device."""
-    return (_mix(_float_bits(values)) % jnp.uint32(hash_size)).astype(jnp.int32)
+def _resolve_impl(impl: str, sharded: bool, hash_size: int = 0) -> str:
+    if impl != "auto":
+        return impl
+    if sharded:
+        # a 'model'-sharded table needs XLA's partitioned gather; the pallas
+        # kernel has no partitioning rule and would force an all-gather
+        return "xla"
+    if hash_size > PALLAS_MAX_HASH_SIZE:
+        return "xla"
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
 class HashedEmbedding(nn.Module):
@@ -48,22 +57,27 @@ class HashedEmbedding(nn.Module):
     hash_size: int
     features: int  # embedding dim per column
     dtype: jnp.dtype = jnp.float32
+    shard_table: bool = True  # annotate the table for the 'model' axis
+    impl: str = "auto"  # auto | xla | pallas
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        init = nn.initializers.normal(stddev=0.05)
         table = self.param(
             "table",
-            nn.with_partitioning(
-                nn.initializers.normal(stddev=0.05), ("model", None)
-            ),
+            nn.with_partitioning(init, ("model", None)) if self.shard_table
+            else init,
             (self.hash_size, self.features),
             self.dtype,
         )
-        # salt per column position so the same value in different columns
-        # lands in different buckets
-        cols = jnp.arange(x.shape[-1], dtype=jnp.uint32)
-        salted = _float_bits(x) ^ (cols * jnp.uint32(0x9E3779B9))
-        ids = (_mix(salted) % jnp.uint32(self.hash_size)).astype(jnp.int32)
+        impl = _resolve_impl(self.impl, self.shard_table, self.hash_size)
+        if impl == "pallas":
+            from shifu_tensorflow_tpu.ops.pallas.embedding import (
+                hashed_embedding_lookup,
+            )
+
+            return hashed_embedding_lookup(x, table)
+        ids = hashing.salted_bucket_ids(x, self.hash_size)
         emb = jnp.take(table, ids, axis=0)  # (B, C, dim)
         return emb.reshape(x.shape[0], -1)
 
@@ -86,10 +100,5 @@ class HashedCross(nn.Module):
             (self.hash_size, self.features),
             self.dtype,
         )
-        bits = _float_bits(x)
-        h = jnp.zeros(x.shape[:1], jnp.uint32)
-        for c in range(x.shape[-1]):
-            h = (h ^ bits[:, c]) * _HASH_MULT
-            h = h ^ (h >> 13)
-        ids = (h % jnp.uint32(self.hash_size)).astype(jnp.int32)
+        ids = hashing.crossed_bucket_ids(x, self.hash_size)
         return jnp.take(table, ids, axis=0)

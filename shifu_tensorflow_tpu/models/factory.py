@@ -29,12 +29,15 @@ class EmbeddingAugmented(nn.Module):
     hash_size: int
     embed_dim: int
     dtype: jnp.dtype = jnp.float32
+    shard_table: bool = True
+    embedding_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
         emb = HashedEmbedding(
             hash_size=self.hash_size, features=self.embed_dim,
-            dtype=self.dtype, name="hashed_columns",
+            dtype=self.dtype, shard_table=self.shard_table,
+            impl=self.embedding_impl, name="hashed_columns",
         )(x[:, jnp.asarray(self.embed_indices)])
         return self.base(jnp.concatenate([x, emb], axis=-1))
 
@@ -54,7 +57,14 @@ def build_model(
     model_config: ModelConfig,
     feature_columns: tuple[int, ...] | None = None,
     dtype: jnp.dtype = jnp.float32,
+    shard_embeddings: bool = True,
+    embedding_impl: str = "auto",
 ) -> nn.Module:
+    """``shard_embeddings=False`` (no 'model' mesh axis present) drops the
+    table's partitioning annotation.  ``embedding_impl`` selects the lookup
+    implementation; pass "xla" whenever the computation runs over a
+    multi-device mesh — the Pallas kernel has no GSPMD partitioning rule, so
+    "auto" is only safe single-device (models/embeddings._resolve_impl)."""
     p: TrainParams = model_config.params
     nodes = p.num_hidden_nodes[: p.num_hidden_layers]
     acts = p.activation_funcs[: p.num_hidden_layers]
@@ -88,6 +98,7 @@ def build_model(
             return EmbeddingAugmented(
                 base=base, embed_indices=embed_idx,
                 hash_size=p.embedding_hash_size, embed_dim=p.embedding_dim,
-                dtype=dtype,
+                dtype=dtype, shard_table=shard_embeddings,
+                embedding_impl=embedding_impl,
             )
     return base

@@ -343,9 +343,13 @@ def main(argv: list[str] | None = None) -> int:
 
     mc_path = conf.get(K.MODEL_CONF)
     model_config = ModelConfig.load(mc_path) if mc_path else ModelConfig.from_json({})
-    # let the conf's column-conf key stand in for the flag
-    if not args.column_config and conf.get(K.COLUMN_CONF):
-        args.column_config = conf.get(K.COLUMN_CONF)
+    # resolve path-valued settings back out of the merged conf so a
+    # --globalconfig file can provide them too (the CLI overlay already won
+    # if both were given — the documented precedence)
+    args.column_config = args.column_config or conf.get(K.COLUMN_CONF)
+    args.checkpoint_dir = conf.get(K.TMP_MODEL_PATH)
+    args.export_dir = conf.get(K.FINAL_MODEL_PATH)
+    args.board_path = conf.get(K.TMP_LOG_PATH)
     schema, _ = resolve_schema(args, model_config)
 
     n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)

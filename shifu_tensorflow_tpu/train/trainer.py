@@ -128,7 +128,17 @@ class Trainer:
         self.num_features = num_features
         self.mesh = mesh
         self.worker_index = worker_index
-        self.model = build_model(model_config, feature_columns, dtype=dtype)
+        # shard embedding tables only when a >1 'model' axis exists; the
+        # fused Pallas lookup is only eligible single-device — it has no
+        # GSPMD partitioning rule, so under a multi-device mesh (even pure
+        # data-parallel) the lookup must stay on XLA's partitioned gather
+        shard_emb = mesh is not None and mesh.shape.get("model", 1) > 1
+        single_device = mesh is None or mesh.size == 1
+        self.model = build_model(
+            model_config, feature_columns, dtype=dtype,
+            shard_embeddings=shard_emb,
+            embedding_impl="auto" if single_device else "xla",
+        )
         self.tx = make_optimizer(model_config.params)
         self.loss_name = loss
         self.seed = seed

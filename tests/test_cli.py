@@ -85,6 +85,31 @@ def test_main_requires_data_path(capsys):
     assert main(["--feature-columns", "1"]) == 2
 
 
+def test_globalconfig_can_provide_artifact_paths(
+    tmp_path, capsys, psv_dataset, model_config_json
+):
+    """Artifact paths from a --globalconfig file must be honored, same as
+    epochs/batch-size (the documented three-layer precedence)."""
+    export_dir = tmp_path / "gc-export"
+    gc = tmp_path / "global.json"
+    gc.write_text(json.dumps({
+        K.FINAL_MODEL_PATH: str(export_dir),
+        K.TMP_MODEL_PATH: str(tmp_path / "gc-ckpt"),
+        K.EPOCHS: 1,
+    }))
+    argv = [
+        "--training-data-path", psv_dataset["root"],
+        "--model-config", _write_model_config(tmp_path, model_config_json, 2),
+        "--feature-columns", ",".join(map(str, psv_dataset["feature_cols"])),
+        "--target-column", str(psv_dataset["target_col"]),
+        "--weight-column", str(psv_dataset["weight_col"]),
+        "--globalconfig", str(gc),
+    ]
+    assert main(argv) == 0
+    assert (export_dir / "shifu_tpu_model.json").exists()
+    assert (tmp_path / "gc-ckpt").exists()
+
+
 @pytest.mark.parametrize("stream", [False, True])
 def test_cli_single_worker_end_to_end(
     tmp_path, capsys, psv_dataset, model_config_json, stream

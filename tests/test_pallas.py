@@ -1,0 +1,140 @@
+"""Pallas fused hashed-embedding kernel — exact parity with the XLA path.
+
+Runs in interpreter mode on CPU (the kernel auto-selects interpret off-TPU);
+the contract is bit-identical outputs and gradients between the pallas and
+XLA implementations for any shape, including non-tile-aligned ones.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.models.embeddings import HashedEmbedding
+from shifu_tensorflow_tpu.ops import hashing
+from shifu_tensorflow_tpu.ops.pallas.embedding import hashed_embedding_lookup
+
+
+def _xla_reference(x, table):
+    ids = hashing.salted_bucket_ids(x, table.shape[0])
+    return jnp.take(table, ids, axis=0).reshape(x.shape[0], -1)
+
+
+@pytest.mark.parametrize(
+    "n,c,h,d",
+    [
+        (16, 5, 256, 8),
+        (33, 3, 100, 4),  # nothing tile-aligned
+        (7, 1, 513, 16),
+        (260, 2, 1030, 8),  # batch and hash both cross block boundaries
+    ],
+)
+def test_forward_parity(n, c, h, d):
+    rng = np.random.default_rng(n * 31 + h)
+    x = jnp.asarray(rng.normal(size=(n, c)) * 5, jnp.float32)
+    table = jnp.asarray(rng.normal(size=(h, d)), jnp.float32)
+    got = hashed_embedding_lookup(x, table, 64, 128)
+    want = _xla_reference(x, table)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gradient_parity():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(40, 3)) * 3, jnp.float32)
+    table = jnp.asarray(rng.normal(size=(128, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(40, 24)), jnp.float32)
+
+    def loss_pallas(t):
+        return jnp.sum(hashed_embedding_lookup(x, t, 16, 64) * w)
+
+    def loss_xla(t):
+        return jnp.sum(_xla_reference(x, t) * w)
+
+    g_pallas = jax.grad(loss_pallas)(table)
+    g_xla = jax.grad(loss_xla)(table)
+    np.testing.assert_allclose(
+        np.asarray(g_pallas), np.asarray(g_xla), rtol=1e-6, atol=1e-6
+    )
+    # collisions: several rows hashing to the same bucket must accumulate,
+    # which the XLA grad does by construction — equality above proves the
+    # scatter-add; also check the grad is not trivially zero
+    assert float(jnp.abs(g_pallas).sum()) > 0
+
+
+def test_x_gradient_is_zero():
+    x = jnp.ones((8, 2), jnp.float32)
+    table = jnp.ones((64, 4), jnp.float32)
+    gx = jax.grad(lambda xx: jnp.sum(hashed_embedding_lookup(xx, table)))(x)
+    np.testing.assert_array_equal(np.asarray(gx), np.zeros_like(gx))
+
+
+def test_module_pallas_impl_matches_xla():
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(20, 4)) * 2, jnp.float32)
+    key = jax.random.key(0)
+    m_xla = HashedEmbedding(hash_size=128, features=8, shard_table=False,
+                            impl="xla")
+    m_pl = HashedEmbedding(hash_size=128, features=8, shard_table=False,
+                           impl="pallas")
+    v = m_xla.init(key, x)
+    out_xla = m_xla.apply(v, x)
+    out_pl = m_pl.apply(v, x)  # same params: impl is not part of the pytree
+    np.testing.assert_array_equal(np.asarray(out_pl), np.asarray(out_xla))
+
+
+def test_auto_impl_off_tpu_is_xla():
+    from shifu_tensorflow_tpu.models.embeddings import _resolve_impl
+
+    assert _resolve_impl("auto", sharded=True) == "xla"
+    # on the CPU test backend auto must not pick pallas
+    assert _resolve_impl("auto", sharded=False) == "xla"
+    assert _resolve_impl("pallas", sharded=False) == "pallas"
+    # huge tables stay on XLA's gather even on TPU (cost ∝ hash_size)
+    assert _resolve_impl("auto", sharded=False, hash_size=1 << 20) == "xla"
+
+
+def test_trainer_forces_xla_impl_on_multi_device_mesh(model_config_json):
+    """The pallas kernel has no GSPMD partitioning rule: any multi-device
+    mesh — including pure data-parallel — must pin the XLA lookup."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mc = dict(model_config_json)
+    mc["train"] = dict(mc["train"])
+    mc["train"]["params"] = dict(
+        mc["train"]["params"], EmbeddingColumnNums=[2], EmbeddingHashSize=64,
+        EmbeddingDim=4,
+    )
+    config = ModelConfig.from_json(mc)
+    t_mesh = Trainer(config, 4, feature_columns=(0, 1, 2, 3),
+                     mesh=make_mesh("data:-1"))
+    assert t_mesh.model.embedding_impl == "xla"
+    t_single = Trainer(config, 4, feature_columns=(0, 1, 2, 3))
+    assert t_single.model.embedding_impl == "auto"
+
+
+def test_trainer_with_embeddings_still_trains(model_config_json):
+    """The factory threads shard_embeddings through; a trainer without a
+    'model' axis must build and train the embedding-augmented model."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mc = dict(model_config_json)
+    mc["train"] = dict(mc["train"])
+    mc["train"]["params"] = dict(
+        mc["train"]["params"],
+        EmbeddingColumnNums=[2, 3],
+        EmbeddingHashSize=64,
+        EmbeddingDim=4,
+    )
+    trainer = Trainer(ModelConfig.from_json(mc), 4,
+                      feature_columns=(0, 1, 2, 3))
+    rng = np.random.default_rng(1)
+    batch = {
+        "x": rng.normal(size=(32, 4)).astype(np.float32),
+        "y": (rng.random((32, 1)) < 0.5).astype(np.float32),
+        "w": np.ones((32, 1), np.float32),
+    }
+    loss, n = trainer.train_epoch(iter([batch]))
+    assert n == 1 and np.isfinite(loss)
