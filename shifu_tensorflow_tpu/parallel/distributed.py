@@ -1,0 +1,120 @@
+"""Multi-host bootstrap: jax.distributed initialization from the
+coordinator's worker assignment.
+
+Parity surface: the reference assembles a TF ClusterSpec through ZooKeeper
+— every container publishes ip:port, the AM broadcasts the final cluster,
+and each process derives its task index from its position in the spec
+(TensorflowSession.java:551-594, TensorflowTaskExecutor.java:93-148).  The
+TPU-native equivalent is ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)``: the JAX runtime runs its own bring-up barrier
+and cross-host device discovery; no dynamic membership, no re-indexing.
+
+This module derives those three values from (in order of precedence)
+explicit arguments, the framework coordinator's registration reply, or the
+``shifu.tpu.*`` config keys, then builds the global mesh spanning all
+hosts.  On a single process it is a no-op, so the same trainer entry path
+runs unchanged from a laptop CPU to a multi-host TPU pod.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+from shifu_tensorflow_tpu.config import keys as K
+
+
+@dataclass(frozen=True)
+class ProcessTopology:
+    """One process's place in the multi-host job."""
+
+    coordinator_address: str | None = None  # "host:port"; None = single process
+    num_processes: int = 1
+    process_id: int = 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @classmethod
+    def from_conf(cls, conf) -> "ProcessTopology":
+        return cls(
+            coordinator_address=conf.get(K.COORDINATOR_ADDRESS),
+            num_processes=conf.get_int(K.NUM_PROCESSES, 1),
+            process_id=conf.get_int(K.PROCESS_ID, 0),
+        )
+
+    @classmethod
+    def from_env(cls) -> "ProcessTopology":
+        """The env-var contract (the reference bridged Java→Python entirely
+        through env vars, TensorflowTaskExecutor.java:200-238)."""
+        return cls(
+            coordinator_address=os.environ.get("SHIFU_TPU_COORDINATOR") or None,
+            num_processes=int(os.environ.get("SHIFU_TPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("SHIFU_TPU_PROCESS_ID", "0")),
+        )
+
+    @classmethod
+    def from_registration(cls, reply: dict, jax_port: int = 8476
+                          ) -> "ProcessTopology":
+        """Derive from the framework coordinator's register() reply: the
+        worker index doubles as the jax process_id (chief = process 0), and
+        the jax coordination service runs next to the chief worker."""
+        host = reply.get("chief_host") or "127.0.0.1"
+        n = int(reply.get("n_workers", 1))
+        return cls(
+            coordinator_address=f"{host}:{jax_port}" if n > 1 else None,
+            num_processes=n,
+            process_id=int(reply.get("worker_index", 0)),
+        )
+
+
+_initialized = False
+
+
+def initialize(topology: ProcessTopology) -> None:
+    """Idempotent ``jax.distributed.initialize``; no-op single-process.
+
+    Must run before the first device query in the process (JAX freezes the
+    backend on first use — same reason the test conftest pins platforms
+    before any jax import).
+    """
+    global _initialized
+    if not topology.is_distributed or _initialized:
+        return
+    if not topology.coordinator_address:
+        raise ValueError("multi-process topology needs a coordinator_address")
+    if not 0 <= topology.process_id < topology.num_processes:
+        raise ValueError(
+            f"process_id {topology.process_id} out of range for "
+            f"{topology.num_processes} processes"
+        )
+    jax.distributed.initialize(
+        coordinator_address=topology.coordinator_address,
+        num_processes=topology.num_processes,
+        process_id=topology.process_id,
+    )
+    _initialized = True
+
+
+def global_mesh(spec: str = "data:-1"):
+    """Mesh over every device in the job (all hosts).  Under
+    ``jax.distributed`` ``jax.devices()`` is already global; single-process
+    it is the local devices — one code path for both."""
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(spec, devices=jax.devices())
+
+
+def process_batch_slice(global_batch: int, topology: ProcessTopology
+                        ) -> tuple[int, int]:
+    """(rows_per_process, row_offset) for this process's shard of a global
+    batch — SPMD processes feed disjoint slices of the same logical batch.
+    Remainder rows go to the lowest-indexed processes, matching the data
+    splitter's skew-bounding policy (data/splitter.py)."""
+    base, rem = divmod(global_batch, topology.num_processes)
+    rows = base + (1 if topology.process_id < rem else 0)
+    offset = base * topology.process_id + min(topology.process_id, rem)
+    return rows, offset

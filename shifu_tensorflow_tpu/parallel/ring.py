@@ -1,0 +1,180 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence dimension anywhere (fixed-width tabular
+vectors, SURVEY.md §5.7), but long-context scaling is first-class in this
+framework: when a sequence model family lands, its attention must already
+scale past one chip's HBM.  Two standard schemes over a mesh ``seq`` axis:
+
+- **ring attention** (`ring_attention`): Q stays put; K/V blocks rotate
+  around the ring via ``jax.lax.ppermute`` while a numerically-stable
+  online softmax (running max / normalizer, flash-attention style)
+  accumulates the output.  Peak memory per chip is O(S/P) for any total
+  sequence length; the K/V transfer rides ICI and overlaps with the next
+  block's compute under XLA's scheduler.
+- **Ulysses all-to-all** (`ulysses_attention`): ``jax.lax.all_to_all``
+  re-shards sequence → heads, runs full local attention on H/P heads, and
+  re-shards back.  Cheaper collectives for moderate S; requires P | H.
+
+Both are functional ops designed for ``shard_map`` over the mesh; the
+``*_sharded`` wrappers apply the shard_map boilerplate.  Numerics are
+validated against single-device full attention in tests/test_ring.py on
+the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False
+) -> jax.Array:
+    """Reference single-device attention.  Shapes (B, S, H, D)."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _block_update(q, k, v, acc, m, l, *, scale, mask=None):
+    """One online-softmax step against a K/V block.
+
+    acc: (B, Sq, H, D) running numerator; m: (B, H, Sq) running max;
+    l: (B, H, Sq) running normalizer.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # exp(-inf - -inf) guards: where m_new is still -inf nothing has been
+    # seen; keep the correction factor at 0 to avoid NaNs
+    corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
+    p = jnp.exp(scores - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise ring attention over sequence shards.
+
+    Call inside ``shard_map`` with q/k/v sharded (B, S/P, H, D) along
+    ``axis_name``.  K/V blocks rotate ring-wise; each chip accumulates its
+    queries' output with an online softmax, so the full attention matrix is
+    never materialized and any S runs in O(S/P) memory per chip.
+    """
+    p_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    sq = q.shape[1]
+    b, _, h, d = q.shape
+
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(carry, step_idx):
+        acc, m, l, kb, vb = carry
+        # the block now held arrived from (my_idx - step_idx) around the ring
+        src = (my_idx - step_idx) % p_size
+        mask = None
+        if causal:
+            sk = kb.shape[1]
+            q_pos = my_idx * sq + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, sk), 0
+            )
+            k_pos = src * sk + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            mask = (k_pos <= q_pos)[None, None]
+        acc, m, l = _block_update(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+            acc, m, l, scale=scale, mask=mask,
+        )
+        # rotate K/V to the next chip (skippable on the last step, but a
+        # uniform loop body keeps the collective schedule static)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (acc, m, l, kb, vb), None
+
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc, m, l, k, v), jnp.arange(p_size)
+    )
+    # rows that saw no unmasked key (causal, strictly-later queries cannot
+    # exist here since every chip sees its own block, but guard anyway)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
+
+    Inside ``shard_map`` with (B, S/P, H, D) shards: all-to-all re-shards to
+    (B, S, H/P, D), full attention runs locally over the whole sequence for
+    a head subset, and the inverse all-to-all restores sequence sharding.
+    Requires the head count to be divisible by the axis size.
+    """
+    # (B, S/P, H, D) -> (B, S, H/P, D): split heads, concat sequence
+    # (tiled: concatenate into the existing axis rather than stacking a new
+    # leading P dimension)
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    qh = a2a(q, split_axis=2, concat_axis=1)
+    kh = a2a(k, split_axis=2, concat_axis=1)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    out = full_attention(qh, kh, vh, causal=causal)
+    # back: split sequence, concat heads
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def _sharded(fn, mesh, axis_name):
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+
+
+def ring_attention_sharded(
+    mesh, q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False
+):
+    """shard_map-wrapped ring attention: q/k/v are global (B, S, H, D)
+    arrays; S is sharded over ``axis_name`` of ``mesh``."""
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal)
+    return _sharded(fn, mesh, axis_name)(q, k, v)
+
+
+def ulysses_attention_sharded(
+    mesh, q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False
+):
+    fn = partial(ulysses_attention, axis_name=axis_name, causal=causal)
+    return _sharded(fn, mesh, axis_name)(q, k, v)

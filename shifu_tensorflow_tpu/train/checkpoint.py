@@ -18,7 +18,34 @@ from __future__ import annotations
 import os
 from typing import Any
 
+import jax
 import orbax.checkpoint as ocp
+from flax.core import meta as flax_meta
+
+
+def _unbox(tree):
+    """Strip flax AxisMetadata boxes (nn.Partitioned) so the on-disk pytree
+    is canonical: whether a trainer annotates params for a 'model' mesh axis
+    must not change checkpoint structure, or a checkpoint written by a
+    model-parallel job could not restore into a mesh-less export/eval
+    trainer (and vice versa)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.unbox() if isinstance(x, flax_meta.AxisMetadata) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata),
+    )
+
+
+def _rebox_like(template, values):
+    """Re-apply the template's boxing to restored raw values."""
+    return jax.tree_util.tree_map(
+        lambda t, v: t.replace_boxed(v)
+        if isinstance(t, flax_meta.AxisMetadata)
+        else v,
+        template,
+        values,
+        is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata),
+    )
 
 
 class Checkpointer:
@@ -45,11 +72,13 @@ class Checkpointer:
 
     @staticmethod
     def _tree(state) -> dict[str, Any]:
-        return {
-            "params": state.params,
-            "opt_state": state.opt_state,
-            "step": state.step,
-        }
+        return _unbox(
+            {
+                "params": state.params,
+                "opt_state": state.opt_state,
+                "step": state.step,
+            }
+        )
 
     def maybe_save(self, epoch: int, state) -> bool:
         if (epoch + 1) % self.every_epochs != 0:
@@ -74,9 +103,13 @@ class Checkpointer:
         restored = self._mgr.restore(
             latest, args=ocp.args.StandardRestore(self._tree(template_state))
         )
+        # the template decides boxing: a sharded trainer gets its
+        # nn.Partitioned annotations back regardless of who wrote the file
         state = template_state.replace(
-            params=restored["params"],
-            opt_state=restored["opt_state"],
+            params=_rebox_like(template_state.params, restored["params"]),
+            opt_state=_rebox_like(
+                template_state.opt_state, restored["opt_state"]
+            ),
             step=restored["step"],
         )
         return state, latest + 1

@@ -1,0 +1,39 @@
+"""JAX backend-environment helpers shared by the test conftest and the
+driver entry file."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(device_count: int | None = None) -> None:
+    """Pin JAX to the CPU backend and drop tunneled-TPU PJRT plugins.
+
+    Some environments register an out-of-tree TPU plugin (e.g. a tunneled
+    chip) via sitecustomize whose initialization can block indefinitely
+    during backend discovery even when ``JAX_PLATFORMS=cpu`` — so pinning
+    the platform is not enough; the plugin's backend factory must be
+    removed before the first device query.  Call before any jax.devices()/
+    jit use; ``device_count`` additionally requests a virtual multi-device
+    CPU (only effective if set before the backend initializes).
+    """
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{device_count}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:  # jax-internal, best-effort
+        import jax._src.xla_bridge as _xb
+
+        for name in list(getattr(_xb, "_backend_factories", {})):
+            if name not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
+                _xb._backend_factories.pop(name, None)
+    except Exception:  # pragma: no cover
+        pass

@@ -8,34 +8,20 @@ tested without TPU hardware.
 import os
 import sys
 
-# force CPU: the ambient environment points JAX_PLATFORMS at the tunneled
-# TPU ("axon"); tests must run on the virtual 8-device CPU mesh
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 # keep XLA/CPU math deterministic-ish and quiet in tests
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Plugins (jaxtyping) may import jax before this conftest runs, and the
-# environment's sitecustomize registers a TPU PJRT plugin ("axon") whose
-# initialization blocks when the platform is forced to cpu.  Re-pin the
-# platform on the already-imported module and drop the axon factory before
-# the first backend query.
-import jax  # noqa: E402
+# force CPU: the ambient environment points JAX_PLATFORMS at a tunneled TPU
+# plugin whose initialization blocks when the platform is forced to cpu;
+# tests must run on the virtual 8-device CPU mesh.  (Plugins like jaxtyping
+# may import jax before this conftest runs, so the shared helper re-pins the
+# platform on the already-imported module and drops the plugin factory
+# before the first backend query.)
+from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-try:  # jax-internal, best-effort
-    import jax._src.xla_bridge as _xb  # noqa: E402
-
-    for _reg in ("_backend_factories",):
-        getattr(_xb, _reg, {}).pop("axon", None)
-except Exception:  # pragma: no cover
-    pass
+force_cpu_backend(device_count=8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,79 @@
+"""Multi-host bootstrap helpers (parallel/distributed.py).
+
+Real multi-process initialize needs multiple hosts; here the derivation
+logic and the single-process no-op contract are unit-tested, and the
+global mesh path runs on the virtual 8-device mesh.
+"""
+
+import pytest
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.parallel.distributed import (
+    ProcessTopology,
+    global_mesh,
+    initialize,
+    process_batch_slice,
+)
+
+
+def test_topology_from_conf():
+    conf = Conf({
+        K.COORDINATOR_ADDRESS: "10.0.0.1:8476",
+        K.NUM_PROCESSES: 4,
+        K.PROCESS_ID: 2,
+    })
+    t = ProcessTopology.from_conf(conf)
+    assert t.is_distributed
+    assert t.coordinator_address == "10.0.0.1:8476"
+    assert (t.num_processes, t.process_id) == (4, 2)
+
+
+def test_topology_from_env(monkeypatch):
+    monkeypatch.setenv("SHIFU_TPU_COORDINATOR", "h0:1234")
+    monkeypatch.setenv("SHIFU_TPU_NUM_PROCESSES", "3")
+    monkeypatch.setenv("SHIFU_TPU_PROCESS_ID", "1")
+    t = ProcessTopology.from_env()
+    assert (t.coordinator_address, t.num_processes, t.process_id) == (
+        "h0:1234", 3, 1,
+    )
+    monkeypatch.delenv("SHIFU_TPU_COORDINATOR")
+    assert ProcessTopology.from_env().coordinator_address is None
+
+
+def test_topology_from_registration_reply():
+    t = ProcessTopology.from_registration(
+        {"worker_index": 3, "n_workers": 8, "chief_host": "w0.pod"},
+        jax_port=9999,
+    )
+    assert t.coordinator_address == "w0.pod:9999"
+    assert (t.num_processes, t.process_id) == (8, 3)
+    # single worker: no coordination service needed
+    t1 = ProcessTopology.from_registration({"worker_index": 0, "n_workers": 1})
+    assert not t1.is_distributed and t1.coordinator_address is None
+
+
+def test_initialize_single_process_noop():
+    initialize(ProcessTopology())  # must not touch jax.distributed
+
+
+def test_initialize_validates():
+    with pytest.raises(ValueError):
+        initialize(ProcessTopology(coordinator_address=None, num_processes=2))
+    with pytest.raises(ValueError):
+        initialize(ProcessTopology(
+            coordinator_address="h:1", num_processes=2, process_id=5
+        ))
+
+
+def test_global_mesh_spans_devices():
+    mesh = global_mesh("data:-1")
+    assert mesh.size == 8  # the forced virtual device count
+
+
+def test_process_batch_slice_partition():
+    # 10 rows over 4 processes: 3,3,2,2 with contiguous offsets
+    tops = [ProcessTopology("h:1", 4, i) for i in range(4)]
+    slices = [process_batch_slice(10, t) for t in tops]
+    assert slices == [(3, 0), (3, 3), (2, 6), (2, 8)]
+    assert sum(r for r, _ in slices) == 10

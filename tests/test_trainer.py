@@ -183,3 +183,42 @@ def test_mesh_indivisible_batch_padded(psv_dataset):
     assert trainer.align_batch_size(100) == 104
     history = trainer.fit(ds, batch_size=100)
     assert np.isfinite(history[0].training_loss)
+
+
+def test_checkpoint_cross_mesh_restore(psv_dataset, tmp_path):
+    """A checkpoint written by a model-parallel trainer (nn.Partitioned
+    boxed embedding table) must restore into a mesh-less trainer and vice
+    versa — the chief-export path builds exactly such a mesh-less Trainer.
+    The on-disk tree is canonical (unboxed); the restoring template decides
+    boxing."""
+    mc = _mc(epochs=1, EmbeddingColumnNums=[2, 3], EmbeddingHashSize=64,
+             EmbeddingDim=4)
+    ds = _dataset(psv_dataset)
+    feats = tuple(psv_dataset["feature_cols"])
+
+    sharded = Trainer(mc, len(feats), feature_columns=feats,
+                      mesh=make_mesh("data:4,model:2"))
+    sharded.fit(ds, epochs=1, batch_size=100)
+    with Checkpointer(str(tmp_path / "xmesh")) as ckpt:
+        ckpt.save(0, sharded.state)
+        ckpt.wait()
+
+        plain = Trainer(mc, len(feats), feature_columns=feats)
+        next_epoch = plain.restore(ckpt)
+    assert next_epoch == 1
+    # predictions agree between the two trainers after restore
+    x = ds.valid.features[:32]
+    np.testing.assert_allclose(
+        plain.predict(x), sharded.predict(x), rtol=1e-5, atol=1e-6
+    )
+
+    # and the reverse direction: plain checkpoint into a sharded template
+    with Checkpointer(str(tmp_path / "xmesh2")) as ckpt2:
+        ckpt2.save(0, plain.state)
+        ckpt2.wait()
+        sharded2 = Trainer(mc, len(feats), feature_columns=feats,
+                           mesh=make_mesh("data:4,model:2"))
+        assert sharded2.restore(ckpt2) == 1
+    np.testing.assert_allclose(
+        sharded2.predict(x), plain.predict(x), rtol=1e-5, atol=1e-6
+    )
