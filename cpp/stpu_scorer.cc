@@ -1,0 +1,587 @@
+// Native scorer for the exported model bundle.
+//
+// Parity surface: the reference scores through TensorFlow's C++ runtime via
+// JNI — Java TensorflowModel.compute feeds shifu_input_0 / fetches
+// shifu_output_0 against a SavedModel (TensorflowModel.java:53-94,112-172).
+// This scorer gives the same zero-Python batch-scoring capability against
+// the framework-native bundle (shifu_tpu_model.json + shifu_tpu_weights.npz
+// written by export/saved_model.py): it parses the architecture JSON,
+// loads float32 arrays out of the (stored, uncompressed) npz, applies
+// ZSCALE normalization, and runs the config-driven DNN forward pass.
+//
+// Scope: the plain DNN family (the only family the reference's evaluator
+// supported).  Wide&deep / multi-task / embedding-augmented bundles are
+// rejected at load with a message — callers fall back to the Python scorer
+// (export/eval_model.py), which rebuilds any family through the model
+// factory.
+//
+// C ABI (ctypes-friendly; see export/native_scorer.py):
+//   void* stpu_scorer_load(const char* dir, char* err, long errlen);
+//   long  stpu_scorer_num_features(void* h);
+//   long  stpu_scorer_score(void* h, const float* rows, long n, float* out);
+//   void  stpu_scorer_free(void* h);
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal recursive-descent parser for the known arch-file structure.
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue* get(const std::string& key) const {
+    if (kind != OBJ) return nullptr;
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void skip() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* s) {
+    size_t n = std::strlen(s);
+    if (static_cast<size_t>(end - p) < n || std::memcmp(p, s, n) != 0) {
+      ok = false;
+      return false;
+    }
+    p += n;
+    return true;
+  }
+  JValue parse() {
+    skip();
+    JValue v;
+    if (p >= end) {
+      ok = false;
+      return v;
+    }
+    switch (*p) {
+      case '{': {
+        v.kind = JValue::OBJ;
+        ++p;
+        skip();
+        if (p < end && *p == '}') {
+          ++p;
+          return v;
+        }
+        while (ok) {
+          skip();
+          JValue key = parse_string();
+          skip();
+          if (p >= end || *p != ':') {
+            ok = false;
+            break;
+          }
+          ++p;
+          v.obj[key.str] = parse();
+          skip();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            ++p;
+            break;
+          }
+          ok = false;
+        }
+        return v;
+      }
+      case '[': {
+        v.kind = JValue::ARR;
+        ++p;
+        skip();
+        if (p < end && *p == ']') {
+          ++p;
+          return v;
+        }
+        while (ok) {
+          v.arr.push_back(parse());
+          skip();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            ++p;
+            break;
+          }
+          ok = false;
+        }
+        return v;
+      }
+      case '"':
+        return parse_string();
+      case 't':
+        v.kind = JValue::BOOL;
+        v.b = true;
+        lit("true");
+        return v;
+      case 'f':
+        v.kind = JValue::BOOL;
+        v.b = false;
+        lit("false");
+        return v;
+      case 'n':
+        v.kind = JValue::NUL;
+        lit("null");
+        return v;
+      default: {
+        v.kind = JValue::NUM;
+        char* q = nullptr;
+        v.num = std::strtod(p, &q);
+        if (q == p) ok = false;
+        p = q;
+        return v;
+      }
+    }
+  }
+  JValue parse_string() {
+    JValue v;
+    v.kind = JValue::STR;
+    if (p >= end || *p != '"') {
+      ok = false;
+      return v;
+    }
+    ++p;
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {
+            // arch files are ASCII; map BMP escapes crudely to '?'
+            if (end - p >= 4) p += 4;
+            c = '?';
+            break;
+          }
+          default: c = e;
+        }
+      }
+      v.str.push_back(c);
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return v;
+  }
+};
+
+// ----------------------------------------------------------------- NPZ ----
+struct Array {
+  std::vector<long> shape;
+  std::vector<float> data;
+};
+
+uint32_t rd32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+uint16_t rd16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+
+bool parse_npy(const uint8_t* buf, size_t len, Array* out, std::string* err) {
+  if (len < 10 || std::memcmp(buf, "\x93NUMPY", 6) != 0) {
+    *err = "bad npy magic";
+    return false;
+  }
+  int major = buf[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = rd16(buf + 8);
+    hoff = 10;
+  } else {
+    if (len < 12) {
+      *err = "short npy";
+      return false;
+    }
+    hlen = rd32(buf + 8);
+    hoff = 12;
+  }
+  if (hoff + hlen > len) {
+    *err = "short npy header";
+    return false;
+  }
+  std::string header(reinterpret_cast<const char*>(buf + hoff), hlen);
+  if (header.find("'<f4'") == std::string::npos) {
+    *err = "npz array is not little-endian float32";
+    return false;
+  }
+  if (header.find("'fortran_order': False") == std::string::npos) {
+    *err = "fortran-order arrays unsupported";
+    return false;
+  }
+  size_t sp = header.find("'shape':");
+  if (sp == std::string::npos) {
+    *err = "npy header missing shape";
+    return false;
+  }
+  size_t lp = header.find('(', sp);
+  size_t rp = header.find(')', sp);
+  if (lp == std::string::npos || rp == std::string::npos) {
+    *err = "bad npy shape";
+    return false;
+  }
+  long total = 1;
+  const char* q = header.c_str() + lp + 1;
+  const char* stop = header.c_str() + rp;
+  while (q < stop) {
+    char* next = nullptr;
+    long d = std::strtol(q, &next, 10);
+    if (next == q) break;
+    out->shape.push_back(d);
+    total *= d;
+    q = next;
+    while (q < stop && (*q == ',' || *q == ' ')) ++q;
+  }
+  size_t doff = hoff + hlen;
+  if (doff + static_cast<size_t>(total) * 4 > len) {
+    *err = "npy data truncated";
+    return false;
+  }
+  out->data.resize(static_cast<size_t>(total));
+  std::memcpy(out->data.data(), buf + doff, static_cast<size_t>(total) * 4);
+  return true;
+}
+
+// Load a .npz (zip) via its central directory; stored (method 0) only —
+// np.savez writes uncompressed entries.
+bool load_npz(const std::string& path, std::map<std::string, Array>* out,
+              std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+  if (buf.size() < 22) {
+    *err = "npz too small";
+    return false;
+  }
+  // find end-of-central-directory (scan back over a possible zip comment)
+  size_t eocd = std::string::npos;
+  size_t lo = buf.size() >= (1 << 16) + 22 ? buf.size() - ((1 << 16) + 22) : 0;
+  for (size_t i = buf.size() - 22 + 1; i-- > lo;) {
+    if (rd32(buf.data() + i) == 0x06054b50) {
+      eocd = i;
+      break;
+    }
+  }
+  if (eocd == std::string::npos) {
+    *err = "zip end-of-central-directory not found";
+    return false;
+  }
+  uint16_t n_entries = rd16(buf.data() + eocd + 10);
+  uint32_t cd_off = rd32(buf.data() + eocd + 16);
+  size_t p = cd_off;
+  for (uint16_t e = 0; e < n_entries; ++e) {
+    if (p + 46 > buf.size() || rd32(buf.data() + p) != 0x02014b50) {
+      *err = "bad zip central directory";
+      return false;
+    }
+    uint16_t method = rd16(buf.data() + p + 10);
+    uint32_t csize = rd32(buf.data() + p + 20);
+    uint16_t namelen = rd16(buf.data() + p + 28);
+    uint16_t extralen = rd16(buf.data() + p + 30);
+    uint16_t commentlen = rd16(buf.data() + p + 32);
+    uint32_t lho = rd32(buf.data() + p + 42);
+    std::string name(reinterpret_cast<const char*>(buf.data() + p + 46),
+                     namelen);
+    p += 46 + namelen + extralen + commentlen;
+    if (method != 0) {
+      *err = "compressed npz unsupported (use np.savez, not savez_compressed)";
+      return false;
+    }
+    // local header: sizes may be zero there; use central-directory values
+    if (lho + 30 > buf.size() || rd32(buf.data() + lho) != 0x04034b50) {
+      *err = "bad zip local header";
+      return false;
+    }
+    uint16_t lnamelen = rd16(buf.data() + lho + 26);
+    uint16_t lextralen = rd16(buf.data() + lho + 28);
+    size_t doff = lho + 30 + lnamelen + lextralen;
+    if (doff + csize > buf.size()) {
+      *err = "zip entry truncated";
+      return false;
+    }
+    if (name.size() >= 4 && name.substr(name.size() - 4) == ".npy") {
+      Array arr;
+      if (!parse_npy(buf.data() + doff, csize, &arr, err)) {
+        *err += " (" + name + ")";
+        return false;
+      }
+      (*out)[name.substr(0, name.size() - 4)] = std::move(arr);
+    }
+  }
+  return true;
+}
+
+// --------------------------------------------------------------- model ----
+enum class Act { kSigmoid, kTanh, kRelu, kLeakyRelu };
+
+Act act_from(const std::string& name) {
+  // reference fallback semantics: unknown -> leakyrelu (ssgd_monitor.py:74-88)
+  std::string s;
+  for (char c : name) s.push_back(static_cast<char>(std::tolower(c)));
+  if (s == "sigmoid") return Act::kSigmoid;
+  if (s == "tanh") return Act::kTanh;
+  if (s == "relu") return Act::kRelu;
+  return Act::kLeakyRelu;
+}
+
+inline float apply_act(Act a, float x) {
+  switch (a) {
+    case Act::kSigmoid: return 1.0f / (1.0f + std::exp(-x));
+    case Act::kTanh: return std::tanh(x);
+    case Act::kRelu: return x > 0 ? x : 0.0f;
+    case Act::kLeakyRelu: return x > 0 ? x : 0.01f * x;  // flax default slope
+  }
+  return x;
+}
+
+struct Layer {
+  Array W;  // (in, out)
+  Array b;  // (out,)
+  Act act;
+  bool sigmoid_head = false;
+};
+
+struct Scorer {
+  long num_features = 0;
+  std::vector<float> means, stds;
+  std::vector<Layer> layers;
+};
+
+std::string read_file(const std::string& path, std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *err = "cannot open " + path;
+    return "";
+  }
+  return std::string((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+}
+
+Scorer* build_scorer(const std::string& dir, std::string* err) {
+  std::string arch_text = read_file(dir + "/shifu_tpu_model.json", err);
+  if (!err->empty()) return nullptr;
+  JParser jp{arch_text.c_str(), arch_text.c_str() + arch_text.size()};
+  JValue arch = jp.parse();
+  if (!jp.ok) {
+    *err = "arch json parse error";
+    return nullptr;
+  }
+  const JValue* params = nullptr;
+  if (const JValue* mc = arch.get("model_config"))
+    if (const JValue* tr = mc->get("train")) params = tr->get("params");
+  if (!params) {
+    *err = "arch json missing train.params";
+    return nullptr;
+  }
+  auto str_of = [](const JValue* v, const std::string& d) {
+    return v && v->kind == JValue::STR ? v->str : d;
+  };
+  auto num_of = [](const JValue* v, double d) {
+    return v && v->kind == JValue::NUM ? v->num : d;
+  };
+  std::string model_type = str_of(params->get("ModelType"), "dnn");
+  if (model_type != "dnn") {
+    *err = "native scorer supports the dnn family only (got " + model_type +
+           "); use the python scorer";
+    return nullptr;
+  }
+  const JValue* emb = params->get("EmbeddingColumnNums");
+  if (emb && emb->kind == JValue::ARR && !emb->arr.empty() &&
+      num_of(params->get("EmbeddingHashSize"), 0) > 0) {
+    *err = "embedding-augmented bundles unsupported natively; use the python "
+           "scorer";
+    return nullptr;
+  }
+
+  auto scorer = std::make_unique<Scorer>();
+  scorer->num_features =
+      static_cast<long>(num_of(arch.get("num_features"), 0));
+  if (scorer->num_features <= 0) {
+    *err = "arch json missing num_features";
+    return nullptr;
+  }
+  if (const JValue* norm = arch.get("normalization")) {
+    const JValue* means = norm->get("means");
+    const JValue* stds = norm->get("stds");
+    if (means && means->kind == JValue::ARR && stds &&
+        stds->kind == JValue::ARR) {
+      // score_rows indexes both per feature — a short array would be an
+      // out-of-bounds read, so validate like every other loader input
+      if (static_cast<long>(means->arr.size()) != scorer->num_features ||
+          static_cast<long>(stds->arr.size()) != scorer->num_features) {
+        *err = "normalization means/stds length != num_features";
+        return nullptr;
+      }
+      for (const auto& v : means->arr)
+        scorer->means.push_back(static_cast<float>(v.num));
+      for (const auto& v : stds->arr) {
+        float s = static_cast<float>(v.num);
+        scorer->stds.push_back(s == 0.0f ? 1.0f : s);
+      }
+    }
+  }
+
+  std::map<std::string, Array> weights;
+  if (!load_npz(dir + "/shifu_tpu_weights.npz", &weights, err)) return nullptr;
+
+  long n_layers = static_cast<long>(num_of(params->get("NumHiddenLayers"), 0));
+  const JValue* acts = params->get("ActivationFunc");
+  for (long i = 0; i < n_layers; ++i) {
+    std::string base = "/trunk/hidden_layer" + std::to_string(i) + "/";
+    auto wk = weights.find(base + "kernel");
+    auto bk = weights.find(base + "bias");
+    if (wk == weights.end() || bk == weights.end()) {
+      *err = "weights missing " + base + "kernel|bias";
+      return nullptr;
+    }
+    Layer layer;
+    layer.W = wk->second;
+    layer.b = bk->second;
+    layer.act = act_from(
+        acts && acts->kind == JValue::ARR &&
+                static_cast<size_t>(i) < acts->arr.size()
+            ? acts->arr[static_cast<size_t>(i)].str
+            : "");
+    scorer->layers.push_back(std::move(layer));
+  }
+  auto wk = weights.find("/shifu_output_0/kernel");
+  auto bk = weights.find("/shifu_output_0/bias");
+  if (wk == weights.end() || bk == weights.end()) {
+    *err = "weights missing /shifu_output_0/kernel|bias";
+    return nullptr;
+  }
+  Layer head;
+  head.W = wk->second;
+  head.b = bk->second;
+  head.act = Act::kSigmoid;
+  head.sigmoid_head = true;
+  scorer->layers.push_back(std::move(head));
+
+  // shape sanity: chain must start at num_features
+  long in_dim = scorer->num_features;
+  for (const auto& l : scorer->layers) {
+    if (l.W.shape.size() != 2 || l.W.shape[0] != in_dim ||
+        l.b.shape.size() != 1 || l.b.shape[0] != l.W.shape[1]) {
+      *err = "weight shape chain mismatch";
+      return nullptr;
+    }
+    in_dim = l.W.shape[1];
+  }
+  if (in_dim != 1) {
+    *err = "output head is not 1-unit";
+    return nullptr;
+  }
+  return scorer.release();
+}
+
+void score_rows(const Scorer& s, const float* rows, long n, float* out) {
+  long f = s.num_features;
+  std::vector<float> h, h2;
+  for (long r = 0; r < n; ++r) {
+    h.assign(rows + r * f, rows + (r + 1) * f);
+    if (!s.means.empty()) {
+      for (long j = 0; j < f; ++j) h[j] = (h[j] - s.means[j]) / s.stds[j];
+    }
+    for (const auto& layer : s.layers) {
+      long in = layer.W.shape[0], outd = layer.W.shape[1];
+      h2.assign(layer.b.data.begin(), layer.b.data.end());
+      // (1,in) @ (in,out): row-major W, walk inputs outer for locality
+      for (long i = 0; i < in; ++i) {
+        float xi = h[i];
+        const float* wrow = layer.W.data.data() + i * outd;
+        for (long j = 0; j < outd; ++j) h2[j] += xi * wrow[j];
+      }
+      for (long j = 0; j < outd; ++j) h2[j] = apply_act(layer.act, h2[j]);
+      h.swap(h2);
+    }
+    out[r] = h[0];
+  }
+}
+
+void set_err(char* err, long errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* stpu_scorer_load(const char* model_dir, char* err, long errlen) {
+  if (!model_dir) {
+    set_err(err, errlen, "null model_dir");
+    return nullptr;
+  }
+  std::string msg;
+  Scorer* s = build_scorer(model_dir, &msg);
+  if (!s) set_err(err, errlen, msg);
+  return s;
+}
+
+long stpu_scorer_num_features(void* handle) {
+  return handle ? static_cast<Scorer*>(handle)->num_features : -1;
+}
+
+// rows: n * num_features raw (un-normalized) float32; out: n scores.
+// Multi-threads across row blocks for large batches.  Returns n or -1.
+long stpu_scorer_score(void* handle, const float* rows, long n, float* out) {
+  if (!handle || !rows || !out || n < 0) return -1;
+  const Scorer& s = *static_cast<Scorer*>(handle);
+  const long kRowsPerThread = 4096;
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int nt = static_cast<int>(
+      std::min<long>(std::max(1, hw), (n + kRowsPerThread - 1) / kRowsPerThread));
+  if (nt <= 1) {
+    score_rows(s, rows, n, out);
+    return n;
+  }
+  std::vector<std::thread> threads;
+  long per = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    long begin = t * per;
+    long count = std::min(per, n - begin);
+    if (count <= 0) break;
+    threads.emplace_back([&s, rows, out, begin, count] {
+      score_rows(s, rows + begin * s.num_features, count, out + begin);
+    });
+  }
+  for (auto& th : threads) th.join();
+  return n;
+}
+
+void stpu_scorer_free(void* handle) { delete static_cast<Scorer*>(handle); }
+
+}  // extern "C"

@@ -5,13 +5,16 @@ Computable`` — ``init(GenericModelConfig)`` loads the SavedModel bundle,
 ``compute(MLData)`` converts a row of doubles to a float tensor, feeds
 ``shifu_input_0``, fetches ``shifu_output_0``, returns the scalar
 (TensorflowModel.java:32,53-94,112-172).  ``EvalModel`` mirrors that
-lifecycle (init → compute/compute_batch → release) with two backends:
+lifecycle (init → compute/compute_batch → release) with three backends:
 
 - ``native``: rebuilds the flax model from ``shifu_tpu_model.json`` and
   loads ``shifu_tpu_weights.npz`` — zero TF dependency;
 - ``saved_model``: loads the TF SavedModel through TensorFlow when
   available, scoring through the exact signature the Java evaluator uses —
-  this is the cross-check that the exported artifact honors the contract.
+  this is the cross-check that the exported artifact honors the contract;
+- ``cpp``: the C++ scorer (cpp/stpu_scorer.cc via ctypes) — the
+  zero-Python-runtime path matching the reference's JNI evaluator; DNN
+  family only.
 """
 
 from __future__ import annotations
@@ -47,6 +50,8 @@ class EvalModel:
             self._init_native()
         elif backend == "saved_model":
             self._init_saved_model()
+        elif backend == "cpp":
+            self._init_cpp()
         else:
             raise ValueError(f"unknown backend {backend!r}")
 
@@ -70,6 +75,14 @@ class EvalModel:
         import jax.numpy as jnp
 
         self._jnp = jnp
+
+    def _init_cpp(self) -> None:
+        from shifu_tensorflow_tpu.export.native_scorer import NativeScorer
+
+        self._cpp = NativeScorer(self.model_dir)
+        self.num_features = self._cpp.num_features
+        # normalization is applied inside the native scorer
+        self._means = self._stds = None
 
     def _init_saved_model(self) -> None:
         import tensorflow as tf
@@ -108,6 +121,8 @@ class EvalModel:
         if self.backend == "native":
             out = self._model.apply({"params": self._params}, self._jnp.asarray(rows))
             return np.asarray(out)
+        if self.backend == "cpp":
+            return self._cpp.score(rows)
         result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
         return result[OUTPUT_NAME].numpy()
 
@@ -115,7 +130,9 @@ class EvalModel:
         """Explicit resource release (closeTensors parity,
         TensorflowModel.java:97-109) — backends hold no leaked handles, so
         this just drops references."""
-        for attr in ("_model", "_params", "_infer", "_tf", "_jnp"):
+        if hasattr(self, "_cpp"):
+            self._cpp.close()
+        for attr in ("_model", "_params", "_infer", "_tf", "_jnp", "_cpp"):
             if hasattr(self, attr):
                 delattr(self, attr)
 

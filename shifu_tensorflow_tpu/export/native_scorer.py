@@ -1,0 +1,105 @@
+"""ctypes binding for the C++ scorer (cpp/stpu_scorer.cc).
+
+The zero-Python-runtime scoring path: parity with the reference's
+Java→libtensorflow JNI evaluator (TensorflowModel.java:112-172) for the
+plain DNN family.  ``EvalModel(backend="cpp")`` routes here; other model
+families raise at load and callers use the Python scorer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from shifu_tensorflow_tpu import _native
+
+_lib = None
+_checked = False
+
+
+def _load():
+    global _lib, _checked
+    if not _checked:
+        lib = _native.load("stpu_scorer")
+        if lib is not None:
+            try:
+                lib.stpu_scorer_load.restype = ctypes.c_void_p
+                lib.stpu_scorer_load.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long,
+                ]
+                lib.stpu_scorer_num_features.restype = ctypes.c_long
+                lib.stpu_scorer_num_features.argtypes = [ctypes.c_void_p]
+                lib.stpu_scorer_score.restype = ctypes.c_long
+                lib.stpu_scorer_score.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.c_long,
+                    ctypes.POINTER(ctypes.c_float),
+                ]
+                lib.stpu_scorer_free.restype = None
+                lib.stpu_scorer_free.argtypes = [ctypes.c_void_p]
+            except AttributeError:
+                lib = None
+        _lib = lib
+        _checked = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeScorer:
+    """Owns a loaded C++ scorer handle; scores raw (un-normalized) rows —
+    ZSCALE from the bundle is applied inside the native code."""
+
+    def __init__(self, model_dir: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native scorer library unavailable")
+        self._lib = lib
+        err = ctypes.create_string_buffer(512)
+        self._handle = lib.stpu_scorer_load(
+            model_dir.encode(), err, len(err)
+        )
+        if not self._handle:
+            raise RuntimeError(
+                f"native scorer load failed: {err.value.decode()}"
+            )
+        self.num_features = int(lib.stpu_scorer_num_features(self._handle))
+
+    def score(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected (n, {self.num_features}) rows, got {rows.shape}"
+            )
+        n = rows.shape[0]
+        out = np.empty((n, 1), np.float32)
+        got = self._lib.stpu_scorer_score(
+            self._handle,
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if got != n:
+            raise RuntimeError(f"native scoring failed (returned {got})")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.stpu_scorer_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass

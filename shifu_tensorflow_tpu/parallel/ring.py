@@ -157,10 +157,11 @@ def ulysses_attention(
 
 
 def _sharded(fn, mesh, axis_name):
+    from shifu_tensorflow_tpu.parallel.shmap import shard_map
+
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+    return shard_map(
+        fn, mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
 
 

@@ -1,0 +1,25 @@
+"""``jax.shard_map`` with the replication-check kwarg pinned across jax
+versions (renamed ``check_rep`` → ``check_vma`` in jax 0.9) — the one shim
+every shard_map call site in the framework shares."""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(jax.shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, *, check_replication=False):
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_replication},
+    )

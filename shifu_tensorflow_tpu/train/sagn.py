@@ -52,15 +52,7 @@ from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
 from shifu_tensorflow_tpu.train.optimizers import make_base_optimizer
 from shifu_tensorflow_tpu.train.trainer import Trainer
 
-import inspect
-
-shard_map = jax.shard_map
-# the replication-check kwarg was renamed check_rep -> check_vma in jax 0.9
-_CHECK_KW = (
-    "check_vma"
-    if "check_vma" in inspect.signature(shard_map).parameters
-    else "check_rep"
-)
+from shifu_tensorflow_tpu.parallel.shmap import shard_map
 
 
 def make_sagn_step(
@@ -125,7 +117,6 @@ def make_sagn_step(
             mesh=mesh,
             in_specs=(P(), P(None, DATA_AXIS)),
             out_specs=(P(), P()),
-            **{_CHECK_KW: False},
         )
         def window_fn(params, wb):
             gsum, lsum, csum = local_window(params, wb)

@@ -123,3 +123,82 @@ def test_saved_model_backend_applies_zscale(psv_dataset, tmp_path):
          EvalModel(export_dir, backend="saved_model") as b:
         np.testing.assert_allclose(a.compute_batch(raw), b.compute_batch(raw),
                                    rtol=1e-4, atol=1e-5)
+
+
+# ---- C++ scorer (cpp/stpu_scorer.cc — JNI-evaluator parity path) ----
+
+def _cpp_available():
+    from shifu_tensorflow_tpu.export import native_scorer
+
+    return native_scorer.available()
+
+
+needs_cpp = pytest.mark.skipif(
+    not _cpp_available(), reason="native scorer library unavailable"
+)
+
+
+@needs_cpp
+def test_cpp_scorer_matches_python(psv_dataset, tmp_path):
+    t, ds, export_dir, _ = _trained(psv_dataset, tmp_path)
+    x = ds.valid.features[:200]
+    with EvalModel(export_dir, backend="native") as py_em, \
+            EvalModel(export_dir, backend="cpp") as cpp_em:
+        want = py_em.compute_batch(x)
+        got = cpp_em.compute_batch(x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+    assert got.min() >= 0.0 and got.max() <= 1.0
+    # single-row compute parity (Computable.compute contract)
+    with EvalModel(export_dir, backend="cpp") as em:
+        assert abs(em.compute(x[0]) - float(want[0, 0])) < 1e-5
+
+
+@needs_cpp
+def test_cpp_scorer_applies_zscale(psv_dataset, tmp_path):
+    """ZSCALE happens inside the native code; both backends must agree on
+    raw (un-normalized) inputs."""
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    ds = InMemoryDataset.load(psv_dataset["paths"], schema, 0.2)
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 1, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 2, "NumHiddenNodes": [8, 4],
+                              "ActivationFunc": ["tanh", "weird_name"],
+                              "LearningRate": 0.05, "Optimizer": "adam"}}}
+    )
+    t = Trainer(mc, schema.num_features)
+    t.fit(ds, batch_size=100)
+    export_dir = str(tmp_path / "zs-model")
+    means = [0.1] * schema.num_features
+    stds = [2.0] * schema.num_features
+    export_model(export_dir, t, feature_columns=psv_dataset["feature_cols"],
+                 zscale_means=means, zscale_stds=stds)
+    x = ds.valid.features[:64]
+    with EvalModel(export_dir, backend="native") as py_em, \
+            EvalModel(export_dir, backend="cpp") as cpp_em:
+        np.testing.assert_allclose(
+            cpp_em.compute_batch(x), py_em.compute_batch(x),
+            rtol=2e-5, atol=2e-6,
+        )
+
+
+@needs_cpp
+def test_cpp_scorer_rejects_unsupported_family(psv_dataset, tmp_path):
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 1, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam",
+                              "EmbeddingColumnNums": [2],
+                              "EmbeddingHashSize": 32, "EmbeddingDim": 4}}}
+    )
+    t = Trainer(mc, 10, feature_columns=tuple(range(10)))
+    export_dir = str(tmp_path / "emb-model")
+    export_model(export_dir, t, feature_columns=tuple(range(10)))
+    with pytest.raises(RuntimeError, match="python scorer"):
+        EvalModel(export_dir, backend="cpp")
