@@ -21,6 +21,7 @@
 //   long  stpu_scorer_score(void* h, const float* rows, long n, float* out);
 //   void  stpu_scorer_free(void* h);
 
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -148,10 +149,12 @@ struct JParser {
         return v;
       default: {
         v.kind = JValue::NUM;
-        char* q = nullptr;
-        v.num = std::strtod(p, &q);
-        if (q == p) ok = false;
-        p = q;
+        // from_chars, not strtod: a host app embedding this library may
+        // have set a non-C LC_NUMERIC locale, under which strtod stops at
+        // the '.' and silently misparses every number
+        auto res = std::from_chars(p, end, v.num);
+        if (res.ec != std::errc() || res.ptr == p) ok = false;
+        p = res.ptr;
         return v;
       }
     }
@@ -370,7 +373,6 @@ struct Layer {
   Array W;  // (in, out)
   Array b;  // (out,)
   Act act;
-  bool sigmoid_head = false;
 };
 
 struct Scorer {
@@ -486,7 +488,6 @@ Scorer* build_scorer(const std::string& dir, std::string* err) {
   head.W = wk->second;
   head.b = bk->second;
   head.act = Act::kSigmoid;
-  head.sigmoid_head = true;
   scorer->layers.push_back(std::move(head));
 
   // shape sanity: chain must start at num_features

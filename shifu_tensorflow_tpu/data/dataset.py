@@ -34,7 +34,8 @@ from shifu_tensorflow_tpu.utils import fs
 
 Batch = dict[str, np.ndarray]  # {"x": (B,F), "y": (B,1), "w": (B,1)}
 
-_SENTINEL = object()
+# reader-thread end marker: (_TAIL, leftover ParsedBlock)
+_TAIL = object()
 
 
 def make_batch(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> Batch:
@@ -114,11 +115,21 @@ class InMemoryDataset:
 
 
 class ShardStream:
-    """Background streaming reader: files → line blocks → parsed batches.
+    """Background streaming reader: files → byte blocks → parsed batches.
 
-    One reader thread fills a bounded queue of fixed-size batches; the
-    consumer (training loop) drains it.  Block size trades parse overhead
-    against memory; defaults target ~1-4 MB of rows per parse call.
+    ``n_readers`` threads split the file list and fill one bounded queue of
+    fixed-size batches; the consumer (training loop) drains it.  Reading,
+    decompression, and (native) parsing of different files overlap with
+    each other and with device step time — the ingredient the 1B-row
+    rows/sec target needs (SURVEY.md §7.2 item 1).  Block size trades parse
+    overhead against memory; defaults target ~1-4 MB per parse call.
+
+    Determinism: row→train/valid membership is per-row content hashing and
+    independent of reader count; with ``n_readers > 1`` the *order* in
+    which batches arrive (and the composition of batches at file
+    boundaries) depends on thread interleaving, so the default stays at 1
+    reader — fully reproducible — and parallel ingest is an explicit
+    opt-in for hosts with cores to spare.
     """
 
     def __init__(
@@ -133,6 +144,7 @@ class ShardStream:
         queue_depth: int = 8,
         drop_remainder: bool = False,
         salt: int = 0,
+        n_readers: int | None = None,
     ):
         self.paths = list(paths)
         self.schema = schema
@@ -143,6 +155,9 @@ class ShardStream:
         self.queue_depth = queue_depth
         self.drop_remainder = drop_remainder
         self.salt = salt
+        if n_readers is None:
+            n_readers = 1
+        self.n_readers = max(1, min(n_readers, max(1, len(self.paths))))
 
     @staticmethod
     def _put_or_stop(q: "queue.Queue", stop: threading.Event, item) -> bool:
@@ -156,10 +171,17 @@ class ShardStream:
                 continue
         return False
 
-    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+    def _produce(
+        self,
+        files: Sequence[str],
+        q: "queue.Queue",
+        stop: threading.Event,
+    ) -> None:
+        """One reader thread: emit full batches from its file subset, then a
+        ``(_TAIL, leftover_block)`` marker the consumer merges."""
         carry = ParsedBlock.empty(self.schema.num_features)
         try:
-            for path in self.paths:
+            for path in files:
                 # read decompressed bytes in large blocks, cut at the last
                 # newline, and hand whole buffers to the (native) block
                 # parser — no per-line Python work on the hot path
@@ -182,18 +204,7 @@ class ShardStream:
                     carry = self._emit_batches(q, stop, carry, tail)
                 if stop.is_set():
                     return
-            # flush the tail
-            if len(carry) and not self.drop_remainder:
-                padded = pad_to_batch(carry, self.batch_size)
-                for i in range(0, len(padded), self.batch_size):
-                    sl = slice(i, i + self.batch_size)
-                    if not self._put_or_stop(
-                        q, stop,
-                        make_batch(padded.features[sl], padded.targets[sl],
-                                   padded.weights[sl]),
-                    ):
-                        return
-            self._put_or_stop(q, stop, _SENTINEL)
+            self._put_or_stop(q, stop, (_TAIL, carry))
         except Exception as e:  # surface reader errors to the consumer
             self._put_or_stop(q, stop, e)
 
@@ -217,24 +228,62 @@ class ShardStream:
     def __iter__(self) -> Iterator[Batch]:
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         stop = threading.Event()
-        t = threading.Thread(target=self._produce, args=(q, stop), daemon=True)
-        t.start()
+        if self.n_readers == 1:
+            buckets = [self.paths]
+        else:
+            # size-aware assignment (greedy LPT): one huge file must not
+            # leave the other readers idle for most of the epoch
+            from shifu_tensorflow_tpu.data.splitter import split_size_aware
+
+            buckets = [
+                list(s.paths)
+                for s in split_size_aware(self.paths, self.n_readers)
+            ]
+        threads = [
+            threading.Thread(
+                target=self._produce, args=(files, q, stop), daemon=True
+            )
+            for files in buckets
+            if files
+        ]
+        for t in threads:
+            t.start()
+        tails: list[ParsedBlock] = []
+        done = 0
         try:
-            while True:
+            while done < len(threads):
                 item = q.get()
-                if item is _SENTINEL:
-                    return
                 if isinstance(item, Exception):
                     raise item
+                if isinstance(item, tuple) and item[0] is _TAIL:
+                    tails.append(item[1])
+                    done += 1
+                    continue
                 yield item
+            # merge per-reader leftovers: full batches always stream; only
+            # the final sub-batch remainder is dropped under drop_remainder
+            # (at most batch_size-1 rows, independent of reader count)
+            tails = [t for t in tails if len(t)]
+            if tails:
+                merged = ParsedBlock.concat(tails) if len(tails) > 1 else tails[0]
+                if not self.drop_remainder:
+                    merged = pad_to_batch(merged, self.batch_size)
+                n_full = (len(merged) // self.batch_size) * self.batch_size
+                for i in range(0, n_full, self.batch_size):
+                    sl = slice(i, i + self.batch_size)
+                    yield make_batch(
+                        merged.features[sl], merged.targets[sl],
+                        merged.weights[sl],
+                    )
         finally:
             stop.set()
-            # drain so the producer can observe stop and exit
-            while t.is_alive():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+            # drain so producers can observe stop and exit
+            for t in threads:
+                while t.is_alive():
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
 
 
 def prefetch_to_device(

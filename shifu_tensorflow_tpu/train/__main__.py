@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help='mesh spec, e.g. "data:-1" or "data:4,model:2"')
     p.add_argument("--stream", action="store_true",
                    help="stream shards (1B-row path) instead of loading to RAM")
+    p.add_argument("--readers", type=int, default=None,
+                   help="parallel reader threads for --stream (default 1 = "
+                        "reproducible batch order; >1 trades determinism "
+                        "for ingest throughput)")
     p.add_argument("--seed", type=int, default=0)
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
@@ -187,10 +191,12 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                     lambda epoch: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="train", salt=args.seed,
+                        n_readers=args.readers,
                     ),
                     (lambda: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="valid", salt=args.seed,
+                        n_readers=args.readers,
                     )) if valid_rate > 0 else None,
                     epochs=epochs,
                     on_epoch=_print_epoch,

@@ -226,3 +226,57 @@ def test_shard_stream_abandoned_consumer_unblocks(psv_dataset):
         time.sleep(0.1)
     # producer must not be stuck on a full queue
     assert time.time() < deadline
+
+
+def _stream_row_multiset(stream, n_features):
+    """Sorted real rows (weight>0) across all batches — order-insensitive."""
+    rows = []
+    for b in stream:
+        mask = b["w"][:, 0] > 0
+        rows.append(np.concatenate(
+            [b["x"][mask], b["y"][mask], b["w"][mask]], axis=1))
+    allr = np.concatenate(rows, axis=0) if rows else np.empty((0, n_features + 2))
+    return allr[np.lexsort(allr.T[::-1])]
+
+
+@pytest.mark.parametrize("n_readers", [1, 3, 4])
+def test_shard_stream_parallel_readers_same_rows(psv_dataset, n_readers):
+    """Reader-count must not change WHICH rows stream (membership is per-row
+    content hashing), only arrival order."""
+    schema = _schema(psv_dataset)
+    nf = psv_dataset["n_features"]
+    base = _stream_row_multiset(
+        ShardStream(psv_dataset["paths"], schema, batch_size=32,
+                    valid_rate=0.2, n_readers=1), nf)
+    got = _stream_row_multiset(
+        ShardStream(psv_dataset["paths"], schema, batch_size=32,
+                    valid_rate=0.2, n_readers=n_readers, block_bytes=512), nf)
+    np.testing.assert_array_equal(got, base)
+
+
+def test_shard_stream_parallel_fixed_batch_shapes(psv_dataset):
+    schema = _schema(psv_dataset)
+    shapes = {
+        b["x"].shape
+        for b in ShardStream(psv_dataset["paths"], schema, batch_size=32,
+                             n_readers=4)
+    }
+    assert shapes == {(32, psv_dataset["n_features"])}
+
+
+def test_shard_stream_parallel_error_propagates(psv_dataset, tmp_path):
+    schema = _schema(psv_dataset)
+    paths = list(psv_dataset["paths"]) + [str(tmp_path / "nope")]
+    with pytest.raises(FileNotFoundError):
+        list(ShardStream(paths, schema, batch_size=16, n_readers=4))
+
+
+def test_shard_stream_drop_remainder(psv_dataset):
+    schema = _schema(psv_dataset)
+    n = psv_dataset["n_rows"]
+    batches = list(ShardStream(psv_dataset["paths"], schema, batch_size=32,
+                               drop_remainder=True, n_readers=2))
+    # every batch full and entirely real rows may not hold at file tails
+    # (tails are dropped), so just check: full shape, count <= n//32
+    assert all(b["x"].shape == (32, psv_dataset["n_features"]) for b in batches)
+    assert len(batches) <= n // 32
