@@ -21,6 +21,7 @@
 //   long  stpu_scorer_score(void* h, const float* rows, long n, float* out);
 //   void  stpu_scorer_free(void* h);
 
+#include <cctype>
 #include <charconv>
 #include <cmath>
 #include <cstdint>
@@ -32,7 +33,44 @@
 #include <thread>
 #include <vector>
 
+#if !(defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L)
+#include <locale.h>
+#include <stdlib.h>
+#if defined(__APPLE__)
+#include <xlocale.h>
+#endif
+#endif
+
 namespace {
+
+// Locale-independent number parse: a host app embedding this library may
+// have set a non-C LC_NUMERIC locale, under which plain strtod stops at the
+// '.' and silently misparses every number.  Prefer from_chars; fall back to
+// a locale-pinned strtod_l on toolchains without the floating-point
+// overload (libc++ before LLVM 20).
+inline bool parse_json_number(const char* p, const char* end, double* out,
+                              const char** next) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto res = std::from_chars(p, end, *out);
+  if (res.ec != std::errc() || res.ptr == p) return false;
+  *next = res.ptr;
+  return true;
+#else
+  // bound the token (JSON number grammar chars) and NUL-terminate a copy
+  const char* q = p;
+  while (q < end && (std::isdigit(static_cast<unsigned char>(*q)) ||
+                     *q == '+' || *q == '-' || *q == '.' || *q == 'e' ||
+                     *q == 'E'))
+    ++q;
+  std::string tok(p, q);
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", static_cast<locale_t>(0));
+  char* tail = nullptr;
+  *out = strtod_l(tok.c_str(), &tail, c_loc);
+  if (tail == tok.c_str()) return false;
+  *next = p + (tail - tok.c_str());
+  return true;
+#endif
+}
 
 // ---------------------------------------------------------------- JSON ----
 // Minimal recursive-descent parser for the known arch-file structure.
@@ -149,12 +187,7 @@ struct JParser {
         return v;
       default: {
         v.kind = JValue::NUM;
-        // from_chars, not strtod: a host app embedding this library may
-        // have set a non-C LC_NUMERIC locale, under which strtod stops at
-        // the '.' and silently misparses every number
-        auto res = std::from_chars(p, end, v.num);
-        if (res.ec != std::errc() || res.ptr == p) ok = false;
-        p = res.ptr;
+        if (!parse_json_number(p, end, &v.num, &p)) ok = false;
         return v;
       }
     }

@@ -45,6 +45,7 @@ class WorkerConfig:
     heartbeat_interval_s: float = 0.5
     mesh_spec: str | None = None
     seed: int = 0
+    dtype: str | None = None  # "float32" | "bfloat16"; None -> float32
 
 
 class _HeartbeatThread(threading.Thread):
@@ -109,12 +110,23 @@ def run_worker(cfg: WorkerConfig, *,
             from shifu_tensorflow_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(cfg.mesh_spec)
+        extra = {}
+        if cfg.dtype:
+            import jax.numpy as jnp
+
+            extra["dtype"] = {"float32": jnp.float32,
+                              "bfloat16": jnp.bfloat16}[cfg.dtype]
+        # feature_columns must match what the export trainer will use, or
+        # wide/embedding column positions (and so the param tree) diverge
+        # between the trained checkpoint and the restored export model
         trainer = make_trainer(
             cfg.model_config,
             cfg.schema.num_features,
+            feature_columns=cfg.schema.feature_columns,
             mesh=mesh,
             worker_index=worker_index,
             seed=cfg.seed,
+            **extra,
         )
 
         start_epoch = 0

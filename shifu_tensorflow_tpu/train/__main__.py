@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "reproducible batch order; >1 trades determinism "
                         "for ingest throughput)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="compute dtype (default float32; bfloat16 feeds the "
+                        "MXU at full rate on TPU)")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -146,8 +150,8 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     from shifu_tensorflow_tpu.data.splitter import list_data_files
     from shifu_tensorflow_tpu.export.saved_model import export_model
     from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train import make_trainer
     from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
-    from shifu_tensorflow_tpu.train.trainer import Trainer
     from shifu_tensorflow_tpu.utils.profiling import trace_if
 
     data_path = conf.get(K.TRAINING_DATA_PATH)
@@ -158,12 +162,21 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
 
     mesh_spec = conf.get(K.MESH_SHAPE, K.DEFAULT_MESH_SHAPE)
     mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
-    trainer = Trainer(
+    extra = {}
+    if args.dtype:
+        import jax.numpy as jnp
+
+        extra["dtype"] = {"float32": jnp.float32,
+                          "bfloat16": jnp.bfloat16}[args.dtype]
+    # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
+    # the reference selected between its two programs by script path
+    trainer = make_trainer(
         model_config,
         schema.num_features,
         feature_columns=schema.feature_columns,
         mesh=mesh,
         seed=args.seed,
+        **extra,
     )
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
     batch_size = trainer.align_batch_size(
@@ -266,6 +279,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
         board_path=args.board_path,
     )
 
+    if args.stream or args.readers:
+        print("--stream/--readers apply to single-process runs only; "
+              "multi-worker jobs load their shard in memory", file=sys.stderr)
+
     def make_cfg(worker_id: str, addr) -> WorkerConfig:
         return WorkerConfig(
             worker_id=worker_id,
@@ -277,6 +294,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             checkpoint_dir=args.checkpoint_dir,
             valid_rate=args.valid_rate,
             seed=args.seed,
+            dtype=args.dtype,
         )
 
     submitter = JobSubmitter(spec, make_cfg)
@@ -316,10 +334,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             print_summary()
             return 2
         from shifu_tensorflow_tpu.export.saved_model import export_model
+        from shifu_tensorflow_tpu.train import make_trainer
         from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
-        from shifu_tensorflow_tpu.train.trainer import Trainer
 
-        trainer = Trainer(
+        trainer = make_trainer(
             model_config,
             schema.num_features,
             feature_columns=schema.feature_columns,
