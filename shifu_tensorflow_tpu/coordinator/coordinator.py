@@ -59,6 +59,18 @@ class WorkerRecord:
     completed: bool = False
     exit_code: int | None = None
     restarts: int = 0
+    # cross-process SPMD bring-up: the worker's host and, for the chief, the
+    # TCP port it reserved for the jax coordination service
+    host: str = ""
+    jax_port: int = 0
+    # which fleet generation this record last registered into (SPMD
+    # recovery restarts the whole fleet; see _fleet_restart)
+    generation: int = 0
+
+
+#: cooperative exit code for a worker leaving because the fleet is
+#: restarting (not a failure; does not consume restart budget)
+RESTART_EXIT_CODE = 44
 
 
 @dataclass
@@ -80,6 +92,16 @@ class JobSpec:
     # relaunch catches up, so recovery is deterministic, not racy
     sync_epochs: bool = False
     epoch_barrier_timeout_s: float = 300.0
+    # cross-process SPMD: the worker fleet is ONE jax.distributed job
+    # training one model (gradient all-reduce across processes — the
+    # SyncReplicasOptimizer semantic, ssgd_monitor.py:136-142).  Any worker
+    # failure restarts the whole fleet from the shared checkpoint, because
+    # SPMD cannot lose a participant mid-collective (SURVEY.md §2.5).
+    spmd: bool = False
+    # per-shard line counts, index-aligned to ``shards`` (None = unknown;
+    # workers count their own shard once and the coordinator caches it so
+    # fleet restarts never re-read 1B-row shards just to size their epochs)
+    shard_lines: list | None = None
 
 
 class Coordinator:
@@ -95,6 +117,19 @@ class Coordinator:
         self._epoch_cond = threading.Condition(self._lock)
         self._last_epoch: dict[int, int] = {}  # worker_index -> max epoch reported
         self._created_at = time.monotonic()
+        # SPMD fleet generations: bumped on fleet restart; the submitter
+        # watches this to kill + relaunch every worker process
+        self._generation = 0
+        self._gen_started_at = self._created_at
+        self._plan_cond = threading.Condition(self._lock)
+        self._plans: dict[int, dict] = {}  # worker_index -> execution plan
+        # worker_index -> shard line count; seeded from the spec, updated
+        # from workers' sync_plan reports, survives fleet restarts
+        self._shard_lines: dict[int, int] = {
+            i: int(n)
+            for i, n in enumerate(spec.shard_lines or [])
+            if n is not None
+        }
         self.failure_reason: str | None = None
         self.aggregator = EpochAggregator(
             spec.n_workers, board_path=spec.board_path
@@ -127,10 +162,20 @@ class Coordinator:
             self.failure_reason = reason
             self._start_barrier.set()  # release anyone waiting
             self._epoch_cond.notify_all()
+            self._plan_cond.notify_all()
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
 
     # ---- worker lifecycle (all called under the TCP handlers) ----
     def register(
-        self, worker_id: str, worker_index: int | None = None
+        self,
+        worker_id: str,
+        worker_index: int | None = None,
+        host: str | None = None,
+        jax_port: int | None = None,
     ) -> dict[str, Any]:
         """``worker_index`` pins the caller to a specific slot (the submitter
         launches worker i with index i, so chief identity is deterministic,
@@ -178,8 +223,16 @@ class Coordinator:
                 # shard, TensorflowSession.java:748-781)
                 rec.completed = False
                 rec.exit_code = None
+            rec.generation = self._generation
+            if host is not None:
+                rec.host = host
+            if jax_port is not None:
+                rec.jax_port = int(jax_port)
             self.liveness.register(worker_id)
-            if len(self.workers) == self.spec.n_workers:
+            if len(self.workers) == self.spec.n_workers and all(
+                r.generation == self._generation
+                for r in self.workers.values()
+            ):
                 if self.state == JobState.REGISTERING:
                     self.state = JobState.TRAINING
                     self.liveness.start()
@@ -193,25 +246,49 @@ class Coordinator:
                 "epochs": self.spec.epochs,
                 "state": self.state.value,
                 "sync_epochs": self.spec.sync_epochs,
+                "spmd": self.spec.spmd,
+                "generation": self._generation,
+                "shard_lines": self._shard_lines.get(rec.worker_index),
             }
 
+    def _cluster_info(self) -> dict[str, Any]:
+        """SPMD bring-up info: where the chief's jax coordination service
+        lives.  Meaningful only once every worker of the current generation
+        has registered (the await_start barrier guarantees that)."""
+        chief_id = self._by_index.get(0)
+        chief = self.workers.get(chief_id) if chief_id else None
+        return {
+            "chief_host": (chief.host if chief else "") or "127.0.0.1",
+            "jax_port": chief.jax_port if chief else 0,
+            "n_workers": self.spec.n_workers,
+            "generation": self._generation,
+        }
+
     def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
-        # registration deadline is absolute (measured from job creation),
-        # not per-call — late callers can't extend the window, and a
-        # short-timeout status probe can't kill the job
+        # registration deadline is absolute (measured from job creation, or
+        # from the current fleet generation's start), not per-call — late
+        # callers can't extend the window, and a short-timeout status probe
+        # can't kill the job
+        with self._lock:
+            barrier = self._start_barrier  # this generation's barrier
+            gen_start = self._gen_started_at
         remaining = self.spec.registration_timeout_s - (
-            time.monotonic() - self._created_at
+            time.monotonic() - gen_start
         )
         wait = max(0.0, remaining)
         if timeout_s is not None:
             wait = min(wait, timeout_s)
-        ok = self._start_barrier.wait(timeout=wait)
+        ok = barrier.wait(timeout=wait)
         with self._lock:
             if self.state == JobState.FAILED:
                 return {"ok": False, "error": self.failure_reason}
             if ok:
-                return {"ok": True, "state": self.state.value}
-            if time.monotonic() - self._created_at >= self.spec.registration_timeout_s:
+                return {
+                    "ok": True,
+                    "state": self.state.value,
+                    "cluster": self._cluster_info(),
+                }
+            if time.monotonic() - gen_start >= self.spec.registration_timeout_s:
                 self._fail(
                     f"registration timeout: {len(self.workers)}/"
                     f"{self.spec.n_workers} workers after "
@@ -221,9 +298,80 @@ class Coordinator:
             # caller's own (shorter) timeout expired; job still registering
             return {"ok": False, "error": "await timeout", "retryable": True}
 
+    def sync_plan(
+        self, worker_id: str, plan: dict, timeout_s: float | None = None
+    ) -> dict[str, Any]:
+        """Barrier agreeing the per-epoch execution plan across the fleet.
+
+        Each SPMD worker reports its local view — per-epoch step counts
+        derived from its shard size, and the latest checkpoint epoch visible
+        on its filesystem — and receives the fleet agreement: the MAX step
+        counts (short shards pad with zero-weight batches; every process
+        must run identical step sequences or the all-reduce deadlocks) and
+        the MIN visible checkpoint (guards the race where the chief saved a
+        new checkpoint between two workers' directory listings).
+        """
+        deadline = time.monotonic() + (
+            timeout_s
+            if timeout_s is not None
+            else self.spec.epoch_barrier_timeout_s
+        )
+        with self._plan_cond:
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return {"ok": False, "error": f"unknown worker {worker_id}"}
+            gen = self._generation
+            self._plans[rec.worker_index] = dict(plan)
+            if plan.get("shard_lines") is not None:
+                # cache the worker's one-time shard count for its relaunches
+                self._shard_lines[rec.worker_index] = int(plan["shard_lines"])
+            self._plan_cond.notify_all()
+            while True:
+                if self.state == JobState.FAILED:
+                    return {
+                        "ok": False,
+                        "abort": True,
+                        "error": self.failure_reason,
+                    }
+                if self._generation != gen:
+                    return {"ok": False, "restart": True}
+                if len(self._plans) == self.spec.n_workers:
+                    plans = list(self._plans.values())
+                    return {
+                        "ok": True,
+                        "train_steps": max(
+                            int(p.get("train_steps", 0)) for p in plans
+                        ),
+                        "valid_steps": max(
+                            int(p.get("valid_steps", 0)) for p in plans
+                        ),
+                        "ckpt_epoch": min(
+                            int(p.get("ckpt_epoch", -1)) for p in plans
+                        ),
+                    }
+                if time.monotonic() >= deadline:
+                    missing = [
+                        i
+                        for i in range(self.spec.n_workers)
+                        if i not in self._plans
+                    ]
+                    return {
+                        "ok": False,
+                        "error": (
+                            f"sync_plan timeout for {worker_id!r} "
+                            f"(workers missing: {missing})"
+                        ),
+                    }
+                self._plan_cond.wait(timeout=0.2)
+
     def heartbeat(self, worker_id: str) -> dict[str, Any]:
         self.liveness.beat(worker_id)
-        return {"ok": True, "abort": self.state == JobState.FAILED}
+        with self._lock:
+            return {
+                "ok": True,
+                "abort": self.state == JobState.FAILED,
+                "generation": self._generation,
+            }
 
     def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
         stats = EpochStats(**stats_dict)
@@ -280,6 +428,11 @@ class Coordinator:
             rec.completed = True
             rec.exit_code = exit_code
             self.liveness.unregister(worker_id)
+            if exit_code == RESTART_EXIT_CODE:
+                # cooperative exit because the fleet is restarting — not a
+                # failure; the submitter relaunches this worker into the
+                # new generation
+                return {"ok": True, "state": self.state.value}
             if exit_code != 0:
                 # only a failure during an active job consumes budget: after
                 # FINISHED the model is already exported, and after FAILED
@@ -304,6 +457,22 @@ class Coordinator:
                 self._on_worker_failed(rec, "missed heartbeats")
 
     def _on_worker_failed(self, rec: WorkerRecord, why: str) -> None:
+        if self.spec.spmd:
+            if rec.generation < self._generation:
+                # casualty of a generation that already restarted: one
+                # root-cause failure cascades (peers die inside the broken
+                # collective, liveness expires the killed process) — only
+                # the first event consumes restart budget
+                return
+            # SPMD: every process participates in every all-reduce, so
+            # losing ANY one (chief included — in SPMD the chief holds no
+            # state its peers lack; the shared checkpoint has everything)
+            # breaks the collective.  Recovery = full fleet restart from the
+            # checkpoint.  This consciously widens the reference's
+            # chief-short-circuit (TensorflowSession.java:434-452): under
+            # SPMD a chief failure is as recoverable as any other.
+            self._fleet_restart(f"worker {rec.worker_index} failed ({why})")
+            return
         if rec.worker_index == 0:
             # chief short-circuit (TensorflowSession.java:434-452)
             self._fail(f"chief worker failed: {why}")
@@ -317,6 +486,35 @@ class Coordinator:
         else:
             rec.restarts += 1  # submitter polls status and relaunches
 
+    def _fleet_restart(self, why: str) -> None:
+        """Bump the fleet generation: the submitter kills every live worker
+        process and relaunches the whole fleet; workers re-register sticky
+        (same index, same shard) and resume from the agreed checkpoint."""
+        with self._lock:
+            if self.state in (JobState.FINISHED, JobState.FAILED):
+                return
+            self._failed_restarts += 1
+            if self._failed_restarts > self.max_restarts:
+                self._fail(
+                    f"{why}; restart budget {self.max_restarts} exhausted"
+                )
+                return
+            self._generation += 1
+            self._gen_started_at = time.monotonic()
+            self._start_barrier = threading.Event()
+            self._plans.clear()
+            self._last_epoch.clear()
+            self.state = JobState.REGISTERING
+            for rec in self.workers.values():
+                rec.completed = False
+                rec.exit_code = None
+                rec.restarts += 1
+                # stale liveness entries must not double-fire a restart for
+                # processes the submitter is about to kill anyway
+                self.liveness.unregister(rec.worker_id)
+            self._epoch_cond.notify_all()
+            self._plan_cond.notify_all()
+
     def restartable_workers(self) -> list[WorkerRecord]:
         """Workers that failed within budget and await relaunch: both clean
         failures (nonzero exit) and hung workers expired by the liveness
@@ -325,12 +523,27 @@ class Coordinator:
         with self._lock:
             if self.state == JobState.FAILED:
                 return []
+            if self.spec.spmd:
+                # SPMD recovery is fleet-wide: the submitter watches
+                # .generation and relaunches everyone, not individuals
+                return []
             return [
                 r
                 for r in self.workers.values()
                 if (r.completed and (r.exit_code or 0) != 0)
                 or (not r.completed and r.worker_id in expired)
             ]
+
+    def last_reported_epochs(self) -> dict[str, int]:
+        """worker_id -> highest epoch it has reported (locked snapshot);
+        the submitter's kill-injection hook keys on this."""
+        with self._lock:
+            by_index = dict(self._last_epoch)
+            return {
+                wid: by_index[rec.worker_index]
+                for wid, rec in self.workers.items()
+                if rec.worker_index in by_index
+            }
 
     def status(self) -> dict[str, Any]:
         with self._lock:
@@ -344,6 +557,8 @@ class Coordinator:
                 "restart_budget": self.max_restarts,
                 "epochs_published": len(self.aggregator.summaries),
                 "pending_epochs": self.aggregator.pending_epochs(),
+                "spmd": self.spec.spmd,
+                "generation": self._generation,
             }
 
     # ---- TCP plumbing ----
@@ -370,9 +585,18 @@ class Coordinator:
     def dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
         op = msg.get("op")
         if op == "register":
-            return self.register(msg["worker_id"], msg.get("worker_index"))
+            return self.register(
+                msg["worker_id"],
+                msg.get("worker_index"),
+                msg.get("host"),
+                msg.get("jax_port"),
+            )
         if op == "await_start":
             return self.await_start(msg.get("timeout_s"))
+        if op == "sync_plan":
+            return self.sync_plan(
+                msg["worker_id"], msg.get("plan") or {}, msg.get("timeout_s")
+            )
         if op == "heartbeat":
             return self.heartbeat(msg["worker_id"])
         if op == "epoch":
@@ -421,13 +645,19 @@ class CoordinatorClient:
             return json.loads(line)
 
     def register(
-        self, worker_id: str, worker_index: int | None = None
+        self,
+        worker_id: str,
+        worker_index: int | None = None,
+        host: str | None = None,
+        jax_port: int | None = None,
     ) -> dict[str, Any]:
         return self.call(
             {
                 "op": "register",
                 "worker_id": worker_id,
                 "worker_index": worker_index,
+                "host": host,
+                "jax_port": jax_port,
             }
         )
 
@@ -436,6 +666,13 @@ class CoordinatorClient:
         # deadline, which may exceed the default RPC timeout
         return self.call(
             {"op": "await_start", "timeout_s": timeout_s}, timeout_s=None
+        )
+
+    def sync_plan(self, worker_id: str, plan: dict) -> dict[str, Any]:
+        # no socket timeout: the server enforces its own barrier deadline
+        return self.call(
+            {"op": "sync_plan", "worker_id": worker_id, "plan": plan},
+            timeout_s=None,
         )
 
     def heartbeat(self, worker_id: str) -> dict[str, Any]:

@@ -3,19 +3,39 @@
 Parity surface: the reference's client submits the AM and polls every 10 s
 until a terminal state (TensorflowClient.run/monitorApplication,
 TensorflowClient.java:333,625-658); the AM requests containers and the NM
-starts executors.  Here the submitter owns both halves directly: it starts
-the Coordinator, launches N workers (in-process threads for tests and
-single-host jobs; a ``spawn`` hook for real multi-host deployments), polls
-status, and relaunches failed workers within the fault budget — the
-checkpoint-restart replacement for backup containers.
+starts executors (AMRMCallbackHandler.java:148-191).  Here the submitter
+owns both halves directly: it starts the Coordinator, launches N workers,
+polls status, and recovers failures within the fault budget.
+
+Two launchers:
+
+- ``process`` (default for real jobs): each worker is a real OS process
+  running ``worker_main`` — the container-launch parity path.  Kill-based
+  fault tolerance is real: SIGKILL a worker and watch checkpoint-restart
+  recover (the test the reference only ever ran by hand,
+  CommonUtils.java:265-273).  Required for SPMD — each process is one
+  ``jax.distributed`` participant.
+- ``thread``: in-process daemon threads; fast, used by unit tests and
+  single-host non-SPMD smoke runs.  Cannot host SPMD (one process cannot
+  be N jax processes).
+
+SPMD recovery is fleet-wide: the coordinator bumps its generation on any
+worker failure; the submitter watches the generation, SIGKILLs every live
+worker process (peers are wedged inside a broken collective — cooperative
+exit cannot be relied on), relaunches the fleet, and the workers re-register
+sticky and resume from the agreed checkpoint.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import subprocess
 import sys
+import tempfile
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from shifu_tensorflow_tpu.coordinator.coordinator import (
@@ -42,34 +62,67 @@ class JobSubmitter:
         spec: JobSpec,
         make_worker_config: Callable[[str, tuple[str, int]], WorkerConfig],
         *,
+        launcher: str = "thread",
         worker_runner: Callable[..., int] = run_worker,
+        worker_env: dict[str, str] | None = None,
+        log_dir: str | None = None,
         poll_interval_s: float = 0.2,
         drain_grace_s: float = 30.0,
         fault_injections: dict[str, int] | None = None,
+        kill_injections: dict[str, int] | None = None,
     ):
         """``make_worker_config(worker_id, (host, port))`` builds each
-        worker's config; ``fault_injections`` maps worker_id -> epoch to
-        fail at (first launch only) for testing recovery."""
+        worker's config.
+
+        ``fault_injections`` maps worker_id -> epoch to fail at (first
+        launch only); ``kill_injections`` maps worker_id -> epoch after
+        whose report the submitter SIGKILLs the worker process (first
+        launch only; process launcher only) — the kill-based recovery test
+        the reference never automated.
+        """
+        if launcher not in ("thread", "process"):
+            raise ValueError(f"unknown launcher {launcher!r}")
+        if spec.spmd and launcher != "process":
+            raise ValueError(
+                "SPMD jobs need launcher='process': each worker must be its "
+                "own OS process to join jax.distributed"
+            )
         self.spec = spec
         self.make_worker_config = make_worker_config
+        self.launcher = launcher
         self.worker_runner = worker_runner
+        self.worker_env = dict(worker_env or {})
+        self.log_dir = log_dir
         self.poll_interval_s = poll_interval_s
         self.drain_grace_s = drain_grace_s
         self.fault_injections = dict(fault_injections or {})
+        self.kill_injections = dict(kill_injections or {})
         self.coordinator = Coordinator(spec)
         self._threads: dict[str, threading.Thread] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
         self._launch_counts: dict[str, int] = {}
+        self._run_dir: str | None = None
+        self._log_files: list[Any] = []
 
+    # ---- launching ----
     def _launch(
         self, worker_id: str, addr: tuple[str, int], index: int | None = None
     ) -> None:
         cfg = self.make_worker_config(worker_id, addr)
         if cfg.worker_index is None:
             cfg.worker_index = index
+        if self.spec.spmd:
+            cfg.spmd = True
         first_launch = self._launch_counts.get(worker_id, 0) == 0
         fail_at = self.fault_injections.get(worker_id) if first_launch else None
         self._launch_counts[worker_id] = self._launch_counts.get(worker_id, 0) + 1
+        if self.launcher == "process":
+            self._launch_process(worker_id, cfg, fail_at)
+        else:
+            self._launch_thread(worker_id, cfg, fail_at)
 
+    def _launch_thread(self, worker_id: str, cfg: WorkerConfig,
+                       fail_at: int | None) -> None:
         def target() -> None:
             self.worker_runner(cfg, fail_at_epoch=fail_at)
 
@@ -77,6 +130,64 @@ class JobSubmitter:
         self._threads[worker_id] = t
         t.start()
 
+    def _launch_process(self, worker_id: str, cfg: WorkerConfig,
+                        fail_at: int | None) -> None:
+        if self._run_dir is None:
+            self._run_dir = tempfile.mkdtemp(prefix="stpu-job-")
+        attempt = self._launch_counts[worker_id]
+        cfg_path = os.path.join(
+            self._run_dir, f"{worker_id}.{attempt}.json"
+        )
+        with open(cfg_path, "w") as f:
+            json.dump(cfg.to_json(), f)
+        cmd = [
+            sys.executable, "-m",
+            "shifu_tensorflow_tpu.coordinator.worker_main",
+            "--config-file", cfg_path,
+        ]
+        if fail_at is not None:
+            cmd += ["--fail-at-epoch", str(fail_at)]
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        # per-worker log files — container-log parity
+        # (TensorflowClient.java:514-529)
+        log_dir = self.log_dir or self._run_dir
+        os.makedirs(log_dir, exist_ok=True)
+        log = open(
+            os.path.join(log_dir, f"{worker_id}.{attempt}.log"), "ab"
+        )
+        self._log_files.append(log)
+        self._procs[worker_id] = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=env
+        )
+
+    # ---- kill/cleanup ----
+    def kill_worker(self, worker_id: str) -> bool:
+        """SIGKILL a worker process (fault injection / fleet restart)."""
+        proc = self._procs.get(worker_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.kill()
+        return True
+
+    def _kill_fleet(self) -> None:
+        for wid in list(self._procs):
+            self.kill_worker(wid)
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _maybe_kill_injected(self) -> None:
+        if not self.kill_injections:
+            return
+        last = self.coordinator.last_reported_epochs()
+        for wid, at_epoch in list(self.kill_injections.items()):
+            if last.get(wid, -1) >= at_epoch and self.kill_worker(wid):
+                del self.kill_injections[wid]
+
+    # ---- main loop ----
     def run(self, timeout_s: float = 600.0) -> JobResult:
         t0 = time.monotonic()
         addr = self.coordinator.serve()
@@ -84,14 +195,28 @@ class JobSubmitter:
         for i, wid in enumerate(worker_ids):
             self._launch(wid, addr, index=i)
 
-        relaunched: set[str] = set()
+        relaunched: set = set()
+        seen_generation = 0
         try:
             while time.monotonic() - t0 < timeout_s:
                 state = self.coordinator.state
                 if state in (JobState.FINISHED, JobState.FAILED):
                     break
-                # checkpoint-restart recovery: relaunch failed workers that
-                # are within budget (coordinator keeps them restartable)
+                self._maybe_kill_injected()
+                gen = self.coordinator.generation
+                if gen != seen_generation:
+                    # SPMD fleet restart: kill survivors (they are wedged in
+                    # a broken collective), relaunch everyone
+                    seen_generation = gen
+                    self._kill_fleet()
+                    if self.coordinator.state not in (
+                        JobState.FINISHED, JobState.FAILED
+                    ):
+                        for i, wid in enumerate(worker_ids):
+                            self._launch(wid, addr, index=i)
+                    continue
+                # per-worker checkpoint-restart recovery (non-SPMD):
+                # relaunch failed workers that are within budget
                 for rec in self.coordinator.restartable_workers():
                     key = (rec.worker_id, rec.restarts)
                     if key not in relaunched:
@@ -110,6 +235,13 @@ class JobSubmitter:
                 drain_deadline = time.monotonic() + self.drain_grace_s
                 for t in self._threads.values():
                     t.join(timeout=max(0.0, drain_deadline - time.monotonic()))
+                for proc in self._procs.values():
+                    try:
+                        proc.wait(
+                            timeout=max(0.0, drain_deadline - time.monotonic())
+                        )
+                    except subprocess.TimeoutExpired:
+                        pass
             try:
                 self.coordinator.aggregator.flush()
             except Exception as e:
@@ -125,7 +257,13 @@ class JobSubmitter:
                 restarts_used=self.coordinator._failed_restarts,
                 wall_time_s=wall,
             )
+            self._kill_fleet()
             self.coordinator.shutdown()
+            for log in self._log_files:
+                try:
+                    log.close()
+                except Exception:
+                    pass
         return result
 
 
@@ -142,15 +280,16 @@ def make_job_spec(
     AM's TrainingDataSet bootstrap, TensorflowSession.java:174-183) and
     optionally count rows (TOTAL_TRAINING_DATA_NUMBER parity)."""
     shards = split_training_data(training_data_path, n_workers, split_strategy)
-    total = (
-        total_line_count([p for s in shards for p in s.paths])
-        if count_rows
-        else 0
-    )
+    shard_lines = None
+    total = 0
+    if count_rows:
+        shard_lines = [total_line_count(list(s.paths)) for s in shards]
+        total = sum(shard_lines)
     return JobSpec(
         n_workers=n_workers,
         shards=shards,
         total_rows=total,
         epochs=epochs,
+        shard_lines=shard_lines,
         **spec_kwargs,
     )

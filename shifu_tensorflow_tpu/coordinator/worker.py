@@ -9,24 +9,43 @@ registers with the coordinator, blocks on the start barrier, streams its
 shard into the Trainer, reports per-epoch stats and heartbeats in-band, and
 completes with an exit code the coordinator's failure policy consumes.
 
+Cross-process SPMD (``JobSpec.spmd``): the fleet is ONE ``jax.distributed``
+job — the worker initializes the jax coordination service from the
+coordinator's cluster info (chief host + reserved port), builds the global
+mesh spanning every process's devices, and feeds only its local slice of
+the global batch; XLA all-reduces gradients across processes.  That is the
+TPU-native replacement for the reference's PS + SyncReplicasOptimizer
+(ssgd_monitor.py:136-142): N workers train ONE model.
+
 Recovery: on start the worker always tries to restore the shared
 checkpoint; a relaunched worker therefore resumes at the right epoch with
 its sticky shard (replaces backup wake-up, and fixes the epoch-budget gap
-acknowledged at backup.py:30).
+acknowledged at backup.py:30).  SPMD recovery is fleet-wide — the
+coordinator bumps the generation, the submitter kills + relaunches every
+process, and sync_plan agrees the restore epoch.
 """
 
 from __future__ import annotations
 
+import math
+import sys
 import threading
 from dataclasses import dataclass
 from typing import Callable
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
-from shifu_tensorflow_tpu.coordinator.coordinator import CoordinatorClient
-from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+from shifu_tensorflow_tpu.coordinator.coordinator import (
+    RESTART_EXIT_CODE,
+    CoordinatorClient,
+)
+from shifu_tensorflow_tpu.data.dataset import (
+    InMemoryDataset,
+    ShardStream,
+    fixed_step_batches,
+)
 from shifu_tensorflow_tpu.data.reader import RecordSchema
 from shifu_tensorflow_tpu.train import make_trainer
-from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+from shifu_tensorflow_tpu.train.checkpoint import Checkpointer, NpzCheckpointer
 
 
 @dataclass
@@ -46,15 +65,62 @@ class WorkerConfig:
     mesh_spec: str | None = None
     seed: int = 0
     dtype: str | None = None  # "float32" | "bfloat16"; None -> float32
+    # cross-process SPMD membership (one model across the fleet)
+    spmd: bool = False
+    host: str = "127.0.0.1"  # this worker's address for peers
+    # streaming input (1B-row path): stream the shard instead of loading it
+    stream: bool = False
+    n_readers: int | None = None
+
+    def to_json(self) -> dict:
+        """JSON transport for subprocess workers (worker_main)."""
+        from dataclasses import asdict
+
+        d = {
+            k: getattr(self, k)
+            for k in (
+                "worker_id", "coordinator_host", "coordinator_port",
+                "worker_index", "batch_size", "checkpoint_dir",
+                "checkpoint_every_epochs", "valid_rate",
+                "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
+                "spmd", "host", "stream", "n_readers",
+            )
+        }
+        d["model_config"] = dict(self.model_config.raw)
+        d["schema"] = asdict(self.schema)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "WorkerConfig":
+        d = dict(d)
+        mc = ModelConfig.from_json(d.pop("model_config") or {})
+        s = d.pop("schema")
+        schema = RecordSchema(
+            feature_columns=tuple(s["feature_columns"]),
+            target_column=s["target_column"],
+            weight_column=s.get("weight_column", -1),
+            delimiter=s.get("delimiter", "|"),
+            means=tuple(s.get("means") or ()),
+            stds=tuple(s.get("stds") or ()),
+        )
+        return cls(model_config=mc, schema=schema, **d)
 
 
 class _HeartbeatThread(threading.Thread):
-    def __init__(self, client: CoordinatorClient, worker_id: str, interval_s: float):
+    def __init__(
+        self,
+        client: CoordinatorClient,
+        worker_id: str,
+        interval_s: float,
+        generation: int = 0,
+    ):
         super().__init__(daemon=True)
         self.client = client
         self.worker_id = worker_id
         self.interval_s = interval_s
+        self.generation = generation
         self.abort = threading.Event()
+        self.restart = threading.Event()
         self._stop = threading.Event()
 
     def run(self) -> None:
@@ -63,6 +129,11 @@ class _HeartbeatThread(threading.Thread):
                 resp = self.client.heartbeat(self.worker_id)
                 if resp.get("abort"):
                     self.abort.set()
+                    return
+                if int(resp.get("generation", self.generation)) != self.generation:
+                    # fleet restarted without us (we may be about to be
+                    # killed by the submitter; exit cooperatively first)
+                    self.restart.set()
                     return
             except Exception:
                 # coordinator unreachable: keep trying; the trainer decides
@@ -73,6 +144,23 @@ class _HeartbeatThread(threading.Thread):
         self._stop.set()
 
 
+def _stream_step_estimate(
+    total_lines: int, rate: float, batch_size: int
+) -> int:
+    """Step count covering a hash-split stream of ``total_lines`` rows at
+    split ``rate`` with overwhelming probability.
+
+    Row→train/valid membership is per-row content hashing, so the actual
+    split size is Binomial(lines, rate): mean ``lines*rate``, sd at most
+    ``sqrt(lines)/2``.  Overshooting steps costs only zero-weight padding
+    batches; undershooting silently drops rows — so budget mean + 8 sd.
+    """
+    if rate <= 0.0:
+        return 0
+    bound = total_lines * rate + 4.0 * math.sqrt(max(total_lines, 1))
+    return max(1, int(math.ceil(min(bound, total_lines) / batch_size)))
+
+
 def run_worker(cfg: WorkerConfig, *,
                fail_at_epoch: int | None = None) -> int:
     """Full worker lifecycle; returns the exit code it reported.
@@ -81,16 +169,27 @@ def run_worker(cfg: WorkerConfig, *,
     only had a commented-out kill-PS-after-80s hack,
     CommonUtils.java:265-273): the worker aborts mid-job at that epoch.
     """
+    from shifu_tensorflow_tpu.parallel import distributed as dist
+
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
-    reg = client.register(cfg.worker_id, cfg.worker_index)
+    # reserve a port for the jax coordination service up front: only the
+    # chief's is used, but index assignment happens at registration
+    jax_port = dist.reserve_port(cfg.host) if cfg.spmd else None
+    reg = client.register(
+        cfg.worker_id, cfg.worker_index, host=cfg.host, jax_port=jax_port
+    )
     if not reg.get("ok"):
         return 1  # never registered; the coordinator doesn't know us
     worker_index = reg["worker_index"]
     shard_paths = reg["shard"]
     epochs = reg.get("epochs") or cfg.model_config.num_train_epochs
     sync_epochs = bool(reg.get("sync_epochs", False))
+    spmd = bool(reg.get("spmd", cfg.spmd))
+    generation = int(reg.get("generation", 0))
 
-    hb = _HeartbeatThread(client, cfg.worker_id, cfg.heartbeat_interval_s)
+    hb = _HeartbeatThread(
+        client, cfg.worker_id, cfg.heartbeat_interval_s, generation
+    )
     hb.start()
     exit_code = 0
     checkpointer = None
@@ -103,13 +202,20 @@ def run_worker(cfg: WorkerConfig, *,
             if cfg.valid_rate is not None
             else cfg.model_config.valid_set_rate
         )
-        dataset = InMemoryDataset.load(shard_paths, cfg.schema, valid_rate)
 
+        topology = None
         mesh = None
-        if cfg.mesh_spec:
+        if spmd:
+            topology = dist.ProcessTopology.from_cluster_info(
+                started.get("cluster") or {}, worker_index
+            )
+            dist.initialize(topology)
+            mesh = dist.global_mesh(cfg.mesh_spec or "data:-1")
+        elif cfg.mesh_spec:
             from shifu_tensorflow_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(cfg.mesh_spec)
+
         extra = {}
         if cfg.dtype:
             import jax.numpy as jnp
@@ -126,46 +232,49 @@ def run_worker(cfg: WorkerConfig, *,
             mesh=mesh,
             worker_index=worker_index,
             seed=cfg.seed,
+            topology=topology,
             **extra,
         )
 
-        start_epoch = 0
         if cfg.checkpoint_dir:
-            checkpointer = Checkpointer(
+            # SPMD uses the flat-file checkpointer: orbax's internal
+            # cross-process barriers deadlock under chief-writes/all-read
+            ckpt_cls = NpzCheckpointer if spmd else Checkpointer
+            checkpointer = ckpt_cls(
                 cfg.checkpoint_dir, every_epochs=cfg.checkpoint_every_epochs
             )
-            start_epoch = trainer.restore(checkpointer)
 
-        def on_epoch(stats) -> None:
-            if hb.abort.is_set():
-                raise _JobAborted()
-            if fail_at_epoch is not None and stats.current_epoch >= fail_at_epoch:
-                raise _InjectedFault()
-            client.report_epoch(stats)
-            if sync_epochs:
-                resp = client.epoch_barrier(cfg.worker_id, stats.current_epoch)
-                if resp.get("abort"):
-                    raise _JobAborted()
-                if not resp.get("ok"):
-                    raise RuntimeError(resp.get("error", "epoch barrier failed"))
-
-        trainer.fit(
-            dataset,
-            epochs=epochs,
-            batch_size=cfg.batch_size,
-            on_epoch=on_epoch,
-            checkpointer=checkpointer if worker_index == 0 else None,
-            start_epoch=start_epoch,
-        )
+        if spmd:
+            exit_code = _run_spmd_training(
+                cfg, client, trainer, hb, checkpointer,
+                worker_index=worker_index,
+                shard_paths=shard_paths,
+                epochs=epochs,
+                valid_rate=valid_rate,
+                fail_at_epoch=fail_at_epoch,
+                shard_lines=reg.get("shard_lines"),
+            )
+        else:
+            exit_code = _run_local_training(
+                cfg, client, trainer, hb, checkpointer,
+                worker_index=worker_index,
+                shard_paths=shard_paths,
+                epochs=epochs,
+                valid_rate=valid_rate,
+                sync_epochs=sync_epochs,
+                fail_at_epoch=fail_at_epoch,
+            )
     except _InjectedFault:
         exit_code = 43
+    except _FleetRestart:
+        exit_code = RESTART_EXIT_CODE
     except _JobAborted:
         exit_code = 42
     except Exception:
         exit_code = 1
     finally:
-        # always release the orbax manager: leaked async writer threads
-        # abort the interpreter at teardown
+        # always release the checkpoint manager: leaked orbax async writer
+        # threads abort the interpreter at teardown
         if checkpointer is not None:
             try:
                 checkpointer.close()
@@ -179,9 +288,200 @@ def run_worker(cfg: WorkerConfig, *,
     return exit_code
 
 
+def _epoch_callback(
+    cfg: WorkerConfig,
+    client: CoordinatorClient,
+    hb: _HeartbeatThread,
+    *,
+    sync_epochs: bool,
+    fail_at_epoch: int | None,
+) -> Callable:
+    def on_epoch(stats) -> None:
+        if hb.abort.is_set():
+            raise _JobAborted()
+        if hb.restart.is_set():
+            raise _FleetRestart()
+        if fail_at_epoch is not None and stats.current_epoch >= fail_at_epoch:
+            raise _InjectedFault()
+        client.report_epoch(stats)
+        if sync_epochs:
+            resp = client.epoch_barrier(cfg.worker_id, stats.current_epoch)
+            if resp.get("abort"):
+                raise _JobAborted()
+            if not resp.get("ok"):
+                raise RuntimeError(resp.get("error", "epoch barrier failed"))
+
+    return on_epoch
+
+
+def _run_local_training(
+    cfg, client, trainer, hb, checkpointer, *,
+    worker_index, shard_paths, epochs, valid_rate, sync_epochs,
+    fail_at_epoch,
+) -> int:
+    """Independent-model path (non-SPMD): each worker trains on its shard;
+    only the chief's checkpoint is exported."""
+    on_epoch = _epoch_callback(
+        cfg, client, hb, sync_epochs=sync_epochs, fail_at_epoch=fail_at_epoch
+    )
+    start_epoch = 0
+    if checkpointer is not None:
+        start_epoch = trainer.restore(checkpointer)
+    save_ckpt = checkpointer if worker_index == 0 else None
+
+    if cfg.stream:
+        batch_size = trainer.align_batch_size(cfg.batch_size)
+        trainer.fit_stream(
+            lambda epoch: ShardStream(
+                shard_paths, cfg.schema, batch_size,
+                valid_rate=valid_rate, emit="train", salt=cfg.seed,
+                n_readers=cfg.n_readers,
+            ),
+            (lambda: ShardStream(
+                shard_paths, cfg.schema, batch_size,
+                valid_rate=valid_rate, emit="valid", salt=cfg.seed,
+                n_readers=cfg.n_readers,
+            )) if valid_rate > 0 else None,
+            epochs=epochs,
+            on_epoch=on_epoch,
+            checkpointer=save_ckpt,
+            start_epoch=start_epoch,
+        )
+    else:
+        dataset = InMemoryDataset.load(
+            shard_paths, cfg.schema, valid_rate, salt=cfg.seed
+        )
+        trainer.fit(
+            dataset,
+            epochs=epochs,
+            batch_size=cfg.batch_size,
+            on_epoch=on_epoch,
+            checkpointer=save_ckpt,
+            start_epoch=start_epoch,
+        )
+    return 0
+
+
+def _run_spmd_training(
+    cfg, client, trainer, hb, checkpointer, *,
+    worker_index, shard_paths, epochs, valid_rate, fail_at_epoch,
+    shard_lines=None,
+) -> int:
+    """One-model path: this process is one SPMD participant.  Every process
+    must execute identical step sequences, so the fleet agrees per-epoch
+    step counts and the restore epoch through the coordinator's sync_plan
+    barrier before training starts."""
+    local_batch = trainer.align_batch_size(cfg.batch_size)
+    num_features = cfg.schema.num_features
+
+    counted_lines = None
+    if cfg.stream:
+        # the register reply carries the coordinator-cached count (seeded at
+        # submit or from a previous launch's report) — a relaunched fleet
+        # must not re-read a 1B-row shard just to size its epochs
+        lines = shard_lines
+        if lines is None:
+            from shifu_tensorflow_tpu.data.splitter import total_line_count
+
+            lines = counted_lines = total_line_count(shard_paths)
+        train_steps = _stream_step_estimate(
+            lines, 1.0 - valid_rate, local_batch
+        )
+        valid_steps = _stream_step_estimate(lines, valid_rate, local_batch)
+        dataset = None
+    else:
+        dataset = InMemoryDataset.load(
+            shard_paths, cfg.schema, valid_rate, salt=cfg.seed
+        )
+        train_steps = dataset.steps_per_epoch(local_batch)
+        valid_steps = dataset.valid_steps(local_batch)
+
+    latest = (
+        checkpointer.latest_epoch() if checkpointer is not None else None
+    )
+    plan_payload = {
+        "train_steps": train_steps,
+        "valid_steps": valid_steps,
+        "ckpt_epoch": -1 if latest is None else int(latest),
+    }
+    if counted_lines is not None:
+        plan_payload["shard_lines"] = counted_lines
+    plan = client.sync_plan(cfg.worker_id, plan_payload)
+    if plan.get("restart"):
+        raise _FleetRestart()
+    if not plan.get("ok"):
+        if plan.get("abort"):
+            raise _JobAborted()
+        raise RuntimeError(plan.get("error", "sync_plan failed"))
+    train_steps = int(plan["train_steps"])
+    valid_steps = int(plan["valid_steps"])
+    agreed_epoch = int(plan.get("ckpt_epoch", -1))
+
+    start_epoch = 0
+    if checkpointer is not None and agreed_epoch >= 0:
+        state, start_epoch = checkpointer.restore_epoch(
+            agreed_epoch, trainer.state
+        )
+        trainer.state = state
+
+    def _warn_dropped(rows: int) -> None:
+        print(
+            f"[worker {worker_index}] fixed-step epoch dropped {rows} "
+            f"surplus rows (agreed {train_steps} steps)",
+            file=sys.stderr, flush=True,
+        )
+
+    if cfg.stream:
+        def make_train(epoch: int):
+            return fixed_step_batches(
+                ShardStream(
+                    shard_paths, cfg.schema, local_batch,
+                    valid_rate=valid_rate, emit="train", salt=cfg.seed,
+                    n_readers=cfg.n_readers,
+                ),
+                local_batch, train_steps, num_features,
+                on_dropped=_warn_dropped,
+            )
+
+        def make_valid():
+            return fixed_step_batches(
+                ShardStream(
+                    shard_paths, cfg.schema, local_batch,
+                    valid_rate=valid_rate, emit="valid", salt=cfg.seed,
+                    n_readers=cfg.n_readers,
+                ),
+                local_batch, valid_steps, num_features,
+            )
+    else:
+        def make_train(epoch: int):
+            return dataset.train_batches_fixed(
+                local_batch, train_steps, epoch=epoch
+            )
+
+        def make_valid():
+            return dataset.valid_batches_fixed(local_batch, valid_steps)
+
+    on_epoch = _epoch_callback(
+        cfg, client, hb, sync_epochs=False, fail_at_epoch=fail_at_epoch
+    )
+    trainer.fit_stream(
+        make_train,
+        make_valid if valid_steps > 0 else None,
+        epochs=epochs,
+        on_epoch=on_epoch,
+        checkpointer=checkpointer if worker_index == 0 else None,
+        start_epoch=start_epoch,
+    )
+    return 0
+
+
 class _InjectedFault(RuntimeError):
     pass
 
 
 class _JobAborted(RuntimeError):
+    pass
+
+
+class _FleetRestart(RuntimeError):
     pass

@@ -1,0 +1,53 @@
+"""Subprocess worker entry: ``python -m shifu_tensorflow_tpu.coordinator.worker_main``.
+
+The reference launched each worker as a real OS process in a YARN container
+(AMRMCallbackHandler.java:159-182) with its configuration passed through
+environment variables and localized files
+(TensorflowTaskExecutor.java:200-238).  This is the equivalent launch shim:
+the submitter writes the WorkerConfig as JSON (file or inline), spawns this
+module, and consumes the process exit code — which makes kill-based fault
+tolerance real (SIGKILL the process, watch checkpoint-restart recover),
+something thread workers cannot model.
+
+Run BEFORE any jax import side effects: when the environment pins
+``JAX_PLATFORMS=cpu`` (tests; the driver's virtual-device harness) the
+tunneled-TPU PJRT plugin is dropped before the first backend query, exactly
+like the test conftest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+        force_cpu_backend()
+
+    p = argparse.ArgumentParser(prog="worker_main")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--config-file", help="path to a WorkerConfig JSON file")
+    g.add_argument("--config-json", help="inline WorkerConfig JSON")
+    p.add_argument("--fail-at-epoch", type=int, default=None,
+                   help="fault injection: abort at this epoch (tests)")
+    args = p.parse_args(argv)
+
+    if args.config_file:
+        with open(args.config_file) as f:
+            payload = json.load(f)
+    else:
+        payload = json.loads(args.config_json)
+
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig, run_worker
+
+    cfg = WorkerConfig.from_json(payload)
+    return run_worker(cfg, fail_at_epoch=args.fail_at_epoch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,8 +110,80 @@ class InMemoryDataset:
     def valid_batches(self, batch_size: int) -> Iterator[Batch]:
         return iter_batches(self.valid, batch_size)
 
+    def train_batches_fixed(
+        self, batch_size: int, steps: int, *, epoch: int = 0
+    ) -> Iterator[Batch]:
+        """Exactly ``steps`` batches (zero-weight padded) — SPMD epochs."""
+        return fixed_step_batches(
+            iter_batches(self.train, batch_size, shuffle=True, seed=epoch),
+            batch_size, steps, self.schema.num_features,
+        )
+
+    def valid_batches_fixed(self, batch_size: int, steps: int) -> Iterator[Batch]:
+        return fixed_step_batches(
+            iter_batches(self.valid, batch_size),
+            batch_size, steps, self.schema.num_features,
+        )
+
     def steps_per_epoch(self, batch_size: int) -> int:
         return -(-len(self.train) // batch_size)
+
+    def valid_steps(self, batch_size: int) -> int:
+        return -(-len(self.valid) // batch_size)
+
+
+def _zero_batch(batch_size: int, num_features: int) -> Batch:
+    """All-padding batch: weight 0 everywhere, so it contributes nothing to
+    the weighted loss/gradient — pure barrier participation."""
+    z = np.zeros((batch_size, 1), np.float32)
+    return make_batch(np.zeros((batch_size, num_features), np.float32), z, z)
+
+
+def fixed_step_batches(
+    batches: Iterable[Batch],
+    batch_size: int,
+    steps: int,
+    num_features: int,
+    *,
+    on_dropped: Callable[[int], None] | None = None,
+) -> Iterator[Batch]:
+    """Adapt any batch iterator to EXACTLY ``steps`` batches of exactly
+    ``batch_size`` rows.
+
+    Under cross-process SPMD every process must execute the same number of
+    identically-shaped steps per epoch or the collective deadlocks (XLA
+    all-reduce is a barrier; the reference had the same constraint spread
+    across SyncReplicasOptimizer's token queue, ssgd_monitor.py:136-142).
+    Shards are rarely equal-sized, so the coordinator agrees on the MAX step
+    count and shorter shards pad with zero-weight batches; a source yielding
+    more than ``steps`` batches has the surplus dropped (``on_dropped``
+    receives the dropped row count — callers log it; silent truncation reads
+    as full coverage when it isn't).
+    """
+    it = iter(batches)
+    emitted = 0
+    for batch in it:
+        if emitted >= steps:
+            dropped = int(batch["x"].shape[0])
+            for extra in it:
+                dropped += int(extra["x"].shape[0])
+            if on_dropped is not None and dropped:
+                on_dropped(dropped)
+            return
+        n = batch["x"].shape[0]
+        if n != batch_size:  # pad a short (final) batch to the fixed shape
+            pad = batch_size - n
+            batch = {
+                k: np.concatenate(
+                    [np.asarray(v), np.zeros((pad,) + v.shape[1:], v.dtype)]
+                )
+                for k, v in batch.items()
+            }
+        yield batch
+        emitted += 1
+    while emitted < steps:
+        yield _zero_batch(batch_size, num_features)
+        emitted += 1
 
 
 class ShardStream:

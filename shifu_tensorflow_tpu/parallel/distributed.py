@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Any
 
 import jax
 
@@ -57,17 +58,22 @@ class ProcessTopology:
         )
 
     @classmethod
-    def from_registration(cls, reply: dict, jax_port: int = 8476
+    def from_cluster_info(cls, info: dict, worker_index: int
                           ) -> "ProcessTopology":
-        """Derive from the framework coordinator's register() reply: the
-        worker index doubles as the jax process_id (chief = process 0), and
-        the jax coordination service runs next to the chief worker."""
-        host = reply.get("chief_host") or "127.0.0.1"
-        n = int(reply.get("n_workers", 1))
+        """Derive from the coordinator's cluster info (carried on the
+        ``await_start`` reply once every worker has registered): the worker
+        index doubles as the jax process_id (chief = process 0), and the
+        jax coordination service runs inside the chief worker process on the
+        port the chief reserved at registration."""
+        host = info.get("chief_host") or "127.0.0.1"
+        port = int(info.get("jax_port") or 0)
+        n = int(info.get("n_workers", 1))
+        if n > 1 and not port:
+            raise ValueError("cluster info lacks the chief's jax_port")
         return cls(
-            coordinator_address=f"{host}:{jax_port}" if n > 1 else None,
+            coordinator_address=f"{host}:{port}" if n > 1 else None,
             num_processes=n,
-            process_id=int(reply.get("worker_index", 0)),
+            process_id=int(worker_index),
         )
 
 
@@ -106,6 +112,60 @@ def global_mesh(spec: str = "data:-1"):
     from shifu_tensorflow_tpu.parallel.mesh import make_mesh
 
     return make_mesh(spec, devices=jax.devices())
+
+
+def reserve_port(host: str = "127.0.0.1") -> int:
+    """Pick a currently-free TCP port for the jax coordination service.
+
+    The reference reserved each worker's TF port by holding a ServerSocket
+    open until just before Python started (TensorflowTaskExecutor.java:
+    181-185) — same idea, same small close-to-bind race, acceptable because
+    the port is consumed within the same bring-up barrier.
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def put_process_local(batch: dict, sharding) -> dict:
+    """Assemble a global device array from each process's local rows.
+
+    Process p's rows land at global offset [p*B_local, (p+1)*B_local): the
+    global batch is the concatenation of the per-process local batches in
+    process order — the SPMD replacement for every worker feed_dict'ing its
+    own rows against shared PS variables (ssgd_monitor.py:268-276).  Every
+    process MUST pass the same local row count or bring-up deadlocks; the
+    coordinator's sync_plan barrier guarantees it.
+    """
+    import jax
+
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch.items()
+    }
+
+
+def local_rows(global_array) -> "Any":
+    """This process's rows of a row-sharded global array, in row order —
+    the inverse of put_process_local for fetching per-worker predictions.
+
+    Replica shards are deduplicated by row range: on a mesh with a >1
+    'model' axis the array is replicated across it, so a process addresses
+    the same row block once per model-axis coordinate — concatenating
+    blindly would silently duplicate rows and misalign scores with labels.
+    """
+    import numpy as np
+
+    by_start: dict[int, Any] = {}
+    for s in global_array.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in by_start:
+            by_start[start] = s.data
+    return np.concatenate(
+        [np.asarray(by_start[k]) for k in sorted(by_start)], axis=0
+    )
 
 
 def process_batch_slice(global_batch: int, topology: ProcessTopology

@@ -48,6 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
     # run shape
     p.add_argument("--workers", type=int, default=None,
                    help="worker count; >1 runs the coordinator/submitter path")
+    p.add_argument("--launcher", choices=["process", "thread"],
+                   default="process",
+                   help="multi-worker launch mode: real OS processes "
+                        "(default; required for SPMD) or in-process threads")
+    spmd = p.add_mutually_exclusive_group()
+    spmd.add_argument("--spmd", dest="spmd", action="store_true", default=None,
+                      help="train ONE model across workers via "
+                           "jax.distributed gradient all-reduce (default "
+                           "with --launcher process)")
+    spmd.add_argument("--no-spmd", dest="spmd", action="store_false",
+                      help="independent per-worker models; only the chief's "
+                           "checkpoint is exported")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--valid-rate", type=float, default=None)
@@ -272,16 +284,17 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
 
     n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
+    # SPMD (one model across workers) is the default for real process
+    # launches — the reference's defining capability; thread workers can't
+    # host it (one process cannot be N jax.distributed participants)
+    use_spmd = args.spmd if args.spmd is not None else args.launcher == "process"
     spec = make_job_spec(
         conf.get(K.TRAINING_DATA_PATH),
         n_workers,
         epochs=epochs,
         board_path=args.board_path,
+        spmd=use_spmd,
     )
-
-    if args.stream or args.readers:
-        print("--stream/--readers apply to single-process runs only; "
-              "multi-worker jobs load their shard in memory", file=sys.stderr)
 
     def make_cfg(worker_id: str, addr) -> WorkerConfig:
         return WorkerConfig(
@@ -295,9 +308,12 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             valid_rate=args.valid_rate,
             seed=args.seed,
             dtype=args.dtype,
+            mesh_spec=conf.get(K.MESH_SHAPE),
+            stream=bool(args.stream),
+            n_readers=args.readers,
         )
 
-    submitter = JobSubmitter(spec, make_cfg)
+    submitter = JobSubmitter(spec, make_cfg, launcher=args.launcher)
     timeout_ms = conf.get_int(K.APPLICATION_TIMEOUT, K.DEFAULT_APPLICATION_TIMEOUT)
     result = submitter.run(
         timeout_s=timeout_ms / 1000.0 if timeout_ms > 0 else 86400.0
@@ -335,7 +351,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             return 2
         from shifu_tensorflow_tpu.export.saved_model import export_model
         from shifu_tensorflow_tpu.train import make_trainer
-        from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+        from shifu_tensorflow_tpu.train.checkpoint import (
+            Checkpointer,
+            NpzCheckpointer,
+        )
 
         trainer = make_trainer(
             model_config,
@@ -343,7 +362,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             feature_columns=schema.feature_columns,
             seed=args.seed,
         )
-        with Checkpointer(args.checkpoint_dir) as ckpt:
+        # SPMD jobs checkpoint through the flat-file format (see
+        # NpzCheckpointer); restore with the matching reader
+        ckpt_cls = NpzCheckpointer if use_spmd else Checkpointer
+        with ckpt_cls(args.checkpoint_dir) as ckpt:
             trainer.restore(ckpt)
         wrote = export_model(
             args.export_dir,

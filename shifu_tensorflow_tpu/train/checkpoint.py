@@ -48,6 +48,139 @@ def _rebox_like(template, values):
     )
 
 
+class NpzCheckpointer:
+    """Flat-file checkpointing for multi-process SPMD jobs.
+
+    Orbax's CheckpointManager synchronizes across *all* jax processes during
+    save/restore; under the framework's chief-writes/everyone-reads policy
+    (only worker 0 saves, parity with the reference's chief-only
+    checkpointing via MonitoredTrainingSession, ssgd_monitor.py:251-257)
+    those internal barriers would deadlock the non-chief processes.  Since
+    parameters are replicated (tabular DNNs are MBs, not GBs), a plain
+    ``np.savez`` of the unboxed state tree is the honest tool: atomic via
+    temp-file + rename, readable by any process without collective
+    participation, and trivially inspectable.
+
+    API-compatible with ``Checkpointer`` (maybe_save / restore_latest /
+    latest_epoch / close / context manager) plus ``restore_epoch`` so SPMD
+    workers can all restore the *agreed* epoch (the coordinator's sync_plan
+    takes the min over workers' visible checkpoints, guarding the race where
+    the chief saved between two workers' directory listings).
+    """
+
+    _PREFIX = "ckpt-"
+    _SUFFIX = ".npz"
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        every_epochs: int = 1,
+        max_to_keep: int = 3,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.every_epochs = max(1, int(every_epochs))
+        self.max_to_keep = max(1, int(max_to_keep))
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"{self._PREFIX}{epoch}{self._SUFFIX}")
+
+    def _epochs(self) -> list[int]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith(self._PREFIX) and name.endswith(self._SUFFIX):
+                try:
+                    out.append(int(name[len(self._PREFIX):-len(self._SUFFIX)]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_epoch(self) -> int | None:
+        eps = self._epochs()
+        return eps[-1] if eps else None
+
+    def maybe_save(self, epoch: int, state) -> bool:
+        if (epoch + 1) % self.every_epochs != 0:
+            return False
+        self.save(epoch, state)
+        return True
+
+    def save(self, epoch: int, state) -> None:
+        import numpy as np
+
+        tree = _unbox(
+            {"params": state.params, "opt_state": state.opt_state,
+             "step": state.step}
+        )
+        leaves = jax.tree_util.tree_leaves(tree)
+        arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+                  for i, x in enumerate(leaves)}
+        tmp = self._path(epoch) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, self._path(epoch))  # atomic publish
+        for old in self._epochs()[: -self.max_to_keep]:
+            try:
+                os.remove(self._path(old))
+            except OSError:
+                pass
+
+    def _restore_tree(self, epoch: int, template_state):
+        import numpy as np
+
+        tree = _unbox(
+            {
+                "params": template_state.params,
+                "opt_state": template_state.opt_state,
+                "step": template_state.step,
+            }
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        with np.load(self._path(epoch)) as z:
+            loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
+        # scalars (e.g. step) round-trip as 0-d arrays; cast back via the
+        # template leaf's dtype to keep the tree structurally identical
+        vals = [
+            np.asarray(v, dtype=np.asarray(t).dtype).reshape(np.shape(t))
+            for v, t in zip(loaded, leaves)
+        ]
+        restored = jax.tree_util.tree_unflatten(treedef, vals)
+        return template_state.replace(
+            params=_rebox_like(template_state.params, restored["params"]),
+            opt_state=_rebox_like(
+                template_state.opt_state, restored["opt_state"]
+            ),
+            step=restored["step"],
+        )
+
+    def restore_epoch(self, epoch: int, template_state):
+        """Restore a specific epoch; returns (state, next_epoch_to_run)."""
+        return self._restore_tree(epoch, template_state), epoch + 1
+
+    def restore_latest(self, template_state):
+        latest = self.latest_epoch()
+        if latest is None:
+            return None, 0
+        return self._restore_tree(latest, template_state), latest + 1
+
+    def wait(self) -> None:  # saves are synchronous
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class Checkpointer:
     def __init__(
         self,

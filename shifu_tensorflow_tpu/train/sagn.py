@@ -132,8 +132,17 @@ def make_sagn_step(
     @partial(jax.jit, donate_argnums=(0,))
     def sagn_step(state, window_batch):
         avg_grads, loss = window_fn(state.params, window_batch)
-        state = state.apply_gradients(grads=avg_grads)
-        return state, loss
+        # all-padding window: skip the update entirely (zero grads would
+        # still move Adam-style momentum / increment step) and report NaN
+        # so epoch means exclude it — same contract as make_train_step
+        has_rows = jnp.sum(window_batch["w"] != 0.0) > 0
+        state = jax.lax.cond(
+            has_rows,
+            lambda s: s.apply_gradients(grads=avg_grads),
+            lambda s: s,
+            state,
+        )
+        return state, jnp.where(has_rows, loss, jnp.nan)
 
     return sagn_step
 
@@ -191,6 +200,12 @@ class SAGNTrainer(Trainer):
             k: np.stack([np.asarray(m[k]) for m in micros], axis=0)
             for k in micros[0]
         }
+        if self._cross_process:
+            from shifu_tensorflow_tpu.parallel.distributed import (
+                put_process_local,
+            )
+
+            return put_process_local(stacked, self._window_sharding)
         if self._window_sharding is not None:
             return jax.device_put(stacked, self._window_sharding)
         return jax.device_put(stacked)
@@ -226,8 +241,14 @@ class SAGNTrainer(Trainer):
             n_micro += 1
         if not losses:
             return float("nan"), 0
-        # microbatch-weighted epoch mean: a K-micro window counts K times
+        # microbatch-weighted epoch mean: a K-micro window counts K times;
+        # NaN losses mark all-padding windows (skipped by contract)
+        vals = np.asarray(jax.device_get(losses), np.float64)
+        ws = np.asarray(weights, np.float64)
+        mask = ~np.isnan(vals)
         return (
-            float(np.average(jax.device_get(losses), weights=weights)),
+            float(np.average(vals[mask], weights=ws[mask]))
+            if mask.any()
+            else float("nan"),
             n_micro,
         )

@@ -91,8 +91,22 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0):
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: Batch):
         loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
-        state = state.apply_gradients(grads=grads)
-        return state, loss
+        # An all-padding (weight-0) batch must be a true no-op: the data
+        # loss is 0 but the l2 term still has gradients, and Adam-style
+        # momentum produces nonzero updates even from zero grads — either
+        # would let the fixed-step SPMD padding batches (data/dataset.py
+        # fixed_step_batches) drift parameters.  The count is over the
+        # GLOBAL batch, so every SPMD process takes the same branch.  The
+        # loss reports NaN for such batches so epoch means (nanmean) skip
+        # them instead of being biased toward zero.
+        has_rows = jnp.sum(batch["w"] != 0.0) > 0
+        state = jax.lax.cond(
+            has_rows,
+            lambda s: s.apply_gradients(grads=grads),
+            lambda s: s,
+            state,
+        )
+        return state, jnp.where(has_rows, loss, jnp.nan)
 
     return train_step
 
@@ -103,7 +117,9 @@ def make_eval_step(apply_fn, loss_name: str = "mse"):
     @jax.jit
     def eval_step(params, batch: Batch):
         pred = apply_fn({"params": params}, batch["x"])
-        return loss_fn(pred, batch["y"], batch["w"]), pred
+        loss = loss_fn(pred, batch["y"], batch["w"])
+        has_rows = jnp.sum(batch["w"] != 0.0) > 0
+        return jnp.where(has_rows, loss, jnp.nan), pred
 
     return eval_step
 
@@ -123,11 +139,23 @@ class Trainer:
         seed: int = 0,
         worker_index: int = 0,
         dtype=jnp.float32,
+        topology: "Any | None" = None,
     ):
         self.model_config = model_config
         self.num_features = num_features
         self.mesh = mesh
         self.worker_index = worker_index
+        # cross-process SPMD (parallel.distributed.ProcessTopology): the
+        # mesh spans every process's devices and each process feeds only its
+        # local slice of the global batch — XLA all-reduces gradients across
+        # processes, the clean SyncReplicasOptimizer equivalent
+        # (ssgd_monitor.py:136-142)
+        # the make_array_from_process_local_data path engages whenever a
+        # topology is given alongside a mesh (even single-process: local
+        # rows are then all rows) so the dryrun exercises exactly what
+        # multi-process runs
+        self._topology = topology
+        self._cross_process = topology is not None and mesh is not None
         # shard embedding tables only when a >1 'model' axis exists; the
         # fused Pallas lookup is only eligible single-device — it has no
         # GSPMD partitioning rule, so under a multi-device mesh (even pure
@@ -164,6 +192,13 @@ class Trainer:
         else:
             self._batch_sharding = None
             self._data_axis = 1
+        # rows each *process* must supply per batch divide by its local
+        # share of the data axis (single-process: the whole axis)
+        self._local_data_divisor = (
+            max(1, self._data_axis // topology.num_processes)
+            if self._cross_process
+            else self._data_axis
+        )
 
         self._train_step = make_train_step(
             self.model.apply, loss, model_config.params.l2_reg
@@ -174,19 +209,29 @@ class Trainer:
 
     # ---- device placement ----
     def _put(self, batch: Batch) -> Batch:
+        if self._cross_process:
+            from shifu_tensorflow_tpu.parallel.distributed import (
+                put_process_local,
+            )
+
+            batch = self._pad_for_mesh(batch)
+            return put_process_local(batch, self._batch_sharding)
         if self._batch_sharding is not None:
             batch = self._pad_for_mesh(batch)
             return jax.device_put(batch, self._batch_sharding)
         return jax.device_put(batch)
 
     def _pad_for_mesh(self, batch: Batch) -> Batch:
-        """Row count must divide the mesh data axis; pad with zero-weight
-        rows (free under the nonzero-weight loss normalization)."""
+        """Row count must divide this process's share of the data axis; pad
+        with zero-weight rows (free under the nonzero-weight loss
+        normalization).  Cross-process, padding only ever triggers if the
+        caller broke the equal-local-batch contract (sync_plan) — identical
+        local shapes are required, not merely aligned ones."""
         n = batch["x"].shape[0]
-        rem = n % self._data_axis
+        rem = n % self._local_data_divisor
         if rem == 0:
             return batch
-        pad = self._data_axis - rem
+        pad = self._local_data_divisor - rem
         return {
             k: np.concatenate(
                 [np.asarray(v), np.zeros((pad,) + v.shape[1:], v.dtype)], axis=0
@@ -195,8 +240,8 @@ class Trainer:
         }
 
     def align_batch_size(self, batch_size: int) -> int:
-        """Round a requested batch size up to a mesh-divisible one."""
-        a = self._data_axis
+        """Round a requested (per-process) batch size up to a divisible one."""
+        a = self._local_data_divisor
         return -(-batch_size // a) * a
 
     # ---- core loops ----
@@ -210,23 +255,51 @@ class Trainer:
                 self.step_timer.step(loss, rows=batch["x"].shape[0])
         if not losses:
             return float("nan"), 0
-        return float(np.mean(jax.device_get(losses))), len(losses)
+        vals = np.asarray(jax.device_get(losses))
+        # all-padding batches report NaN by contract (make_train_step);
+        # exclude them from the epoch mean instead of biasing it
+        real = vals[~np.isnan(vals)]
+        return (
+            float(np.mean(real)) if real.size else float("nan"),
+            len(losses),
+        )
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
         losses, scores, labels, weights = [], [], [], []
-        for batch in prefetch_to_device(batches, put=self._put):
-            loss, pred = self._eval_step(self.state.params, batch)
-            losses.append(loss)
-            scores.append(np.asarray(pred))
-            labels.append(np.asarray(batch["y"]))
-            weights.append(np.asarray(batch["w"]))
+        if self._cross_process:
+            # labels/weights stay host-side (the device copies are global
+            # row-sharded arrays, not locally fetchable); predictions come
+            # back as this process's rows, so KS/AUC are per-worker over the
+            # worker's own validation shard — parity with each reference
+            # worker reporting valid metrics on its own data
+            # (ssgd_monitor.py:281-293); the loss is the global scalar.
+            from shifu_tensorflow_tpu.parallel.distributed import local_rows
+
+            for host_batch in batches:
+                dev = self._put(host_batch)
+                loss, pred = self._eval_step(self.state.params, dev)
+                losses.append(loss)
+                # drop any locally-padded rows so rows align with the host
+                # batch (padding sits at the tail)
+                scores.append(local_rows(pred)[: host_batch["y"].shape[0]])
+                labels.append(np.asarray(host_batch["y"]))
+                weights.append(np.asarray(host_batch["w"]))
+        else:
+            for batch in prefetch_to_device(batches, put=self._put):
+                loss, pred = self._eval_step(self.state.params, batch)
+                losses.append(loss)
+                scores.append(np.asarray(pred))
+                labels.append(np.asarray(batch["y"]))
+                weights.append(np.asarray(batch["w"]))
         if not losses:
             return {"loss": float("nan"), "ks": 0.0, "auc": 0.5}
         s = np.concatenate(scores)[:, 0]
         y = np.concatenate(labels)[:, 0]
         w = np.concatenate(weights)[:, 0]
+        vals = np.asarray(jax.device_get(losses))
+        real = vals[~np.isnan(vals)]
         return {
-            "loss": float(np.mean(jax.device_get(losses))),
+            "loss": float(np.mean(real)) if real.size else float("nan"),
             "ks": M.ks_statistic(s, y, w),
             "auc": M.auc(s, y, w),
         }

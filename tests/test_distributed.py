@@ -41,16 +41,19 @@ def test_topology_from_env(monkeypatch):
     assert ProcessTopology.from_env().coordinator_address is None
 
 
-def test_topology_from_registration_reply():
-    t = ProcessTopology.from_registration(
-        {"worker_index": 3, "n_workers": 8, "chief_host": "w0.pod"},
-        jax_port=9999,
+def test_topology_from_cluster_info():
+    t = ProcessTopology.from_cluster_info(
+        {"chief_host": "w0.pod", "jax_port": 9999, "n_workers": 8},
+        worker_index=3,
     )
     assert t.coordinator_address == "w0.pod:9999"
     assert (t.num_processes, t.process_id) == (8, 3)
     # single worker: no coordination service needed
-    t1 = ProcessTopology.from_registration({"worker_index": 0, "n_workers": 1})
+    t1 = ProcessTopology.from_cluster_info({"n_workers": 1}, worker_index=0)
     assert not t1.is_distributed and t1.coordinator_address is None
+    # multi-worker info without the chief's port is a bring-up bug
+    with pytest.raises(ValueError):
+        ProcessTopology.from_cluster_info({"n_workers": 4}, worker_index=1)
 
 
 def test_initialize_single_process_noop():
