@@ -1,25 +1,38 @@
 """Benchmark: training rows/sec/chip on the flagship tabular workload.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+plus context fields (platform, streaming end-to-end throughput, diagnostics).
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
-compares against a measured stand-in for the reference's per-step execution
-model, run on this same host: a feed-dict-style loop — per-batch host→
-framework marshalling, one synchronous step at a time through TF-1-style
-session overhead approximated by an uncompiled numpy forward+backward of
-the same DNN.  That is generous to the reference (no gRPC PS round-trips,
-no Python 2, no parameter-server serialization), so vs_baseline understates
-the real gap.
+Two measurements:
 
-Run context: executed by the driver on real TPU hardware; also runs on CPU
-(slow, small) for local smoke.
+- ``training_rows_per_sec_per_chip`` (primary): steady-state jitted SPMD
+  step throughput on a device-resident batch — the MXU ceiling.
+- ``stream_rows_per_sec``: END-TO-END ingest — ShardStream (gzip PSV →
+  native block parser → bounded queue) → prefetch_to_device → jitted step,
+  on a generated multi-shard dataset.  This is SURVEY.md §7.2 item 1, the
+  real 1B-row battle: the number the input pipeline can actually sustain.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+comparison is a measured stand-in for its execution model, run on this same
+host — a feed-dict-style uncompiled numpy forward+backward at the
+reference's batch 100 (ssgd_monitor.py:33).  Generous to the reference (no
+gRPC PS round-trips, no Python 2); vs_baseline understates the real gap.
+
+Robustness (round-1 lesson: BENCH_r01 died in TPU backend init): the
+parent process never touches jax.  Each attempt runs in a SUBPROCESS with a
+hard timeout — a hanging or failing PJRT plugin cannot take the bench down.
+TPU attempts retry with backoff, then fall back to an explicit CPU
+measurement with the failure recorded in ``diagnostics``.
 """
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -31,6 +44,12 @@ WARMUP_STEPS = 3
 MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", 10.0))
 REF_SAMPLE_STEPS = 20
 REF_BATCH = 100  # the reference's fixed batch size (ssgd_monitor.py:33)
+STREAM_ROWS = int(os.environ.get("BENCH_STREAM_ROWS", 2_000_000))
+STREAM_SHARDS = int(os.environ.get("BENCH_STREAM_SHARDS", 8))
+STREAM_READERS = int(os.environ.get("BENCH_STREAM_READERS", 4))
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
+TPU_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 900.0))
+CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT", 900.0))
 
 
 def _model_config():
@@ -53,7 +72,11 @@ def _model_config():
     )
 
 
-def bench_tpu_rows_per_sec() -> float:
+# --------------------------------------------------------------- measurement
+
+
+def bench_step_rows_per_sec() -> float:
+    """Steady-state jitted step throughput, device-resident batch."""
     import jax
 
     from shifu_tensorflow_tpu.parallel.mesh import make_mesh
@@ -92,6 +115,85 @@ def bench_tpu_rows_per_sec() -> float:
     return rows_per_sec / jax.local_device_count()
 
 
+def _write_stream_shards(root: str, total_rows: int, n_shards: int) -> list[str]:
+    """Synthetic gzip PSV shards (target|f0..f29|weight).  One formatted
+    block is written repeatedly — content repetition is irrelevant to
+    ingest throughput, and generation stays seconds, not minutes."""
+    rng = np.random.default_rng(0)
+    block_rows = 20_000
+    x = rng.normal(size=(block_rows, NUM_FEATURES)).astype(np.float32)
+    y = (rng.random(block_rows) < 0.3).astype(np.int32)
+    lines = []
+    for i in range(block_rows):
+        cols = [str(int(y[i]))] + [f"{v:.5f}" for v in x[i]] + ["1.0"]
+        lines.append("|".join(cols))
+    block = ("\n".join(lines) + "\n").encode()
+
+    rows_per_shard = total_rows // n_shards
+    reps = max(1, rows_per_shard // block_rows)
+    paths = []
+    for s in range(n_shards):
+        path = os.path.join(root, f"part-{s:05d}.gz")
+        # gzip level 1: realistic-enough compression without dominating
+        # generation time
+        with gzip.open(path, "wb", compresslevel=1) as f:
+            for _ in range(reps):
+                f.write(block)
+        paths.append(path)
+    return paths
+
+
+def bench_stream_rows_per_sec() -> dict:
+    """End-to-end: ShardStream -> prefetch -> jitted step, rows/sec."""
+    import jax
+
+    from shifu_tensorflow_tpu.data.dataset import ShardStream, prefetch_to_device
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mesh = make_mesh("data:-1")
+    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
+    batch_size = trainer.align_batch_size(BATCH)
+    schema = RecordSchema(
+        feature_columns=tuple(range(1, NUM_FEATURES + 1)),
+        target_column=0,
+        weight_column=NUM_FEATURES + 1,
+    )
+    with tempfile.TemporaryDirectory(prefix="stpu-bench-") as root:
+        t_gen = time.perf_counter()
+        paths = _write_stream_shards(root, STREAM_ROWS, STREAM_SHARDS)
+        gen_s = time.perf_counter() - t_gen
+
+        stream = ShardStream(
+            paths, schema, batch_size,
+            valid_rate=0.0, emit="train", n_readers=STREAM_READERS,
+            drop_remainder=True,
+        )
+        state = trainer.state
+        step = trainer._train_step
+        rows = 0
+        # warmup/compile on the first batch, then measure wall-clock over
+        # the rest of the stream
+        it = prefetch_to_device(iter(stream), put=trainer._put)
+        first = next(it)
+        state, loss = step(state, first)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for batch in it:
+            state, loss = step(state, batch)
+            rows += batch_size
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+    return {
+        "stream_rows_per_sec": round(rows / elapsed, 1),
+        "stream_rows": rows,
+        "stream_readers": STREAM_READERS,
+        "stream_gen_s": round(gen_s, 1),
+        "stream_elapsed_s": round(elapsed, 2),
+    }
+
+
 def bench_reference_style_rows_per_sec() -> float:
     """Feed-dict-style numpy loop: the reference's per-batch execution model
     (uncompiled forward+backward, batch 100, host-resident)."""
@@ -126,16 +228,99 @@ def bench_reference_style_rows_per_sec() -> float:
     return REF_SAMPLE_STEPS * REF_BATCH / elapsed
 
 
-def main() -> None:
-    value = bench_tpu_rows_per_sec()
+def run_measurements() -> dict:
+    """Child-process entry: measure on whatever backend the env selects."""
+    import jax
+
+    value = bench_step_rows_per_sec()
     ref = bench_reference_style_rows_per_sec()
     result = {
         "metric": "training_rows_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "rows/s/chip",
         "vs_baseline": round(value / ref, 2),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "n_devices": jax.local_device_count(),
+        "baseline": "measured reference-style feeddict numpy loop, same host",
+        "baseline_rows_per_sec": round(ref, 1),
     }
-    print(json.dumps(result))
+    try:
+        result.update(bench_stream_rows_per_sec())
+    except Exception as e:  # streaming must not void the primary number
+        result["stream_error"] = f"{type(e).__name__}: {e}"
+    return result
+
+
+# ------------------------------------------------------------- orchestration
+
+
+def _attempt(env_overrides: dict, timeout_s: float) -> tuple[dict | None, str]:
+    """Run the measurement child; returns (result | None, diagnostic)."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            capture_output=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s (backend init hang?)"
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()[-3:]
+        return None, f"rc={proc.returncode}: {' | '.join(tail)}"
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), "ok"
+            except json.JSONDecodeError:
+                continue
+    return None, "child produced no JSON"
+
+
+def main() -> None:
+    if "--run" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # the tunneled-TPU PJRT plugin can block backend discovery even
+            # when the platform is pinned to cpu — drop it first
+            from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+            force_cpu_backend()
+        print(json.dumps(run_measurements()), flush=True)
+        return
+
+    diagnostics = []
+    result = None
+    # attempt the ambient platform (TPU under the driver) with retries
+    for attempt in range(TPU_ATTEMPTS):
+        result, diag = _attempt({}, TPU_TIMEOUT_S)
+        diagnostics.append(f"attempt {attempt + 1}: {diag}")
+        if result is not None:
+            break
+        time.sleep(5.0 * (attempt + 1))
+    if result is None:
+        # explicit CPU fallback: a real (if slow) measured number beats a
+        # traceback; the platform field keeps it honest
+        result, diag = _attempt(
+            {"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "4096",
+             "BENCH_STREAM_ROWS": "500000"},
+            CPU_TIMEOUT_S,
+        )
+        diagnostics.append(f"cpu fallback: {diag}")
+    if result is None:
+        result = {
+            "metric": "training_rows_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "rows/s/chip",
+            "vs_baseline": 0.0,
+            "error": "all bench attempts failed",
+        }
+    if len(diagnostics) > 1 or result.get("platform") != "tpu":
+        result["diagnostics"] = diagnostics
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
