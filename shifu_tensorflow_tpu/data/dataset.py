@@ -29,6 +29,7 @@ from shifu_tensorflow_tpu.data.reader import (
     ParsedBlock,
     RecordSchema,
     parse_buffer_split,
+    wanted_columns,
 )
 from shifu_tensorflow_tpu.utils import fs
 
@@ -187,21 +188,29 @@ def fixed_step_batches(
 
 
 class ShardStream:
-    """Background streaming reader: files → byte blocks → parsed batches.
+    """Background streaming reader: files → parsed blocks → fixed batches.
 
     ``n_readers`` threads split the file list and fill one bounded queue of
-    fixed-size batches; the consumer (training loop) drains it.  Reading,
-    decompression, and (native) parsing of different files overlap with
-    each other and with device step time — the ingredient the 1B-row
-    rows/sec target needs (SURVEY.md §7.2 item 1).  Block size trades parse
-    overhead against memory; defaults target ~1-4 MB per parse call.
+    fixed-size batches; the consumer (training loop) drains it.  Each file
+    is served from the fastest available source, in order:
+
+    1. **binary cache hit** (``cache_dir`` set, entry valid): finalized
+       tensors are memory-mapped and batches are zero-copy views — ingest
+       at page-cache speed, the steady-state multi-epoch path
+       (data/cache.py);
+    2. **fused native stream** (local file, native lib built): one C++ pass
+       does read→inflate→parse (cpp/stpu_data.cc stpu_stream_*) with the
+       GIL released; a cache entry is written as a side effect when
+       ``cache_dir`` is set;
+    3. **byte-chunk fallback** (remote schemes / no native lib): fs-layer
+       reads + block parse, the original path.
 
     Determinism: row→train/valid membership is per-row content hashing and
-    independent of reader count; with ``n_readers > 1`` the *order* in
-    which batches arrive (and the composition of batches at file
-    boundaries) depends on thread interleaving, so the default stays at 1
-    reader — fully reproducible — and parallel ingest is an explicit
-    opt-in for hosts with cores to spare.
+    independent of reader count and of which source served the file; with
+    ``n_readers > 1`` the *order* in which batches arrive (and batch
+    composition at file boundaries) depends on thread interleaving, so the
+    default stays at 1 reader — fully reproducible — and parallel ingest
+    is an explicit opt-in for hosts with cores to spare.
     """
 
     def __init__(
@@ -217,6 +226,7 @@ class ShardStream:
         drop_remainder: bool = False,
         salt: int = 0,
         n_readers: int | None = None,
+        cache_dir: str | None = None,
     ):
         self.paths = list(paths)
         self.schema = schema
@@ -227,6 +237,7 @@ class ShardStream:
         self.queue_depth = queue_depth
         self.drop_remainder = drop_remainder
         self.salt = salt
+        self.cache_dir = cache_dir
         if n_readers is None:
             n_readers = 1
         self.n_readers = max(1, min(n_readers, max(1, len(self.paths))))
@@ -250,51 +261,159 @@ class ShardStream:
         stop: threading.Event,
     ) -> None:
         """One reader thread: emit full batches from its file subset, then a
-        ``(_TAIL, leftover_block)`` marker the consumer merges."""
+        ``(_TAIL, leftover ParsedBlock)`` marker the consumer merges."""
         carry = ParsedBlock.empty(self.schema.num_features)
         try:
             for path in files:
-                # read decompressed bytes in large blocks, cut at the last
-                # newline, and hand whole buffers to the (native) block
-                # parser — no per-line Python work on the hot path
-                tail = b""
-                with fs.open_maybe_gzip(path) as f:
-                    while True:
-                        chunk = f.read(self.block_bytes)
-                        if not chunk:
-                            break
-                        data = tail + chunk
-                        cut = data.rfind(b"\n")
-                        if cut < 0:
-                            tail = data
-                            continue
-                        carry = self._emit_batches(q, stop, carry, data[: cut + 1])
-                        tail = data[cut + 1 :]
-                        if stop.is_set():
-                            return
-                if tail:
-                    carry = self._emit_batches(q, stop, carry, tail)
-                if stop.is_set():
-                    return
+                for block, hashes in self._file_blocks(path):
+                    carry = self._emit_blocks(
+                        q, stop, carry, self._route(block, hashes)
+                    )
+                    if stop.is_set():
+                        return
             self._put_or_stop(q, stop, (_TAIL, carry))
         except Exception as e:  # surface reader errors to the consumer
             self._put_or_stop(q, stop, e)
 
-    def _emit_batches(self, q, stop, carry: ParsedBlock, buf: bytes) -> ParsedBlock:
-        tr, va = parse_buffer_split(buf, self.schema, self.valid_rate, self.salt)
-        parsed = tr if self.emit == "train" else va
-        merged = ParsedBlock.concat([carry, parsed]) if len(carry) else parsed
-        n_full = (len(merged) // self.batch_size) * self.batch_size
-        for i in range(0, n_full, self.batch_size):
-            sl = slice(i, i + self.batch_size)
+    # ---- sources ----------------------------------------------------------
+
+    def _file_blocks(self, path: str):
+        """Yield (finalized full ParsedBlock, routing hashes|None) for one
+        shard, from cache / native stream / byte-chunk fallback."""
+        from shifu_tensorflow_tpu.data import cache as shard_cache
+        from shifu_tensorflow_tpu.data import native
+        from shifu_tensorflow_tpu.data.reader import _finalize
+
+        need_hashes = self.valid_rate > 0.0
+        if self.cache_dir is not None:
+            reader = shard_cache.lookup(self.cache_dir, path, self.schema,
+                                        self.salt)
+            if reader is not None and (not need_hashes or reader.has_hashes):
+                yield from reader.blocks()
+                return
+
+        writer = None
+        if self.cache_dir is not None:
+            writer = shard_cache.ShardCacheWriter(
+                self.cache_dir, path, self.schema, self.salt
+            )
+        want_hashes = need_hashes or writer is not None
+
+        gen = None
+        if "://" not in path or path.startswith("file://"):
+            gen = native.stream_blocks(
+                fs.strip_local(path), wanted_columns(self.schema),
+                self.schema.delimiter, salt=self.salt,
+                want_hashes=want_hashes,
+            )
+        try:
+            blocks = (
+                gen if gen is not None
+                else self._byte_chunk_blocks(path, want_hashes)
+            )
+            for arr, hashes in blocks:
+                block = _finalize(arr, self.schema)
+                if writer is not None:
+                    writer.append(block, hashes)
+                yield block, hashes
+            if writer is not None:
+                writer.commit()
+        except BaseException:
+            if writer is not None:
+                writer.abort()
+            raise
+
+    def _byte_chunk_blocks(self, path: str, want_hashes: bool):
+        """fs-layer fallback: decompressed byte chunks cut at line
+        boundaries, parsed per chunk (native block parser when present,
+        pure Python otherwise).  Yields (wanted-matrix, hashes|None)."""
+        from shifu_tensorflow_tpu.data import native
+        from shifu_tensorflow_tpu.data.reader import parse_lines_full
+
+        wanted = wanted_columns(self.schema)
+
+        def _parse(buf: bytes):
+            parsed = native.parse_buffer(
+                buf, wanted, self.schema.delimiter,
+                salt=self.salt, want_hashes=want_hashes,
+            )
+            if parsed is None:
+                parsed = parse_lines_full(buf, self.schema, self.salt,
+                                          want_hashes)
+            return parsed
+
+        tail = b""
+        with fs.open_maybe_gzip(path) as f:
+            while True:
+                chunk = f.read(self.block_bytes)
+                if not chunk:
+                    break
+                data = tail + chunk
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    tail = data
+                    continue
+                tail = data[cut + 1 :]
+                yield _parse(data[: cut + 1])
+        if tail:
+            yield _parse(tail)
+
+    # ---- routing + batch emission -----------------------------------------
+
+    def _route(self, block: ParsedBlock, hashes) -> ParsedBlock:
+        """Select this stream's side of the train/valid split."""
+        if self.valid_rate <= 0.0:
+            if self.emit == "train":
+                return block
+            return ParsedBlock.empty(self.schema.num_features)
+        if hashes is None:
+            raise ValueError("valid_rate > 0 requires routing hashes")
+        from shifu_tensorflow_tpu.data.reader import route_is_valid
+
+        is_valid = route_is_valid(hashes, self.valid_rate)
+        keep = is_valid if self.emit == "valid" else ~is_valid
+        if keep.all():
+            return block
+        return ParsedBlock(
+            block.features[keep], block.targets[keep], block.weights[keep]
+        )
+
+    def _emit_blocks(self, q, stop, carry: ParsedBlock,
+                     block: ParsedBlock) -> ParsedBlock:
+        """Emit fixed-size batches; full batches inside ``block`` are pure
+        slices (views — zero copy on the memmap'd cache path); only the
+        carry top-up at block boundaries copies rows."""
+        B = self.batch_size
+        i = 0
+        if len(carry):
+            take = min(B - len(carry), len(block))
+            if take:
+                carry = ParsedBlock.concat([
+                    carry,
+                    ParsedBlock(block.features[:take], block.targets[:take],
+                                block.weights[:take]),
+                ])
+                i = take
+            if len(carry) < B:
+                return carry
             if not self._put_or_stop(
                 q, stop,
-                make_batch(merged.features[sl], merged.targets[sl],
-                           merged.weights[sl]),
+                make_batch(carry.features, carry.targets, carry.weights),
             ):
-                return merged
+                return ParsedBlock.empty(self.schema.num_features)
+            carry = ParsedBlock.empty(self.schema.num_features)
+        n_full = i + ((len(block) - i) // B) * B
+        for j in range(i, n_full, B):
+            sl = slice(j, j + B)
+            if not self._put_or_stop(
+                q, stop,
+                make_batch(block.features[sl], block.targets[sl],
+                           block.weights[sl]),
+            ):
+                return carry
         return ParsedBlock(
-            merged.features[n_full:], merged.targets[n_full:], merged.weights[n_full:]
+            block.features[n_full:], block.targets[n_full:],
+            block.weights[n_full:],
         )
 
     def __iter__(self) -> Iterator[Batch]:

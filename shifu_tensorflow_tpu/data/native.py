@@ -41,6 +41,26 @@ def _load():
                 ]
                 lib.stpu_count_lines.restype = ctypes.c_long
                 lib.stpu_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_long]
+                lib.stpu_stream_open.restype = ctypes.c_void_p
+                lib.stpu_stream_open.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_char,
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.c_int,
+                    ctypes.c_uint,
+                    ctypes.c_int,
+                ]
+                lib.stpu_stream_next.restype = ctypes.c_long
+                lib.stpu_stream_next.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_uint),
+                    ctypes.c_long,
+                ]
+                lib.stpu_stream_error.restype = ctypes.c_char_p
+                lib.stpu_stream_error.argtypes = [ctypes.c_void_p]
+                lib.stpu_stream_close.restype = None
+                lib.stpu_stream_close.argtypes = [ctypes.c_void_p]
             except AttributeError:
                 lib = None
         _lib = lib
@@ -104,3 +124,61 @@ def parse_buffer(
     if n < 0:
         return None
     return out[:n], (hashes[:n] if hashes is not None else None)
+
+
+def stream_blocks(
+    path: str,
+    wanted_columns: tuple[int, ...],
+    delimiter: str,
+    *,
+    salt: int = 0,
+    want_hashes: bool = True,
+    block_rows: int = 1 << 16,
+):
+    """Generator over ``(arr, hashes)`` blocks of a delimited shard, parsed
+    by the fused native read→inflate→parse stream (cpp/stpu_data.cc
+    stpu_stream_*).  Yields fresh arrays (the consumer keeps references).
+    Returns None (instead of a generator) when the native path is
+    unavailable — caller falls back to the Python byte-chunk path.
+    """
+    lib = _load()
+    delim = delimiter.encode()
+    if lib is None or len(delim) != 1:
+        return None
+    n_wanted = len(wanted_columns)
+    cols = (ctypes.c_int * n_wanted)(*wanted_columns)
+    handle = lib.stpu_stream_open(
+        os.fsencode(path), delim, cols, n_wanted,
+        ctypes.c_uint(salt & 0xFFFFFFFF), 1 if want_hashes else 0,
+    )
+    if not handle:
+        return None
+
+    def _gen():
+        try:
+            while True:
+                out = np.empty((block_rows, n_wanted), np.float32)
+                hashes = np.empty((block_rows,), np.uint32) if want_hashes else None
+                n = lib.stpu_stream_next(
+                    handle,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    (
+                        hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint))
+                        if hashes is not None
+                        else None
+                    ),
+                    block_rows,
+                )
+                if n < 0:
+                    msg = lib.stpu_stream_error(handle)
+                    raise OSError(
+                        f"native stream failed on {path}: "
+                        f"{(msg or b'?').decode(errors='replace')}"
+                    )
+                if n == 0:
+                    return
+                yield out[:n], (hashes[:n] if hashes is not None else None)
+        finally:
+            lib.stpu_stream_close(handle)
+
+    return _gen()

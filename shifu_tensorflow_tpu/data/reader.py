@@ -107,39 +107,50 @@ def _reject():
     raise ValueError("cell outside the shared parser grammar")
 
 
-def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
-    """Parse a block of raw delimited lines into arrays.
+def _parse_lines(
+    lines: list[bytes], schema: RecordSchema, salt: int, want_hashes: bool
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """The one pure-Python row-parse loop (the native parsers mirror its
+    grammar): wanted-column matrix for every kept row plus (optionally) the
+    kept rows' crc32 routing hashes, hash[i] aligned with row i.
 
     Bad rows (wrong column count / non-numeric cells) are dropped, matching
     the reference's tolerance of unparseable cells (ssgd_monitor.py:404-408)
     but at row granularity so feature vectors never silently shorten.
     """
-    if not lines:
-        return ParsedBlock.empty(schema.num_features)
-
     delim = schema.delimiter.encode()
     wanted = wanted_columns(schema)
     max_col = max(wanted)
-
     rows: list[list[float]] = []
+    hashes: list[int] = []
     for line in lines:
         cols = line.rstrip(b"\r\n").split(delim)
         if len(cols) <= max_col:
             continue
         try:
-            rows.append(
-                [
-                    float(cols[c]) if _CELL_RE.match(cols[c]) else _reject()
-                    for c in wanted
-                ]
-            )
+            row = [
+                float(cols[c]) if _CELL_RE.match(cols[c]) else _reject()
+                for c in wanted
+            ]
         except ValueError:
             continue
+        rows.append(row)
+        if want_hashes:
+            hashes.append(zlib.crc32(line, salt) & 0xFFFFFFFF)
+    arr = (
+        np.asarray(rows, dtype=np.float32)
+        if rows
+        else np.empty((0, len(wanted)), np.float32)
+    )
+    return arr, (np.asarray(hashes, np.uint32) if want_hashes else None)
 
-    if not rows:
+
+def parse_block(lines: list[bytes], schema: RecordSchema) -> ParsedBlock:
+    """Parse a block of raw delimited lines into finalized arrays."""
+    arr, _ = _parse_lines(lines, schema, 0, want_hashes=False)
+    if arr.shape[0] == 0:
         return ParsedBlock.empty(schema.num_features)
-
-    return _finalize(np.asarray(rows, dtype=np.float32), schema)
+    return _finalize(arr, schema)
 
 
 def _finalize(arr: np.ndarray, schema: RecordSchema) -> ParsedBlock:
@@ -172,6 +183,39 @@ def wanted_columns(schema: RecordSchema) -> tuple[int, ...]:
     return tuple(wanted)
 
 
+def split_buffer_lines(buf: bytes) -> list[bytes]:
+    """Split strictly on '\\n' (keeping it), matching file iteration —
+    unlike bytes.splitlines, which also breaks on \\r/\\v/\\f and would
+    change both row boundaries and routing hashes."""
+    lines = [chunk + b"\n" for chunk in buf.split(b"\n")]
+    if lines:
+        lines[-1] = lines[-1][:-1]  # last line keeps no invented newline
+        if not lines[-1]:
+            lines.pop()
+    return lines
+
+
+def parse_lines_full(
+    buf: bytes, schema: RecordSchema, salt: int, want_hashes: bool
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Pure-Python mirror of the native parsers' full-block contract, over
+    a raw byte buffer."""
+    return _parse_lines(split_buffer_lines(buf), schema, salt, want_hashes)
+
+
+def routing_threshold(valid_rate: float) -> np.uint64:
+    """The single train/valid routing rule: a row goes to validation iff
+    crc32(line, salt) < valid_rate * 2**32 (compare in uint64 — at
+    valid_rate=1.0 the threshold exceeds uint32).  Every splitter — Python
+    line loop, native block parser, ShardStream routing — must use this."""
+    return np.uint64(int(valid_rate * 0x100000000))
+
+
+def route_is_valid(hashes: np.ndarray, valid_rate: float) -> np.ndarray:
+    """Vectorized routing mask: True where the row belongs to validation."""
+    return hashes.astype(np.uint64) < routing_threshold(valid_rate)
+
+
 def parse_buffer_split(
     buf: bytes,
     schema: RecordSchema,
@@ -200,22 +244,13 @@ def parse_buffer_split(
         arr, hashes = parsed
         if valid_rate <= 0.0 or hashes is None:
             return _finalize(arr, schema), ParsedBlock.empty(schema.num_features)
-        threshold = np.uint64(int(valid_rate * 0x100000000))
-        is_valid = hashes.astype(np.uint64) < threshold
+        is_valid = route_is_valid(hashes, valid_rate)
         return (
             _finalize(arr[~is_valid], schema),
             _finalize(arr[is_valid], schema),
         )
 
-    # split strictly on '\n' (keeping it), matching file iteration — unlike
-    # bytes.splitlines, which also breaks on \r/\v/\f and would change both
-    # row boundaries and routing hashes
-    lines = [chunk + b"\n" for chunk in buf.split(b"\n")]
-    if lines:
-        lines[-1] = lines[-1][:-1]  # last line keeps no invented newline
-        if not lines[-1]:
-            lines.pop()
-    tr, va = split_train_valid(lines, valid_rate, salt)
+    tr, va = split_train_valid(split_buffer_lines(buf), valid_rate, salt)
     return parse_block(tr, schema), parse_block(va, schema)
 
 
@@ -229,7 +264,7 @@ def split_train_valid(
     if valid_rate <= 0.0:
         return list(lines), []
     train, valid = [], []
-    threshold = int(valid_rate * 0x100000000)
+    threshold = int(routing_threshold(valid_rate))
     for line in lines:
         h = zlib.crc32(line, salt) & 0xFFFFFFFF
         (valid if h < threshold else train).append(line)

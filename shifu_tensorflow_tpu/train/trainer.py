@@ -71,14 +71,41 @@ class EpochStats:
 MetricsCallback = Callable[[EpochStats], None]
 
 
-def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0):
+def donation_is_safe() -> bool:
+    """Whether donating the train state to the jitted step is a win here.
+
+    Donation reuses the state's device buffers in place — the right default
+    on real TPU HBM.  But through the axon-tunneled single-chip backend it
+    is pathological: measured on this host, a donated step degrades from
+    ~2ms to ~100-140ms after ~50 iterations (buffer churn over the tunnel),
+    a 50x throughput collapse, while the undonated step stays flat at
+    ~1.8ms.  Detect the tunnel via the PJRT platform_version string;
+    override either way with STPU_DONATE=0/1.
+    """
+    import os
+
+    env = os.environ.get("STPU_DONATE")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    try:
+        version = jax.devices()[0].client.platform_version
+    except Exception:
+        return True
+    return "axon" not in version.lower()
+
+
+def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
+                    donate: bool | None = None):
     """Build the jitted SPMD train step.
 
-    state is donated (buffers reused in place); with a sharded batch the
-    grad all-reduce is inserted by XLA — no explicit psum needed under jit
-    (shard_map users would write it; we stay at the jit level so the same
-    step runs single-chip and multi-chip).
+    state is donated (buffers reused in place) where safe — see
+    donation_is_safe; with a sharded batch the grad all-reduce is inserted
+    by XLA — no explicit psum needed under jit (shard_map users would
+    write it; we stay at the jit level so the same step runs single-chip
+    and multi-chip).
     """
+    if donate is None:
+        donate = donation_is_safe()
     loss_fn = get_loss(loss_name)
 
     def compute_loss(params, batch):
@@ -88,7 +115,7 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0):
             loss = loss + l2_penalty(params, l2)
         return loss
 
-    @partial(jax.jit, donate_argnums=(0,))
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, batch: Batch):
         loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
         # An all-padding (weight-0) batch must be a true no-op: the data

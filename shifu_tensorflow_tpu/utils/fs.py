@@ -15,6 +15,7 @@ one.  Everything else in the framework goes through this seam.
 from __future__ import annotations
 
 import gzip
+import io
 import os
 from typing import BinaryIO, Callable, Iterator
 
@@ -36,6 +37,12 @@ class FileSystem:
     def size(self, path: str) -> int:
         raise NotImplementedError
 
+    def mtime_ns(self, path: str) -> int | None:
+        """Last-modification time in nanoseconds, or None if the backend
+        cannot provide one (callers that fingerprint content — the shard
+        cache — then refuse to cache rather than risk staleness)."""
+        return None
+
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 
@@ -56,6 +63,9 @@ class LocalFileSystem(FileSystem):
 
     def size(self, path: str) -> int:
         return os.path.getsize(path)
+
+    def mtime_ns(self, path: str) -> int | None:
+        return os.stat(path).st_mtime_ns
 
     def mkdirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
@@ -117,13 +127,48 @@ class _OwningGzipFile(gzip.GzipFile):
                 raw.close()
 
 
+class _PrefixedRaw(io.RawIOBase):
+    """Raw stream serving ``head`` bytes first, then ``raw`` — lets gzip
+    sniffing work on non-seekable (remote) streams."""
+
+    def __init__(self, head: bytes, raw: BinaryIO):
+        self._head = head
+        self._raw = raw
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        if self._head:
+            n = min(len(b), len(self._head))
+            b[:n] = self._head[:n]
+            self._head = self._head[n:]
+            return n
+        data = self._raw.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def close(self) -> None:
+        try:
+            self._raw.close()
+        finally:
+            super().close()
+
+
 def open_maybe_gzip(path: str) -> BinaryIO:
-    """Open transparently decompressing ``.gz`` — the reference's shards are
-    gzip PSV (ssgd_monitor.py:380-381)."""
+    """Open transparently decompressing gzip content.
+
+    Detection is by magic bytes (1f 8b), NOT extension — the native stream
+    parser (cpp/stpu_data.cc stpu_stream_open) sniffs the same way, so a
+    file yields identical rows whichever path serves it (the reference's
+    shards are gzip PSV regardless of name, ssgd_monitor.py:380-381)."""
     raw = open_read(path)
-    if path.endswith(".gz"):
-        return _OwningGzipFile(fileobj=raw)  # type: ignore[return-value]
-    return raw
+    head = raw.read(2)
+    stream = io.BufferedReader(_PrefixedRaw(head, raw), 1 << 20)
+    if head == b"\x1f\x8b":
+        return _OwningGzipFile(fileobj=stream)  # type: ignore[return-value]
+    return stream  # type: ignore[return-value]
 
 
 def read_text(path: str) -> str:
@@ -156,6 +201,10 @@ def exists(path: str) -> bool:
 
 def size(path: str) -> int:
     return filesystem_for(path).size(strip_local(path))
+
+
+def mtime_ns(path: str) -> int | None:
+    return filesystem_for(path).mtime_ns(strip_local(path))
 
 
 def mkdirs(path: str) -> None:

@@ -1,0 +1,204 @@
+"""Binary shard cache (data/cache.py) + fused native stream + routing rule.
+
+The contract under test: a ShardStream emits IDENTICAL batches whether a
+file is served by the byte-chunk fallback, the fused native stream, a cold
+cache build, or a warm cache hit — and the cache invalidates itself when
+the source or the parse config changes.
+"""
+
+import gzip
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.data import cache as shard_cache
+from shifu_tensorflow_tpu.data import native
+from shifu_tensorflow_tpu.data.dataset import ShardStream
+from shifu_tensorflow_tpu.data.reader import (
+    RecordSchema,
+    parse_lines_full,
+    route_is_valid,
+    wanted_columns,
+)
+from shifu_tensorflow_tpu.utils import fs
+
+SCHEMA = RecordSchema(feature_columns=(1, 2, 3), target_column=0, weight_column=4)
+
+
+def _write_shards(root, n_shards=3, rows=2000, compress=True, seed=0):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(root, f"part-{s}{'.gz' if compress else '.psv'}")
+        lines = []
+        for _ in range(rows):
+            x = rng.normal(size=3)
+            y = int(x.sum() > 0)
+            lines.append("|".join([str(y)] + [f"{v:.5f}" for v in x] + ["1.0"]))
+        data = ("\n".join(lines) + "\n").encode()
+        with open(p, "wb") as f:
+            f.write(gzip.compress(data) if compress else data)
+        paths.append(p)
+    return paths
+
+
+def _drain(paths, cache_dir, valid_rate=0.0, emit="train", batch=256):
+    stream = ShardStream(
+        paths, SCHEMA, batch, valid_rate=valid_rate, emit=emit,
+        cache_dir=cache_dir,
+    )
+    return [
+        (b["x"].copy(), b["y"].copy(), b["w"].copy()) for b in stream
+    ]
+
+
+def _assert_same(a, b):
+    assert len(a) == len(b)
+    for (x1, y1, w1), (x2, y2, w2) in zip(a, b):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+        np.testing.assert_array_equal(w1, w2)
+
+
+def test_cold_warm_nocache_batch_parity(tmp_path):
+    paths = _write_shards(str(tmp_path))
+    cache_dir = str(tmp_path / "cache")
+    no_cache = _drain(paths, None)
+    cold = _drain(paths, cache_dir)  # parse + write entries
+    warm = _drain(paths, cache_dir)  # memmap hit
+    _assert_same(no_cache, cold)
+    _assert_same(no_cache, warm)
+    metas = [f for f in os.listdir(cache_dir) if f.endswith(".meta.json")]
+    assert len(metas) == len(paths)
+    # no leftover temp slabs
+    assert not [f for f in os.listdir(cache_dir) if ".tmp." in f]
+
+
+def test_valid_split_parity_cached(tmp_path):
+    paths = _write_shards(str(tmp_path))
+    cache_dir = str(tmp_path / "cache")
+    for emit in ("train", "valid"):
+        ref = _drain(paths, None, valid_rate=0.3, emit=emit)
+        _drain(paths, cache_dir, valid_rate=0.3, emit=emit)  # cold
+        warm = _drain(paths, cache_dir, valid_rate=0.3, emit=emit)
+        _assert_same(ref, warm)
+
+
+def test_cache_invalidated_on_source_change(tmp_path):
+    paths = _write_shards(str(tmp_path), n_shards=1)
+    cache_dir = str(tmp_path / "cache")
+    before = _drain(paths, cache_dir)
+    _drain(paths, cache_dir)  # warm once
+    # rewrite the shard with different content (different size + mtime)
+    _write_shards(str(tmp_path), n_shards=1, seed=9)
+    os.utime(paths[0], ns=(time.time_ns(), time.time_ns() + 10**9))
+    after = _drain(paths, cache_dir)
+    with pytest.raises(AssertionError):
+        _assert_same(before, after)
+
+
+def test_cache_key_covers_parse_config(tmp_path):
+    paths = _write_shards(str(tmp_path), n_shards=1)
+    k1 = shard_cache.cache_key(paths[0], SCHEMA, 0)
+    k2 = shard_cache.cache_key(paths[0], SCHEMA, salt=7)
+    zs = SCHEMA.with_zscale([0.1, 0.2, 0.3], [1.0, 1.0, 1.0])
+    k3 = shard_cache.cache_key(paths[0], zs, 0)
+    assert k1 and len({k1, k2, k3}) == 3
+
+
+def test_concurrent_writers_same_key(tmp_path):
+    """Two streams building the same entries at once (train+valid zipped)
+    must not corrupt each other — the round-2 review found PID-only temp
+    suffixes let same-process writers truncate each other's slabs."""
+    paths = _write_shards(str(tmp_path))
+    cache_dir = str(tmp_path / "cache")
+    ref_t = _drain(paths, None, valid_rate=0.3, emit="train")
+    ref_v = _drain(paths, None, valid_rate=0.3, emit="valid")
+
+    results = {}
+
+    def run(emit):
+        results[emit] = _drain(paths, cache_dir, valid_rate=0.3, emit=emit)
+
+    threads = [threading.Thread(target=run, args=(e,)) for e in ("train", "valid")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _assert_same(results["train"], ref_t)
+    _assert_same(results["valid"], ref_v)
+    # whatever got committed must serve correct warm reads
+    _assert_same(_drain(paths, cache_dir, valid_rate=0.3, emit="train"), ref_t)
+    _assert_same(_drain(paths, cache_dir, valid_rate=0.3, emit="valid"), ref_v)
+
+
+def test_plain_text_shards_and_gzip_sniffing(tmp_path):
+    # gzip content named .psv and plain content named .gz must both parse
+    # identically on every path (magic sniff, not extension)
+    rng = np.random.default_rng(3)
+    lines = []
+    for _ in range(500):
+        x = rng.normal(size=3)
+        lines.append("|".join(["1"] + [f"{v:.5f}" for v in x] + ["1.0"]))
+    data = ("\n".join(lines) + "\n").encode()
+    p_gz_as_psv = str(tmp_path / "a.psv")
+    p_plain_as_gz = str(tmp_path / "b.gz")
+    with open(p_gz_as_psv, "wb") as f:
+        f.write(gzip.compress(data))
+    with open(p_plain_as_gz, "wb") as f:
+        f.write(data)
+    a = _drain([p_gz_as_psv], None)
+    b = _drain([p_plain_as_gz], None)
+    _assert_same(a, b)
+    # 500 rows pad up to 2 full batches; padding rows carry weight 0
+    assert sum(x.shape[0] for x, _, _ in a) == 512
+    assert float(a[-1][2][-12:].sum()) == 0.0
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_native_stream_matches_python_fallback(tmp_path):
+    paths = _write_shards(str(tmp_path), n_shards=1, rows=777)
+    wanted = wanted_columns(SCHEMA)
+    blocks = list(native.stream_blocks(paths[0], wanted, "|", salt=5,
+                                       want_hashes=True, block_rows=100))
+    arr = np.concatenate([a for a, _ in blocks])
+    hashes = np.concatenate([h for _, h in blocks])
+    with fs.open_maybe_gzip(paths[0]) as f:
+        buf = f.read()
+    ref_arr, ref_h = parse_lines_full(buf, SCHEMA, 5, True)
+    np.testing.assert_array_equal(arr, ref_arr)
+    np.testing.assert_array_equal(hashes, ref_h)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_native_stream_truncated_gzip_raises(tmp_path):
+    p = str(tmp_path / "t.gz")
+    with open(p, "wb") as f:
+        f.write(gzip.compress(b"1|2|3|4|5\n" * 500)[:-16])
+    with pytest.raises(OSError):
+        list(native.stream_blocks(p, wanted_columns(SCHEMA), "|"))
+
+
+def test_routing_rule_shared_and_uint64_safe():
+    hashes = np.array([0, 1, 0x7FFFFFFF, 0xFFFFFFFF], np.uint32)
+    # valid_rate=1.0: threshold is 2**32 — EVERY row is valid, including
+    # hash 0xFFFFFFFF (a uint32-clamped compare would misroute it)
+    assert route_is_valid(hashes, 1.0).all()
+    assert not route_is_valid(hashes, 0.0).any()
+    half = route_is_valid(hashes, 0.5)  # threshold 0x80000000
+    np.testing.assert_array_equal(half, [True, True, True, False])
+
+
+def test_remote_scheme_without_mtime_is_never_cached(tmp_path):
+    class NoMtimeFS(fs.FileSystem):
+        def size(self, path):
+            return 10
+
+    fs.register_filesystem("fakefs", NoMtimeFS())
+    try:
+        assert shard_cache.cache_key("fakefs://x/y.gz", SCHEMA, 0) is None
+    finally:
+        fs._SCHEME_HANDLERS.pop("fakefs", None)
