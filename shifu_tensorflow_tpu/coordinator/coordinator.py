@@ -486,6 +486,31 @@ class Coordinator:
         else:
             rec.restarts += 1  # submitter polls status and relaunches
 
+    def request_restart(self, worker_id: str, why: str) -> dict[str, Any]:
+        """A worker hit an infrastructure fault it knows a fresh generation
+        cures (canonically: the chief's reserved jax port was stolen before
+        ``jax.distributed.initialize`` could bind it).  SPMD: bump the
+        generation — ONE budgeted restart attributed to the root cause,
+        instead of an opaque exit-1 whose cascade the coordinator must
+        dedup.  The caller then exits RESTART_EXIT_CODE (not a failure)."""
+        with self._lock:  # RLock: held across _fleet_restart so concurrent
+            # requesters can't each pass the dedup check and burn N budget
+            # units for one root cause
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return {"ok": False, "error": f"unknown worker {worker_id}"}
+            if not self.spec.spmd:
+                # non-SPMD workers restart individually: exit nonzero and
+                # the submitter relaunches within budget
+                return {"ok": True, "fleet": False}
+            if rec.generation < self._generation:
+                # a restart for this fault is already underway
+                return {"ok": True, "fleet": True}
+            self._fleet_restart(
+                f"worker {rec.worker_index} requested restart ({why})"
+            )
+            return {"ok": True, "fleet": True}
+
     def _fleet_restart(self, why: str) -> None:
         """Bump the fleet generation: the submitter kills every live worker
         process and relaunches the whole fleet; workers re-register sticky
@@ -607,6 +632,10 @@ class Coordinator:
             )
         if op == "complete":
             return self.complete(msg["worker_id"], int(msg.get("exit_code", 0)))
+        if op == "request_restart":
+            return self.request_restart(
+                msg["worker_id"], msg.get("why") or "unspecified"
+            )
         if op == "status":
             return self.status()
         return {"ok": False, "error": f"unknown op {op!r}"}
@@ -691,6 +720,11 @@ class CoordinatorClient:
     def complete(self, worker_id: str, exit_code: int = 0) -> dict[str, Any]:
         return self.call(
             {"op": "complete", "worker_id": worker_id, "exit_code": exit_code}
+        )
+
+    def request_restart(self, worker_id: str, why: str) -> dict[str, Any]:
+        return self.call(
+            {"op": "request_restart", "worker_id": worker_id, "why": why}
         )
 
     def status(self) -> dict[str, Any]:

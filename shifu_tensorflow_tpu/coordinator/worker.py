@@ -30,6 +30,7 @@ from __future__ import annotations
 import math
 import sys
 import threading
+import traceback
 from dataclasses import dataclass
 from typing import Callable
 
@@ -173,12 +174,22 @@ def run_worker(cfg: WorkerConfig, *,
 
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
     # reserve a port for the jax coordination service up front: only the
-    # chief's is used, but index assignment happens at registration
-    jax_port = dist.reserve_port(cfg.host) if cfg.spmd else None
+    # chief's is used, but index assignment happens at registration.  The
+    # reservation is HELD (socket open) until just before initialize binds
+    # it — round 2's flaky recovery traced to this port being stolen in the
+    # registration window under load.
+    port_hold = dist.ReservedPort(cfg.host) if cfg.spmd else None
     reg = client.register(
-        cfg.worker_id, cfg.worker_index, host=cfg.host, jax_port=jax_port
+        cfg.worker_id, cfg.worker_index, host=cfg.host,
+        jax_port=port_hold.port if port_hold else None,
     )
     if not reg.get("ok"):
+        if port_hold is not None:
+            port_hold.release()
+        print(
+            f"[worker {cfg.worker_id}] registration rejected: "
+            f"{reg.get('error')}", file=sys.stderr, flush=True,
+        )
         return 1  # never registered; the coordinator doesn't know us
     worker_index = reg["worker_index"]
     shard_paths = reg["shard"]
@@ -209,7 +220,30 @@ def run_worker(cfg: WorkerConfig, *,
             topology = dist.ProcessTopology.from_cluster_info(
                 started.get("cluster") or {}, worker_index
             )
-            dist.initialize(topology)
+            if port_hold is not None:
+                port_hold.release()  # chief: initialize rebinds it NOW
+            try:
+                dist.initialize(topology)
+            except Exception:
+                # canonical cause: the chief's port was stolen anyway, or a
+                # peer died mid-bring-up.  A fresh generation (fresh port,
+                # full re-registration) cures both — request ONE budgeted
+                # fleet restart attributed to this root cause instead of
+                # dying opaquely and making the coordinator untangle the
+                # cascade.
+                traceback.print_exc()
+                print(
+                    f"[worker {worker_index}] jax.distributed.initialize "
+                    f"failed; requesting fleet restart",
+                    file=sys.stderr, flush=True,
+                )
+                try:
+                    client.request_restart(
+                        cfg.worker_id, "jax.distributed.initialize failed"
+                    )
+                except Exception:
+                    pass
+                raise _FleetRestart()
             mesh = dist.global_mesh(cfg.mesh_spec or "data:-1")
         elif cfg.mesh_spec:
             from shifu_tensorflow_tpu.parallel.mesh import make_mesh
@@ -265,14 +299,27 @@ def run_worker(cfg: WorkerConfig, *,
                 fail_at_epoch=fail_at_epoch,
             )
     except _InjectedFault:
+        print(f"[worker {worker_index}] injected fault fired "
+              f"(fail_at_epoch={fail_at_epoch})", file=sys.stderr, flush=True)
         exit_code = 43
     except _FleetRestart:
+        print(f"[worker {worker_index}] exiting for fleet restart",
+              file=sys.stderr, flush=True)
         exit_code = RESTART_EXIT_CODE
     except _JobAborted:
+        print(f"[worker {worker_index}] job aborted by coordinator",
+              file=sys.stderr, flush=True)
         exit_code = 42
     except Exception:
+        # the per-worker log file (submitter) must carry the root cause —
+        # round 2's flaky recovery was undiagnosable because this path
+        # swallowed the traceback
+        traceback.print_exc()
+        sys.stderr.flush()
         exit_code = 1
     finally:
+        if port_hold is not None:
+            port_hold.release()
         # always release the checkpoint manager: leaked orbax async writer
         # threads abort the interpreter at teardown
         if checkpointer is not None:

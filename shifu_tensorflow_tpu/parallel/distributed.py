@@ -114,19 +114,36 @@ def global_mesh(spec: str = "data:-1"):
     return make_mesh(spec, devices=jax.devices())
 
 
-def reserve_port(host: str = "127.0.0.1") -> int:
-    """Pick a currently-free TCP port for the jax coordination service.
+class ReservedPort:
+    """A held TCP port reservation for the jax coordination service.
 
     The reference reserved each worker's TF port by holding a ServerSocket
-    open until just before Python started (TensorflowTaskExecutor.java:
-    181-185) — same idea, same small close-to-bind race, acceptable because
-    the port is consumed within the same bring-up barrier.
+    open until just before Python exec'd the trainer
+    (TensorflowTaskExecutor.java:181-185).  Same idea here: the hold spans
+    the whole registration + start-barrier window, and release() is called
+    immediately before ``jax.distributed.initialize`` rebinds the port, so
+    the steal window shrinks from seconds (round-2's flaky recovery traced
+    to a close-at-reserve-time helper) to microseconds.  listen() makes the
+    reservation exclusive — a bound-but-not-listening socket can still be
+    re-bound by a second SO_REUSEADDR binder; a listening one cannot.  The
+    never-accepted listener leaves no TIME_WAIT state behind, so the
+    coordination service rebinds instantly after release.
     """
-    import socket
 
-    with socket.socket() as s:
-        s.bind((host, 0))
-        return s.getsockname()[1]
+    def __init__(self, host: str = "127.0.0.1"):
+        import socket
+
+        self._sock = socket.socket()
+        self._sock.bind((host, 0))
+        self._sock.listen(1)
+        self.port: int = self._sock.getsockname()[1]
+
+    def release(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
 
 
 def put_process_local(batch: dict, sharding) -> dict:

@@ -22,6 +22,8 @@ import jax
 import orbax.checkpoint as ocp
 from flax.core import meta as flax_meta
 
+from shifu_tensorflow_tpu.utils import fs
+
 
 def _unbox(tree):
     """Strip flax AxisMetadata boxes (nn.Partitioned) so the on-disk pytree
@@ -78,18 +80,23 @@ class NpzCheckpointer:
         every_epochs: int = 1,
         max_to_keep: int = 3,
     ):
-        self.directory = os.path.abspath(directory)
+        # IO goes through the fs seam, so the directory may live on any
+        # registered scheme (hdfs://, gs://) — the reference checkpointed
+        # straight to HDFS (ssgd_monitor.py:251-257, TMP_MODEL_PATH env)
+        if "://" not in directory:
+            directory = os.path.abspath(directory)
+        self.directory = directory
         self.every_epochs = max(1, int(every_epochs))
         self.max_to_keep = max(1, int(max_to_keep))
-        os.makedirs(self.directory, exist_ok=True)
+        fs.mkdirs(self.directory)
 
     def _path(self, epoch: int) -> str:
-        return os.path.join(self.directory, f"{self._PREFIX}{epoch}{self._SUFFIX}")
+        return f"{self.directory.rstrip('/')}/{self._PREFIX}{epoch}{self._SUFFIX}"
 
     def _epochs(self) -> list[int]:
         out = []
         try:
-            names = os.listdir(self.directory)
+            names = fs.listdir(self.directory)
         except OSError:
             return []
         for name in names:
@@ -121,12 +128,12 @@ class NpzCheckpointer:
         arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
                   for i, x in enumerate(leaves)}
         tmp = self._path(epoch) + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
+        with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, **arrays)
-        os.replace(tmp, self._path(epoch))  # atomic publish
+        fs.rename(tmp, self._path(epoch))  # atomic publish (local/hdfs)
         for old in self._epochs()[: -self.max_to_keep]:
             try:
-                os.remove(self._path(old))
+                fs.delete(self._path(old))
             except OSError:
                 pass
 
@@ -141,8 +148,15 @@ class NpzCheckpointer:
             }
         )
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        with np.load(self._path(epoch)) as z:
-            loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
+        import io
+
+        with fs.open_read(self._path(epoch)) as f:
+            # np.load's zip reader needs a seekable file; local files are,
+            # raw HTTP response streams are not — buffer only those
+            src = f if getattr(f, "seekable", lambda: False)() \
+                else io.BytesIO(f.read())
+            with np.load(src) as z:
+                loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
         # scalars (e.g. step) round-trip as 0-d arrays; cast back via the
         # template leaf's dtype to keep the tree structurally identical
         vals = [

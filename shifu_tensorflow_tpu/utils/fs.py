@@ -1,15 +1,17 @@
-"""Filesystem abstraction: local, HDFS (webhdfs), and GCS paths.
+"""Filesystem abstraction: local, HDFS (WebHDFS REST), and GCS paths.
 
 Parity surface: the reference reads/writes through Hadoop's ``FileSystem``
 (shifu-core HDFSUtils, used at TensorflowClient.java:80, Constants.java:96)
 and TF's ``gfile`` in Python (ssgd_monitor.py:380).  Here a minimal scheme
 dispatch covers the same call sites: ``open_read``, ``read_text``,
-``write_text``, ``listdir_recursive``, ``exists``, ``mkdirs``.
+``write_text``, ``listdir_recursive``, ``exists``, ``mkdirs``, plus
+``rename``/``delete``/``mtime_ns`` for checkpointing and cache keys.
 
-Only the local backend is implemented in-process; ``hdfs://`` and ``gs://``
-resolve through optional handlers registered at runtime (fsspec-style), so
-cluster deployments can plug in a real client without this module importing
-one.  Everything else in the framework goes through this seam.
+Backends: local (below); ``hdfs://``/``webhdfs://`` (fs_webhdfs.py, REST
+via stdlib urllib) and ``gs://`` (fs_gcs.py, JSON API) auto-register on
+first use.  ``register_filesystem`` overrides any scheme with a custom
+implementation (fsspec-style).  Everything else in the framework goes
+through this seam.
 """
 
 from __future__ import annotations
@@ -49,6 +51,18 @@ class FileSystem:
     def listdir_recursive(self, path: str) -> list[str]:
         raise NotImplementedError
 
+    def listdir(self, path: str) -> list[str]:
+        """Immediate child names (not paths) of a directory."""
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Move src to dst.  Atomic on local/HDFS; object stores document
+        their weaker copy+delete semantics."""
+        raise NotImplementedError
+
 
 class LocalFileSystem(FileSystem):
     def open_read(self, path: str) -> BinaryIO:
@@ -79,6 +93,15 @@ class LocalFileSystem(FileSystem):
                 out.append(os.path.join(root, f))
         return sorted(out)
 
+    def listdir(self, path: str) -> list[str]:
+        return sorted(os.listdir(path))
+
+    def delete(self, path: str) -> None:
+        os.remove(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
 
 _LOCAL = LocalFileSystem()
 
@@ -99,11 +122,29 @@ def filesystem_for(path: str) -> FileSystem:
         return _LOCAL
     fs_impl = _SCHEME_HANDLERS.get(scheme)
     if fs_impl is None:
+        fs_impl = _auto_register(scheme)
+    if fs_impl is None:
         raise ValueError(
             f"no filesystem registered for scheme {scheme!r} "
             f"(register one via shifu_tensorflow_tpu.utils.fs.register_filesystem)"
         )
     return fs_impl
+
+
+def _auto_register(scheme: str) -> FileSystem | None:
+    """Built-in backends load lazily on first use of their scheme."""
+    if scheme in ("hdfs", "webhdfs"):
+        from shifu_tensorflow_tpu.utils.fs_webhdfs import WebHdfsFileSystem
+
+        impl: FileSystem = WebHdfsFileSystem()
+    elif scheme in ("gs", "gcs"):
+        from shifu_tensorflow_tpu.utils.fs_gcs import GcsFileSystem
+
+        impl = GcsFileSystem()
+    else:
+        return None
+    _SCHEME_HANDLERS[scheme] = impl
+    return impl
 
 
 def strip_scheme(path: str) -> str:
@@ -125,6 +166,54 @@ class _OwningGzipFile(gzip.GzipFile):
         finally:
             if raw is not None:
                 raw.close()
+
+
+class UploadOnClose:
+    """Seekable write buffer that hands its bytes to ``on_close`` exactly
+    once — the write half for object-store-style backends whose uploads are
+    single-shot.  The full seekable-file surface is exposed because writers
+    like ``np.savez`` wrap their target in a ZipFile."""
+
+    def __init__(self, on_close: Callable[[bytes], None]):
+        self._on_close = on_close
+        self._buf = io.BytesIO()
+        self._closed = False
+
+    def write(self, data: bytes) -> int:
+        return self._buf.write(data)
+
+    def seek(self, *a):
+        return self._buf.seek(*a)
+
+    def tell(self):
+        return self._buf.tell()
+
+    def read(self, *a):
+        return self._buf.read(*a)
+
+    def seekable(self):
+        return True
+
+    def readable(self):
+        return True
+
+    def writable(self):
+        return True
+
+    def flush(self):
+        pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._on_close(self._buf.getvalue())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class _PrefixedRaw(io.RawIOBase):
@@ -213,6 +302,18 @@ def mkdirs(path: str) -> None:
 
 def listdir_recursive(path: str) -> list[str]:
     return filesystem_for(path).listdir_recursive(strip_local(path))
+
+
+def listdir(path: str) -> list[str]:
+    return filesystem_for(path).listdir(strip_local(path))
+
+
+def delete(path: str) -> None:
+    filesystem_for(path).delete(strip_local(path))
+
+
+def rename(src: str, dst: str) -> None:
+    filesystem_for(src).rename(strip_local(src), strip_local(dst))
 
 
 def strip_local(path: str) -> str:

@@ -1,0 +1,174 @@
+"""WebHDFS backend for the fs seam — streaming reads of hdfs:// shards.
+
+Parity surface: the reference reads and writes HDFS everywhere through
+Hadoop's FileSystem (HdfsUtils.java:143-175 line counting,
+TensorflowClient.java:361-382 staging, CommonUtils.ClientConsoleBoard
+appends).  The TPU-native equivalent speaks the WebHDFS REST API
+(stdlib urllib only — no Hadoop client dependency): the namenode answers
+metadata ops and 307-redirects data ops to a datanode, which urllib
+follows transparently.
+
+Path convention: ``hdfs://<host>:<port>/path`` — host:port is the namenode
+**HTTP** (WebHDFS) endpoint, e.g. the 9870/50070 port, not the 8020 RPC
+port the Java client uses.  ``webhdfs://`` is accepted as an alias.
+Optional ``user.name`` for simple auth comes from $STPU_HDFS_USER.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import BinaryIO
+
+from shifu_tensorflow_tpu.utils.fs import FileSystem, UploadOnClose
+
+
+class WebHdfsError(OSError):
+    pass
+
+
+def _split(path: str) -> tuple[str, str]:
+    """hdfs://host:port/a/b -> ("host:port", "/a/b")."""
+    u = urllib.parse.urlsplit(path)
+    if not u.netloc:
+        raise ValueError(f"webhdfs path needs host:port authority: {path!r}")
+    return u.netloc, u.path or "/"
+
+
+class WebHdfsFileSystem(FileSystem):
+    def __init__(self, timeout_s: float = 60.0, user: str | None = None):
+        self.timeout_s = timeout_s
+        self.user = user if user is not None else os.environ.get("STPU_HDFS_USER")
+
+    # ---- REST plumbing ----
+    def _url(self, path: str, op: str, **params) -> str:
+        netloc, p = _split(path)
+        q = {"op": op, **params}
+        if self.user:
+            q["user.name"] = self.user
+        return (
+            f"http://{netloc}/webhdfs/v1{urllib.parse.quote(p)}"
+            f"?{urllib.parse.urlencode(q)}"
+        )
+
+    def _request(self, url: str, method: str = "GET",
+                 data: bytes | None = None):
+        req = urllib.request.Request(url, method=method, data=data)
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}")
+                msg = detail.get("RemoteException", {}).get("message", str(e))
+            except Exception:
+                msg = str(e)
+            raise WebHdfsError(f"webhdfs {method} {url}: {msg}") from e
+        except urllib.error.URLError as e:
+            raise WebHdfsError(f"webhdfs {method} {url}: {e.reason}") from e
+
+    def _json(self, path: str, op: str, method: str = "GET", **params) -> dict:
+        with self._request(self._url(path, op, **params), method) as r:
+            body = r.read()
+        return json.loads(body) if body else {}
+
+    def _status(self, path: str) -> dict:
+        return self._json(path, "GETFILESTATUS")["FileStatus"]
+
+    def _create(self, path: str, data: bytes) -> None:
+        """Two-step WebHDFS write: PUT (no body) to the namenode, receive a
+        307 with the datanode Location, PUT the body there.  urllib does
+        not follow redirects for PUT, so the hop is explicit; a server
+        answering 200/201 directly (single-node, fakes) skips the hop."""
+        url = self._url(path, "CREATE", overwrite="true")
+        req = urllib.request.Request(url, method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                location = None  # accepted directly
+        except urllib.error.HTTPError as e:
+            if e.code in (301, 302, 307):
+                location = e.headers.get("Location")
+                if not location:
+                    raise WebHdfsError(
+                        f"webhdfs CREATE {url}: redirect without Location"
+                    ) from e
+            else:
+                raise WebHdfsError(f"webhdfs CREATE {url}: {e}") from e
+        except urllib.error.URLError as e:
+            raise WebHdfsError(f"webhdfs CREATE {url}: {e.reason}") from e
+        with self._request(location or url, "PUT", data=data):
+            pass
+
+    # ---- FileSystem surface ----
+    def open_read(self, path: str) -> BinaryIO:
+        # the response object is file-like; ShardStream reads it in blocks,
+        # so a multi-GB shard streams without landing in memory
+        return self._request(self._url(path, "OPEN"))  # type: ignore[return-value]
+
+    def open_write(self, path: str) -> BinaryIO:
+        return UploadOnClose(  # type: ignore[return-value]
+            lambda data: self._create(path, data)
+        )
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._status(path)
+            return True
+        except WebHdfsError:
+            return False
+
+    def size(self, path: str) -> int:
+        return int(self._status(path)["length"])
+
+    def mtime_ns(self, path: str) -> int | None:
+        # modificationTime is epoch milliseconds
+        return int(self._status(path)["modificationTime"]) * 1_000_000
+
+    def mkdirs(self, path: str) -> None:
+        self._json(path, "MKDIRS", method="PUT")
+
+    def listdir_recursive(self, path: str) -> list[str]:
+        netloc, _ = _split(path)
+        out: list[str] = []
+
+        def walk(p: str) -> None:
+            listing = self._json(p, "LISTSTATUS")
+            for st in listing.get("FileStatuses", {}).get("FileStatus", []):
+                _, parent = _split(p)
+                child = f"hdfs://{netloc}{parent.rstrip('/')}/{st['pathSuffix']}" \
+                    if st.get("pathSuffix") else p
+                if st.get("type") == "DIRECTORY":
+                    walk(child)
+                else:
+                    out.append(child)
+
+        try:
+            if self._status(path).get("type") == "FILE":
+                return [path]
+        except WebHdfsError:
+            return []
+        walk(path)
+        return sorted(out)
+
+    def delete(self, path: str) -> None:
+        self._json(path, "DELETE", method="DELETE", recursive="false")
+
+    def rename(self, src: str, dst: str) -> None:
+        # WebHDFS RENAME has no-overwrite semantics (boolean:false when dst
+        # exists), unlike the os.replace the local backend maps to — clear
+        # the destination first so checkpoint re-publishes don't fail
+        if self.exists(dst):
+            self.delete(dst)
+        _, dst_path = _split(dst)
+        res = self._json(src, "RENAME", method="PUT", destination=dst_path)
+        if not res.get("boolean", False):
+            raise WebHdfsError(f"rename {src} -> {dst} failed")
+
+    def listdir(self, path: str) -> list[str]:
+        listing = self._json(path, "LISTSTATUS")
+        return sorted(
+            st["pathSuffix"]
+            for st in listing.get("FileStatuses", {}).get("FileStatus", [])
+        )

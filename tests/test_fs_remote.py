@@ -1,0 +1,388 @@
+"""HDFS (WebHDFS) + GCS backends against in-process fake servers.
+
+The fakes implement the REST subset the backends speak, over a temp dir /
+dict — the test strategy the reference never had for its HDFS paths
+(SURVEY.md §4: no fake backends existed at all).  End-to-end coverage:
+ShardStream ingest, NpzCheckpointer save/restore, and the metrics board
+all on non-local schemes.
+"""
+
+import gzip
+import json
+import os
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.data.dataset import ShardStream
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.utils import fs
+from shifu_tensorflow_tpu.utils.fs_gcs import GcsFileSystem
+from shifu_tensorflow_tpu.utils.fs_webhdfs import WebHdfsFileSystem
+
+SCHEMA = RecordSchema(feature_columns=(1, 2, 3), target_column=0, weight_column=4)
+
+
+# --------------------------------------------------------------------------
+# fake WebHDFS namenode+datanode in one server, backed by a local dir
+# --------------------------------------------------------------------------
+
+
+class _WebHdfsHandler(BaseHTTPRequestHandler):
+    root: str
+    redirect_creates = True
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _local(self, urlpath: str) -> str:
+        assert urlpath.startswith("/webhdfs/v1")
+        rel = urllib.parse.unquote(urlpath[len("/webhdfs/v1"):]).lstrip("/")
+        return os.path.join(self.root, rel)
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _status_obj(self, p: str) -> dict:
+        st = os.stat(p)
+        return {
+            "length": st.st_size,
+            "modificationTime": int(st.st_mtime * 1000),
+            "type": "DIRECTORY" if os.path.isdir(p) else "FILE",
+            "pathSuffix": "",
+        }
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        p = self._local(u.path)
+        op = q.get("op")
+        if op == "GETFILESTATUS":
+            if not os.path.exists(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "File does not exist"}})
+            return self._json(200, {"FileStatus": self._status_obj(p)})
+        if op == "LISTSTATUS":
+            if not os.path.isdir(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "not a directory"}})
+            entries = []
+            for name in sorted(os.listdir(p)):
+                e = self._status_obj(os.path.join(p, name))
+                e["pathSuffix"] = name
+                entries.append(e)
+            return self._json(200, {"FileStatuses": {"FileStatus": entries}})
+        if op == "OPEN":
+            if not os.path.exists(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "File does not exist"}})
+            with open(p, "rb") as f:
+                data = f.read()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self._json(400, {"RemoteException": {"message": f"bad op {op}"}})
+
+    def do_PUT(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        p = self._local(u.path)
+        op = q.get("op")
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if op == "CREATE":
+            # the real namenode 307-redirects the first (bodyless) PUT to a
+            # datanode; model that to exercise the client's two-step hop
+            if self.redirect_creates and "step2" not in q:
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://{self.headers['Host']}{u.path}?"
+                    + urllib.parse.urlencode({**q, "step2": "1"}),
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "wb") as f:
+                f.write(body)
+            return self._json(201, {})
+        if op == "MKDIRS":
+            os.makedirs(p, exist_ok=True)
+            return self._json(200, {"boolean": True})
+        if op == "RENAME":
+            dst = os.path.join(self.root, q["destination"].lstrip("/"))
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            os.replace(p, dst)
+            return self._json(200, {"boolean": True})
+        self._json(400, {"RemoteException": {"message": f"bad op {op}"}})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        p = self._local(u.path)
+        ok = os.path.exists(p)
+        if ok:
+            os.remove(p)
+        self._json(200, {"boolean": ok})
+
+
+@pytest.fixture
+def webhdfs(tmp_path):
+    root = str(tmp_path / "hdfs-root")
+    os.makedirs(root)
+    handler = type("H", (_WebHdfsHandler,), {"root": root})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address[:2]
+    yield {"base": f"hdfs://{host}:{port}", "root": root}
+    server.shutdown()
+    server.server_close()
+
+
+# --------------------------------------------------------------------------
+# fake GCS JSON API, backed by a dict
+# --------------------------------------------------------------------------
+
+
+class _GcsHandler(BaseHTTPRequestHandler):
+    objects: dict  # name -> (bytes, generation)
+    gen_counter: list
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _meta(self, name: str) -> dict:
+        data, gen = self.objects[name]
+        return {"name": name, "size": str(len(data)), "generation": str(gen)}
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        m = re.match(r"^/storage/v1/b/[^/]+/o/([^/]+)$", u.path)
+        if m:
+            name = urllib.parse.unquote(m.group(1))
+            if name not in self.objects:
+                return self._json(404, {"error": "not found"})
+            if q.get("alt") == "media":
+                data = self.objects[name][0]
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            return self._json(200, self._meta(name))
+        if re.match(r"^/storage/v1/b/[^/]+/o$", u.path):
+            prefix = q.get("prefix", "")
+            items = [
+                self._meta(n) for n in sorted(self.objects)
+                if n.startswith(prefix)
+            ]
+            return self._json(200, {"items": items})
+        self._json(400, {"error": f"bad path {u.path}"})
+
+    def do_POST(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if u.path.startswith("/upload/storage/v1/b/"):
+            name = q["name"]
+            self.gen_counter[0] += 1
+            self.objects[name] = (body, self.gen_counter[0])
+            return self._json(200, self._meta(name))
+        m = re.match(
+            r"^/storage/v1/b/[^/]+/o/([^/]+)/rewriteTo/b/[^/]+/o/([^/]+)$",
+            u.path,
+        )
+        if m:
+            src = urllib.parse.unquote(m.group(1))
+            dst = urllib.parse.unquote(m.group(2))
+            self.gen_counter[0] += 1
+            self.objects[dst] = (self.objects[src][0], self.gen_counter[0])
+            return self._json(200, {"done": True})
+        self._json(400, {"error": f"bad path {u.path}"})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        m = re.match(r"^/storage/v1/b/[^/]+/o/([^/]+)$", u.path)
+        if m:
+            self.objects.pop(urllib.parse.unquote(m.group(1)), None)
+            return self._json(204, {})
+        self._json(400, {"error": "bad path"})
+
+
+@pytest.fixture
+def gcs(monkeypatch):
+    handler = type("G", (_GcsHandler,), {"objects": {}, "gen_counter": [0]})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address[:2]
+    fs.register_filesystem("gs", GcsFileSystem(endpoint=f"http://{host}:{port}"))
+    yield {"base": "gs://bucket", "objects": handler.objects}
+    fs._SCHEME_HANDLERS.pop("gs", None)
+    server.shutdown()
+    server.server_close()
+
+
+# --------------------------------------------------------------------------
+
+
+def _shard_bytes(rows=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(rows):
+        x = rng.normal(size=3)
+        lines.append("|".join(["1"] + [f"{v:.5f}" for v in x] + ["1.0"]))
+    return ("\n".join(lines) + "\n").encode()
+
+
+class TestWebHdfs:
+    def test_roundtrip(self, webhdfs):
+        base = webhdfs["base"]
+        fs.mkdirs(f"{base}/data")
+        fs.write_text(f"{base}/data/a.txt", "hello")
+        assert fs.exists(f"{base}/data/a.txt")
+        assert not fs.exists(f"{base}/data/missing")
+        assert fs.read_text(f"{base}/data/a.txt") == "hello"
+        assert fs.size(f"{base}/data/a.txt") == 5
+        assert fs.mtime_ns(f"{base}/data/a.txt") > 0
+        fs.rename(f"{base}/data/a.txt", f"{base}/data/b.txt")
+        assert fs.read_text(f"{base}/data/b.txt") == "hello"
+        assert fs.listdir_recursive(f"{base}/data") == [f"{base}/data/b.txt"]
+        fs.delete(f"{base}/data/b.txt")
+        assert not fs.exists(f"{base}/data/b.txt")
+
+    def test_append_text_board(self, webhdfs):
+        board = f"{webhdfs['base']}/board/progress.log"
+        fs.append_text(board, "epoch 0\n")
+        fs.append_text(board, "epoch 1\n")
+        assert fs.read_text(board) == "epoch 0\nepoch 1\n"
+
+    def test_shardstream_over_hdfs(self, webhdfs, tmp_path):
+        base = webhdfs["base"]
+        data = _shard_bytes()
+        # one gzip shard, one plain shard (magic-sniffed, not extension)
+        fs.mkdirs(f"{base}/shards")
+        with fs.filesystem_for(base).open_write(f"{base}/shards/s0.gz") as f:
+            f.write(gzip.compress(data))
+        with fs.filesystem_for(base).open_write(f"{base}/shards/s1.psv") as f:
+            f.write(data)
+        local = tmp_path / "local.psv"
+        local.write_bytes(data)
+
+        remote = [f"{base}/shards/s0.gz", f"{base}/shards/s1.psv"]
+        got = [
+            b["x"].copy()
+            for b in ShardStream(remote, SCHEMA, 128, valid_rate=0.2)
+        ]
+        want = [
+            b["x"].copy()
+            for b in ShardStream(
+                [str(local), str(local)], SCHEMA, 128, valid_rate=0.2
+            )
+        ]
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_shard_cache_from_remote_source(self, webhdfs, tmp_path):
+        base = webhdfs["base"]
+        data = _shard_bytes()
+        with fs.filesystem_for(base).open_write(f"{base}/s.gz") as f:
+            f.write(gzip.compress(data))
+        cache_dir = str(tmp_path / "cache")
+        path = f"{base}/s.gz"
+        cold = [b["x"].copy() for b in ShardStream([path], SCHEMA, 128,
+                                                   cache_dir=cache_dir)]
+        assert any(
+            f.endswith(".meta.json") for f in os.listdir(cache_dir)
+        ), "remote shard should cache (webhdfs supplies mtime)"
+        warm = [b["x"].copy() for b in ShardStream([path], SCHEMA, 128,
+                                                   cache_dir=cache_dir)]
+        for c, w in zip(cold, warm):
+            np.testing.assert_array_equal(c, w)
+
+    def test_npz_checkpointer_on_hdfs(self, webhdfs):
+        jax = pytest.importorskip("jax")
+        from shifu_tensorflow_tpu.config.model_config import ModelConfig
+        from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+        from shifu_tensorflow_tpu.train.trainer import Trainer
+
+        mc = ModelConfig.from_json(
+            {"train": {"numTrainEpochs": 1, "params": {
+                "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                "ActivationFunc": ["relu"], "LearningRate": 0.1}}}
+        )
+        tr = Trainer(mc, 3)
+        ckpt_dir = f"{webhdfs['base']}/ckpt"
+        ck = NpzCheckpointer(ckpt_dir, every_epochs=1, max_to_keep=2)
+        ck.save(0, tr.state)
+        ck.save(1, tr.state)
+        ck.save(2, tr.state)  # max_to_keep prunes epoch 0
+        assert ck.latest_epoch() == 2
+        assert ck._epochs() == [1, 2]
+        restored, nxt = ck.restore_latest(tr.state)
+        assert nxt == 3
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored.step)),
+            np.asarray(jax.device_get(tr.state.step)),
+        )
+
+
+class TestGcs:
+    def test_roundtrip(self, gcs):
+        base = gcs["base"]
+        fs.write_text(f"{base}/data/a.txt", "hello")
+        assert fs.exists(f"{base}/data/a.txt")
+        assert not fs.exists(f"{base}/data/missing")
+        assert fs.read_text(f"{base}/data/a.txt") == "hello"
+        assert fs.size(f"{base}/data/a.txt") == 5
+        m1 = fs.mtime_ns(f"{base}/data/a.txt")
+        fs.write_text(f"{base}/data/a.txt", "hello2")
+        assert fs.mtime_ns(f"{base}/data/a.txt") > m1, \
+            "generation must advance on rewrite (cache staleness signal)"
+        fs.rename(f"{base}/data/a.txt", f"{base}/data/b.txt")
+        assert fs.read_text(f"{base}/data/b.txt") == "hello2"
+        assert not fs.exists(f"{base}/data/a.txt")
+        assert fs.listdir_recursive(f"{base}/data") == [f"{base}/data/b.txt"]
+
+    def test_shardstream_over_gcs(self, gcs, tmp_path):
+        base = gcs["base"]
+        data = _shard_bytes()
+        with fs.filesystem_for(base).open_write(f"{base}/shards/s0.gz") as f:
+            f.write(gzip.compress(data))
+        local = tmp_path / "local.psv"
+        local.write_bytes(data)
+        got = [
+            b["x"].copy()
+            for b in ShardStream([f"{base}/shards/s0.gz"], SCHEMA, 128)
+        ]
+        want = [
+            b["x"].copy() for b in ShardStream([str(local)], SCHEMA, 128)
+        ]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+
+def test_unknown_scheme_still_errors():
+    with pytest.raises(ValueError, match="no filesystem registered"):
+        fs.filesystem_for("s3://bucket/x")
