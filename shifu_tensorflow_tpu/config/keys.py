@@ -47,10 +47,18 @@ TASK_HEARTBEAT_INTERVAL_MS = TASK_PREFIX + "heartbeat-interval"
 DEFAULT_TASK_HEARTBEAT_INTERVAL_MS = 1000
 TASK_MAX_MISSED_HEARTBEATS = TASK_PREFIX + "max-missed-heartbeats"
 DEFAULT_TASK_MAX_MISSED_HEARTBEATS = 25
+# per-epoch fleet barrier for non-SPMD multi-worker jobs (SPMD is
+# implicitly synchronous; this key re-creates the reference's lockstep
+# epochs for independent-model mode)
+SYNC_EPOCHS = TASK_PREFIX + "sync-epochs"
+DEFAULT_SYNC_EPOCHS = False
 
 # ---- role templating (reference: getInstancesKey etc. :123-150) ----
+# NOTE: there is no "ps" role — the PS architecture has no TPU analogue
+# (variables are replicated and gradients all-reduced, SURVEY.md §7.0);
+# shifu.ps.* keys in legacy configs parse (Conf stores any key) and are
+# simply never read.
 WORKER_JOB_NAME = "worker"
-PS_JOB_NAME = "ps"  # accepted in configs for compat; there is no PS on TPU
 
 
 def instances_key(job_name: str) -> str:
@@ -87,10 +95,13 @@ PREFETCH_DEPTH = TPU_PREFIX + "prefetch-depth"
 DEFAULT_PREFETCH_DEPTH = 2
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
 DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
+# binary shard cache directory (data/cache.py): parse text shards once,
+# stream later epochs from memory-mapped finalized tensors
+CACHE_DIR = TPU_PREFIX + "cache-dir"
 
-# ---- fault-tolerance envelope (reference: Constants.java:87-94) ----
+# ---- fault-tolerance envelope (reference: Constants.java:87-89; the ps
+# threshold has no analogue — there is no PS role) ----
 WORKER_FAULT_TOLERANCE_THRESHOLD = 0.1
-PS_FAULT_TOLERANCE_THRESHOLD = 0.9
 MIN_WORKERS_START_TRAINING_THRESHOLD = 0.95
 REGISTRATION_SOFT_TIMEOUT_S = 6 * 60  # partial-start wait
 REGISTRATION_HARD_TIMEOUT_S = 20 * 60  # hard abort

@@ -72,6 +72,10 @@ class WorkerConfig:
     # streaming input (1B-row path): stream the shard instead of loading it
     stream: bool = False
     n_readers: int | None = None
+    # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
+    prefetch_depth: int = 2
+    # binary shard cache directory (data/cache.py); None = no caching
+    cache_dir: str | None = None
 
     def to_json(self) -> dict:
         """JSON transport for subprocess workers (worker_main)."""
@@ -84,7 +88,8 @@ class WorkerConfig:
                 "worker_index", "batch_size", "checkpoint_dir",
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
-                "spmd", "host", "stream", "n_readers",
+                "spmd", "host", "stream", "n_readers", "prefetch_depth",
+                "cache_dir",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -267,6 +272,7 @@ def run_worker(cfg: WorkerConfig, *,
             worker_index=worker_index,
             seed=cfg.seed,
             topology=topology,
+            prefetch_depth=cfg.prefetch_depth,
             **extra,
         )
 
@@ -383,11 +389,13 @@ def _run_local_training(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="train", salt=cfg.seed,
                 n_readers=cfg.n_readers,
+                    cache_dir=cfg.cache_dir,
             ),
             (lambda: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="valid", salt=cfg.seed,
                 n_readers=cfg.n_readers,
+                    cache_dir=cfg.cache_dir,
             )) if valid_rate > 0 else None,
             epochs=epochs,
             on_epoch=on_epoch,
@@ -485,6 +493,7 @@ def _run_spmd_training(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="train", salt=cfg.seed,
                     n_readers=cfg.n_readers,
+                    cache_dir=cfg.cache_dir,
                 ),
                 local_batch, train_steps, num_features,
                 on_dropped=_warn_dropped,
@@ -496,6 +505,7 @@ def _run_spmd_training(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="valid", salt=cfg.seed,
                     n_readers=cfg.n_readers,
+                    cache_dir=cfg.cache_dir,
                 ),
                 local_batch, valid_steps, num_features,
             )

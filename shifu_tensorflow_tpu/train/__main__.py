@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="parallel reader threads for --stream (default 1 = "
                         "reproducible batch order; >1 trades determinism "
                         "for ingest throughput)")
+    p.add_argument("--cache-dir", default=None,
+                   help="binary shard cache dir: text shards parse once, "
+                        "later epochs stream memory-mapped tensors")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", default=None,
                    choices=["float32", "bfloat16"],
@@ -102,6 +105,8 @@ def load_conf(args: argparse.Namespace) -> Conf:
         K.TMP_MODEL_PATH: args.checkpoint_dir,
         K.FINAL_MODEL_PATH: args.export_dir,
         K.TMP_LOG_PATH: args.board_path,
+        K.CACHE_DIR: args.cache_dir,
+        K.DTYPE: args.dtype,
     }
     conf.update({k: v for k, v in overlay.items() if v is not None},
                 source="<cli>")
@@ -147,6 +152,44 @@ def resolve_schema(
     return schema, cc
 
 
+def trainer_extras(args, conf: Conf) -> dict:
+    """Trainer kwargs resolved through the conf layer: the CLI flag wins,
+    then the conf key, then the built-in default — so a globalconfig can
+    set shifu.tpu.dtype / shifu.tpu.prefetch-depth without flags."""
+    import jax.numpy as jnp
+
+    dtype_name = args.dtype or conf.get(K.DTYPE, K.DEFAULT_DTYPE)
+    try:
+        dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    except KeyError:
+        raise SystemExit(
+            f"unsupported {K.DTYPE}={dtype_name!r} (float32 | bfloat16)"
+        )
+    return {
+        "dtype": dtype,
+        "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
+                                       K.DEFAULT_PREFETCH_DEPTH),
+    }
+
+
+def job_spec_kwargs(conf: Conf) -> dict:
+    """JobSpec fields driven by conf keys — the reference's backup-instance
+    and heartbeat tunables (GlobalConfigurationKeys.java:75-79,148-150)
+    mapped onto the TPU-native recovery model."""
+    return {
+        # backup instances -> spare restart budget: hot standbys have no
+        # SPMD analogue; the same capacity buys extra relaunches
+        "spare_restarts": conf.num_backup_instances(),
+        "heartbeat_interval_ms": conf.get_int(
+            K.TASK_HEARTBEAT_INTERVAL_MS, K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS
+        ),
+        "max_missed_heartbeats": conf.get_int(
+            K.TASK_MAX_MISSED_HEARTBEATS, K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
+        ),
+        "sync_epochs": conf.get_bool(K.SYNC_EPOCHS, K.DEFAULT_SYNC_EPOCHS),
+    }
+
+
 def _print_epoch(stats) -> None:
     print(
         f"epoch {stats.current_epoch}: train_loss={stats.training_loss:.6f} "
@@ -174,12 +217,6 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
 
     mesh_spec = conf.get(K.MESH_SHAPE, K.DEFAULT_MESH_SHAPE)
     mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
-    extra = {}
-    if args.dtype:
-        import jax.numpy as jnp
-
-        extra["dtype"] = {"float32": jnp.float32,
-                          "bfloat16": jnp.bfloat16}[args.dtype]
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
     trainer = make_trainer(
@@ -188,7 +225,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         feature_columns=schema.feature_columns,
         mesh=mesh,
         seed=args.seed,
-        **extra,
+        **trainer_extras(args, conf),
     )
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
     batch_size = trainer.align_batch_size(
@@ -203,7 +240,11 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     checkpointer = None
     start_epoch = 0
     if args.checkpoint_dir:
-        checkpointer = Checkpointer(args.checkpoint_dir)
+        checkpointer = Checkpointer(
+            args.checkpoint_dir,
+            every_epochs=conf.get_int(K.CHECKPOINT_EVERY_EPOCHS,
+                                      K.DEFAULT_CHECKPOINT_EVERY_EPOCHS),
+        )
         start_epoch = trainer.restore(checkpointer)
         if start_epoch:
             print(f"resuming at epoch {start_epoch}", flush=True)
@@ -212,16 +253,17 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     try:
         with trace_if(args.profile_dir):
             if args.stream:
+                cache_dir = conf.get(K.CACHE_DIR)
                 history = trainer.fit_stream(
                     lambda epoch: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="train", salt=args.seed,
-                        n_readers=args.readers,
+                        n_readers=args.readers, cache_dir=cache_dir,
                     ),
                     (lambda: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="valid", salt=args.seed,
-                        n_readers=args.readers,
+                        n_readers=args.readers, cache_dir=cache_dir,
                     )) if valid_rate > 0 else None,
                     epochs=epochs,
                     on_epoch=_print_epoch,
@@ -284,6 +326,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
 
     n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
+    # preflight the dtype mapping HERE: a bad shifu.tpu.dtype must be one
+    # clean error before launch, not an N-worker crash cascade after
+    # cluster bring-up
+    trainer_extras(args, conf)
     # SPMD (one model across workers) is the default for real process
     # launches — the reference's defining capability; thread workers can't
     # host it (one process cannot be N jax.distributed participants)
@@ -294,6 +340,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
         epochs=epochs,
         board_path=args.board_path,
         spmd=use_spmd,
+        **job_spec_kwargs(conf),
     )
 
     def make_cfg(worker_id: str, addr) -> WorkerConfig:
@@ -305,12 +352,26 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             schema=schema,
             batch_size=conf.get_int(K.BATCH_SIZE, model_config.batch_size),
             checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every_epochs=conf.get_int(
+                K.CHECKPOINT_EVERY_EPOCHS, K.DEFAULT_CHECKPOINT_EVERY_EPOCHS
+            ),
+            # both halves of the heartbeat pipe come from the SAME key: the
+            # coordinator's expiry window is interval*misses, so a worker
+            # sending at a different hardcoded rate would be expired while
+            # healthy
+            heartbeat_interval_s=conf.get_int(
+                K.TASK_HEARTBEAT_INTERVAL_MS,
+                K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS,
+            ) / 1000.0,
             valid_rate=args.valid_rate,
             seed=args.seed,
-            dtype=args.dtype,
+            dtype=args.dtype or conf.get(K.DTYPE, K.DEFAULT_DTYPE),
             mesh_spec=conf.get(K.MESH_SHAPE),
             stream=bool(args.stream),
             n_readers=args.readers,
+            prefetch_depth=conf.get_int(K.PREFETCH_DEPTH,
+                                        K.DEFAULT_PREFETCH_DEPTH),
+            cache_dir=conf.get(K.CACHE_DIR),
         )
 
     submitter = JobSubmitter(spec, make_cfg, launcher=args.launcher)

@@ -228,7 +228,8 @@ class SAGNTrainer(Trainer):
 
         # overlap host-side window stacking + transfer with device compute,
         # same double-buffering the plain trainer gets from prefetch_to_device
-        for wb in prefetch_to_device(windows(), put=self._put_window):
+        for wb in prefetch_to_device(windows(), put=self._put_window,
+                                     depth=self.prefetch_depth):
             self.state, loss = self._sagn_step(self.state, wb)
             losses.append(loss)
             weights.append(K)

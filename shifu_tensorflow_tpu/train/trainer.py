@@ -59,14 +59,6 @@ class EpochStats:
     ks: float = 0.0
     auc: float = 0.0
 
-    def as_wire(self) -> str:
-        """The reference's socket wire format (ssgd_monitor.py:288-291)."""
-        return (
-            f"worker_index:{self.worker_index},time:{self.training_time_s},"
-            f"current_epoch:{self.global_step},training_loss:{self.training_loss},"
-            f"valid_loss:{self.valid_loss}\n"
-        )
-
 
 MetricsCallback = Callable[[EpochStats], None]
 
@@ -167,6 +159,7 @@ class Trainer:
         worker_index: int = 0,
         dtype=jnp.float32,
         topology: "Any | None" = None,
+        prefetch_depth: int = 2,
     ):
         self.model_config = model_config
         self.num_features = num_features
@@ -231,6 +224,8 @@ class Trainer:
             self.model.apply, loss, model_config.params.l2_reg
         )
         self._eval_step = make_eval_step(self.model.apply, loss)
+        # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
+        self.prefetch_depth = max(1, int(prefetch_depth))
         # opt-in per-step timing (utils/profiling.StepTimer); None = free
         self.step_timer = None
 
@@ -275,7 +270,9 @@ class Trainer:
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count)."""
         losses = []
-        for batch in prefetch_to_device(batches, put=self._put):
+        for batch in prefetch_to_device(
+            batches, put=self._put, depth=self.prefetch_depth
+        ):
             self.state, loss = self._train_step(self.state, batch)
             losses.append(loss)
             if self.step_timer is not None:
@@ -312,7 +309,9 @@ class Trainer:
                 labels.append(np.asarray(host_batch["y"]))
                 weights.append(np.asarray(host_batch["w"]))
         else:
-            for batch in prefetch_to_device(batches, put=self._put):
+            for batch in prefetch_to_device(
+            batches, put=self._put, depth=self.prefetch_depth
+        ):
                 loss, pred = self._eval_step(self.state.params, batch)
                 losses.append(loss)
                 scores.append(np.asarray(pred))

@@ -209,11 +209,20 @@ class UploadOnClose:
         self._closed = True
         self._on_close(self._buf.getvalue())
 
+    def discard(self) -> None:
+        """Drop the buffer without uploading."""
+        self._closed = True
+
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        # an exception inside the with-block means the buffer is partial —
+        # publishing it would hand the object store a corrupt file
+        if exc_type is not None:
+            self.discard()
+        else:
+            self.close()
 
 
 class _PrefixedRaw(io.RawIOBase):

@@ -29,7 +29,9 @@ _DEFAULT_ENDPOINT = "https://storage.googleapis.com"
 
 
 class GcsError(OSError):
-    pass
+    def __init__(self, msg: str, code: int | None = None):
+        super().__init__(msg)
+        self.code = code
 
 
 def _split(path: str) -> tuple[str, str]:
@@ -59,7 +61,8 @@ class GcsFileSystem(FileSystem):
         try:
             return urllib.request.urlopen(req, timeout=self.timeout_s)
         except urllib.error.HTTPError as e:
-            raise GcsError(f"gcs {method} {url}: {e.code} {e.reason}") from e
+            raise GcsError(f"gcs {method} {url}: {e.code} {e.reason}",
+                           code=e.code) from e
         except urllib.error.URLError as e:
             raise GcsError(f"gcs {method} {url}: {e.reason}") from e
 
@@ -102,8 +105,13 @@ class GcsFileSystem(FileSystem):
         try:
             self._meta(path)
             return True
-        except GcsError:
-            return False
+        except GcsError as e:
+            # ONLY not-found means absent; a 403/5xx/timeout must propagate
+            # or callers like append_text would silently rebuild state an
+            # existing object already holds
+            if e.code == 404:
+                return False
+            raise
 
     def size(self, path: str) -> int:
         return int(self._meta(path)["size"])

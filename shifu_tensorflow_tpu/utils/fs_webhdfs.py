@@ -27,7 +27,9 @@ from shifu_tensorflow_tpu.utils.fs import FileSystem, UploadOnClose
 
 
 class WebHdfsError(OSError):
-    pass
+    def __init__(self, msg: str, code: int | None = None):
+        super().__init__(msg)
+        self.code = code
 
 
 def _split(path: str) -> tuple[str, str]:
@@ -65,7 +67,8 @@ class WebHdfsFileSystem(FileSystem):
                 msg = detail.get("RemoteException", {}).get("message", str(e))
             except Exception:
                 msg = str(e)
-            raise WebHdfsError(f"webhdfs {method} {url}: {msg}") from e
+            raise WebHdfsError(f"webhdfs {method} {url}: {msg}",
+                               code=e.code) from e
         except urllib.error.URLError as e:
             raise WebHdfsError(f"webhdfs {method} {url}: {e.reason}") from e
 
@@ -116,8 +119,13 @@ class WebHdfsFileSystem(FileSystem):
         try:
             self._status(path)
             return True
-        except WebHdfsError:
-            return False
+        except WebHdfsError as e:
+            # ONLY not-found means absent; a 403/5xx/timeout must propagate
+            # or callers like append_text would silently rebuild state an
+            # existing file already holds
+            if e.code == 404:
+                return False
+            raise
 
     def size(self, path: str) -> int:
         return int(self._status(path)["length"])
@@ -147,8 +155,10 @@ class WebHdfsFileSystem(FileSystem):
         try:
             if self._status(path).get("type") == "FILE":
                 return [path]
-        except WebHdfsError:
-            return []
+        except WebHdfsError as e:
+            if e.code == 404:
+                return []
+            raise
         walk(path)
         return sorted(out)
 

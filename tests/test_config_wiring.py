@@ -1,0 +1,114 @@
+"""Every conf key must change behavior — no dead keys.
+
+Round-2 verdict found keys with accessors nothing called
+(shifu.worker.instances.backup, heartbeat tunables, shifu.tpu.dtype,
+shifu.tpu.prefetch-depth).  These tests pin each key to the object it now
+configures, through the same CLI resolution paths run_single/run_multi use.
+"""
+
+import jax.numpy as jnp
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.train import make_trainer
+from shifu_tensorflow_tpu.train.__main__ import (
+    build_parser,
+    job_spec_kwargs,
+    trainer_extras,
+)
+
+
+def _args(extra=()):
+    return build_parser().parse_args(
+        ["--training-data-path", "/tmp/x", "--feature-columns", "1,2",
+         *extra]
+    )
+
+
+def _conf(values: dict) -> Conf:
+    conf = Conf()
+    conf.update(values, source="<test>")
+    return conf
+
+
+def test_backup_instances_key_drives_spare_restarts():
+    kw = job_spec_kwargs(_conf({K.backup_instances_key("worker"): 3}))
+    assert kw["spare_restarts"] == 3
+    assert job_spec_kwargs(_conf({}))["spare_restarts"] == 0
+
+
+def test_heartbeat_keys_drive_job_spec():
+    kw = job_spec_kwargs(_conf({
+        K.TASK_HEARTBEAT_INTERVAL_MS: 250,
+        K.TASK_MAX_MISSED_HEARTBEATS: 7,
+    }))
+    assert kw["heartbeat_interval_ms"] == 250
+    assert kw["max_missed_heartbeats"] == 7
+    base = job_spec_kwargs(_conf({}))
+    assert base["heartbeat_interval_ms"] == K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS
+    assert base["max_missed_heartbeats"] == K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
+
+
+def test_sync_epochs_key_drives_job_spec():
+    assert job_spec_kwargs(_conf({K.SYNC_EPOCHS: "true"}))["sync_epochs"] is True
+    assert job_spec_kwargs(_conf({}))["sync_epochs"] is False
+
+
+def test_dtype_conf_key_reaches_trainer():
+    extras = trainer_extras(_args(), _conf({K.DTYPE: "bfloat16"}))
+    assert extras["dtype"] is jnp.bfloat16
+    # CLI flag wins over conf
+    extras = trainer_extras(_args(["--dtype", "float32"]),
+                            _conf({K.DTYPE: "bfloat16"}))
+    assert extras["dtype"] is jnp.float32
+    # and the dtype actually lands in the model parameters
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1),
+                           dtype=jnp.bfloat16)
+    pred = trainer.model.apply(
+        {"params": trainer.state.params}, jnp.zeros((1, 2), jnp.bfloat16)
+    )
+    assert pred.dtype == jnp.bfloat16
+
+
+def test_prefetch_depth_key_reaches_trainer():
+    extras = trainer_extras(_args(), _conf({K.PREFETCH_DEPTH: 5}))
+    assert extras["prefetch_depth"] == 5
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1), prefetch_depth=5)
+    assert trainer.prefetch_depth == 5
+
+
+def test_prefetch_depth_changes_infeed_lookahead():
+    """The depth value must actually govern the prefetch window: with
+    depth=d, d batches are transferred before the first is consumed."""
+    from shifu_tensorflow_tpu.data.dataset import prefetch_to_device
+
+    for depth in (1, 3):
+        put_order = []
+
+        def put(b, _log=put_order):
+            _log.append(b)
+            return b
+
+        it = prefetch_to_device(iter(range(10)), put=put, depth=depth)
+        first = next(it)
+        assert first == 0
+        assert len(put_order) == depth  # exactly the window, no more
+
+
+def test_ps_keys_are_gone():
+    assert not hasattr(K, "PS_JOB_NAME")
+    assert not hasattr(K, "PS_FAULT_TOLERANCE_THRESHOLD")
+    # legacy configs carrying shifu.ps.* still parse
+    conf = _conf({"shifu.ps.instances": 2})
+    assert conf.get_int("shifu.ps.instances", 0) == 2

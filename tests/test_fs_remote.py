@@ -386,3 +386,31 @@ class TestGcs:
 def test_unknown_scheme_still_errors():
     with pytest.raises(ValueError, match="no filesystem registered"):
         fs.filesystem_for("s3://bucket/x")
+
+
+class TestErrorSemantics:
+    def test_exists_propagates_non_404(self, gcs, monkeypatch):
+        """A transient 5xx/403 must NOT read as 'absent' — append_text
+        would silently rebuild the metrics board from scratch."""
+        from shifu_tensorflow_tpu.utils.fs_gcs import GcsError
+
+        base = gcs["base"]
+        fs.write_text(f"{base}/board.log", "history\n")
+        impl = fs.filesystem_for(base)
+
+        def broken_meta(path):
+            raise GcsError("gcs GET ...: 503 Service Unavailable", code=503)
+
+        monkeypatch.setattr(impl, "_meta", broken_meta)
+        with pytest.raises(GcsError):
+            impl.exists(f"{base}/board.log")
+
+    def test_upload_on_close_discards_on_exception(self, gcs):
+        """An exception inside the with-block must not publish the partial
+        buffer (checkpoint writers raise mid-serialization)."""
+        base = gcs["base"]
+        with pytest.raises(RuntimeError):
+            with fs.filesystem_for(base).open_write(f"{base}/partial.npz") as f:
+                f.write(b"half-written")
+                raise RuntimeError("serialization failed")
+        assert not fs.exists(f"{base}/partial.npz")
