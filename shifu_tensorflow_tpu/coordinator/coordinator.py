@@ -38,6 +38,12 @@ from shifu_tensorflow_tpu.config import keys as K
 from shifu_tensorflow_tpu.coordinator.heartbeat import LivenessMonitor
 from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
 from shifu_tensorflow_tpu.train.trainer import EpochStats
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("coordinator")
+
+#: addresses peers cannot reach across machines; shared with the submitter
+LOOPBACK_HOSTS = ("", "127.0.0.1", "localhost", "::1")
 
 
 class JobState(str, Enum):
@@ -160,6 +166,7 @@ class Coordinator:
                 return
             self.state = JobState.FAILED
             self.failure_reason = reason
+            log.error("job FAILED: %s", reason)
             self._start_barrier.set()  # release anyone waiting
             self._epoch_cond.notify_all()
             self._plan_cond.notify_all()
@@ -235,6 +242,9 @@ class Coordinator:
             ):
                 if self.state == JobState.REGISTERING:
                     self.state = JobState.TRAINING
+                    log.info("all %d workers registered (generation %d): "
+                             "TRAINING", self.spec.n_workers,
+                             self._generation)
                     self.liveness.start()
                 self._start_barrier.set()
             return {
@@ -251,14 +261,40 @@ class Coordinator:
                 "shard_lines": self._shard_lines.get(rec.worker_index),
             }
 
+    _LOOPBACK = LOOPBACK_HOSTS
+
     def _cluster_info(self) -> dict[str, Any]:
         """SPMD bring-up info: where the chief's jax coordination service
         lives.  Meaningful only once every worker of the current generation
-        has registered (the await_start barrier guarantees that)."""
+        has registered (the await_start barrier guarantees that).
+
+        Raises when the chief registered a loopback address but peers
+        registered routable ones: those peers would try to reach the jax
+        coordination service at THEIR OWN 127.0.0.1 and hang to a timeout —
+        correct on one machine, silently wrong on two (round-2 Weak #6).
+        """
         chief_id = self._by_index.get(0)
         chief = self.workers.get(chief_id) if chief_id else None
+        chief_host = (chief.host if chief else "") or "127.0.0.1"
+        if self.spec.n_workers > 1 and chief_host in self._LOOPBACK:
+            remote = sorted(
+                {
+                    r.host
+                    for r in self.workers.values()
+                    if r.host and r.host not in self._LOOPBACK
+                }
+            )
+            if remote:
+                raise ValueError(
+                    f"chief registered loopback host {chief_host!r} but "
+                    f"peers registered {remote}; SPMD peers cannot reach "
+                    f"the jax coordination service there — set "
+                    f"WorkerConfig.host to a routable address on every "
+                    f"worker (the ssh launcher does this from its hosts "
+                    f"list)"
+                )
         return {
-            "chief_host": (chief.host if chief else "") or "127.0.0.1",
+            "chief_host": chief_host,
             "jax_port": chief.jax_port if chief else 0,
             "n_workers": self.spec.n_workers,
             "generation": self._generation,
@@ -283,10 +319,17 @@ class Coordinator:
             if self.state == JobState.FAILED:
                 return {"ok": False, "error": self.failure_reason}
             if ok:
+                try:
+                    cluster = self._cluster_info()
+                except ValueError as e:
+                    # misconfigured topology: fail the job with the clear
+                    # message instead of letting peers hang on a connect
+                    self._fail(str(e))
+                    return {"ok": False, "error": self.failure_reason}
                 return {
                     "ok": True,
                     "state": self.state.value,
-                    "cluster": self._cluster_info(),
+                    "cluster": cluster,
                 }
             if time.monotonic() - gen_start >= self.spec.registration_timeout_s:
                 self._fail(
@@ -446,6 +489,7 @@ class Coordinator:
                 # TensorflowApplicationMaster.java:373-376)
                 if rec.worker_index == 0 and self.state == JobState.TRAINING:
                     self.state = JobState.FINISHED
+                    log.info("chief completed cleanly: FINISHED")
                     self._epoch_cond.notify_all()
             return {"ok": True, "state": self.state.value}
 
@@ -525,6 +569,9 @@ class Coordinator:
                 )
                 return
             self._generation += 1
+            log.warning("fleet restart -> generation %d (%s); budget %d/%d "
+                        "used", self._generation, why,
+                        self._failed_restarts, self.max_restarts)
             self._gen_started_at = time.monotonic()
             self._start_barrier = threading.Event()
             self._plans.clear()

@@ -7,14 +7,20 @@ starts executors (AMRMCallbackHandler.java:148-191).  Here the submitter
 owns both halves directly: it starts the Coordinator, launches N workers,
 polls status, and recovers failures within the fault budget.
 
-Two launchers:
+Three launchers:
 
-- ``process`` (default for real jobs): each worker is a real OS process
-  running ``worker_main`` — the container-launch parity path.  Kill-based
-  fault tolerance is real: SIGKILL a worker and watch checkpoint-restart
-  recover (the test the reference only ever ran by hand,
-  CommonUtils.java:265-273).  Required for SPMD — each process is one
-  ``jax.distributed`` participant.
+- ``process`` (default for real single-host jobs): each worker is a real
+  OS process running ``worker_main`` — the container-launch parity path.
+  Kill-based fault tolerance is real: SIGKILL a worker and watch
+  checkpoint-restart recover (the test the reference only ever ran by
+  hand, CommonUtils.java:265-273).  Required for SPMD — each process is
+  one ``jax.distributed`` participant.
+- ``ssh``: multi-host — worker i launches on ``hosts[i % len(hosts)]``
+  via ssh (or any exec wrapper: ``ssh_command`` is pluggable, which is
+  also how tests run localhost-as-remote).  The WorkerConfig travels as
+  JSON on stdin (no shared filesystem needed — the reference localized
+  configs into each container instead, TensorflowClient.java:378-382);
+  remote kill matches a unique ``--run-tag`` with pkill.
 - ``thread``: in-process daemon threads; fast, used by unit tests and
   single-host non-SPMD smoke runs.  Cannot host SPMD (one process cannot
   be N jax processes).
@@ -39,12 +45,16 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from shifu_tensorflow_tpu.coordinator.coordinator import (
+    LOOPBACK_HOSTS,
     Coordinator,
     JobSpec,
     JobState,
 )
 from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig, run_worker
 from shifu_tensorflow_tpu.data.splitter import split_training_data, total_line_count
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("submitter")
 
 
 @dataclass
@@ -70,6 +80,12 @@ class JobSubmitter:
         drain_grace_s: float = 30.0,
         fault_injections: dict[str, int] | None = None,
         kill_injections: dict[str, int] | None = None,
+        hosts: list[str] | None = None,
+        ssh_command: list[str] | None = None,
+        remote_python: str | None = None,
+        remote_env: dict[str, str] | None = None,
+        bind_host: str = "127.0.0.1",
+        advertise_host: str | None = None,
     ):
         """``make_worker_config(worker_id, (host, port))`` builds each
         worker's config.
@@ -77,16 +93,41 @@ class JobSubmitter:
         ``fault_injections`` maps worker_id -> epoch to fail at (first
         launch only); ``kill_injections`` maps worker_id -> epoch after
         whose report the submitter SIGKILLs the worker process (first
-        launch only; process launcher only) — the kill-based recovery test
+        launch only; process/ssh launchers) — the kill-based recovery test
         the reference never automated.
+
+        ssh launcher: ``hosts`` assigns worker i to hosts[i % len(hosts)]
+        (also written into WorkerConfig.host so SPMD peers learn routable
+        addresses); ``ssh_command`` is the exec wrapper (default
+        ``["ssh", "-o", "BatchMode=yes"]``); ``remote_python`` the remote
+        interpreter (default: this one); ``remote_env`` KEY=VALs prefixed
+        onto the remote command.  ``bind_host``/``advertise_host`` control
+        where the coordinator listens and which address workers are told —
+        multi-host jobs bind 0.0.0.0 and advertise a routable IP.
         """
-        if launcher not in ("thread", "process"):
+        if launcher not in ("thread", "process", "ssh"):
             raise ValueError(f"unknown launcher {launcher!r}")
-        if spec.spmd and launcher != "process":
+        if spec.spmd and launcher == "thread":
             raise ValueError(
-                "SPMD jobs need launcher='process': each worker must be its "
-                "own OS process to join jax.distributed"
+                "SPMD jobs need launcher='process' or 'ssh': each worker "
+                "must be its own OS process to join jax.distributed"
             )
+        if launcher == "ssh":
+            if not hosts:
+                raise ValueError("launcher='ssh' needs a non-empty hosts list")
+            # catch the unreachable-coordinator misconfig at construction:
+            # remote workers told to connect to the submitter's loopback
+            # (or to the 0.0.0.0 wildcard) would only die minutes later by
+            # registration timeout
+            advertised = advertise_host or bind_host
+            remote_hosts = [h for h in hosts if h not in LOOPBACK_HOSTS]
+            if remote_hosts and advertised in (*LOOPBACK_HOSTS, "0.0.0.0"):
+                raise ValueError(
+                    f"launcher='ssh' with remote hosts {remote_hosts} needs "
+                    f"a routable coordinator address: pass advertise_host "
+                    f"(and usually bind_host='0.0.0.0'); advertised "
+                    f"{advertised!r} is not reachable from another machine"
+                )
         self.spec = spec
         self.make_worker_config = make_worker_config
         self.launcher = launcher
@@ -97,14 +138,32 @@ class JobSubmitter:
         self.drain_grace_s = drain_grace_s
         self.fault_injections = dict(fault_injections or {})
         self.kill_injections = dict(kill_injections or {})
+        self.hosts = list(hosts or [])
+        self.ssh_command = list(ssh_command or ["ssh", "-o", "BatchMode=yes"])
+        self.remote_python = remote_python or sys.executable
+        self.remote_env = dict(remote_env or {})
+        self.bind_host = bind_host
+        self.advertise_host = advertise_host
         self.coordinator = Coordinator(spec)
         self._threads: dict[str, threading.Thread] = {}
         self._procs: dict[str, subprocess.Popen] = {}
         self._launch_counts: dict[str, int] = {}
+        self._run_tags: dict[str, str] = {}
+        self._worker_hosts: dict[str, str] = {}
         self._run_dir: str | None = None
         self._log_files: list[Any] = []
 
     # ---- launching ----
+    def _host_for(self, worker_id: str, index: int | None) -> str | None:
+        if not self.hosts:
+            return None
+        if worker_id in self._worker_hosts:
+            return self._worker_hosts[worker_id]
+        i = index if index is not None else len(self._worker_hosts)
+        host = self.hosts[i % len(self.hosts)]
+        self._worker_hosts[worker_id] = host
+        return host
+
     def _launch(
         self, worker_id: str, addr: tuple[str, int], index: int | None = None
     ) -> None:
@@ -113,11 +172,21 @@ class JobSubmitter:
             cfg.worker_index = index
         if self.spec.spmd:
             cfg.spmd = True
+        if self.launcher == "ssh":
+            # the assigned host is the worker's routable identity: peers
+            # reach the chief's jax coordination service at it, and sticky
+            # relaunches keep it (parity: a backup inherits the failed
+            # worker's shard, not its host — here identity is stable)
+            host = self._host_for(worker_id, cfg.worker_index)
+            if host and cfg.host in LOOPBACK_HOSTS:
+                cfg.host = host
         first_launch = self._launch_counts.get(worker_id, 0) == 0
         fail_at = self.fault_injections.get(worker_id) if first_launch else None
         self._launch_counts[worker_id] = self._launch_counts.get(worker_id, 0) + 1
         if self.launcher == "process":
             self._launch_process(worker_id, cfg, fail_at)
+        elif self.launcher == "ssh":
+            self._launch_ssh(worker_id, cfg, fail_at)
         else:
             self._launch_thread(worker_id, cfg, fail_at)
 
@@ -129,6 +198,18 @@ class JobSubmitter:
         t = threading.Thread(target=target, daemon=True, name=f"worker-{worker_id}")
         self._threads[worker_id] = t
         t.start()
+
+    def _worker_log_file(self, worker_id: str, attempt: int):
+        """Per-worker, per-attempt log file — container-log parity
+        (TensorflowClient.java:514-529)."""
+        if self._run_dir is None:
+            self._run_dir = tempfile.mkdtemp(prefix="stpu-job-")
+        log_dir = self.log_dir or self._run_dir
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"{worker_id}.{attempt}.log"),
+                     "ab")
+        self._log_files.append(log_f)
+        return log_f
 
     def _launch_process(self, worker_id: str, cfg: WorkerConfig,
                         fail_at: int | None) -> None:
@@ -149,26 +230,76 @@ class JobSubmitter:
             cmd += ["--fail-at-epoch", str(fail_at)]
         env = dict(os.environ)
         env.update(self.worker_env)
-        # per-worker log files — container-log parity
-        # (TensorflowClient.java:514-529)
-        log_dir = self.log_dir or self._run_dir
-        os.makedirs(log_dir, exist_ok=True)
-        log = open(
-            os.path.join(log_dir, f"{worker_id}.{attempt}.log"), "ab"
-        )
-        self._log_files.append(log)
+        log_f = self._worker_log_file(worker_id, attempt)
         self._procs[worker_id] = subprocess.Popen(
-            cmd, stdout=log, stderr=subprocess.STDOUT, env=env
+            cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env
         )
+
+    def _launch_ssh(self, worker_id: str, cfg: WorkerConfig,
+                    fail_at: int | None) -> None:
+        import shlex
+        import uuid
+
+        attempt = self._launch_counts[worker_id]
+        tag = f"stpu-{worker_id}-{attempt}-{uuid.uuid4().hex[:8]}"
+        self._run_tags[worker_id] = tag
+        remote = []
+        env_pairs = {**self.worker_env, **self.remote_env}
+        if env_pairs:
+            remote += ["env"] + [f"{k}={v}" for k, v in env_pairs.items()]
+        remote += [
+            self.remote_python, "-m",
+            "shifu_tensorflow_tpu.coordinator.worker_main",
+            "--config-stdin", "--run-tag", tag,
+        ]
+        if fail_at is not None:
+            remote += ["--fail-at-epoch", str(fail_at)]
+        host = self._worker_hosts.get(worker_id, cfg.host)
+        # ssh concatenates argv with spaces and runs it through the remote
+        # shell — quote so paths/values survive the round trip
+        cmd = self.ssh_command + [host, shlex.join(remote)]
+        log_f = self._worker_log_file(worker_id, attempt)
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        self._procs[worker_id] = proc
+        try:
+            proc.stdin.write(json.dumps(cfg.to_json()).encode())
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass  # ssh died at connect; the poll loop sees the exit code
 
     # ---- kill/cleanup ----
     def kill_worker(self, worker_id: str) -> bool:
-        """SIGKILL a worker process (fault injection / fleet restart)."""
+        """SIGKILL a worker process (fault injection / fleet restart).
+        Returns whether the worker was alive when the kill began."""
         proc = self._procs.get(worker_id)
-        if proc is None or proc.poll() is not None:
-            return False
-        proc.kill()
-        return True
+        # aliveness is sampled BEFORE the remote pkill: under
+        # localhost-as-remote the pkill reaps the local process chain too,
+        # and a post-pkill poll() would misreport "already dead" — which
+        # made _maybe_kill_injected keep the injection armed and re-kill
+        # the relaunched worker next generation
+        was_alive = proc is not None and proc.poll() is None
+        if self.launcher == "ssh" and proc is not None:
+            # killing the local ssh client does not reliably kill the
+            # remote process tree — and the remote worker can outlive a
+            # dropped ssh connection, so the pkill runs even when the local
+            # client already exited (else a stale remote process would race
+            # its own relaunch in the next generation)
+            tag = self._run_tags.get(worker_id)
+            host = self._worker_hosts.get(worker_id)
+            if tag and host:
+                try:
+                    subprocess.run(
+                        self.ssh_command + [host, f"pkill -KILL -f {tag}"],
+                        timeout=10.0, capture_output=True,
+                    )
+                except (subprocess.TimeoutExpired, OSError):
+                    pass
+        if was_alive:
+            proc.kill()
+        return was_alive
 
     def _kill_fleet(self) -> None:
         for wid in list(self._procs):
@@ -190,7 +321,12 @@ class JobSubmitter:
     # ---- main loop ----
     def run(self, timeout_s: float = 600.0) -> JobResult:
         t0 = time.monotonic()
-        addr = self.coordinator.serve()
+        bound = self.coordinator.serve(host=self.bind_host)
+        log.info("coordinator serving on %s:%s (%d workers, launcher=%s%s)",
+                 bound[0], bound[1], self.spec.n_workers, self.launcher,
+                 ", spmd" if self.spec.spmd else "")
+        # workers connect to the ADVERTISED address (bind may be 0.0.0.0)
+        addr = (self.advertise_host or bound[0], bound[1])
         worker_ids = [f"worker-{i}" for i in range(self.spec.n_workers)]
         for i, wid in enumerate(worker_ids):
             self._launch(wid, addr, index=i)
@@ -208,6 +344,8 @@ class JobSubmitter:
                     # SPMD fleet restart: kill survivors (they are wedged in
                     # a broken collective), relaunch everyone
                     seen_generation = gen
+                    log.warning("fleet restart: generation %d — killing and "
+                                "relaunching all workers", gen)
                     self._kill_fleet()
                     if self.coordinator.state not in (
                         JobState.FINISHED, JobState.FAILED
@@ -221,6 +359,9 @@ class JobSubmitter:
                     key = (rec.worker_id, rec.restarts)
                     if key not in relaunched:
                         relaunched.add(key)
+                        log.warning("relaunching failed worker %s "
+                                    "(restart %d)", rec.worker_id,
+                                    rec.restarts)
                         self._launch(rec.worker_id, addr)
                 time.sleep(self.poll_interval_s)
             else:
@@ -244,10 +385,10 @@ class JobSubmitter:
                         pass
             try:
                 self.coordinator.aggregator.flush()
-            except Exception as e:
+            except Exception:
                 # board-file IO must not turn a finished job into a raise;
                 # the summaries list is already updated under the lock
-                print(f"metrics flush failed: {e}", file=sys.stderr)
+                log.exception("metrics board flush failed")
         finally:
             wall = time.monotonic() - t0
             result = JobResult(
@@ -259,9 +400,9 @@ class JobSubmitter:
             )
             self._kill_fleet()
             self.coordinator.shutdown()
-            for log in self._log_files:
+            for log_f in self._log_files:
                 try:
-                    log.close()
+                    log_f.close()
                 except Exception:
                     pass
         return result

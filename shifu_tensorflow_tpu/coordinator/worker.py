@@ -28,9 +28,7 @@ process, and sync_plan agrees the restore epoch.
 from __future__ import annotations
 
 import math
-import sys
 import threading
-import traceback
 from dataclasses import dataclass
 from typing import Callable
 
@@ -47,6 +45,9 @@ from shifu_tensorflow_tpu.data.dataset import (
 from shifu_tensorflow_tpu.data.reader import RecordSchema
 from shifu_tensorflow_tpu.train import make_trainer
 from shifu_tensorflow_tpu.train.checkpoint import Checkpointer, NpzCheckpointer
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("worker")
 
 
 @dataclass
@@ -177,6 +178,7 @@ def run_worker(cfg: WorkerConfig, *,
     """
     from shifu_tensorflow_tpu.parallel import distributed as dist
 
+    logs.set_worker(cfg.worker_id)
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
     # reserve a port for the jax coordination service up front: only the
     # chief's is used, but index assignment happens at registration.  The
@@ -191,10 +193,7 @@ def run_worker(cfg: WorkerConfig, *,
     if not reg.get("ok"):
         if port_hold is not None:
             port_hold.release()
-        print(
-            f"[worker {cfg.worker_id}] registration rejected: "
-            f"{reg.get('error')}", file=sys.stderr, flush=True,
-        )
+        log.error("registration rejected: %s", reg.get("error"))
         return 1  # never registered; the coordinator doesn't know us
     worker_index = reg["worker_index"]
     shard_paths = reg["shard"]
@@ -236,11 +235,9 @@ def run_worker(cfg: WorkerConfig, *,
                 # fleet restart attributed to this root cause instead of
                 # dying opaquely and making the coordinator untangle the
                 # cascade.
-                traceback.print_exc()
-                print(
-                    f"[worker {worker_index}] jax.distributed.initialize "
-                    f"failed; requesting fleet restart",
-                    file=sys.stderr, flush=True,
+                log.exception(
+                    "jax.distributed.initialize failed (worker_index=%s); "
+                    "requesting fleet restart", worker_index,
                 )
                 try:
                     client.request_restart(
@@ -305,23 +302,21 @@ def run_worker(cfg: WorkerConfig, *,
                 fail_at_epoch=fail_at_epoch,
             )
     except _InjectedFault:
-        print(f"[worker {worker_index}] injected fault fired "
-              f"(fail_at_epoch={fail_at_epoch})", file=sys.stderr, flush=True)
+        log.warning("injected fault fired (worker_index=%s, "
+                    "fail_at_epoch=%s)", worker_index, fail_at_epoch)
         exit_code = 43
     except _FleetRestart:
-        print(f"[worker {worker_index}] exiting for fleet restart",
-              file=sys.stderr, flush=True)
+        log.info("exiting for fleet restart (worker_index=%s)", worker_index)
         exit_code = RESTART_EXIT_CODE
     except _JobAborted:
-        print(f"[worker {worker_index}] job aborted by coordinator",
-              file=sys.stderr, flush=True)
+        log.warning("job aborted by coordinator (worker_index=%s)",
+                    worker_index)
         exit_code = 42
     except Exception:
         # the per-worker log file (submitter) must carry the root cause —
         # round 2's flaky recovery was undiagnosable because this path
         # swallowed the traceback
-        traceback.print_exc()
-        sys.stderr.flush()
+        log.exception("worker failed (worker_index=%s)", worker_index)
         exit_code = 1
     finally:
         if port_hold is not None:
@@ -389,13 +384,13 @@ def _run_local_training(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="train", salt=cfg.seed,
                 n_readers=cfg.n_readers,
-                    cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
             ),
             (lambda: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="valid", salt=cfg.seed,
                 n_readers=cfg.n_readers,
-                    cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
             )) if valid_rate > 0 else None,
             epochs=epochs,
             on_epoch=on_epoch,
@@ -480,10 +475,9 @@ def _run_spmd_training(
         trainer.state = state
 
     def _warn_dropped(rows: int) -> None:
-        print(
-            f"[worker {worker_index}] fixed-step epoch dropped {rows} "
-            f"surplus rows (agreed {train_steps} steps)",
-            file=sys.stderr, flush=True,
+        log.warning(
+            "fixed-step epoch dropped %d surplus rows (agreed %d steps)",
+            rows, train_steps,
         )
 
     if cfg.stream:
@@ -493,7 +487,7 @@ def _run_spmd_training(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="train", salt=cfg.seed,
                     n_readers=cfg.n_readers,
-                    cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
                 ),
                 local_batch, train_steps, num_features,
                 on_dropped=_warn_dropped,
@@ -505,7 +499,7 @@ def _run_spmd_training(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="valid", salt=cfg.seed,
                     n_readers=cfg.n_readers,
-                    cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
                 ),
                 local_batch, valid_steps, num_features,
             )

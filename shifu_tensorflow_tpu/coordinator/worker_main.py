@@ -33,13 +33,21 @@ def main(argv: list[str] | None = None) -> int:
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument("--config-file", help="path to a WorkerConfig JSON file")
     g.add_argument("--config-json", help="inline WorkerConfig JSON")
+    g.add_argument("--config-stdin", action="store_true",
+                   help="read WorkerConfig JSON from stdin (remote launch: "
+                        "no shared filesystem required)")
     p.add_argument("--fail-at-epoch", type=int, default=None,
                    help="fault injection: abort at this epoch (tests)")
+    p.add_argument("--run-tag", default=None,
+                   help="opaque marker on the command line; the remote "
+                        "launcher kills by matching it (pkill -f)")
     args = p.parse_args(argv)
 
     if args.config_file:
         with open(args.config_file) as f:
             payload = json.load(f)
+    elif args.config_stdin:
+        payload = json.loads(sys.stdin.read())
     else:
         payload = json.loads(args.config_json)
 

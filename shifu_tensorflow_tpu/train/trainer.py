@@ -270,9 +270,8 @@ class Trainer:
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count)."""
         losses = []
-        for batch in prefetch_to_device(
-            batches, put=self._put, depth=self.prefetch_depth
-        ):
+        for batch in prefetch_to_device(batches, put=self._put,
+                                        depth=self.prefetch_depth):
             self.state, loss = self._train_step(self.state, batch)
             losses.append(loss)
             if self.step_timer is not None:
@@ -309,9 +308,8 @@ class Trainer:
                 labels.append(np.asarray(host_batch["y"]))
                 weights.append(np.asarray(host_batch["w"]))
         else:
-            for batch in prefetch_to_device(
-            batches, put=self._put, depth=self.prefetch_depth
-        ):
+            for batch in prefetch_to_device(batches, put=self._put,
+                                        depth=self.prefetch_depth):
                 loss, pred = self._eval_step(self.state.params, batch)
                 losses.append(loss)
                 scores.append(np.asarray(pred))

@@ -1,0 +1,93 @@
+"""Structured logging for the runtime plane.
+
+Parity surface: the reference ships log4j config routing INFO to stdout
+(log4j.properties:1-10) and per-container logs collected by YARN
+(TensorflowClient.java:514-529).  Here every runtime component logs
+through one package logger tree with timestamps and a per-process worker
+identity; in subprocess workers stderr is already redirected to the
+submitter's per-worker log files, so the stream handler IS the container
+log.  An explicit file handler is available via configure(log_file=...) or
+$STPU_LOG_FILE for deployments that separate diagnostics from stdout.
+
+User-facing CLI output (epoch lines, board lines, the final JSON summary)
+stays on plain print — that is the product's console contract, not
+diagnostics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+ROOT = "stpu"
+_FORMAT = (
+    "%(asctime)s %(levelname)s [%(stpu_worker)s] %(name)s: %(message)s"
+)
+
+_lock = threading.Lock()
+_configured = False
+# thread-local so the thread launcher's N in-process workers (and the
+# coordinator's own threads) each carry their OWN identity — a process
+# global would stamp every record with whichever worker set it last
+_context = threading.local()
+
+
+class _ContextFilter(logging.Filter):
+    """Injects the calling thread's worker identity into every record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.stpu_worker = getattr(_context, "worker", "-")
+        return True
+
+
+def set_worker(worker_id: str) -> None:
+    """Tag every subsequent record from this thread with the worker id
+    (the reference's per-container log identity).  Subprocess workers call
+    it once on their main thread."""
+    _context.worker = worker_id
+
+
+def configure(
+    level: int | str = logging.INFO,
+    *,
+    log_file: str | None = None,
+    stream=None,
+    force: bool = False,
+) -> None:
+    """Idempotent root setup: one stream handler (stderr), an optional file
+    handler, timestamped format.  Called lazily by get()."""
+    global _configured
+    with _lock:
+        if _configured and not force:
+            return
+        root = logging.getLogger(ROOT)
+        root.setLevel(
+            level if isinstance(level, int)
+            else getattr(logging, str(level).upper(), logging.INFO)
+        )
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        handlers: list[logging.Handler] = [
+            logging.StreamHandler(stream or sys.stderr)
+        ]
+        log_file = log_file or os.environ.get("STPU_LOG_FILE")
+        if log_file:
+            os.makedirs(os.path.dirname(os.path.abspath(log_file)),
+                        exist_ok=True)
+            handlers.append(logging.FileHandler(log_file))
+        fmt = logging.Formatter(_FORMAT)
+        flt = _ContextFilter()
+        for h in handlers:
+            h.setFormatter(fmt)
+            h.addFilter(flt)
+            root.addHandler(h)
+        root.propagate = False
+        _configured = True
+
+
+def get(name: str) -> logging.Logger:
+    """Component logger, e.g. get('coordinator') -> 'stpu.coordinator'."""
+    configure()
+    return logging.getLogger(f"{ROOT}.{name}")

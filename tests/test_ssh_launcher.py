@@ -1,0 +1,202 @@
+"""Multi-host launcher seam: ssh exec wrapper + routable-host plumbing.
+
+The reference launched workers as containers on remote cluster nodes
+(AMRMCallbackHandler.java:159-182).  The ssh launcher is the TPU-native
+equivalent; these tests run localhost-as-remote through a fake ``ssh``
+that executes the remote command locally, with workers bound to this
+machine's real (non-loopback) interface — exercising exactly the address
+plumbing a 2-machine run needs: stdin config transport, routable
+WorkerConfig.host, a 0.0.0.0-bound coordinator with an advertised address,
+and the loopback-mismatch guard.
+"""
+
+import os
+import socket
+import stat
+
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.coordinator.coordinator import (
+    Coordinator,
+    JobSpec,
+    JobState,
+)
+from shifu_tensorflow_tpu.coordinator.submitter import JobSubmitter
+from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.data.splitter import split_training_data
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO_ROOT,
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+FAKE_SSH = """#!/bin/sh
+# fake ssh: skip -o options, drop the host argument, run the command
+# locally through the shell — exactly what sshd would do remotely.
+while [ "$1" = "-o" ]; do shift 2; done
+shift  # the host
+exec /bin/sh -c "$*"
+"""
+
+
+def _primary_ip() -> str | None:
+    """This machine's non-loopback IP (no packets are sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("198.51.100.1", 53))
+        ip = s.getsockname()[0]
+    except OSError:
+        return None
+    finally:
+        s.close()
+    return None if ip.startswith("127.") else ip
+
+
+@pytest.fixture
+def fake_ssh(tmp_path):
+    path = tmp_path / "ssh"
+    path.write_text(FAKE_SSH)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def test_ssh_launcher_spmd_on_nonloopback_interface(
+    psv_dataset, tmp_path, fake_ssh
+):
+    ip = _primary_ip()
+    if ip is None:
+        pytest.skip("no non-loopback interface available")
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 2, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam"}}}
+    )
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    shards = split_training_data(psv_dataset["root"], 2)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def make_cfg(worker_id: str, addr) -> WorkerConfig:
+        return WorkerConfig(
+            worker_id=worker_id,
+            coordinator_host=addr[0],
+            coordinator_port=addr[1],
+            model_config=mc,
+            schema=schema,
+            batch_size=32,
+            checkpoint_dir=ckpt_dir,
+            heartbeat_interval_s=0.2,
+            spmd=True,
+        )
+
+    spec = JobSpec(
+        n_workers=2, shards=shards, spmd=True, epochs=2,
+        registration_timeout_s=120.0,
+    )
+    submitter = JobSubmitter(
+        spec, make_cfg,
+        launcher="ssh",
+        hosts=[ip, ip],  # localhost-as-remote: both "machines" are this one
+        ssh_command=[fake_ssh],
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        bind_host="0.0.0.0",
+        advertise_host=ip,
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    # every worker registered its routable (non-loopback) identity, and
+    # the chief's jax coordination service was reachable there
+    recs = list(submitter.coordinator.workers.values())
+    assert len(recs) == 2
+    assert all(r.host == ip for r in recs)
+    assert len(result.epoch_summaries) == 2
+
+
+def test_ssh_launcher_remote_kill_uses_run_tag(
+    psv_dataset, tmp_path, fake_ssh
+):
+    """kill_worker for the ssh launcher must issue the remote pkill (the
+    local ssh client alone cannot kill the remote tree)."""
+    ip = _primary_ip() or "127.0.0.1"
+    calls = tmp_path / "ssh-calls.log"
+    logging_ssh = tmp_path / "ssh-logging"
+    logging_ssh.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {calls}\n'
+        + FAKE_SSH.split("\n", 1)[1]
+    )
+    logging_ssh.chmod(logging_ssh.stat().st_mode | stat.S_IEXEC)
+
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 3, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam"}}}
+    )
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    shards = split_training_data(psv_dataset["root"], 2)
+    spec = JobSpec(
+        n_workers=2, shards=shards, spmd=True, epochs=3,
+        spare_restarts=1, registration_timeout_s=120.0,
+        heartbeat_interval_ms=200, max_missed_heartbeats=5,
+    )
+
+    def make_cfg(worker_id: str, addr) -> WorkerConfig:
+        return WorkerConfig(
+            worker_id=worker_id, coordinator_host=addr[0],
+            coordinator_port=addr[1], model_config=mc, schema=schema,
+            batch_size=32, checkpoint_dir=str(tmp_path / "ckpt"),
+            heartbeat_interval_s=0.2, spmd=True,
+        )
+
+    submitter = JobSubmitter(
+        spec, make_cfg, launcher="ssh", hosts=[ip, ip],
+        ssh_command=[str(logging_ssh)], worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        bind_host="0.0.0.0" if not ip.startswith("127.") else "127.0.0.1",
+        advertise_host=ip,
+        kill_injections={"worker-1": 0},
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 1
+    logged = calls.read_text()
+    assert "pkill -KILL -f stpu-worker-1" in logged
+
+
+def test_loopback_chief_with_remote_peers_fails_clearly():
+    """Round-2 Weak #6: a chief registering 127.0.0.1 while peers register
+    routable hosts must be a clear error, not a silent peer hang."""
+    from shifu_tensorflow_tpu.data.splitter import Shard
+
+    spec = JobSpec(
+        n_workers=2,
+        shards=[
+            Shard(worker_index=0, paths=("a",), total_bytes=0),
+            Shard(worker_index=1, paths=("b",), total_bytes=0),
+        ],
+        spmd=True,
+        registration_timeout_s=10.0,
+    )
+    coord = Coordinator(spec)
+    r0 = coord.register("w0", 0, host="127.0.0.1", jax_port=12345)
+    r1 = coord.register("w1", 1, host="10.9.8.7", jax_port=12346)
+    assert r0["ok"] and r1["ok"]
+    started = coord.await_start(timeout_s=5.0)
+    assert not started.get("ok")
+    assert "loopback" in (started.get("error") or "")
+    assert coord.state == JobState.FAILED
+    coord.shutdown()
