@@ -276,7 +276,10 @@ class Coordinator:
         chief_id = self._by_index.get(0)
         chief = self.workers.get(chief_id) if chief_id else None
         chief_host = (chief.host if chief else "") or "127.0.0.1"
-        if self.spec.n_workers > 1 and chief_host in self._LOOPBACK:
+        # SPMD only: non-SPMD workers never dial chief_host/jax_port, so a
+        # mixed loopback/routable topology is fine there
+        if (self.spec.spmd and self.spec.n_workers > 1
+                and chief_host in self._LOOPBACK):
             remote = sorted(
                 {
                     r.host

@@ -273,20 +273,24 @@ class JobSubmitter:
     # ---- kill/cleanup ----
     def kill_worker(self, worker_id: str) -> bool:
         """SIGKILL a worker process (fault injection / fleet restart).
-        Returns whether the worker was alive when the kill began."""
+        Returns whether a kill was actually delivered (locally or via the
+        remote pkill) — _maybe_kill_injected disarms on True."""
         proc = self._procs.get(worker_id)
         # aliveness is sampled BEFORE the remote pkill: under
         # localhost-as-remote the pkill reaps the local process chain too,
         # and a post-pkill poll() would misreport "already dead" — which
         # made _maybe_kill_injected keep the injection armed and re-kill
         # the relaunched worker next generation
-        was_alive = proc is not None and proc.poll() is None
-        if self.launcher == "ssh" and proc is not None:
-            # killing the local ssh client does not reliably kill the
-            # remote process tree — and the remote worker can outlive a
-            # dropped ssh connection, so the pkill runs even when the local
-            # client already exited (else a stale remote process would race
-            # its own relaunch in the next generation)
+        rc = proc.poll() if proc is not None else None
+        was_alive = proc is not None and rc is None
+        remote_killed = False
+        # the remote worker can outlive the local ssh client (dropped
+        # connection: ssh exits 255 / dies by signal) — pkill then, too.
+        # A normal remote exit status means the remote tree already
+        # finished; skip the per-worker ssh round trip on clean teardown.
+        if self.launcher == "ssh" and proc is not None and (
+            was_alive or rc == 255 or (rc is not None and rc < 0)
+        ):
             tag = self._run_tags.get(worker_id)
             host = self._worker_hosts.get(worker_id)
             if tag and host:
@@ -295,11 +299,12 @@ class JobSubmitter:
                         self.ssh_command + [host, f"pkill -KILL -f {tag}"],
                         timeout=10.0, capture_output=True,
                     )
+                    remote_killed = True
                 except (subprocess.TimeoutExpired, OSError):
                     pass
         if was_alive:
             proc.kill()
-        return was_alive
+        return was_alive or remote_killed
 
     def _kill_fleet(self) -> None:
         for wid in list(self._procs):

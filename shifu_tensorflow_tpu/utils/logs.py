@@ -34,6 +34,21 @@ _configured = False
 _context = threading.local()
 
 
+class _LazyDirFileHandler(logging.FileHandler):
+    """FileHandler that creates the parent directory on first emission
+    instead of at construction (= import) time."""
+
+    def __init__(self, path: str):
+        super().__init__(path, delay=True)
+
+    def _open(self):
+        os.makedirs(
+            os.path.dirname(os.path.abspath(self.baseFilename)) or ".",
+            exist_ok=True,
+        )
+        return super()._open()
+
+
 class _ContextFilter(logging.Filter):
     """Injects the calling thread's worker identity into every record."""
 
@@ -74,9 +89,10 @@ def configure(
         ]
         log_file = log_file or os.environ.get("STPU_LOG_FILE")
         if log_file:
-            os.makedirs(os.path.dirname(os.path.abspath(log_file)),
-                        exist_ok=True)
-            handlers.append(logging.FileHandler(log_file))
+            # delay=True + lazy mkdir: configure() runs at import time (the
+            # component loggers are module-level), so it must not touch the
+            # filesystem or raise until a record is actually emitted
+            handlers.append(_LazyDirFileHandler(log_file))
         fmt = logging.Formatter(_FORMAT)
         flt = _ContextFilter()
         for h in handlers:
