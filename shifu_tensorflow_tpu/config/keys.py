@@ -98,6 +98,10 @@ DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
 # binary shard cache directory (data/cache.py): parse text shards once,
 # stream later epochs from memory-mapped finalized tensors
 CACHE_DIR = TPU_PREFIX + "cache-dir"
+# cache size budget in bytes; oldest entries evicted after training
+# (0 = unbounded)
+CACHE_MAX_BYTES = TPU_PREFIX + "cache-max-bytes"
+DEFAULT_CACHE_MAX_BYTES = 0
 
 # ---- fault-tolerance envelope (reference: Constants.java:87-89; the ps
 # threshold has no analogue — there is no PS role) ----

@@ -148,6 +148,87 @@ def lookup(cache_dir: str, src_path: str, schema: RecordSchema,
         return None
 
 
+def cache_size_bytes(cache_dir: str) -> int:
+    """Total bytes of committed cache entries (temp files excluded)."""
+    total = 0
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if ".tmp." in name:
+            continue
+        try:
+            total += os.path.getsize(os.path.join(cache_dir, name))
+        except OSError:
+            continue
+    return total
+
+
+#: temp files / orphan slabs younger than this are assumed to belong to an
+#: in-flight writer and are left alone by prune_cache
+_ORPHAN_MIN_AGE_S = 3600.0
+
+
+def prune_cache(cache_dir: str, max_bytes: int) -> int:
+    """Evict whole entries, oldest meta-mtime first, until the cache fits
+    ``max_bytes``; also sweep stale debris — ``.tmp.`` files from writers
+    that died without abort() (SIGKILL mid-write) and slabs orphaned
+    between commit()'s slab renames and the meta publish — once older than
+    an hour.  Returns committed entries removed.  Safe against concurrent
+    readers on POSIX: an open memmap keeps its data reachable after
+    unlink; the entry simply stops being discoverable."""
+    import time
+
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return 0
+    metas = [n for n in names if n.endswith(".meta.json")]
+    keys = {m[: -len(".meta.json")] for m in metas}
+    now = time.time()
+    for name in names:
+        if name.endswith(".meta.json"):
+            continue
+        stale = ".tmp." in name or name.split(".", 1)[0] not in keys
+        if not stale:
+            continue
+        p = os.path.join(cache_dir, name)
+        try:
+            if now - os.path.getmtime(p) >= _ORPHAN_MIN_AGE_S:
+                os.unlink(p)
+        except OSError:
+            continue
+    entries = []
+    for meta in metas:
+        key = meta[: -len(".meta.json")]
+        paths = [os.path.join(cache_dir, meta)] + [
+            os.path.join(cache_dir, f"{key}.{s}")
+            for s in _SLABS
+            if os.path.exists(os.path.join(cache_dir, f"{key}.{s}"))
+        ]
+        try:
+            mtime = os.path.getmtime(paths[0])
+            size = sum(os.path.getsize(p) for p in paths)
+        except OSError:
+            continue
+        entries.append((mtime, size, paths))
+    total = sum(e[1] for e in entries)
+    removed = 0
+    for mtime, size, paths in sorted(entries):
+        if total <= max_bytes:
+            break
+        # meta first: the entry disappears atomically from lookup's view
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        total -= size
+        removed += 1
+    return removed
+
+
 class ShardCacheWriter:
     """Streaming writer for one shard's cache entry.
 

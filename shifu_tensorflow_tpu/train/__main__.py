@@ -190,6 +190,26 @@ def job_spec_kwargs(conf: Conf) -> dict:
     }
 
 
+def prune_cache_if_configured(conf: Conf) -> None:
+    """Cache eviction to the shifu.tpu.cache-max-bytes budget (accepts
+    memory strings: "2g", "512m", plain bytes).  Runs in the CLI's finally
+    paths — a failing job must not grow the cache past budget forever."""
+    cache_dir = conf.get(K.CACHE_DIR)
+    try:
+        max_bytes = conf.get_memory(K.CACHE_MAX_BYTES,
+                                    K.DEFAULT_CACHE_MAX_BYTES) or 0
+    except ValueError as e:
+        print(f"ignoring {K.CACHE_MAX_BYTES}: {e}", file=sys.stderr)
+        return
+    if cache_dir and max_bytes > 0:
+        from shifu_tensorflow_tpu.data import cache as shard_cache
+
+        removed = shard_cache.prune_cache(cache_dir, max_bytes)
+        if removed:
+            print(f"cache: evicted {removed} entries to fit "
+                  f"{max_bytes} bytes", flush=True)
+
+
 def _print_epoch(stats) -> None:
     print(
         f"epoch {stats.current_epoch}: train_loss={stats.training_loss:.6f} "
@@ -290,6 +310,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     finally:
         if checkpointer is not None:
             checkpointer.close()
+        prune_cache_if_configured(conf)
     wall = time.time() - t0
 
     if args.export_dir:
@@ -398,6 +419,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             flush=True,
         )
 
+    prune_cache_if_configured(conf)
     if result.state != JobState.FINISHED:
         print_summary()
         return 1

@@ -112,3 +112,90 @@ def test_ps_keys_are_gone():
     # legacy configs carrying shifu.ps.* still parse
     conf = _conf({"shifu.ps.instances": 2})
     assert conf.get_int("shifu.ps.instances", 0) == 2
+
+
+def test_cache_max_bytes_prunes_oldest(tmp_path):
+    import gzip
+    import os
+    import time as _time
+
+    import numpy as np
+
+    from shifu_tensorflow_tpu.data import cache as shard_cache
+    from shifu_tensorflow_tpu.data.dataset import ShardStream
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    schema = RecordSchema(feature_columns=(1, 2), target_column=0)
+    cache_dir = str(tmp_path / "cache")
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"s{i}.gz")
+        with gzip.open(p, "wt") as f:
+            for _ in range(500):
+                x = rng.normal(size=2)
+                f.write(f"1|{x[0]:.5f}|{x[1]:.5f}\n")
+        paths.append(p)
+    for p in paths:  # build one entry per shard, oldest first
+        for _ in ShardStream([p], schema, 128, cache_dir=cache_dir):
+            pass
+        _time.sleep(0.02)
+    assert len([f for f in os.listdir(cache_dir)
+                if f.endswith(".meta.json")]) == 3
+    total = shard_cache.cache_size_bytes(cache_dir)
+    removed = shard_cache.prune_cache(cache_dir, total // 2)
+    assert removed >= 1
+    assert shard_cache.cache_size_bytes(cache_dir) <= total // 2
+    # the NEWEST entry survives and still serves warm reads
+    survivors = [f for f in os.listdir(cache_dir)
+                 if f.endswith(".meta.json")]
+    assert survivors
+    newest = shard_cache.lookup(cache_dir, paths[-1], schema, 0)
+    assert newest is not None and newest.n_rows == 500
+    # unbounded budget is a no-op
+    assert shard_cache.prune_cache(cache_dir, 10**12) == 0
+
+
+def test_cache_max_bytes_key_reaches_prune(tmp_path, capsys):
+    from shifu_tensorflow_tpu.train.__main__ import prune_cache_if_configured
+
+    conf = _conf({K.CACHE_DIR: str(tmp_path), K.CACHE_MAX_BYTES: 1})
+    (tmp_path / "aaaa.meta.json").write_text('{"version": 1, "n_rows": 0}')
+    (tmp_path / "aaaa.x.f32").write_bytes(b"\0" * 4096)
+    (tmp_path / "aaaa.y.f32").write_bytes(b"")
+    (tmp_path / "aaaa.w.f32").write_bytes(b"")
+    prune_cache_if_configured(conf)
+    assert not (tmp_path / "aaaa.meta.json").exists()
+    assert "evicted" in capsys.readouterr().out
+
+
+def test_prune_sweeps_stale_tmp_and_orphan_slabs(tmp_path):
+    import os
+    import time as _time
+
+    from shifu_tensorflow_tpu.data import cache as shard_cache
+
+    old = _time.time() - 7200
+    # stale tmp from a SIGKILLed writer + slab orphaned before meta publish
+    for name in ("k1.x.f32.tmp.123.456.0", "k2.x.f32", "k2.y.f32"):
+        p = tmp_path / name
+        p.write_bytes(b"\0" * 128)
+        os.utime(p, (old, old))
+    # fresh tmp (in-flight writer) must survive
+    fresh = tmp_path / "k3.x.f32.tmp.789.1.2"
+    fresh.write_bytes(b"\0" * 128)
+    shard_cache.prune_cache(str(tmp_path), max_bytes=10**9)
+    left = sorted(os.listdir(tmp_path))
+    assert left == ["k3.x.f32.tmp.789.1.2"], left
+
+
+def test_cache_max_bytes_accepts_memory_strings(tmp_path, capsys):
+    from shifu_tensorflow_tpu.train.__main__ import prune_cache_if_configured
+
+    # "2g" must parse, not crash a finished run
+    conf = _conf({K.CACHE_DIR: str(tmp_path), K.CACHE_MAX_BYTES: "2g"})
+    prune_cache_if_configured(conf)  # no entries: no-op, no raise
+    # garbage values are reported, never raised
+    conf = _conf({K.CACHE_DIR: str(tmp_path), K.CACHE_MAX_BYTES: "lots"})
+    prune_cache_if_configured(conf)
+    assert "ignoring" in capsys.readouterr().err
