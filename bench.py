@@ -47,6 +47,9 @@ REF_BATCH = 100  # the reference's fixed batch size (ssgd_monitor.py:33)
 STREAM_ROWS = int(os.environ.get("BENCH_STREAM_ROWS", 2_000_000))
 STREAM_SHARDS = int(os.environ.get("BENCH_STREAM_SHARDS", 8))
 STREAM_READERS = int(os.environ.get("BENCH_STREAM_READERS", 4))
+# ingest-bound phases run larger device batches: host->device transfer has
+# a fixed per-call latency that 16K-row batches leave unamortized
+STREAM_BATCH = int(os.environ.get("BENCH_STREAM_BATCH", 65536))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
 TPU_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 900.0))
 CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT", 900.0))
@@ -168,7 +171,7 @@ def bench_stream_rows_per_sec() -> dict:
 
     mesh = make_mesh("data:-1")
     trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
-    batch_size = trainer.align_batch_size(BATCH)
+    batch_size = trainer.align_batch_size(STREAM_BATCH)
     schema = RecordSchema(
         feature_columns=tuple(range(1, NUM_FEATURES + 1)),
         target_column=0,
@@ -208,6 +211,7 @@ def bench_stream_rows_per_sec() -> dict:
     return {
         "stream_rows_per_sec": round(steady, 1),
         "stream_cold_rows_per_sec": round(cold, 1),
+        "stream_batch": batch_size,
         "stream_rows": STREAM_ROWS,
         "stream_readers": STREAM_READERS,
         "stream_gen_s": round(gen_s, 1),
