@@ -383,14 +383,14 @@ def _run_local_training(
             lambda epoch: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="train", salt=cfg.seed,
-                n_readers=cfg.n_readers,
-                cache_dir=cfg.cache_dir,
+                n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                feature_dtype=_feature_dtype_for(cfg),
             ),
             (lambda: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="valid", salt=cfg.seed,
-                n_readers=cfg.n_readers,
-                cache_dir=cfg.cache_dir,
+                n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                feature_dtype=_feature_dtype_for(cfg),
             )) if valid_rate > 0 else None,
             epochs=epochs,
             on_epoch=on_epoch,
@@ -410,6 +410,18 @@ def _run_local_training(
             start_epoch=start_epoch,
         )
     return 0
+
+
+def _np_feature_dtype(cfg):
+    from shifu_tensorflow_tpu.data.cache import feature_np_dtype
+
+    return feature_np_dtype(_feature_dtype_for(cfg))
+
+
+def _feature_dtype_for(cfg) -> str:
+    """bf16 runs stream bf16 features: half the cache-slab reads and
+    host->device bytes, same values the model would cast to anyway."""
+    return "bfloat16" if cfg.dtype == "bfloat16" else "float32"
 
 
 def _run_spmd_training(
@@ -481,16 +493,18 @@ def _run_spmd_training(
         )
 
     if cfg.stream:
+        x_dtype = _np_feature_dtype(cfg)
+
         def make_train(epoch: int):
             return fixed_step_batches(
                 ShardStream(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="train", salt=cfg.seed,
-                    n_readers=cfg.n_readers,
-                cache_dir=cfg.cache_dir,
+                    n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                    feature_dtype=_feature_dtype_for(cfg),
                 ),
                 local_batch, train_steps, num_features,
-                on_dropped=_warn_dropped,
+                on_dropped=_warn_dropped, x_dtype=x_dtype,
             )
 
         def make_valid():
@@ -498,10 +512,10 @@ def _run_spmd_training(
                 ShardStream(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="valid", salt=cfg.seed,
-                    n_readers=cfg.n_readers,
-                cache_dir=cfg.cache_dir,
+                    n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                    feature_dtype=_feature_dtype_for(cfg),
                 ),
-                local_batch, valid_steps, num_features,
+                local_batch, valid_steps, num_features, x_dtype=x_dtype,
             )
     else:
         def make_train(epoch: int):

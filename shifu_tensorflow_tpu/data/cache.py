@@ -17,8 +17,11 @@ reader is ``np.memmap`` and nothing else.
 
 Layout, per source shard, under ``cache_dir``::
 
-    <key>.meta.json   {"version", "n_rows", "n_features", "has_hashes", ...}
+    <key>.meta.json   {"version", "n_rows", "n_features", "has_hashes",
+                       "feature_dtype", ...}
     <key>.x.f32       features  (n_rows x n_features) float32, row-major
+                      (or <key>.x.bf16 — bfloat16 features halve slab reads
+                      and host->device bytes for bf16 training runs)
     <key>.y.f32       targets   (n_rows,) float32
     <key>.w.f32       weights   (n_rows,) float32
     <key>.h.u32       crc32 routing hashes (n_rows,) uint32   [optional]
@@ -46,15 +49,37 @@ import numpy as np
 from shifu_tensorflow_tpu.data.reader import ParsedBlock, RecordSchema, wanted_columns
 from shifu_tensorflow_tpu.utils import fs
 
-CACHE_VERSION = 1
-_SLABS = ("x.f32", "y.f32", "w.f32", "h.u32")
+CACHE_VERSION = 2
+#: every slab name that can belong to an entry (both feature variants)
+_SLABS = ("x.f32", "x.bf16", "y.f32", "w.f32", "h.u32")
+
+
+def _feature_slab(feature_dtype: str) -> str:
+    return "x.bf16" if feature_dtype == "bfloat16" else "x.f32"
+
+
+def feature_np_dtype(name: str):
+    """Feature-slab dtype: float32 (default) or bfloat16 — the MXU-native
+    dtype, halving slab reads and host->device transfer for bf16 runs.
+    Targets/weights stay float32 (loss normalization precision)."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if name in ("float32", "", None):
+        return np.dtype(np.float32)
+    raise ValueError(f"unsupported cache feature dtype {name!r}")
+
+
+_feature_dtype = feature_np_dtype  # intra-module alias
 # distinguishes concurrent writers for the same key within one process
 # (e.g. a train and a valid ShardStream iterating at once) — PID alone
 # would have them truncate each other's temp slabs
 _WRITER_SEQ = itertools.count()
 
 
-def cache_key(src_path: str, schema: RecordSchema, salt: int) -> str | None:
+def cache_key(src_path: str, schema: RecordSchema, salt: int,
+              feature_dtype: str = "float32") -> str | None:
     """Fingerprint of (source file identity, parse config).  None when the
     source can't be fingerprinted — size alone is NOT enough (a shard
     replaced with same-size different content would silently serve stale
@@ -71,6 +96,7 @@ def cache_key(src_path: str, schema: RecordSchema, salt: int) -> str | None:
                    else src_path, "size": size, "mtime_ns": mtime_ns}
     cfg = {
         "version": CACHE_VERSION,
+        "feature_dtype": feature_dtype or "float32",
         "wanted": list(wanted_columns(schema)),
         "delimiter": schema.delimiter,
         "means": list(schema.means),
@@ -107,9 +133,10 @@ class ShardCacheReader:
 
 
 def lookup(cache_dir: str, src_path: str, schema: RecordSchema,
-           salt: int) -> ShardCacheReader | None:
+           salt: int, feature_dtype: str = "float32"
+           ) -> ShardCacheReader | None:
     """Open the cache entry for ``src_path``, or None on miss/corruption."""
-    key = cache_key(src_path, schema, salt)
+    key = cache_key(src_path, schema, salt, feature_dtype)
     if key is None:
         return None
     meta_path = os.path.join(cache_dir, f"{key}.meta.json")
@@ -135,11 +162,15 @@ def lookup(cache_dir: str, src_path: str, schema: RecordSchema,
                 return np.empty(shape, dtype)
             return np.memmap(p, dtype=dtype, mode="r", shape=shape)
 
+        if meta.get("feature_dtype", "float32") != (
+                feature_dtype or "float32"):
+            return None  # key collision should make this unreachable
         return ShardCacheReader(
             n_rows=n,
             n_features=nf,
             has_hashes=has_hashes,
-            features=mm("x.f32", np.float32, (n, nf)),
+            features=mm(_feature_slab(feature_dtype),
+                        _feature_dtype(feature_dtype), (n, nf)),
             targets=mm("y.f32", np.float32, (n, 1)),
             weights=mm("w.f32", np.float32, (n, 1)),
             hashes=mm("h.u32", np.uint32, (n,)) if has_hashes else None,
@@ -208,6 +239,20 @@ def prune_cache(cache_dir: str, max_bytes: int) -> int:
             if os.path.exists(os.path.join(cache_dir, f"{key}.{s}"))
         ]
         try:
+            with open(paths[0], "r", encoding="utf-8") as f:
+                version = json.load(f).get("version")
+        except (OSError, json.JSONDecodeError):
+            version = None
+        if version != CACHE_VERSION:
+            # superseded/corrupt entry: unreadable by lookup, so it would
+            # sit on disk forever — drop it regardless of the budget
+            for p in paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            continue
+        try:
             mtime = os.path.getmtime(paths[0])
             size = sum(os.path.getsize(p) for p in paths)
         except OSError:
@@ -238,8 +283,8 @@ class ShardCacheWriter:
     """
 
     def __init__(self, cache_dir: str, src_path: str, schema: RecordSchema,
-                 salt: int):
-        self.key = cache_key(src_path, schema, salt)
+                 salt: int, feature_dtype: str = "float32"):
+        self.key = cache_key(src_path, schema, salt, feature_dtype)
         self.ok = self.key is not None
         if not self.ok:
             return
@@ -247,13 +292,17 @@ class ShardCacheWriter:
         self.cache_dir = cache_dir
         self.src_path = src_path
         self.n_features = schema.num_features
+        self.feature_dtype = feature_dtype or "float32"
+        self._x_dtype = _feature_dtype(feature_dtype)
+        self._slabs = (_feature_slab(feature_dtype), "y.f32", "w.f32",
+                       "h.u32")
         self.n_rows = 0
         self.has_hashes: bool | None = None
         self._suffix = (
             f".tmp.{os.getpid()}.{threading.get_ident()}.{next(_WRITER_SEQ)}"
         )
         self._tmp = {s: os.path.join(cache_dir, f"{self.key}.{s}{self._suffix}")
-                     for s in _SLABS}
+                     for s in self._slabs}
         self._files = {s: open(p, "wb") for s, p in self._tmp.items()}
 
     def append(self, block: ParsedBlock, hashes: np.ndarray | None) -> None:
@@ -265,8 +314,8 @@ class ShardCacheWriter:
             # mixed availability would desync the hash slab; drop the entry
             self.abort()
             return
-        np.ascontiguousarray(block.features, np.float32).tofile(
-            self._files["x.f32"])
+        np.ascontiguousarray(block.features, self._x_dtype).tofile(
+            self._files[self._slabs[0]])
         np.ascontiguousarray(block.targets, np.float32).tofile(
             self._files["y.f32"])
         np.ascontiguousarray(block.weights, np.float32).tofile(
@@ -280,7 +329,7 @@ class ShardCacheWriter:
             return False
         for f in self._files.values():
             f.close()
-        for s in _SLABS:
+        for s in self._slabs:
             if s == "h.u32" and not self.has_hashes:
                 os.unlink(self._tmp[s])
                 continue
@@ -291,6 +340,7 @@ class ShardCacheWriter:
             "n_rows": self.n_rows,
             "n_features": self.n_features,
             "has_hashes": bool(self.has_hashes),
+            "feature_dtype": self.feature_dtype,
             "src": self.src_path,
         }
         meta_tmp = os.path.join(self.cache_dir,

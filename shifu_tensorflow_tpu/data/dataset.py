@@ -50,7 +50,9 @@ def pad_to_batch(block: ParsedBlock, batch_size: int) -> ParsedBlock:
     if rem == 0 and n > 0:
         return block
     pad = batch_size - rem if n > 0 else batch_size
-    f = np.zeros((pad, block.features.shape[1]), np.float32)
+    # padding keeps the block's feature dtype: a float32 pad concatenated
+    # onto bfloat16 features would silently promote the whole batch
+    f = np.zeros((pad, block.features.shape[1]), block.features.dtype)
     z = np.zeros((pad, 1), np.float32)
     return ParsedBlock.concat([block, ParsedBlock(f, z, z)])
 
@@ -133,11 +135,14 @@ class InMemoryDataset:
         return -(-len(self.valid) // batch_size)
 
 
-def _zero_batch(batch_size: int, num_features: int) -> Batch:
+def _zero_batch(batch_size: int, num_features: int,
+                x_dtype=np.float32) -> Batch:
     """All-padding batch: weight 0 everywhere, so it contributes nothing to
-    the weighted loss/gradient — pure barrier participation."""
+    the weighted loss/gradient — pure barrier participation.  ``x_dtype``
+    must match the real batches' feature dtype or SPMD processes would
+    compile different programs for padded vs real steps."""
     z = np.zeros((batch_size, 1), np.float32)
-    return make_batch(np.zeros((batch_size, num_features), np.float32), z, z)
+    return make_batch(np.zeros((batch_size, num_features), x_dtype), z, z)
 
 
 def fixed_step_batches(
@@ -147,6 +152,7 @@ def fixed_step_batches(
     num_features: int,
     *,
     on_dropped: Callable[[int], None] | None = None,
+    x_dtype=np.float32,
 ) -> Iterator[Batch]:
     """Adapt any batch iterator to EXACTLY ``steps`` batches of exactly
     ``batch_size`` rows.
@@ -183,7 +189,7 @@ def fixed_step_batches(
         yield batch
         emitted += 1
     while emitted < steps:
-        yield _zero_batch(batch_size, num_features)
+        yield _zero_batch(batch_size, num_features, x_dtype)
         emitted += 1
 
 
@@ -227,6 +233,7 @@ class ShardStream:
         salt: int = 0,
         n_readers: int | None = None,
         cache_dir: str | None = None,
+        feature_dtype: str = "float32",
     ):
         self.paths = list(paths)
         self.schema = schema
@@ -238,6 +245,9 @@ class ShardStream:
         self.drop_remainder = drop_remainder
         self.salt = salt
         self.cache_dir = cache_dir
+        # "float32" | "bfloat16": emitted batch x dtype; bf16 halves cache
+        # slab reads and host->device transfer for bf16 training runs
+        self.feature_dtype = feature_dtype or "float32"
         if n_readers is None:
             n_readers = 1
         self.n_readers = max(1, min(n_readers, max(1, len(self.paths))))
@@ -287,7 +297,7 @@ class ShardStream:
         need_hashes = self.valid_rate > 0.0
         if self.cache_dir is not None:
             reader = shard_cache.lookup(self.cache_dir, path, self.schema,
-                                        self.salt)
+                                        self.salt, self.feature_dtype)
             if reader is not None and (not need_hashes or reader.has_hashes):
                 yield from reader.blocks()
                 return
@@ -295,7 +305,8 @@ class ShardStream:
         writer = None
         if self.cache_dir is not None:
             writer = shard_cache.ShardCacheWriter(
-                self.cache_dir, path, self.schema, self.salt
+                self.cache_dir, path, self.schema, self.salt,
+                self.feature_dtype,
             )
         want_hashes = need_hashes or writer is not None
 
@@ -311,8 +322,9 @@ class ShardStream:
                 gen if gen is not None
                 else self._byte_chunk_blocks(path, want_hashes)
             )
+            cast = self._cast_features
             for arr, hashes in blocks:
-                block = _finalize(arr, self.schema)
+                block = cast(_finalize(arr, self.schema))
                 if writer is not None:
                     writer.append(block, hashes)
                 yield block, hashes
@@ -357,6 +369,18 @@ class ShardStream:
                 yield _parse(data[: cut + 1])
         if tail:
             yield _parse(tail)
+
+    def _cast_features(self, block: ParsedBlock) -> ParsedBlock:
+        """Cast parsed float32 features to the emission dtype (no-op for
+        float32); cold parse and warm cache then serve identical values."""
+        if self.feature_dtype == "float32":
+            return block
+        from shifu_tensorflow_tpu.data.cache import _feature_dtype
+
+        return ParsedBlock(
+            block.features.astype(_feature_dtype(self.feature_dtype)),
+            block.targets, block.weights,
+        )
 
     # ---- routing + batch emission -----------------------------------------
 

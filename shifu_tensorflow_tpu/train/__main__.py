@@ -239,13 +239,14 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
+    extras = trainer_extras(args, conf)
     trainer = make_trainer(
         model_config,
         schema.num_features,
         feature_columns=schema.feature_columns,
         mesh=mesh,
         seed=args.seed,
-        **trainer_extras(args, conf),
+        **extras,
     )
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
     batch_size = trainer.align_batch_size(
@@ -274,16 +275,24 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         with trace_if(args.profile_dir):
             if args.stream:
                 cache_dir = conf.get(K.CACHE_DIR)
+                import jax.numpy as jnp
+
+                feature_dtype = (
+                    "bfloat16" if extras["dtype"] == jnp.bfloat16
+                    else "float32"
+                )
                 history = trainer.fit_stream(
                     lambda epoch: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="train", salt=args.seed,
                         n_readers=args.readers, cache_dir=cache_dir,
+                        feature_dtype=feature_dtype,
                     ),
                     (lambda: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="valid", salt=args.seed,
                         n_readers=args.readers, cache_dir=cache_dir,
+                        feature_dtype=feature_dtype,
                     )) if valid_rate > 0 else None,
                     epochs=epochs,
                     on_epoch=_print_epoch,

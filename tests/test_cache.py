@@ -202,3 +202,52 @@ def test_remote_scheme_without_mtime_is_never_cached(tmp_path):
         assert shard_cache.cache_key("fakefs://x/y.gz", SCHEMA, 0) is None
     finally:
         fs._SCHEME_HANDLERS.pop("fakefs", None)
+
+
+def test_bf16_feature_dtype_cold_warm_parity(tmp_path):
+    """bf16 streams must serve identical values cold (parse + cast +
+    cache-write) and warm (bf16 memmap), in separate cache entries from
+    the f32 variant."""
+    import ml_dtypes
+
+    paths = _write_shards(str(tmp_path), n_shards=2, rows=700)
+    cache_dir = str(tmp_path / "cache")
+
+    def drain(dtype, cd=cache_dir):
+        stream = ShardStream(paths, SCHEMA, 128, valid_rate=0.2,
+                             emit="train", cache_dir=cd,
+                             feature_dtype=dtype)
+        return [b["x"].copy() for b in stream]
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    cold = drain("bfloat16")
+    warm = drain("bfloat16")
+    assert cold and all(b.dtype == bf16 for b in cold)
+    for c, w in zip(cold, warm):
+        np.testing.assert_array_equal(c.view(np.uint16), w.view(np.uint16))
+    # both dtype variants coexist without collision
+    f32 = drain("float32")
+    assert all(b.dtype == np.float32 for b in f32)
+    metas = [f for f in os.listdir(cache_dir) if f.endswith(".meta.json")]
+    assert len(metas) == 4  # 2 shards x 2 dtypes
+    # bf16 values are the f32 values rounded to bf16
+    np.testing.assert_array_equal(
+        cold[0].view(np.uint16),
+        f32[0].astype(bf16).view(np.uint16),
+    )
+    # bf16 slabs are half the f32 feature bytes
+    x_f32 = sum(os.path.getsize(os.path.join(cache_dir, f))
+                for f in os.listdir(cache_dir) if f.endswith(".x.f32"))
+    x_bf16 = sum(os.path.getsize(os.path.join(cache_dir, f))
+                 for f in os.listdir(cache_dir) if f.endswith(".x.bf16"))
+    assert x_bf16 * 2 == x_f32
+
+
+def test_bf16_fixed_step_zero_batches_match_dtype():
+    import ml_dtypes
+
+    from shifu_tensorflow_tpu.data.dataset import fixed_step_batches
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    out = list(fixed_step_batches(iter([]), 8, 2, 3, x_dtype=bf16))
+    assert len(out) == 2 and all(b["x"].dtype == bf16 for b in out)

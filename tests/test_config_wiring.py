@@ -159,8 +159,12 @@ def test_cache_max_bytes_prunes_oldest(tmp_path):
 def test_cache_max_bytes_key_reaches_prune(tmp_path, capsys):
     from shifu_tensorflow_tpu.train.__main__ import prune_cache_if_configured
 
+    from shifu_tensorflow_tpu.data.cache import CACHE_VERSION
+
     conf = _conf({K.CACHE_DIR: str(tmp_path), K.CACHE_MAX_BYTES: 1})
-    (tmp_path / "aaaa.meta.json").write_text('{"version": 1, "n_rows": 0}')
+    (tmp_path / "aaaa.meta.json").write_text(
+        '{"version": %d, "n_rows": 0}' % CACHE_VERSION
+    )
     (tmp_path / "aaaa.x.f32").write_bytes(b"\0" * 4096)
     (tmp_path / "aaaa.y.f32").write_bytes(b"")
     (tmp_path / "aaaa.w.f32").write_bytes(b"")
@@ -199,3 +203,19 @@ def test_cache_max_bytes_accepts_memory_strings(tmp_path, capsys):
     conf = _conf({K.CACHE_DIR: str(tmp_path), K.CACHE_MAX_BYTES: "lots"})
     prune_cache_if_configured(conf)
     assert "ignoring" in capsys.readouterr().err
+
+
+def test_prune_drops_superseded_version_entries(tmp_path):
+    import json
+    import os
+
+    from shifu_tensorflow_tpu.data import cache as shard_cache
+
+    # a v1-era entry: unreadable by lookup, must not sit on disk forever
+    (tmp_path / "old.meta.json").write_text(
+        json.dumps({"version": 1, "n_rows": 5, "n_features": 2})
+    )
+    (tmp_path / "old.x.f32").write_bytes(b"\0" * 40)
+    shard_cache.prune_cache(str(tmp_path), max_bytes=10**9)
+    assert not (tmp_path / "old.meta.json").exists()
+    assert not (tmp_path / "old.x.f32").exists()
