@@ -183,23 +183,24 @@ def bench_stream_rows_per_sec() -> dict:
         gen_s = time.perf_counter() - t_gen
         cache_dir = os.path.join(root, "cache")
 
-        def one_epoch() -> float:
+        def one_epoch(tr=trainer, feature_dtype="float32") -> float:
             stream = ShardStream(
                 paths, schema, batch_size,
                 valid_rate=0.0, emit="train", n_readers=STREAM_READERS,
                 drop_remainder=True, cache_dir=cache_dir,
+                feature_dtype=feature_dtype,
             )
-            step = trainer._train_step
+            step = tr._train_step
             rows = 0
             # warmup/compile on the first batch, then measure wall-clock
             # over the rest of the stream; the state threads through
-            # trainer.state because the step may donate its input buffers
-            it = prefetch_to_device(iter(stream), put=trainer._put)
-            trainer.state, loss = step(trainer.state, next(it))
+            # tr.state because the step may donate its input buffers
+            it = prefetch_to_device(iter(stream), put=tr._put)
+            tr.state, loss = step(tr.state, next(it))
             jax.block_until_ready(loss)
             t0 = time.perf_counter()
             for batch in it:
-                trainer.state, loss = step(trainer.state, batch)
+                tr.state, loss = step(tr.state, batch)
                 rows += batch_size
             jax.block_until_ready(loss)
             return rows / (time.perf_counter() - t0)
@@ -213,27 +214,10 @@ def bench_stream_rows_per_sec() -> dict:
 
         trainer16 = Trainer(_model_config(), NUM_FEATURES, mesh=mesh,
                             dtype=jnp.bfloat16)
-
-        def bf16_epoch() -> float:
-            stream = ShardStream(
-                paths, schema, batch_size, valid_rate=0.0, emit="train",
-                n_readers=STREAM_READERS, drop_remainder=True,
-                cache_dir=cache_dir, feature_dtype="bfloat16",
-            )
-            step = trainer16._train_step
-            rows = 0
-            it = prefetch_to_device(iter(stream), put=trainer16._put)
-            trainer16.state, loss = step(trainer16.state, next(it))
-            jax.block_until_ready(loss)
-            t0 = time.perf_counter()
-            for batch in it:
-                trainer16.state, loss = step(trainer16.state, batch)
-                rows += batch_size
-            jax.block_until_ready(loss)
-            return rows / (time.perf_counter() - t0)
-
-        bf16_epoch()  # cold: builds the bf16 cache entries
-        steady_bf16 = max(bf16_epoch() for _ in range(2))
+        one_epoch(trainer16, "bfloat16")  # cold: builds bf16 cache entries
+        steady_bf16 = max(
+            one_epoch(trainer16, "bfloat16") for _ in range(2)
+        )
         stages = _stream_stage_breakdown(paths, schema, cache_dir, trainer,
                                          batch_size)
     return {

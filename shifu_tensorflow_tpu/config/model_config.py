@@ -47,6 +47,18 @@ class TrainParams:
     embedding_columns: tuple[int, ...] = ()  # high-cardinality hashed cols
     embedding_hash_size: int = 0  # rows per hashed table (0 = disabled)
     embedding_dim: int = 8
+
+    @property
+    def uses_feature_hashing(self) -> bool:
+        """Whether any column's raw float BITS feed a hash (hashed
+        embeddings / wide crosses).  Such columns carry category codes that
+        bfloat16 cannot represent exactly (8-bit mantissa: codes > 256
+        round), so bf16 feature ingest would silently re-bucket them —
+        train/serve skew against the f32-hashing exported scorer."""
+        return (
+            (len(self.embedding_columns) > 0 and self.embedding_hash_size > 0)
+            or self.cross_hash_size > 0
+        )
     # local-update DP: >1 reproduces SAGN's communication window of local
     # steps before the global update (reference: SAGN.py:110-176)
     update_window: int = 1

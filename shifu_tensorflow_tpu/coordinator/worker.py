@@ -419,9 +419,14 @@ def _np_feature_dtype(cfg):
 
 
 def _feature_dtype_for(cfg) -> str:
-    """bf16 runs stream bf16 features: half the cache-slab reads and
-    host->device bytes, same values the model would cast to anyway."""
-    return "bfloat16" if cfg.dtype == "bfloat16" else "float32"
+    """bf16 runs stream bf16 features — half the cache-slab reads and
+    host->device bytes — EXCEPT when any column feeds a hash (embedding /
+    wide-cross models): bucket ids are computed from raw float bits, and
+    bf16 rounding of category codes > 256 would re-bucket them, skewing
+    training against the f32-hashing exported scorer."""
+    if cfg.dtype == "bfloat16" and not cfg.model_config.params.uses_feature_hashing:
+        return "bfloat16"
+    return "float32"
 
 
 def _run_spmd_training(

@@ -243,9 +243,14 @@ def prune_cache(cache_dir: str, max_bytes: int) -> int:
                 version = json.load(f).get("version")
         except (OSError, json.JSONDecodeError):
             version = None
-        if version != CACHE_VERSION:
-            # superseded/corrupt entry: unreadable by lookup, so it would
-            # sit on disk forever — drop it regardless of the budget
+        if version is None or (
+            isinstance(version, int) and version < CACHE_VERSION
+        ):
+            # superseded/corrupt entry: unreadable by this binary's lookup,
+            # so it would sit on disk forever — drop it regardless of the
+            # budget.  NEWER versions are left alone: during a rolling
+            # upgrade two binaries may share a cache_dir, and mutual
+            # eviction would defeat the cache for both.
             for p in paths:
                 try:
                     os.unlink(p)

@@ -167,6 +167,7 @@ def trainer_extras(args, conf: Conf) -> dict:
         )
     return {
         "dtype": dtype,
+        "dtype_name": dtype_name,
         "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
                                        K.DEFAULT_PREFETCH_DEPTH),
     }
@@ -240,6 +241,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
     extras = trainer_extras(args, conf)
+    dtype_name = extras.pop("dtype_name")
     trainer = make_trainer(
         model_config,
         schema.num_features,
@@ -275,10 +277,11 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         with trace_if(args.profile_dir):
             if args.stream:
                 cache_dir = conf.get(K.CACHE_DIR)
-                import jax.numpy as jnp
-
+                # same gate as the worker: hashed columns must see f32 bits
                 feature_dtype = (
-                    "bfloat16" if extras["dtype"] == jnp.bfloat16
+                    "bfloat16"
+                    if dtype_name == "bfloat16"
+                    and not model_config.params.uses_feature_hashing
                     else "float32"
                 )
                 history = trainer.fit_stream(

@@ -251,3 +251,47 @@ def test_bf16_fixed_step_zero_batches_match_dtype():
     bf16 = np.dtype(ml_dtypes.bfloat16)
     out = list(fixed_step_batches(iter([]), 8, 2, 3, x_dtype=bf16))
     assert len(out) == 2 and all(b["x"].dtype == bf16 for b in out)
+
+
+def test_bf16_gated_off_for_hashed_feature_models():
+    """bf16 ingest must not engage when raw float bits feed a hash —
+    bf16-rounded category codes would re-bucket embeddings, skewing
+    training against the f32-hashing exported scorer."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.coordinator.worker import (
+        WorkerConfig,
+        _feature_dtype_for,
+    )
+
+    def cfg(params):
+        mc = ModelConfig.from_json({"train": {"params": {
+            "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+            "ActivationFunc": ["relu"], "LearningRate": 0.1, **params}}})
+        return WorkerConfig(
+            worker_id="w", coordinator_host="h", coordinator_port=1,
+            model_config=mc, schema=SCHEMA, dtype="bfloat16",
+        )
+
+    assert _feature_dtype_for(cfg({})) == "bfloat16"
+    assert _feature_dtype_for(cfg({
+        "EmbeddingColumnNums": [1], "EmbeddingHashSize": 128,
+    })) == "float32"
+    assert _feature_dtype_for(cfg({
+        "ModelType": "wide_deep", "WideColumnNums": [1],
+        "CrossHashSize": 64,
+    })) == "float32"
+
+
+def test_prune_keeps_newer_version_entries(tmp_path):
+    """Rolling upgrades share cache dirs: a NEWER binary's entries must
+    survive this binary's prune (only superseded versions are swept)."""
+    import json as _json
+
+    newer = shard_cache.CACHE_VERSION + 1
+    (tmp_path / "new.meta.json").write_text(
+        _json.dumps({"version": newer, "n_rows": 1, "n_features": 2})
+    )
+    (tmp_path / "new.x.f32").write_bytes(b"\0" * 8)
+    shard_cache.prune_cache(str(tmp_path), max_bytes=10**9)
+    assert (tmp_path / "new.meta.json").exists()
+    assert (tmp_path / "new.x.f32").exists()
