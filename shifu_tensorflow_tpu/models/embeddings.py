@@ -33,9 +33,12 @@ from shifu_tensorflow_tpu.ops import hashing
 hash_to_buckets = hashing.hash_to_buckets
 
 
-# Measured on v5e (4096-row batch, C=5): the one-hot-matmul kernel sweeps
-# the whole table once per lookup (cost ∝ hash_size), so it beats XLA's
-# gather by ~1.3-1.5x for tables up to ~16K rows and loses beyond ~256K.
+# The one-hot-matmul kernel sweeps the whole table once per lookup
+# (cost ∝ hash_size), so it wins for small tables and loses for large
+# ones.  The cutover is MEASURED, not assumed: scripts/
+# bench_pallas_embedding.py sweeps table 4K→256K x batch {4K,16K} on the
+# target chip and writes BENCH_PALLAS_EMBEDDING.json, whose
+# `pallas_wins_up_to_hash_size` field backs this constant.
 PALLAS_MAX_HASH_SIZE = 16384
 
 
