@@ -357,3 +357,51 @@ def test_spmd_sigkill_recovers_via_fleet_restart(psv_dataset, tmp_path):
     # the final model exists and covers the full epoch budget
     ckpt = NpzCheckpointer(ckpt_dir)
     assert ckpt.latest_epoch() == 2
+
+
+def test_spmd_streaming_sigkill_during_cold_cache_build(psv_dataset, tmp_path):
+    """SIGKILL a worker while the fleet is streaming its FIRST epoch — the
+    cold pass that parses text shards and writes binary cache entries.
+    Recovery must (a) not trip over half-written cache temp files (atomic
+    commit: aborted entries are invisible), and (b) finish with the full
+    epoch budget from the shared checkpoint."""
+    mc = _model_config(epochs=3)
+    shards = split_training_data(psv_dataset["root"], 2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    cache_dir = str(tmp_path / "cache")
+    spec = _spec(
+        shards, 2, epochs=3,
+        spare_restarts=1,
+        heartbeat_interval_ms=200,
+        max_missed_heartbeats=5,
+    )
+    submitter = JobSubmitter(
+        spec,
+        _worker_cfg_factory(
+            psv_dataset, mc, ckpt_dir,
+            stream=True, cache_dir=cache_dir,
+        ),
+        launcher="process",
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        kill_injections={"worker-1": 0},
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 1
+    ckpt = NpzCheckpointer(ckpt_dir)
+    assert ckpt.latest_epoch() == 2
+    # the relaunched fleet streams warm where entries committed; whatever
+    # was mid-write at kill time must not have produced a visible entry
+    # without its meta (lookup-able implies complete)
+    import os
+
+    names = os.listdir(cache_dir)
+    keys_with_meta = {n[: -len(".meta.json")] for n in names
+                      if n.endswith(".meta.json")}
+    assert keys_with_meta, "warm epochs should have committed cache entries"
+    for k in keys_with_meta:
+        # a published meta implies its slabs exist (commit renames slabs
+        # FIRST, meta last) — a kill can orphan slabs, never a meta
+        assert any(n.startswith(f"{k}.x.") for n in names), k
+        assert f"{k}.y.f32" in names and f"{k}.w.f32" in names, k
