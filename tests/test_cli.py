@@ -184,3 +184,126 @@ def test_cli_resume_from_checkpoint(
     assert "resuming at epoch 1" in out
     tail = json.loads(out.strip().splitlines()[-1])
     assert tail["epochs_run"] == 2  # only the remaining budget
+
+
+class TestDataCli:
+    def test_build_status_prune_roundtrip(self, psv_dataset, tmp_path, capsys):
+        import json
+
+        from shifu_tensorflow_tpu.data.__main__ import main as data_main
+        from shifu_tensorflow_tpu.data.dataset import ShardStream
+        from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+        cache_dir = str(tmp_path / "cache")
+        cols = ",".join(str(c) for c in psv_dataset["feature_cols"])
+        rc = data_main([
+            "build", "--training-data-path", psv_dataset["root"],
+            "--cache-dir", cache_dir, "--feature-columns", cols,
+            "--target-column", str(psv_dataset["target_col"]),
+            "--weight-column", str(psv_dataset["weight_col"]),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        summary = json.loads(out[-1])
+        assert summary["rows"] == psv_dataset["n_rows"]
+
+        # a training stream over the SAME schema/salt hits the prebuilt
+        # entries — including a valid split (hashes were stored)
+        schema = RecordSchema(
+            feature_columns=tuple(psv_dataset["feature_cols"]),
+            target_column=psv_dataset["target_col"],
+            weight_column=psv_dataset["weight_col"],
+        )
+        ref = [b["x"].copy() for b in ShardStream(
+            psv_dataset["paths"], schema, 128, valid_rate=0.2)]
+        warm = [b["x"].copy() for b in ShardStream(
+            psv_dataset["paths"], schema, 128, valid_rate=0.2,
+            cache_dir=cache_dir)]
+        assert len(ref) == len(warm)
+        import numpy as np
+
+        for r, w in zip(ref, warm):
+            np.testing.assert_array_equal(r, w)
+
+        rc = data_main(["status", "--cache-dir", cache_dir])
+        assert rc == 0
+        status = json.loads(capsys.readouterr().out.strip())
+        assert status["entries"] == len(psv_dataset["paths"])
+        assert status["bytes"] > 0
+
+        rc = data_main(["prune", "--cache-dir", cache_dir,
+                        "--max-bytes", "1"])
+        assert rc == 0
+        removed = json.loads(capsys.readouterr().out.strip())
+        assert removed["removed"] == len(psv_dataset["paths"])
+
+    def test_build_fails_nonzero_when_nothing_caches(self, psv_dataset,
+                                                     tmp_path, capsys,
+                                                     monkeypatch):
+        import json
+
+        from shifu_tensorflow_tpu.data import cache as shard_cache
+        from shifu_tensorflow_tpu.data.__main__ import main as data_main
+
+        monkeypatch.setattr(shard_cache, "cache_key",
+                            lambda *a, **k: None)
+        cols = ",".join(str(c) for c in psv_dataset["feature_cols"])
+        rc = data_main([
+            "build", "--training-data-path", psv_dataset["root"],
+            "--cache-dir", str(tmp_path / "c"), "--feature-columns", cols,
+        ])
+        assert rc == 1
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["cached_files"] == 0
+
+    def test_build_with_column_config_zscale_matches_training_keys(
+            self, tmp_path, capsys):
+        import gzip
+        import json
+
+        import numpy as np
+
+        from shifu_tensorflow_tpu.config.model_config import ColumnConfig
+        from shifu_tensorflow_tpu.data.__main__ import main as data_main
+        from shifu_tensorflow_tpu.data.dataset import ShardStream
+
+        rng = np.random.default_rng(0)
+        p = tmp_path / "s.gz"
+        with gzip.open(p, "wt") as f:
+            for _ in range(300):
+                x = rng.normal(size=2)
+                f.write(f"1|{x[0]:.5f}|{x[1]:.5f}|1.0\n")
+        cc_path = tmp_path / "ColumnConfig.json"
+        cc_path.write_text(json.dumps([
+            {"columnNum": 0, "columnName": "t", "finalSelect": False},
+            {"columnNum": 1, "columnName": "a", "finalSelect": True,
+             "columnStats": {"mean": 0.1, "stdDev": 1.2}},
+            {"columnNum": 2, "columnName": "b", "finalSelect": True,
+             "columnStats": {"mean": -0.3, "stdDev": 0.8}},
+            {"columnNum": 3, "columnName": "w", "finalSelect": False},
+        ]))
+        cache_dir = str(tmp_path / "cache")
+        rc = data_main([
+            "build", "--training-data-path", str(p),
+            "--cache-dir", cache_dir, "--column-config", str(cc_path),
+            "--zscale", "--target-column", "0", "--weight-column", "3",
+            "--salt", "7",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        # the training-side schema (same stats, same salt) must HIT
+        cc = ColumnConfig.load(str(cc_path))
+        from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+        features = tuple(cc.selected_column_nums)
+        means, stds = cc.zscale_stats(features)
+        schema = RecordSchema(feature_columns=features, target_column=0,
+                              weight_column=3).with_zscale(means, stds)
+        from shifu_tensorflow_tpu.data import cache as shard_cache
+
+        assert shard_cache.lookup(cache_dir, str(p), schema, 7) is not None
+        warm = [b["x"].copy() for b in ShardStream(
+            [str(p)], schema, 64, valid_rate=0.2, salt=7,
+            cache_dir=cache_dir)]
+        assert warm
