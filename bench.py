@@ -78,21 +78,32 @@ def _model_config():
 # --------------------------------------------------------------- measurement
 
 
-def bench_step_rows_per_sec() -> float:
+def bench_step_rows_per_sec(dtype: str = "float32",
+                            measure_seconds: float | None = None) -> float:
     """Steady-state jitted step throughput, device-resident batch."""
     import jax
+    import jax.numpy as jnp
 
     from shifu_tensorflow_tpu.parallel.mesh import make_mesh
     from shifu_tensorflow_tpu.train.trainer import Trainer
 
+    if measure_seconds is None:
+        measure_seconds = MEASURE_SECONDS
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown bench dtype {dtype!r}")
     # shard the batch over every local chip so the per-chip division below
     # is honest on multi-chip hosts; single chip gets a 1-device mesh
     mesh = make_mesh("data:-1")
-    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
+    model_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh,
+                      dtype=model_dtype)
     rng = np.random.default_rng(0)
     rows = trainer.align_batch_size(BATCH)
+    x = rng.normal(size=(rows, NUM_FEATURES)).astype(np.float32)
+    if dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
     batch = {
-        "x": rng.normal(size=(rows, NUM_FEATURES)).astype(np.float32),
+        "x": x,
         "y": (rng.random((rows, 1)) < 0.3).astype(np.float32),
         "w": np.ones((rows, 1), np.float32),
     }
@@ -110,7 +121,7 @@ def bench_step_rows_per_sec() -> float:
         n_steps += 1
         if n_steps % 50 == 0:
             jax.block_until_ready(loss)
-            if time.perf_counter() - t0 >= MEASURE_SECONDS:
+            if time.perf_counter() - t0 >= measure_seconds:
                 break
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
@@ -171,7 +182,11 @@ def bench_stream_rows_per_sec() -> dict:
 
     mesh = make_mesh("data:-1")
     trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
-    batch_size = trainer.align_batch_size(STREAM_BATCH)
+    # small-config runs (CPU fallback) must still see several measured
+    # batches after the warmup one, or the rate degenerates to 0
+    batch_size = trainer.align_batch_size(
+        max(1024, min(STREAM_BATCH, STREAM_ROWS // 8))
+    )
     schema = RecordSchema(
         feature_columns=tuple(range(1, NUM_FEATURES + 1)),
         target_column=0,
@@ -338,6 +353,15 @@ def run_measurements() -> dict:
         "baseline": "measured reference-style feeddict numpy loop, same host",
         "baseline_rows_per_sec": round(ref, 1),
     }
+    try:
+        # MXU-native variant: bf16 params + bf16 features (the dtype the
+        # brief's hardware guidance recommends); reported as context, the
+        # primary stays float32 for cross-round comparability
+        result["value_bf16"] = round(
+            bench_step_rows_per_sec("bfloat16", MEASURE_SECONDS / 2), 1
+        )
+    except Exception as e:
+        result["value_bf16_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(bench_stream_rows_per_sec())
     except Exception as e:  # streaming must not void the primary number
