@@ -50,6 +50,7 @@ STREAM_READERS = int(os.environ.get("BENCH_STREAM_READERS", 4))
 # ingest-bound phases run larger device batches: host->device transfer has
 # a fixed per-call latency that 16K-row batches leave unamortized
 STREAM_BATCH = int(os.environ.get("BENCH_STREAM_BATCH", 65536))
+SCAN_STEPS = int(os.environ.get("BENCH_SCAN_STEPS", 16))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
 TPU_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 900.0))
 CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT", 900.0))
@@ -127,6 +128,45 @@ def bench_step_rows_per_sec(dtype: str = "float32",
     elapsed = time.perf_counter() - t0
     rows_per_sec = n_steps * rows / elapsed
     return rows_per_sec / jax.local_device_count()
+
+
+def bench_scan_rows_per_sec(measure_seconds: float) -> float:
+    """Chunked-scan training throughput: SCAN_STEPS distinct device-resident
+    batches per lax.scan dispatch (train/trainer.py make_scan_epoch) —
+    dispatch latency amortized the XLA-idiomatic way."""
+    import jax
+
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    S = SCAN_STEPS
+    mesh = make_mesh("data:-1")
+    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh, scan_steps=S)
+    rng = np.random.default_rng(0)
+    rows = trainer.align_batch_size(BATCH)
+    stacked = {
+        "x": rng.normal(size=(S, rows, NUM_FEATURES)).astype(np.float32),
+        "y": (rng.random((S, rows, 1)) < 0.3).astype(np.float32),
+        "w": np.ones((S, rows, 1), np.float32),
+    }
+    dev = trainer._put_stacked(stacked)
+    scan = trainer._scan_epoch
+    state = trainer.state
+    for _ in range(2):
+        state, losses = scan(state, dev)
+    jax.block_until_ready(losses)
+    n_calls = 0
+    t0 = time.perf_counter()
+    while True:
+        state, losses = scan(state, dev)
+        n_calls += 1
+        if n_calls % 5 == 0:
+            jax.block_until_ready(losses)
+            if time.perf_counter() - t0 >= measure_seconds:
+                break
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+    return n_calls * S * rows / elapsed / jax.local_device_count()
 
 
 def _write_stream_shards(root: str, total_rows: int, n_shards: int) -> list[str]:
@@ -362,6 +402,15 @@ def run_measurements() -> dict:
         )
     except Exception as e:
         result["value_bf16_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # chunked-scan path (shifu.tpu.scan-steps): SCAN_STEPS updates per
+        # dispatch; shows the dispatch-amortized ceiling
+        result["value_scan"] = round(
+            bench_scan_rows_per_sec(MEASURE_SECONDS / 2), 1
+        )
+        result["scan_steps"] = SCAN_STEPS
+    except Exception as e:
+        result["value_scan_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(bench_stream_rows_per_sec())
     except Exception as e:  # streaming must not void the primary number

@@ -93,6 +93,11 @@ DTYPE = TPU_PREFIX + "dtype"
 DEFAULT_DTYPE = "float32"  # tabular nets are tiny; bf16 is opt-in
 PREFETCH_DEPTH = TPU_PREFIX + "prefetch-depth"
 DEFAULT_PREFETCH_DEPTH = 2
+# chunked-scan epochs: batches per lax.scan dispatch (1 = per-step path).
+# Amortizes per-step dispatch latency; worth raising when steps are much
+# shorter than dispatch (small models, tunneled/driven-from-Python hosts)
+SCAN_STEPS = TPU_PREFIX + "scan-steps"
+DEFAULT_SCAN_STEPS = 1
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
 DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
 # binary shard cache directory (data/cache.py): parse text shards once,

@@ -75,6 +75,8 @@ class WorkerConfig:
     n_readers: int | None = None
     # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
     prefetch_depth: int = 2
+    # batches per lax.scan dispatch (conf key shifu.tpu.scan-steps)
+    scan_steps: int = 1
     # binary shard cache directory (data/cache.py); None = no caching
     cache_dir: str | None = None
 
@@ -90,7 +92,7 @@ class WorkerConfig:
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
-                "cache_dir",
+                "scan_steps", "cache_dir",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -270,6 +272,7 @@ def run_worker(cfg: WorkerConfig, *,
             seed=cfg.seed,
             topology=topology,
             prefetch_depth=cfg.prefetch_depth,
+            scan_steps=cfg.scan_steps,
             **extra,
         )
 

@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="compute dtype (default float32; bfloat16 feeds the "
                         "MXU at full rate on TPU)")
+    p.add_argument("--scan-steps", type=int, default=None,
+                   help="batches per lax.scan dispatch (default 1 = one "
+                        "dispatch per step; raise to amortize dispatch "
+                        "latency when steps are short)")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -170,7 +174,17 @@ def trainer_extras(args, conf: Conf) -> dict:
         "dtype_name": dtype_name,
         "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
                                        K.DEFAULT_PREFETCH_DEPTH),
+        "scan_steps": resolve_scan_steps(args, conf),
     }
+
+
+def resolve_scan_steps(args, conf: Conf) -> int:
+    """CLI flag wins when given (None = unset, so an explicit
+    ``--scan-steps 0/1`` forces the per-step path even if the conf raises
+    the key); then the conf key; then the default."""
+    if getattr(args, "scan_steps", None) is not None:
+        return args.scan_steps
+    return conf.get_int(K.SCAN_STEPS, K.DEFAULT_SCAN_STEPS)
 
 
 def job_spec_kwargs(conf: Conf) -> dict:
@@ -404,6 +418,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             n_readers=args.readers,
             prefetch_depth=conf.get_int(K.PREFETCH_DEPTH,
                                         K.DEFAULT_PREFETCH_DEPTH),
+            scan_steps=resolve_scan_steps(args, conf),
             cache_dir=conf.get(K.CACHE_DIR),
         )
 

@@ -165,6 +165,11 @@ class SAGNTrainer(Trainer):
         **kw,
     ):
         super().__init__(model_config, num_features, **kw)
+        # SAGN's window step already batches update_window microbatches per
+        # dispatch — the scan_steps chunking would compose confusingly with
+        # it for no additional amortization; disable the inherited path
+        self.scan_steps = 1
+        self._scan_epoch = None
         p = model_config.params
         self.update_window = max(int(p.update_window), 1)
         local_name = local_optimizer or p.optimizer

@@ -31,7 +31,12 @@ import numpy as np
 from flax.training import train_state
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
-from shifu_tensorflow_tpu.data.dataset import Batch, InMemoryDataset, prefetch_to_device
+from shifu_tensorflow_tpu.data.dataset import (
+    Batch,
+    InMemoryDataset,
+    _zero_batch,
+    prefetch_to_device,
+)
 from shifu_tensorflow_tpu.models.factory import build_model
 from shifu_tensorflow_tpu.ops import metrics as M
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
@@ -86,18 +91,10 @@ def donation_is_safe() -> bool:
     return "axon" not in version.lower()
 
 
-def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
-                    donate: bool | None = None):
-    """Build the jitted SPMD train step.
-
-    state is donated (buffers reused in place) where safe — see
-    donation_is_safe; with a sharded batch the grad all-reduce is inserted
-    by XLA — no explicit psum needed under jit (shard_map users would
-    write it; we stay at the jit level so the same step runs single-chip
-    and multi-chip).
-    """
-    if donate is None:
-        donate = donation_is_safe()
+def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0):
+    """The un-jitted (state, batch) -> (state, loss) transition — jitted
+    per-batch by make_train_step, lax.scan'ed over stacked batches by
+    make_scan_epoch.  One definition, so the two paths cannot drift."""
     loss_fn = get_loss(loss_name)
 
     def compute_loss(params, batch):
@@ -107,7 +104,6 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
             loss = loss + l2_penalty(params, l2)
         return loss
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, batch: Batch):
         loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
         # An all-padding (weight-0) batch must be a true no-op: the data
@@ -128,6 +124,47 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
         return state, jnp.where(has_rows, loss, jnp.nan)
 
     return train_step
+
+
+def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
+                    donate: bool | None = None):
+    """Build the jitted SPMD train step.
+
+    state is donated (buffers reused in place) where safe — see
+    donation_is_safe; with a sharded batch the grad all-reduce is inserted
+    by XLA — no explicit psum needed under jit (shard_map users would
+    write it; we stay at the jit level so the same step runs single-chip
+    and multi-chip).
+    """
+    if donate is None:
+        donate = donation_is_safe()
+    body = make_train_step_body(apply_fn, loss_name, l2)
+    return partial(jax.jit, donate_argnums=(0,) if donate else ())(body)
+
+
+def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
+                    donate: bool | None = None):
+    """Compiled multi-step run: lax.scan the train-step body over a stacked
+    chunk ``{"x": (S,B,F), "y": (S,B,1), "w": (S,B,1)}`` — S sequential
+    optimizer updates in ONE dispatch.
+
+    The per-step path pays one host→device dispatch per update; on a
+    dispatch-latency-dominated link (the tunneled bench chip; any
+    Python-driven loop at small step times) that overhead bounds
+    throughput.  Scanning is the XLA-idiomatic fix — data-independent
+    control flow compiled once, identical update semantics (same body, same
+    order).  SURVEY.md §3.4's hot-loop finding, taken one step further
+    than per-batch jit.
+    """
+    if donate is None:
+        donate = donation_is_safe()
+    body = make_train_step_body(apply_fn, loss_name, l2)
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def scan_epoch(state: TrainState, stacked: Batch):
+        return jax.lax.scan(body, state, stacked)
+
+    return scan_epoch
 
 
 def make_eval_step(apply_fn, loss_name: str = "mse"):
@@ -160,6 +197,7 @@ class Trainer:
         dtype=jnp.float32,
         topology: "Any | None" = None,
         prefetch_depth: int = 2,
+        scan_steps: int = 1,
     ):
         self.model_config = model_config
         self.num_features = num_features
@@ -208,9 +246,18 @@ class Trainer:
 
             self.state = shard_params(self.state, mesh)
             self._batch_sharding = batch_sharding(mesh)
+            # stacked chunks (S, B, ...) shard the BATCH dim (1); the scan
+            # dim stays replicated
+            from jax.sharding import NamedSharding, PartitionSpec
+            from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+            self._stacked_sharding = NamedSharding(
+                mesh, PartitionSpec(None, DATA_AXIS)
+            )
             self._data_axis = data_axis_size(mesh)
         else:
             self._batch_sharding = None
+            self._stacked_sharding = None
             self._data_axis = 1
         # rows each *process* must supply per batch divide by its local
         # share of the data axis (single-process: the whole axis)
@@ -224,6 +271,16 @@ class Trainer:
             self.model.apply, loss, model_config.params.l2_reg
         )
         self._eval_step = make_eval_step(self.model.apply, loss)
+        # chunked-scan epochs (conf key shifu.tpu.scan-steps): accumulate
+        # this many batches and run them as one lax.scan dispatch; 1 = the
+        # plain per-step path
+        self.scan_steps = max(1, int(scan_steps))
+        self._scan_epoch = (
+            make_scan_epoch(self.model.apply, loss,
+                            model_config.params.l2_reg)
+            if self.scan_steps > 1
+            else None
+        )
         # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
         self.prefetch_depth = max(1, int(prefetch_depth))
         # opt-in per-step timing (utils/profiling.StepTimer); None = free
@@ -261,6 +318,18 @@ class Trainer:
             for k, v in batch.items()
         }
 
+    def _put_stacked(self, stacked: Batch) -> Batch:
+        """Device-place one (S, B, ...) chunk; batch dim sharded."""
+        if self._cross_process:
+            from shifu_tensorflow_tpu.parallel.distributed import (
+                put_process_local,
+            )
+
+            return put_process_local(stacked, self._stacked_sharding)
+        if self._stacked_sharding is not None:
+            return jax.device_put(stacked, self._stacked_sharding)
+        return jax.device_put(stacked)
+
     def align_batch_size(self, batch_size: int) -> int:
         """Round a requested (per-process) batch size up to a divisible one."""
         a = self._local_data_divisor
@@ -269,6 +338,8 @@ class Trainer:
     # ---- core loops ----
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count)."""
+        if self._scan_epoch is not None:
+            return self._train_epoch_scan(batches)
         losses = []
         for batch in prefetch_to_device(batches, put=self._put,
                                         depth=self.prefetch_depth):
@@ -285,6 +356,88 @@ class Trainer:
         return (
             float(np.mean(real)) if real.size else float("nan"),
             len(losses),
+        )
+
+    def _train_epoch_scan(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        """Chunked-scan epoch: K batches stacked per device dispatch.
+
+        The last chunk pads with zero-weight no-op batches (exact no-ops by
+        the train-step body's has_rows gate) so exactly ONE scan shape ever
+        compiles.  Update semantics are identical to the per-step path —
+        same body, same order; only the dispatch granularity changes.
+        Cross-process SPMD stays in lockstep because fixed_step_batches
+        already guarantees identical per-process batch counts, hence
+        identical chunk counts and padding.
+        """
+        K = self.scan_steps
+        n_real = 0
+        batch_rows = 0
+
+        def _pad_rows(b: Batch, rows: int) -> Batch:
+            """Zero-weight-pad a batch up to ``rows`` — free under the
+            nonzero-weight loss normalization, same as _pad_for_mesh."""
+            n = b["x"].shape[0]
+            if n == rows:
+                return b
+            return {
+                k: np.concatenate(
+                    [np.asarray(v),
+                     np.zeros((rows - n,) + v.shape[1:],
+                              np.asarray(v).dtype)]
+                )
+                for k, v in b.items()
+            }
+
+        def _emit(buf: list[Batch]) -> Batch:
+            nonlocal batch_rows
+            # one stacked shape per chunk: every batch padded to the
+            # chunk's max row count, itself aligned to the mesh divisor —
+            # the scan-path equivalent of the per-step path's per-batch
+            # _pad_for_mesh (variable/indivisible batch sizes must not
+            # become a crash the moment scan_steps is raised)
+            rows = self.align_batch_size(
+                max(b["x"].shape[0] for b in buf)
+            )
+            batch_rows = rows
+            if len(buf) < K:
+                pad = _zero_batch(rows, buf[0]["x"].shape[1],
+                                  buf[0]["x"].dtype)
+                buf = buf + [pad] * (K - len(buf))
+            return {
+                k: np.stack([np.asarray(_pad_rows(c, rows)[k]) for c in buf])
+                for k in buf[0]
+            }
+
+        def chunk_iter():
+            nonlocal n_real
+            buf: list[Batch] = []
+            for b in batches:
+                buf.append(b)
+                if len(buf) == K:
+                    n_real += K
+                    yield _emit(buf)
+                    buf = []
+            if buf:
+                n_real += len(buf)
+                yield _emit(buf)
+
+        losses = []  # (K,) device arrays, chunk-pad entries NaN
+        for stacked in prefetch_to_device(
+            chunk_iter(), put=self._put_stacked, depth=self.prefetch_depth
+        ):
+            self.state, chunk_losses = self._scan_epoch(self.state, stacked)
+            losses.append(chunk_losses)
+            if self.step_timer is not None:
+                self.step_timer.step(chunk_losses, rows=K * batch_rows)
+        if not losses:
+            return float("nan"), 0
+        vals = np.concatenate(
+            [np.atleast_1d(np.asarray(v)) for v in jax.device_get(losses)]
+        )
+        real = vals[~np.isnan(vals)]
+        return (
+            float(np.mean(real)) if real.size else float("nan"),
+            n_real,
         )
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:

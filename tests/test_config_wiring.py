@@ -219,3 +219,26 @@ def test_prune_drops_superseded_version_entries(tmp_path):
     shard_cache.prune_cache(str(tmp_path), max_bytes=10**9)
     assert not (tmp_path / "old.meta.json").exists()
     assert not (tmp_path / "old.x.f32").exists()
+
+
+def test_scan_steps_key_reaches_trainer():
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    extras = trainer_extras(_args(), _conf({K.SCAN_STEPS: 8}))
+    assert extras["scan_steps"] == 8
+    # CLI flag wins over conf
+    extras = trainer_extras(_args(["--scan-steps", "2"]),
+                            _conf({K.SCAN_STEPS: 8}))
+    assert extras["scan_steps"] == 2
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1), scan_steps=8)
+    assert trainer.scan_steps == 8
+    assert trainer._scan_epoch is not None
+    # default stays on the per-step path
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1))
+    assert trainer.scan_steps == 1 and trainer._scan_epoch is None

@@ -217,3 +217,105 @@ def test_checkpoint_cross_mesh_restore(psv_dataset, tmp_path):
     np.testing.assert_allclose(
         sharded2.predict(x), plain.predict(x), rtol=1e-5, atol=1e-6
     )
+
+
+# ---- chunked-scan epochs (shifu.tpu.scan-steps) ----
+
+def test_scan_epoch_matches_per_step(psv_dataset):
+    """scan_steps=K runs the same body in the same order as the per-step
+    path — final params and reported epoch losses must match."""
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=2, opt="adam", lr=0.05)
+
+    t_step = Trainer(mc, ds.schema.num_features, seed=3)
+    h_step = t_step.fit(ds, batch_size=64)
+
+    t_scan = Trainer(mc, ds.schema.num_features, seed=3, scan_steps=4)
+    h_scan = t_scan.fit(ds, batch_size=64)
+
+    a = jax.device_get(t_step.state.params["shifu_output_0"]["kernel"])
+    b = jax.device_get(t_scan.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    for hs, hc in zip(h_step, h_scan):
+        assert np.isclose(hs.training_loss, hc.training_loss,
+                          rtol=1e-5, atol=1e-6)
+        assert hs.global_step == hc.global_step
+
+
+def test_scan_epoch_tail_padding_counts():
+    """A batch count not divisible by K pads the last chunk with no-op
+    batches: the reported batch count and global step must count only the
+    real batches, and the loss mean must ignore the padding."""
+    mc = _mc(epochs=1)
+    rng_ = np.random.default_rng(5)
+    trainer = Trainer(mc, 6, seed=1, scan_steps=4)
+    batches = [
+        {
+            "x": rng_.normal(size=(32, 6)).astype(np.float32),
+            "y": (rng_.random((32, 1)) < 0.4).astype(np.float32),
+            "w": np.ones((32, 1), np.float32),
+        }
+        for _ in range(7)  # 1 full chunk + tail of 3
+    ]
+    loss, n = trainer.train_epoch(iter(batches))
+    assert n == 7
+    assert int(jax.device_get(trainer.state.step)) == 7
+    assert np.isfinite(loss)
+
+    # parity with the per-step path on the identical batch sequence
+    t_ref = Trainer(mc, 6, seed=1)
+    loss_ref, n_ref = t_ref.train_epoch(iter(batches))
+    assert n_ref == 7
+    np.testing.assert_allclose(loss, loss_ref, rtol=1e-5, atol=1e-6)
+    a = jax.device_get(trainer.state.params["shifu_output_0"]["kernel"])
+    b = jax.device_get(t_ref.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_scan_epoch_on_mesh_matches_per_step(psv_dataset):
+    """Stacked chunks shard the batch dim over the data axis; mesh-sharded
+    scan training equals mesh-sharded per-step training."""
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=1, opt="sgd", lr=0.1)
+
+    t_step = Trainer(mc, ds.schema.num_features, seed=7,
+                     mesh=make_mesh("data:8"))
+    t_step.fit(ds, batch_size=64)
+
+    t_scan = Trainer(mc, ds.schema.num_features, seed=7,
+                     mesh=make_mesh("data:8"), scan_steps=3)
+    t_scan.fit(ds, batch_size=64)
+
+    a = jax.device_get(t_step.state.params["shifu_output_0"]["kernel"])
+    b = jax.device_get(t_scan.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_scan_epoch_indivisible_and_ragged_batches(psv_dataset):
+    """Review regression: the scan path must accept exactly what the
+    per-step path accepts — batch sizes that don't divide the data axis
+    (padded via align_batch_size, like _pad_for_mesh) and a short final
+    batch (padded to the chunk's row count)."""
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=1)
+
+    # 100-row batches on an 8-device mesh, scan chunks of 3
+    mesh = make_mesh("data:8")
+    t = Trainer(mc, ds.schema.num_features, mesh=mesh, scan_steps=3)
+    history = t.fit(ds, batch_size=100)
+    assert np.isfinite(history[0].training_loss)
+
+    # ragged iterator: mixed 32/20-row batches, no mesh
+    rng_ = np.random.default_rng(9)
+
+    def mk(n):
+        return {
+            "x": rng_.normal(size=(n, ds.schema.num_features)).astype(np.float32),
+            "y": (rng_.random((n, 1)) < 0.4).astype(np.float32),
+            "w": np.ones((n, 1), np.float32),
+        }
+
+    t2 = Trainer(mc, ds.schema.num_features, scan_steps=4)
+    loss, n = t2.train_epoch(iter([mk(32), mk(32), mk(20), mk(32), mk(8)]))
+    assert n == 5 and np.isfinite(loss)
+    assert int(jax.device_get(t2.state.step)) == 5
