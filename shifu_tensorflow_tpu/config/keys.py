@@ -100,6 +100,11 @@ SCAN_STEPS = TPU_PREFIX + "scan-steps"
 DEFAULT_SCAN_STEPS = 1
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
 DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
+# background-thread checkpoint writes for the flat-file (SPMD) path: the
+# epoch loop pays only the device->host fetch, the (possibly remote) file
+# write overlaps the next epoch.  The orbax path is already async.
+ASYNC_CHECKPOINT = TPU_PREFIX + "async-checkpoint"
+DEFAULT_ASYNC_CHECKPOINT = False
 # binary shard cache directory (data/cache.py): parse text shards once,
 # stream later epochs from memory-mapped finalized tensors
 CACHE_DIR = TPU_PREFIX + "cache-dir"

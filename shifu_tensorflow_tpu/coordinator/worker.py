@@ -77,6 +77,8 @@ class WorkerConfig:
     prefetch_depth: int = 2
     # batches per lax.scan dispatch (conf key shifu.tpu.scan-steps)
     scan_steps: int = 1
+    # background checkpoint writes (conf key shifu.tpu.async-checkpoint)
+    async_checkpoint: bool = False
     # binary shard cache directory (data/cache.py); None = no caching
     cache_dir: str | None = None
 
@@ -92,7 +94,7 @@ class WorkerConfig:
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
-                "scan_steps", "cache_dir",
+                "scan_steps", "async_checkpoint", "cache_dir",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -279,10 +281,17 @@ def run_worker(cfg: WorkerConfig, *,
         if cfg.checkpoint_dir:
             # SPMD uses the flat-file checkpointer: orbax's internal
             # cross-process barriers deadlock under chief-writes/all-read
-            ckpt_cls = NpzCheckpointer if spmd else Checkpointer
-            checkpointer = ckpt_cls(
-                cfg.checkpoint_dir, every_epochs=cfg.checkpoint_every_epochs
-            )
+            if spmd:
+                checkpointer = NpzCheckpointer(
+                    cfg.checkpoint_dir,
+                    every_epochs=cfg.checkpoint_every_epochs,
+                    async_save=cfg.async_checkpoint,
+                )
+            else:
+                checkpointer = Checkpointer(
+                    cfg.checkpoint_dir,
+                    every_epochs=cfg.checkpoint_every_epochs,
+                )
 
         if spmd:
             exit_code = _run_spmd_training(
@@ -412,6 +421,12 @@ def _run_local_training(
             checkpointer=save_ckpt,
             start_epoch=start_epoch,
         )
+    if save_ckpt is not None:
+        # surface a failed background write of the FINAL checkpoint here,
+        # on the success path — run_worker's cleanup close() swallows
+        # exceptions, so without this the job would report success with
+        # the checkpoint missing
+        save_ckpt.wait()
     return 0
 
 
@@ -545,6 +560,8 @@ def _run_spmd_training(
         checkpointer=checkpointer if worker_index == 0 else None,
         start_epoch=start_epoch,
     )
+    if worker_index == 0 and checkpointer is not None:
+        checkpointer.wait()  # see _run_local_training: no silent ckpt loss
     return 0
 
 

@@ -178,6 +178,20 @@ def trainer_extras(args, conf: Conf) -> dict:
     }
 
 
+def worker_runtime_kwargs(args, conf: Conf) -> dict:
+    """WorkerConfig runtime fields resolved through the conf layer — the
+    run_multi analogue of trainer_extras, extracted so the wiring tests can
+    pin each key to the field it drives (no dead keys)."""
+    return {
+        "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
+                                       K.DEFAULT_PREFETCH_DEPTH),
+        "scan_steps": resolve_scan_steps(args, conf),
+        "async_checkpoint": conf.get_bool(K.ASYNC_CHECKPOINT,
+                                          K.DEFAULT_ASYNC_CHECKPOINT),
+        "cache_dir": conf.get(K.CACHE_DIR),
+    }
+
+
 def resolve_scan_steps(args, conf: Conf) -> int:
     """CLI flag wins when given (None = unset, so an explicit
     ``--scan-steps 0/1`` forces the per-step path even if the conf raises
@@ -416,10 +430,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             mesh_spec=conf.get(K.MESH_SHAPE),
             stream=bool(args.stream),
             n_readers=args.readers,
-            prefetch_depth=conf.get_int(K.PREFETCH_DEPTH,
-                                        K.DEFAULT_PREFETCH_DEPTH),
-            scan_steps=resolve_scan_steps(args, conf),
-            cache_dir=conf.get(K.CACHE_DIR),
+            **worker_runtime_kwargs(args, conf),
         )
 
     submitter = JobSubmitter(spec, make_cfg, launcher=args.launcher)

@@ -68,6 +68,14 @@ class NpzCheckpointer:
     workers can all restore the *agreed* epoch (the coordinator's sync_plan
     takes the min over workers' visible checkpoints, guarding the race where
     the chief saved between two workers' directory listings).
+
+    ``async_save=True`` (conf key shifu.tpu.async-checkpoint) moves the
+    file write to a background thread: the epoch loop pays only the
+    device→host fetch (which must happen inline — the very next train step
+    may donate the state's device buffers) while a remote-filesystem write
+    proceeds under it.  Write failures surface on the next save/wait/close,
+    never silently.  Orbax's manager (the non-SPMD path) already saves
+    asynchronously; this brings the flat-file path to parity.
     """
 
     _PREFIX = "ckpt-"
@@ -79,6 +87,7 @@ class NpzCheckpointer:
         *,
         every_epochs: int = 1,
         max_to_keep: int = 3,
+        async_save: bool = False,
     ):
         # IO goes through the fs seam, so the directory may live on any
         # registered scheme (hdfs://, gs://) — the reference checkpointed
@@ -88,6 +97,16 @@ class NpzCheckpointer:
         self.directory = directory
         self.every_epochs = max(1, int(every_epochs))
         self.max_to_keep = max(1, int(max_to_keep))
+        self._executor = None
+        self._pending: list = []
+        if async_save:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # one thread: writes stay ordered (epoch N publishes before
+            # N+1), so latest_epoch never goes backwards mid-run
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="npz-ckpt"
+            )
         fs.mkdirs(self.directory)
 
     def _path(self, epoch: int) -> str:
@@ -125,8 +144,23 @@ class NpzCheckpointer:
              "step": state.step}
         )
         leaves = jax.tree_util.tree_leaves(tree)
+        # the host fetch happens HERE, in the caller's thread: after save()
+        # returns the trainer's next step may donate these device buffers
         arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
                   for i, x in enumerate(leaves)}
+        if self._executor is None:
+            self._write(epoch, arrays)
+            return
+        # at most ONE write in flight (orbax behavior): each pending future
+        # pins a full host copy of params+opt_state, so an unbounded queue
+        # behind a stalled remote filesystem grows by a checkpoint per
+        # epoch until OOM — blocking here bounds it at two copies
+        self._reap_pending(block=True)
+        self._pending.append(self._executor.submit(self._write, epoch, arrays))
+
+    def _write(self, epoch: int, arrays: dict) -> None:
+        import numpy as np
+
         tmp = self._path(epoch) + f".tmp.{os.getpid()}"
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, **arrays)
@@ -136,6 +170,34 @@ class NpzCheckpointer:
                 fs.delete(self._path(old))
             except OSError:
                 pass
+
+    def _reap_pending(self, block: bool) -> None:
+        """Collect finished background writes; re-raise the first failure
+        (a checkpoint that silently never landed would turn the next
+        recovery into data loss).  A consumed future leaves _pending even
+        when it raises — repeated wait()/close() must not re-raise the
+        same failure forever."""
+        pending, self._pending = self._pending, []
+        try:
+            for i, fut in enumerate(pending):
+                if block or fut.done():
+                    fut.result()  # raises if the write failed
+                else:
+                    self._pending.append(fut)
+        except BaseException:
+            # keep the not-yet-inspected tail; the raising future is dropped
+            self._pending.extend(pending[i + 1:])
+            raise
+
+    def wait(self) -> None:
+        self._reap_pending(block=True)
+
+    def close(self) -> None:
+        try:
+            self._reap_pending(block=True)
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
 
     def _restore_tree(self, epoch: int, template_state):
         import numpy as np
@@ -174,19 +236,15 @@ class NpzCheckpointer:
 
     def restore_epoch(self, epoch: int, template_state):
         """Restore a specific epoch; returns (state, next_epoch_to_run)."""
+        self.wait()  # a still-in-flight save of this very epoch must land
         return self._restore_tree(epoch, template_state), epoch + 1
 
     def restore_latest(self, template_state):
+        self.wait()
         latest = self.latest_epoch()
         if latest is None:
             return None, 0
         return self._restore_tree(latest, template_state), latest + 1
-
-    def wait(self) -> None:  # saves are synchronous
-        pass
-
-    def close(self) -> None:
-        pass
 
     def __enter__(self):
         return self

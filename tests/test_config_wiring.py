@@ -242,3 +242,25 @@ def test_scan_steps_key_reaches_trainer():
     # default stays on the per-step path
     trainer = make_trainer(mc, 2, feature_columns=(0, 1))
     assert trainer.scan_steps == 1 and trainer._scan_epoch is None
+
+
+def test_async_checkpoint_key_reaches_worker_config():
+    """shifu.tpu.async-checkpoint drives WorkerConfig.async_checkpoint via
+    the run_multi field resolution (worker_runtime_kwargs) and lands in
+    NpzCheckpointer's async machinery."""
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.train.__main__ import worker_runtime_kwargs
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+    kw = worker_runtime_kwargs(_args(), _conf({K.ASYNC_CHECKPOINT: "true"}))
+    assert kw["async_checkpoint"] is True
+    kw = worker_runtime_kwargs(_args(), _conf({}))
+    assert kw["async_checkpoint"] is False
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with NpzCheckpointer(d, async_save=True) as ck:
+            assert ck._executor is not None
+        with NpzCheckpointer(d) as ck:
+            assert ck._executor is None

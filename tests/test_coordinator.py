@@ -384,3 +384,26 @@ def test_abort_exit_codes_do_not_mask_failure_reason():
         assert "budget" in coord.failure_reason
     finally:
         coord.shutdown()
+
+
+def test_worker_config_json_roundtrip_new_fields(job_model_config, psv_dataset):
+    """scan_steps / async_checkpoint survive the subprocess JSON transport,
+    and configs serialized before these fields existed still load (defaults
+    apply)."""
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    cfg = WorkerConfig(
+        worker_id="w0", coordinator_host="127.0.0.1", coordinator_port=1,
+        model_config=job_model_config, schema=schema,
+        scan_steps=8, async_checkpoint=True,
+    )
+    back = WorkerConfig.from_json(cfg.to_json())
+    assert back.scan_steps == 8 and back.async_checkpoint is True
+
+    legacy = cfg.to_json()
+    del legacy["scan_steps"], legacy["async_checkpoint"]
+    old = WorkerConfig.from_json(legacy)
+    assert old.scan_steps == 1 and old.async_checkpoint is False
