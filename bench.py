@@ -51,6 +51,8 @@ STREAM_READERS = int(os.environ.get("BENCH_STREAM_READERS", 4))
 # a fixed per-call latency that 16K-row batches leave unamortized
 STREAM_BATCH = int(os.environ.get("BENCH_STREAM_BATCH", 65536))
 SCAN_STEPS = int(os.environ.get("BENCH_SCAN_STEPS", 16))
+DEVICE_EPOCH_ROWS = int(os.environ.get("BENCH_DEVICE_EPOCH_ROWS", 1_000_000))
+DEVICE_EPOCH_EPOCHS = int(os.environ.get("BENCH_DEVICE_EPOCH_EPOCHS", 5))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
 TPU_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT", 900.0))
 CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT", 900.0))
@@ -167,6 +169,42 @@ def bench_scan_rows_per_sec(measure_seconds: float) -> float:
     jax.block_until_ready(losses)
     elapsed = time.perf_counter() - t0
     return n_calls * S * rows / elapsed / jax.local_device_count()
+
+
+def bench_device_epoch_rows_per_sec(measure_seconds: float) -> float:
+    """Device-resident epochs (--device-resident): dataset lives in HBM,
+    one compiled program per epoch (on-device shuffle + scanned steps).
+    Measures the steady multi-epoch rate of the reference's all-in-RAM
+    regime (ssgd_monitor.py:348-454) in its TPU-native form."""
+    import jax
+
+    from shifu_tensorflow_tpu.data.reader import ParsedBlock
+    from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    n = DEVICE_EPOCH_ROWS
+    rng = np.random.default_rng(0)
+    block = ParsedBlock(
+        rng.normal(size=(n, NUM_FEATURES)).astype(np.float32),
+        (rng.random((n, 1)) < 0.3).astype(np.float32),
+        np.ones((n, 1), np.float32),
+    )
+    schema = RecordSchema(feature_columns=tuple(range(1, NUM_FEATURES + 1)),
+                          target_column=0)
+    ds = InMemoryDataset(block, ParsedBlock.empty(NUM_FEATURES), schema)
+    mesh = make_mesh("data:-1")
+    trainer = Trainer(_model_config(), NUM_FEATURES, mesh=mesh)
+    # one call, many epochs: epoch 0 pays the transfer + compile; the
+    # steady rate is the median of the later epochs' training_time_s
+    history = trainer.fit_device_resident(
+        ds, epochs=DEVICE_EPOCH_EPOCHS, batch_size=BATCH
+    )
+    tail = history[1:] if len(history) > 1 else history
+    steady = float(np.median([h.training_time_s for h in tail]))
+    _ = measure_seconds  # epoch count, not wall-clock, bounds this one
+    return n / steady / jax.local_device_count()
 
 
 def _write_stream_shards(root: str, total_rows: int, n_shards: int) -> list[str]:
@@ -411,6 +449,14 @@ def run_measurements() -> dict:
         result["scan_steps"] = SCAN_STEPS
     except Exception as e:
         result["value_scan_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # all-in-HBM multi-epoch regime (--device-resident): one compiled
+        # program per epoch, zero per-epoch batch transfer
+        result["device_epoch_rows_per_sec"] = round(
+            bench_device_epoch_rows_per_sec(MEASURE_SECONDS), 1
+        )
+    except Exception as e:
+        result["device_epoch_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(bench_stream_rows_per_sec())
     except Exception as e:  # streaming must not void the primary number

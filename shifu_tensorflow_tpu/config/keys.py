@@ -105,6 +105,10 @@ DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
 # write overlaps the next epoch.  The orbax path is already async.
 ASYNC_CHECKPOINT = TPU_PREFIX + "async-checkpoint"
 DEFAULT_ASYNC_CHECKPOINT = False
+# all-in-HBM training (--device-resident): dataset transfers once, each
+# epoch is one compiled program (on-device shuffle + scanned steps)
+DEVICE_RESIDENT = TPU_PREFIX + "device-resident"
+DEFAULT_DEVICE_RESIDENT = False
 # binary shard cache directory (data/cache.py): parse text shards once,
 # stream later epochs from memory-mapped finalized tensors
 CACHE_DIR = TPU_PREFIX + "cache-dir"

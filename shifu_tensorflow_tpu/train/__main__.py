@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="compute dtype (default float32; bfloat16 feeds the "
                         "MXU at full rate on TPU)")
+    p.add_argument("--device-resident", action="store_true",
+                   help="keep the whole dataset in device memory and run "
+                        "each epoch as ONE compiled program (on-device "
+                        "shuffle + scanned steps); single-process, "
+                        "dataset must fit in HBM")
     p.add_argument("--scan-steps", type=int, default=None,
                    help="batches per lax.scan dispatch (default 1 = one "
                         "dispatch per step; raise to amortize dispatch "
@@ -258,6 +263,15 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
     from shifu_tensorflow_tpu.utils.profiling import trace_if
 
+    device_resident = args.device_resident or conf.get_bool(
+        K.DEVICE_RESIDENT, K.DEFAULT_DEVICE_RESIDENT
+    )
+    if device_resident and args.stream:
+        raise SystemExit(
+            "--stream and --device-resident conflict: streaming exists for "
+            "datasets that do NOT fit in memory; drop one of them "
+            "(or unset shifu.tpu.device-resident)"
+        )
     data_path = conf.get(K.TRAINING_DATA_PATH)
     paths = list_data_files(data_path)
     if not paths:
@@ -339,7 +353,12 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                     f"{len(dataset.valid)} valid rows from {len(paths)} files",
                     flush=True,
                 )
-                history = trainer.fit(
+                fit = (
+                    trainer.fit_device_resident
+                    if device_resident
+                    else trainer.fit
+                )
+                history = fit(
                     dataset,
                     epochs=epochs,
                     batch_size=batch_size,

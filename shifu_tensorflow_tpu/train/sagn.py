@@ -215,6 +215,15 @@ class SAGNTrainer(Trainer):
             return jax.device_put(stacked, self._window_sharding)
         return jax.device_put(stacked)
 
+    def fit_device_resident(self, *a, **kw):
+        """The inherited device-resident epoch scans the PLAIN train-step
+        body — running it here would silently replace SAGN's window-averaged
+        update rule with per-batch SSGD.  Refuse instead."""
+        raise NotImplementedError(
+            "fit_device_resident trains with plain-SSGD semantics; the SAGN "
+            "window algorithm uses fit/fit_stream"
+        )
+
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         K = self.update_window
         losses: list = []

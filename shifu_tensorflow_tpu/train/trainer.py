@@ -167,10 +167,12 @@ def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     return scan_epoch
 
 
-def make_eval_step(apply_fn, loss_name: str = "mse"):
+def make_eval_step_body(apply_fn, loss_name: str = "mse"):
+    """Un-jitted (params, batch) -> (loss, pred) — shared by the per-batch
+    eval step and the device-resident scanned eval, so the all-padding
+    NaN contract cannot drift between them."""
     loss_fn = get_loss(loss_name)
 
-    @jax.jit
     def eval_step(params, batch: Batch):
         pred = apply_fn({"params": params}, batch["x"])
         loss = loss_fn(pred, batch["y"], batch["w"])
@@ -178,6 +180,10 @@ def make_eval_step(apply_fn, loss_name: str = "mse"):
         return jnp.where(has_rows, loss, jnp.nan), pred
 
     return eval_step
+
+
+def make_eval_step(apply_fn, loss_name: str = "mse"):
+    return jax.jit(make_eval_step_body(apply_fn, loss_name))
 
 
 class Trainer:
@@ -526,6 +532,176 @@ class Trainer:
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self.state)
         return history
+
+    def fit_device_resident(
+        self,
+        dataset: InMemoryDataset,
+        *,
+        epochs: int | None = None,
+        batch_size: int | None = None,
+        on_epoch: MetricsCallback | None = None,
+        checkpointer: "Any | None" = None,
+        start_epoch: int = 0,
+    ) -> list[EpochStats]:
+        """All-in-HBM training: the reference's load-everything workload
+        (ssgd_monitor.py:348-454) in its TPU-native form.
+
+        The train/valid tensors transfer to device ONCE; every epoch is a
+        single compiled program — on-device shuffle (jax.random.permutation
+        gather) + lax.scan over the batched steps — so steady-state epochs
+        involve zero host↔device batch traffic and one dispatch.  Per-epoch
+        host work is only the scalar losses and the validation scores for
+        KS/AUC.
+
+        Single-controller only: multi-process SPMD feeds per-process shards
+        through fit_stream; this path is for datasets that fit in HBM
+        (demo/eval scale, the reference's own regime).
+        """
+        if self._cross_process:
+            raise ValueError(
+                "fit_device_resident is single-controller; multi-process "
+                "SPMD jobs stream per-process shards (fit_stream)"
+            )
+        epochs = epochs or self.model_config.num_train_epochs
+        B = self.align_batch_size(batch_size or self.model_config.batch_size)
+
+        def _padded_device(block):
+            n = len(block)
+            if n == 0:
+                return None, 0, None, None
+            steps = -(-n // B)
+            pad = steps * B - n
+            x = np.asarray(block.features)
+            y = np.asarray(block.targets)
+            w = np.asarray(block.weights)
+            if pad:
+                x = np.concatenate([x, np.zeros((pad, x.shape[1]), x.dtype)])
+                y = np.concatenate([y, np.zeros((pad, 1), y.dtype)])
+                w = np.concatenate([w, np.zeros((pad, 1), w.dtype)])
+            data = {"x": x, "y": y, "w": w}
+            dev = (
+                jax.device_put(data, self._batch_sharding)
+                if self._batch_sharding is not None
+                else jax.device_put(data)
+            )
+            # host copies of labels/weights stay for KS/AUC (no fetch)
+            return dev, steps, y, w
+
+        train_dev, S, _, _ = _padded_device(dataset.train)
+        valid_dev, Sv, valid_y, valid_w = _padded_device(dataset.valid)
+        if train_dev is None:
+            return []
+
+        epoch_fn = self._make_device_epoch(S, B)
+        eval_fn = self._make_device_eval(Sv, B) if valid_dev is not None else None
+
+        history: list[EpochStats] = []
+        base_key = jax.random.key(self.seed)
+        for epoch in range(start_epoch, epochs):
+            t0 = time.time()
+            self.state, losses = epoch_fn(
+                self.state, train_dev, jax.random.fold_in(base_key, epoch)
+            )
+            vals = np.asarray(jax.device_get(losses))
+            real = vals[~np.isnan(vals)]
+            train_loss = float(np.mean(real)) if real.size else float("nan")
+            train_time = time.time() - t0
+
+            ev = {"loss": float("nan"), "ks": 0.0, "auc": 0.5}
+            valid_time = 0.0
+            if eval_fn is not None:
+                t1 = time.time()
+                vlosses, preds = eval_fn(self.state.params, valid_dev)
+                vvals = np.asarray(jax.device_get(vlosses))
+                vreal = vvals[~np.isnan(vvals)]
+                scores = np.asarray(jax.device_get(preds)).reshape(-1)
+                mask = valid_w[:, 0] > 0
+                ev = {
+                    "loss": float(np.mean(vreal)) if vreal.size else float("nan"),
+                    "ks": M.ks_statistic(scores[mask], valid_y[mask, 0],
+                                         valid_w[mask, 0]),
+                    "auc": M.auc(scores[mask], valid_y[mask, 0],
+                                 valid_w[mask, 0]),
+                }
+                valid_time = time.time() - t1
+
+            stats = EpochStats(
+                worker_index=self.worker_index,
+                current_epoch=epoch,
+                training_loss=train_loss,
+                valid_loss=ev["loss"],
+                training_time_s=train_time,
+                valid_time_s=valid_time,
+                global_step=int(jax.device_get(self.state.step)),
+                ks=ev["ks"],
+                auc=ev["auc"],
+            )
+            history.append(stats)
+            if on_epoch:
+                on_epoch(stats)
+            if checkpointer is not None:
+                checkpointer.maybe_save(epoch, self.state)
+        return history
+
+    def _make_device_epoch(self, steps: int, batch_size: int):
+        """One-dispatch epoch: on-device shuffle + scanned updates.  Memoized
+        per (steps, batch) — a fresh jit closure per fit call would recompile
+        the identical program every time."""
+        cache = getattr(self, "_device_epoch_cache", None)
+        if cache is None:
+            cache = self._device_epoch_cache = {}
+        key = (steps, batch_size)
+        if key in cache:
+            return cache[key]
+        body = make_train_step_body(
+            self.model.apply, self.loss_name, self.model_config.params.l2_reg
+        )
+        donate = donation_is_safe()
+        stacked_sh = self._stacked_sharding
+
+        @partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def epoch_fn(state, data, key):
+            n = data["x"].shape[0]
+            perm = jax.random.permutation(key, n)
+            stacked = {
+                k: v[perm].reshape((steps, batch_size) + v.shape[1:])
+                for k, v in data.items()
+            }
+            if stacked_sh is not None:
+                stacked = jax.lax.with_sharding_constraint(
+                    stacked, stacked_sh
+                )
+            return jax.lax.scan(body, state, stacked)
+
+        cache[key] = epoch_fn
+        return epoch_fn
+
+    def _make_device_eval(self, steps: int, batch_size: int):
+        """Scanned validation pass: (losses, preds) in one dispatch.
+        Memoized like _make_device_epoch."""
+        cache = getattr(self, "_device_eval_cache", None)
+        if cache is None:
+            cache = self._device_eval_cache = {}
+        key = (steps, batch_size)
+        if key in cache:
+            return cache[key]
+        eval_body = make_eval_step_body(self.model.apply, self.loss_name)
+
+        @jax.jit
+        def eval_fn(params, data):
+            stacked = {
+                k: v.reshape((steps, batch_size) + v.shape[1:])
+                for k, v in data.items()
+            }
+
+            def body(_, batch):
+                return None, eval_body(params, batch)
+
+            _, (losses, preds) = jax.lax.scan(body, None, stacked)
+            return losses, preds
+
+        cache[key] = eval_fn
+        return eval_fn
 
     def fit_stream(
         self,

@@ -307,3 +307,17 @@ class TestDataCli:
             [str(p)], schema, 64, valid_rate=0.2, salt=7,
             cache_dir=cache_dir)]
         assert warm
+
+
+def test_stream_and_device_resident_conflict(tmp_path):
+    """Explicitly requested but silently dropped modes are bugs: the pair
+    is rejected up front."""
+    import pytest
+
+    from shifu_tensorflow_tpu.train.__main__ import main
+
+    with pytest.raises(SystemExit, match="conflict"):
+        main([
+            "--training-data-path", str(tmp_path),
+            "--feature-columns", "1,2", "--stream", "--device-resident",
+        ])

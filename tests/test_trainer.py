@@ -319,3 +319,79 @@ def test_scan_epoch_indivisible_and_ragged_batches(psv_dataset):
     loss, n = t2.train_epoch(iter([mk(32), mk(32), mk(20), mk(32), mk(8)]))
     assert n == 5 and np.isfinite(loss)
     assert int(jax.device_get(t2.state.step)) == 5
+
+
+# ---- device-resident fit (--device-resident / shifu.tpu.device-resident) ----
+
+def test_device_resident_fit_learns(psv_dataset):
+    """Whole-dataset-in-HBM epochs: converges on the synthetic set, counts
+    steps correctly (ceil(n/B) per epoch), reports KS/AUC."""
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=4)
+    trainer = Trainer(mc, ds.schema.num_features, seed=2)
+    history = trainer.fit_device_resident(ds, batch_size=64)
+    assert len(history) == 4
+    assert history[-1].valid_loss < history[0].valid_loss
+    assert history[-1].ks > 0.3
+    steps_per_epoch = -(-len(ds.train) // 64)
+    assert history[-1].global_step == 4 * steps_per_epoch
+
+
+def test_device_resident_fit_deterministic(psv_dataset):
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=2)
+    a = Trainer(mc, ds.schema.num_features, seed=11)
+    a.fit_device_resident(ds, batch_size=64)
+    b = Trainer(mc, ds.schema.num_features, seed=11)
+    b.fit_device_resident(ds, batch_size=64)
+    ka = jax.device_get(a.state.params["shifu_output_0"]["kernel"])
+    kb = jax.device_get(b.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_array_equal(ka, kb)
+
+
+def test_device_resident_fit_on_mesh(psv_dataset):
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=2)
+    trainer = Trainer(mc, ds.schema.num_features, seed=2,
+                      mesh=make_mesh("data:8"))
+    history = trainer.fit_device_resident(ds, batch_size=64)
+    assert np.isfinite(history[-1].training_loss)
+    assert history[-1].ks > 0.2
+
+
+def test_device_resident_checkpoint_interop(psv_dataset, tmp_path):
+    """Checkpoints written by the device-resident path restore into the
+    per-step path and vice versa — one on-disk contract."""
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=2)
+    t1 = Trainer(mc, ds.schema.num_features, seed=4)
+    with Checkpointer(str(tmp_path / "dr")) as ckpt:
+        t1.fit_device_resident(ds, batch_size=64, checkpointer=ckpt)
+        ckpt.wait()
+        t2 = Trainer(mc, ds.schema.num_features, seed=99)
+        restored, nxt = ckpt.restore_latest(t2.state)
+    assert nxt == 2
+    ka = jax.device_get(t1.state.params["shifu_output_0"]["kernel"])
+    kb = jax.device_get(restored.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(ka, kb, rtol=1e-6)
+
+
+def test_device_resident_rejects_cross_process(psv_dataset):
+    from shifu_tensorflow_tpu.parallel.distributed import ProcessTopology
+
+    ds = _dataset(psv_dataset)
+    trainer = Trainer(_mc(epochs=1), ds.schema.num_features,
+                      mesh=make_mesh("data:8"),
+                      topology=ProcessTopology(num_processes=1, process_id=0))
+    with pytest.raises(ValueError, match="single-controller"):
+        trainer.fit_device_resident(ds, batch_size=64)
+
+
+def test_device_resident_rejects_sagn(psv_dataset):
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    ds = _dataset(psv_dataset)
+    mc = _mc(epochs=1, Algorithm="sagn")
+    trainer = make_trainer(mc, ds.schema.num_features)
+    with pytest.raises(NotImplementedError, match="SAGN"):
+        trainer.fit_device_resident(ds, batch_size=64)
