@@ -1,0 +1,224 @@
+"""Per-family benchmark over the BASELINE.json config matrix (configs 1-4).
+
+For each model family the framework ships (plain DNN, Wide&Deep with a
+hashed-cross wide part, multi-task heads, hashed-embedding-augmented DNN)
+this measures, on whatever backend the environment provides:
+
+- ``step_rows_per_sec``: steady-state jitted train-step throughput on a
+  device-resident batch (the same methodology as bench.py's primary);
+- ``seconds_to_ks``: wall-clock for device-resident training to reach
+  KS >= --ks-target (default 0.45, the BASELINE.md north-star threshold)
+  on a synthetic learnable binary set, plus the epoch count that got there.
+
+Writes BENCH_MODELS.json next to the repo root.  Config #5 (full-pod
+1B-row) is the driver-run bench.py streaming story, not this script.
+
+Run: python scripts/bench_models.py [--rows N] [--batch B] [--ks-target T]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# when the run is pinned to CPU, drop the tunneled-TPU PJRT plugin BEFORE
+# the first backend query — its init can hang indefinitely even with
+# JAX_PLATFORMS=cpu (same gate as bench.py / __graft_entry__)
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+NUM_FEATURES = 30
+HIDDEN = [256, 128, 64]
+
+
+def _params(**extra) -> dict:
+    base = {
+        "NumHiddenLayers": 3,
+        "NumHiddenNodes": HIDDEN,
+        "ActivationFunc": ["relu", "relu", "tanh"],
+        # 0.05 (the demo default) collapses the deep trunk to the
+        # constant-mean optimum on this synthetic at batch 4096+; 0.01
+        # converges every family to KS ~0.55 in 1-2 epochs
+        "LearningRate": 0.01,
+        "Optimizer": "adam",
+    }
+    base.update(extra)
+    return base
+
+
+# BASELINE.json configs 1-4; column numbers are absolute (feature columns
+# are 1..NUM_FEATURES in the synthetic schema, matching PSV layout)
+FAMILIES: dict[str, dict] = {
+    "dnn": _params(),
+    "wide_deep": _params(
+        ModelType="wide_deep",
+        WideColumnNums=[1, 2, 3, 4],
+        CrossHashSize=4096,
+    ),
+    "multi_task": _params(ModelType="multi_task", NumTasks=3),
+    "hashed_embeddings": _params(
+        EmbeddingColumnNums=[1, 2, 3, 4],
+        EmbeddingHashSize=16384,
+        EmbeddingDim=16,
+    ),
+}
+
+
+def _model_config(params: dict, epochs: int = 50):
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": epochs, "validSetRate": 0.2,
+                   "params": params}}
+    )
+
+
+def _synthetic(rows: int, seed: int = 0):
+    """Learnable binary set: logistic signal over the feature vector, a few
+    integer 'category' columns so crossed/embedded families have real
+    categorical structure."""
+    from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+    from shifu_tensorflow_tpu.data.reader import ParsedBlock, RecordSchema
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(rows, NUM_FEATURES)).astype(np.float32)
+    # columns 0-3 (absolute 1-4): small-cardinality category codes.  The
+    # signal derives from the integer codes; the stored features are
+    # ZSCALE-normalized like a real Shifu pipeline's (the reference's
+    # normtype, ssgd_monitor.py:476-490) — unscaled 0..50 inputs at the
+    # configured lr collapse training to the constant-mean optimum
+    codes = rng.integers(0, 50, size=(rows, 4))
+    x[:, :4] = ((codes - 24.5) / 14.4).astype(np.float32)
+    w_true = rng.normal(size=NUM_FEATURES)
+    w_true[:4] = 0.0
+    cat_effect = ((codes[:, 0] * 31 + codes[:, 1]) % 7 - 3) * 0.8
+    logit = x @ w_true * 0.6 + cat_effect
+    y = (rng.random(rows) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float32)
+    n_valid = rows // 5
+    schema = RecordSchema(
+        feature_columns=tuple(range(1, NUM_FEATURES + 1)), target_column=0
+    )
+    mk = lambda lo, hi: ParsedBlock(
+        x[lo:hi], y[lo:hi, None], np.ones((hi - lo, 1), np.float32)
+    )
+    return InMemoryDataset(mk(n_valid, rows), mk(0, n_valid), schema)
+
+
+def bench_family(name: str, params: dict, rows: int, batch: int,
+                 ks_target: float, step_seconds: float) -> dict:
+    import jax
+
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mesh = make_mesh("data:-1")
+    ds = _synthetic(rows)
+    out: dict = {"family": name}
+
+    # --- step throughput (device-resident batch, bench.py methodology)
+    trainer = Trainer(_model_config(params), NUM_FEATURES,
+                      feature_columns=tuple(range(1, NUM_FEATURES + 1)),
+                      mesh=mesh)
+    B = trainer.align_batch_size(batch)
+    rng = np.random.default_rng(0)
+    dev = trainer._put({
+        "x": np.ascontiguousarray(ds.train.features[:B])
+        if len(ds.train) >= B
+        else rng.normal(size=(B, NUM_FEATURES)).astype(np.float32),
+        "y": (rng.random((B, 1)) < 0.3).astype(np.float32),
+        "w": np.ones((B, 1), np.float32),
+    })
+    state = trainer.state
+    step = trainer._train_step
+    for _ in range(3):
+        state, loss = step(state, dev)
+    jax.block_until_ready(loss)
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        state, loss = step(state, dev)
+        n += 1
+        if n % 20 == 0:
+            jax.block_until_ready(loss)
+            if time.perf_counter() - t0 >= step_seconds:
+                break
+    jax.block_until_ready(loss)
+    out["step_rows_per_sec"] = round(
+        n * B / (time.perf_counter() - t0) / jax.local_device_count(), 1
+    )
+    out["batch_rows"] = B
+
+    # --- wall-clock to the KS target (fresh trainer, device-resident fit)
+    trainer2 = Trainer(_model_config(params), NUM_FEATURES,
+                       feature_columns=tuple(range(1, NUM_FEATURES + 1)),
+                       mesh=mesh, seed=1)
+
+    class _Reached(Exception):
+        pass
+
+    t0 = time.perf_counter()
+    hit: dict = {"best": 0.0, "epoch": None, "seconds": None}
+
+    def on_epoch(stats):
+        hit["best"] = max(hit["best"], stats.ks)
+        if stats.ks >= ks_target and hit["epoch"] is None:
+            hit["epoch"] = stats.current_epoch + 1
+            hit["seconds"] = time.perf_counter() - t0
+            raise _Reached  # dataset stays on device; no need to finish
+
+    try:
+        trainer2.fit_device_resident(ds, epochs=20, batch_size=batch,
+                                     on_epoch=on_epoch)
+    except _Reached:
+        pass
+    out["ks_target"] = ks_target
+    out["best_ks"] = round(hit["best"], 4)
+    out["seconds_to_ks"] = (
+        round(hit["seconds"], 2) if hit["seconds"] is not None else None
+    )
+    out["epochs_to_ks"] = hit["epoch"]
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--ks-target", type=float, default=0.45)
+    ap.add_argument("--step-seconds", type=float, default=5.0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_MODELS.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    result = {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "rows": args.rows,
+        "families": [],
+    }
+    for name, params in FAMILIES.items():
+        t0 = time.perf_counter()
+        fam = bench_family(name, params, args.rows, args.batch,
+                           args.ks_target, args.step_seconds)
+        fam["total_bench_seconds"] = round(time.perf_counter() - t0, 1)
+        result["families"].append(fam)
+        print(json.dumps(fam), flush=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

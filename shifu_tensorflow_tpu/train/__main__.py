@@ -272,6 +272,13 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             "datasets that do NOT fit in memory; drop one of them "
             "(or unset shifu.tpu.device-resident)"
         )
+    if device_resident and model_config.params.algorithm == "sagn":
+        # knowable before any data I/O; a raw NotImplementedError after a
+        # minutes-long dataset load would say the same thing rudely
+        raise SystemExit(
+            "Algorithm=sagn does not support --device-resident (the scanned "
+            "epoch runs plain-SSGD updates, not SAGN windows); drop one"
+        )
     data_path = conf.get(K.TRAINING_DATA_PATH)
     paths = list_data_files(data_path)
     if not paths:
@@ -410,6 +417,15 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # clean error before launch, not an N-worker crash cascade after
     # cluster bring-up
     trainer_extras(args, conf)
+    if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
+                                             K.DEFAULT_DEVICE_RESIDENT):
+        # silently training a different mode than requested is a bug; the
+        # multi-worker path feeds per-process shards via fit/fit_stream
+        raise SystemExit(
+            "--device-resident is single-process (the whole dataset lives "
+            "in one process's device memory); multi-worker jobs load or "
+            "stream per-worker shards — drop --workers or the flag/key"
+        )
     # SPMD (one model across workers) is the default for real process
     # launches — the reference's defining capability; thread workers can't
     # host it (one process cannot be N jax.distributed participants)

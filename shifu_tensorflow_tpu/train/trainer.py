@@ -614,7 +614,10 @@ class Trainer:
                 vlosses, preds = eval_fn(self.state.params, valid_dev)
                 vvals = np.asarray(jax.device_get(vlosses))
                 vreal = vvals[~np.isnan(vvals)]
-                scores = np.asarray(jax.device_get(preds)).reshape(-1)
+                # (Sv, B, C) -> rows x outputs; KS/AUC score column 0, the
+                # same contract as evaluate() (multi-task C>1: head 0)
+                p_host = np.asarray(jax.device_get(preds))
+                scores = p_host.reshape(-1, p_host.shape[-1])[:, 0]
                 mask = valid_w[:, 0] > 0
                 ev = {
                     "loss": float(np.mean(vreal)) if vreal.size else float("nan"),

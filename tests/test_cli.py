@@ -321,3 +321,30 @@ def test_stream_and_device_resident_conflict(tmp_path):
             "--training-data-path", str(tmp_path),
             "--feature-columns", "1,2", "--stream", "--device-resident",
         ])
+
+
+def test_device_resident_rejected_for_multi_worker_and_sagn(tmp_path):
+    import gzip
+
+    import pytest
+
+    from shifu_tensorflow_tpu.train.__main__ import main
+
+    with gzip.open(tmp_path / "part-0.gz", "wt") as f:
+        for i in range(50):
+            f.write(f"{i % 2}|0.5|1.5|1.0\n")
+    base = [
+        "--training-data-path", str(tmp_path),
+        "--feature-columns", "1,2", "--device-resident",
+    ]
+    with pytest.raises(SystemExit, match="single-process"):
+        main(base + ["--workers", "2"])
+
+    import json
+    mc = tmp_path / "mc.json"
+    mc.write_text(json.dumps({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.1,
+        "Algorithm": "sagn"}}}))
+    with pytest.raises(SystemExit, match="sagn"):
+        main(base + ["--model-config", str(mc)])

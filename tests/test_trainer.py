@@ -395,3 +395,14 @@ def test_device_resident_rejects_sagn(psv_dataset):
     trainer = make_trainer(mc, ds.schema.num_features)
     with pytest.raises(NotImplementedError, match="SAGN"):
         trainer.fit_device_resident(ds, batch_size=64)
+
+
+def test_device_resident_multi_task_eval(psv_dataset):
+    """Regression: multi-output heads (C>1) must score head 0 for KS/AUC,
+    not a flattened (rows*C) vector."""
+    ds = _dataset(psv_dataset)
+    trainer = Trainer(_mc(epochs=2, ModelType="multi_task", NumTasks=3),
+                      ds.schema.num_features, seed=2)
+    history = trainer.fit_device_resident(ds, batch_size=64)
+    assert np.isfinite(history[-1].valid_loss)
+    assert 0.0 <= history[-1].auc <= 1.0
