@@ -40,13 +40,22 @@ class TrainParams:
     # convergence parity; opt in via train.params.L2Reg.
     l2_reg: float = 0.0
     # ---- extensions beyond the reference (BASELINE.json configs) ----
-    model_type: str = "dnn"  # dnn | wide_deep | multi_task
+    model_type: str = "dnn"  # dnn | wide_deep | multi_task | sequence
     wide_column_nums: tuple[int, ...] = ()  # crossed/categorical cols for wide part
     cross_hash_size: int = 0  # >0: hashed-cross table for the wide part
     num_tasks: int = 1  # >1 => multi-task sigmoid heads sharing the trunk
     embedding_columns: tuple[int, ...] = ()  # high-cardinality hashed cols
     embedding_hash_size: int = 0  # rows per hashed table (0 = disabled)
     embedding_dim: int = 8
+    # ModelType "sequence": transformer encoder over event sequences.  Each
+    # PSV row carries seq_len steps x (features/seq_len) values flattened,
+    # so the whole ingest pipeline (schema, cache, streaming) is unchanged.
+    seq_len: int = 0  # >0 selects/validates the sequence family
+    seq_d_model: int = 64
+    seq_heads: int = 4
+    seq_blocks: int = 2
+    # "auto": ring attention when the mesh has a seq axis >1, else full
+    seq_attention: str = "auto"  # auto | full | ring | ulysses
 
     @property
     def uses_feature_hashing(self) -> bool:
@@ -90,6 +99,11 @@ class TrainParams:
             embedding_columns=tuple(int(c) for c in params.get("EmbeddingColumnNums", [])),
             embedding_hash_size=int(params.get("EmbeddingHashSize", 0)),
             embedding_dim=int(params.get("EmbeddingDim", 8)),
+            seq_len=int(params.get("SeqLen", 0)),
+            seq_d_model=int(params.get("SeqDModel", 64)),
+            seq_heads=int(params.get("SeqHeads", 4)),
+            seq_blocks=int(params.get("SeqBlocks", 2)),
+            seq_attention=str(params.get("SeqAttention", "auto")).lower(),
             update_window=int(params.get("UpdateWindow", 1)),
             algorithm=str(params.get("Algorithm", "ssgd")).lower(),
         )

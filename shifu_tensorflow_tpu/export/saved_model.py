@@ -121,6 +121,12 @@ def export_native_bundle(
                     "EmbeddingColumnNums": list(model_config.params.embedding_columns),
                     "EmbeddingHashSize": model_config.params.embedding_hash_size,
                     "EmbeddingDim": model_config.params.embedding_dim,
+                    "SeqLen": model_config.params.seq_len,
+                    "SeqDModel": model_config.params.seq_d_model,
+                    "SeqHeads": model_config.params.seq_heads,
+                    "SeqBlocks": model_config.params.seq_blocks,
+                    # serving is single-device: full attention always
+                    "SeqAttention": "full",
                 },
             }
         },
@@ -213,7 +219,18 @@ def export_model(
     zscale_means=None,
     zscale_stds=None,
 ) -> dict[str, bool]:
-    """One-call export of both artifacts from a Trainer."""
+    """One-call export of both artifacts from a Trainer.
+
+    The serving function is REBUILT mesh-less (single-device) instead of
+    reusing ``trainer.model.apply``: a trainer on a mesh may have baked
+    collective ops into its model — ring/Ulysses attention's shard_map, a
+    'model'-sharded embedding's partitioned gather — and jax2tf would trace
+    those device-bound collectives into the SavedModel.  The rebuilt module
+    resolves to single-device implementations (full attention, local
+    lookup); parameters are identical, so scores are too.
+    """
+    from shifu_tensorflow_tpu.models.factory import build_model
+
     export_native_bundle(
         export_dir,
         trainer.state.params,
@@ -223,7 +240,27 @@ def export_model(
         zscale_means=zscale_means,
         zscale_stds=zscale_stds,
     )
+    serve_mc = ModelConfig.from_json(dict(trainer.model_config.raw))
+    if serve_mc.params.seq_len > 0:
+        # force single-device attention regardless of how training ran
+        raw = dict(serve_mc.raw)
+        raw.setdefault("train", {}).setdefault("params", {})[
+            "SeqAttention"
+        ] = "full"
+        serve_mc = ModelConfig.from_json(raw)
+    serve_model = build_model(
+        serve_mc,
+        tuple(feature_columns) if feature_columns else None,
+        shard_embeddings=False,
+    )
+    from flax.core import meta as flax_meta
+
+    serve_params = jax.tree_util.tree_map(
+        lambda x: x.unbox() if isinstance(x, flax_meta.AxisMetadata) else x,
+        trainer.state.params,
+        is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata),
+    )
     ok_tf = export_saved_model(
-        export_dir, trainer.model.apply, trainer.state.params, trainer.num_features
+        export_dir, serve_model.apply, serve_params, trainer.num_features
     )
     return {"native": True, "saved_model": ok_tf}

@@ -59,15 +59,48 @@ def build_model(
     dtype: jnp.dtype = jnp.float32,
     shard_embeddings: bool = True,
     embedding_impl: str = "auto",
+    mesh=None,
 ) -> nn.Module:
     """``shard_embeddings=False`` (no 'model' mesh axis present) drops the
     table's partitioning annotation.  ``embedding_impl`` selects the lookup
     implementation; pass "xla" whenever the computation runs over a
     multi-device mesh — the Pallas kernel has no GSPMD partitioning rule, so
-    "auto" is only safe single-device (models/embeddings._resolve_impl)."""
+    "auto" is only safe single-device (models/embeddings._resolve_impl).
+    ``mesh`` is consulted only by the sequence family (attention impl
+    selection: ring/Ulysses need the mesh's 'seq' axis)."""
     p: TrainParams = model_config.params
     nodes = p.num_hidden_nodes[: p.num_hidden_layers]
     acts = p.activation_funcs[: p.num_hidden_layers]
+
+    if p.seq_len > 0 and p.model_type != "sequence":
+        raise ValueError(
+            f"SeqLen={p.seq_len} conflicts with ModelType={p.model_type!r}: "
+            "sequence params only apply to ModelType=sequence"
+        )
+    if p.model_type == "sequence":
+        from shifu_tensorflow_tpu.models.sequence import (
+            SequenceClassifier,
+            make_attention,
+        )
+
+        if p.seq_len <= 0:
+            raise ValueError("ModelType=sequence requires SeqLen > 0")
+        if p.seq_d_model % p.seq_heads:
+            raise ValueError(
+                f"SeqDModel={p.seq_d_model} not divisible by "
+                f"SeqHeads={p.seq_heads}"
+            )
+        return SequenceClassifier(
+            seq_len=p.seq_len,
+            d_model=p.seq_d_model,
+            num_heads=p.seq_heads,
+            num_blocks=p.seq_blocks,
+            attention=make_attention(
+                p.seq_attention, mesh,
+                seq_len=p.seq_len, num_heads=p.seq_heads,
+            ),
+            dtype=dtype,
+        )
 
     if p.model_type == "wide_deep":
         wide_idx = (

@@ -230,6 +230,7 @@ class Trainer:
             model_config, feature_columns, dtype=dtype,
             shard_embeddings=shard_emb,
             embedding_impl="auto" if single_device else "xla",
+            mesh=mesh,
         )
         self.tx = make_optimizer(model_config.params)
         self.loss_name = loss
