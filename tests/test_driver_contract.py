@@ -29,23 +29,33 @@ def test_bench_prints_one_json_line_with_contract_keys():
         "BENCH_TPU_TIMEOUT": "200",
         "BENCH_CPU_TIMEOUT": "200",
     })
-    # outer timeout must exceed bench's worst-case internal budget
-    # (one 200s attempt + 5s backoff + 200s cpu fallback)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, timeout=540, env=env, cwd=REPO,
-    )
-    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
-    lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
-    assert len(lines) == 1, f"bench must print exactly ONE line, got: {lines}"
     def _reject(tok):  # json.loads accepts NaN/Infinity by default
         raise ValueError(f"non-standard JSON token {tok} in bench line")
 
-    d = json.loads(lines[0], parse_constant=_reject)
-    for k in ("metric", "value", "unit", "vs_baseline"):
-        assert k in d, f"contract key {k} missing"
-    assert d["metric"] == "training_rows_per_sec_per_chip"
-    assert d["value"] > 0 and np.isfinite(d["vs_baseline"])
+    # one retry: on a loaded 1-CPU host the timed child can blow its
+    # internal budget and bench (correctly) reports value 0 with
+    # diagnostics — bench working as designed, not a contract break, so
+    # give it one quiet second chance before failing the suite
+    for attempt in (1, 2):
+        # outer timeout must exceed bench's worst-case internal budget
+        # (one 200s attempt + 5s backoff + 200s cpu fallback)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, timeout=540, env=env, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1, (
+            f"bench must print exactly ONE line, got: {lines}"
+        )
+        d = json.loads(lines[0], parse_constant=_reject)
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in d, f"contract key {k} missing"
+        assert d["metric"] == "training_rows_per_sec_per_chip"
+        if d["value"] > 0 or attempt == 2:
+            break
+    assert d["value"] > 0, f"bench measured nothing twice: {d}"
+    assert np.isfinite(d["vs_baseline"])
 
 
 def test_graft_entry_is_jittable_with_example_args():
