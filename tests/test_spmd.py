@@ -57,19 +57,21 @@ def _spec(shards, n_workers, **kw) -> JobSpec:
     return JobSpec(n_workers=n_workers, shards=shards, spmd=True, **kw)
 
 
-def _model_config(epochs: int) -> ModelConfig:
+def _model_config(epochs: int, **params_extra) -> ModelConfig:
+    params = {
+        "NumHiddenLayers": 1,
+        "NumHiddenNodes": [8],
+        "ActivationFunc": ["relu"],
+        "LearningRate": 0.05,
+        "Optimizer": "adam",
+    }
+    params.update(params_extra)
     return ModelConfig.from_json(
         {
             "train": {
                 "numTrainEpochs": epochs,
                 "validSetRate": 0.2,
-                "params": {
-                    "NumHiddenLayers": 1,
-                    "NumHiddenNodes": [8],
-                    "ActivationFunc": ["relu"],
-                    "LearningRate": 0.05,
-                    "Optimizer": "adam",
-                },
+                "params": params,
             }
         }
     )
@@ -446,3 +448,28 @@ def test_spmd_streaming_sigkill_during_cold_cache_build(psv_dataset, tmp_path):
         # FIRST, meta last) — a kill can orphan slabs, never a meta
         assert any(n.startswith(f"{k}.x.") for n in names), k
         assert f"{k}.y.f32" in names and f"{k}.w.f32" in names, k
+
+
+def test_spmd_trains_sequence_family(psv_dataset, tmp_path):
+    """The sequence model family composes with cross-process SPMD: a
+    2-process fleet trains ONE transformer over jax.distributed and
+    checkpoints it (attention=auto resolves to full on the data-only
+    mesh; seq-axis sharding is a single-controller mesh concern)."""
+    mc = _model_config(
+        1, LearningRate=0.01, ModelType="sequence",
+        SeqLen=5, SeqDModel=16, SeqHeads=4, SeqBlocks=1,
+    )
+    shards = split_training_data(psv_dataset["root"], 2)
+    ckpt_dir = str(tmp_path / "seq-ckpt")
+    spec = _spec(shards, 2, epochs=1)
+    submitter = JobSubmitter(
+        spec,
+        _worker_cfg_factory(psv_dataset, mc, ckpt_dir),
+        launcher="process",
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    ckpt = NpzCheckpointer(ckpt_dir)
+    assert ckpt.latest_epoch() == 0
