@@ -179,3 +179,20 @@ def test_ring_trained_model_exports_saved_model(tmp_path):
     status = export_model(str(tmp_path / "ring-export"), trainer,
                           feature_columns=tuple(range(1, NUM_FEATURES + 1)))
     assert status["native"] and status["saved_model"]
+
+
+def test_ulysses_attention_forward_parity_with_full():
+    """Ulysses all-to-all attention (heads re-sharded over the seq axis)
+    must also reproduce full attention at the model level (seq:4 | heads=4)."""
+    mesh = make_mesh("data:2,seq:4")
+    model_full = build_model(_mc(attention="full"),
+                             tuple(range(1, NUM_FEATURES + 1)))
+    model_uly = build_model(_mc(attention="ulysses"),
+                            tuple(range(1, NUM_FEATURES + 1)), mesh=mesh)
+    x = np.random.default_rng(2).normal(size=(8, NUM_FEATURES)).astype(
+        np.float32
+    )
+    params = model_full.init(jax.random.key(9), x)["params"]
+    a = np.asarray(model_full.apply({"params": params}, x))
+    b = np.asarray(model_uly.apply({"params": params}, x))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
