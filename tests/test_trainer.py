@@ -406,3 +406,30 @@ def test_device_resident_multi_task_eval(psv_dataset):
     history = trainer.fit_device_resident(ds, batch_size=64)
     assert np.isfinite(history[-1].valid_loss)
     assert 0.0 <= history[-1].auc <= 1.0
+
+
+def test_scan_epoch_composes_with_shard_stream(psv_dataset):
+    """--stream + --scan-steps: chunked-scan over a deterministic 1-reader
+    ShardStream must equal the per-step stream run exactly."""
+    from shifu_tensorflow_tpu.data.dataset import ShardStream
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    mc = _mc(epochs=2)
+
+    def run(scan_steps):
+        tr = Trainer(mc, schema.num_features, seed=6, scan_steps=scan_steps)
+        tr.fit_stream(
+            lambda epoch: ShardStream(
+                psv_dataset["paths"], schema, 64,
+                valid_rate=0.2, emit="train", n_readers=1,
+            ),
+            epochs=2,
+        )
+        return jax.device_get(tr.state.params["shifu_output_0"]["kernel"])
+
+    np.testing.assert_allclose(run(1), run(3), rtol=2e-5, atol=2e-6)
