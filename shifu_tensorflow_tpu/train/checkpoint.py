@@ -108,6 +108,58 @@ class NpzCheckpointer:
                 max_workers=1, thread_name_prefix="npz-ckpt"
             )
         fs.mkdirs(self.directory)
+        self._sweep_stale_tmp()
+
+    #: a dead-pid temp younger than this may belong to a LIVE writer in a
+    #: foreign pid namespace (containers sharing a checkpoint volume make
+    #: os.kill-liveness unreliable); local npz writes finish in seconds,
+    #: so a 2-minute grace makes deleting an in-flight file implausible
+    _TMP_DEAD_GRACE_S = 120.0
+    #: past this age a temp is debris no matter what the pid says
+    #: (mirrors data/cache.py prune_cache's _ORPHAN_MIN_AGE_S policy)
+    _TMP_MAX_AGE_S = 3600.0
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove ``*.tmp.<pid>`` debris from writers that died mid-write
+        (SIGKILL'd workers — the fleet-restart drill): a dead pid's temp
+        file can never be renamed into place and would sit forever.  Local
+        directories only; pid liveness is meaningless across hosts."""
+        if "://" in self.directory:
+            return
+        import time
+
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        now = time.time()
+        for name in names:
+            if ".tmp." not in name:
+                continue
+            pid_part = name.rsplit(".tmp.", 1)[1]
+            try:
+                pid = int(pid_part)
+            except ValueError:
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age < self._TMP_MAX_AGE_S:
+                if pid == os.getpid() or age < self._TMP_DEAD_GRACE_S:
+                    continue
+                try:  # portable liveness: signal 0 (no /proc dependency)
+                    os.kill(pid, 0)
+                    continue  # alive — keep
+                except PermissionError:
+                    continue  # alive, different user — keep
+                except (ProcessLookupError, OSError):
+                    pass  # dead (or unknowable) AND past the grace: sweep
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _path(self, epoch: int) -> str:
         return f"{self.directory.rstrip('/')}/{self._PREFIX}{epoch}{self._SUFFIX}"

@@ -184,6 +184,28 @@ def test_npz_checkpointer_async_roundtrip(tmp_path):
         bad.close()
 
 
+def test_npz_checkpointer_sweeps_dead_writer_tmp(tmp_path):
+    """SIGKILL'd writers leave ckpt-N.npz.tmp.<pid> debris; construction
+    sweeps it once the pid is dead AND the file is past the in-flight
+    grace — young files and live/own pids are kept (a live writer in a
+    foreign pid namespace must never lose its in-flight file)."""
+    import time
+
+    d = str(tmp_path)
+    dead = os.path.join(d, "ckpt-3.npz.tmp.999999")
+    young = os.path.join(d, "ckpt-4.npz.tmp.999998")
+    mine = os.path.join(d, f"ckpt-5.npz.tmp.{os.getpid()}")
+    for p in (dead, young, mine):
+        open(p, "w").write("partial")
+    old_t = time.time() - 600  # past the 120s grace, under the 1h max
+    os.utime(dead, (old_t, old_t))
+    os.utime(mine, (old_t, old_t))
+    NpzCheckpointer(d)
+    assert not os.path.exists(dead)      # dead pid + past grace: swept
+    assert os.path.exists(young)         # young: could be in flight
+    assert os.path.exists(mine)          # own pid: kept
+
+
 def test_sync_plan_agrees_max_steps_min_epoch(tiny_shards):
     spec = _spec(tiny_shards, 2)
     coord = Coordinator(spec)
@@ -473,3 +495,36 @@ def test_spmd_trains_sequence_family(psv_dataset, tmp_path):
     assert result.state == JobState.FINISHED, result.failure_reason
     ckpt = NpzCheckpointer(ckpt_dir)
     assert ckpt.latest_epoch() == 0
+
+
+def test_spmd_sigkill_recovery_with_async_checkpointing(psv_dataset, tmp_path):
+    """Same SIGKILL drill with shifu.tpu.async-checkpoint on: background
+    writes must leave either a complete published checkpoint or nothing —
+    a crash mid-write must not corrupt what the restarted fleet restores."""
+    mc = _model_config(epochs=3)
+    shards = split_training_data(psv_dataset["root"], 2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    spec = _spec(
+        shards, 2, epochs=3,
+        spare_restarts=1,
+        heartbeat_interval_ms=200,
+        max_missed_heartbeats=5,
+    )
+    submitter = JobSubmitter(
+        spec,
+        _worker_cfg_factory(psv_dataset, mc, ckpt_dir,
+                            async_checkpoint=True),
+        launcher="process",
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        kill_injections={"worker-1": 0},
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 1
+    # atomic publish: only complete published checkpoints are ever visible
+    # to restore (kill-mid-write debris, if any, is .tmp.* the reader
+    # never parses; the age-gated sweep collects it later — see
+    # test_npz_checkpointer_sweeps_dead_writer_tmp)
+    ckpt = NpzCheckpointer(ckpt_dir)
+    assert ckpt.latest_epoch() == 2
