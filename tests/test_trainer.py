@@ -433,3 +433,14 @@ def test_scan_epoch_composes_with_shard_stream(psv_dataset):
         return jax.device_get(tr.state.params["shifu_output_0"]["kernel"])
 
     np.testing.assert_allclose(run(1), run(3), rtol=2e-5, atol=2e-6)
+
+
+def test_device_resident_bf16(psv_dataset):
+    """--device-resident composes with --dtype bfloat16 (fp32 host data
+    cast on device; loss finite, metrics sane)."""
+    ds = _dataset(psv_dataset)
+    trainer = Trainer(_mc(epochs=2), ds.schema.num_features, seed=2,
+                      dtype=jnp.bfloat16)
+    history = trainer.fit_device_resident(ds, batch_size=64)
+    assert np.isfinite(history[-1].training_loss)
+    assert 0.0 <= history[-1].auc <= 1.0
