@@ -35,10 +35,15 @@ hash_to_buckets = hashing.hash_to_buckets
 
 # The one-hot-matmul kernel sweeps the whole table once per lookup
 # (cost ∝ hash_size), so it wins for small tables and loses for large
-# ones.  The cutover is MEASURED, not assumed: scripts/
-# bench_pallas_embedding.py sweeps table 4K→256K x batch {4K,16K} on the
-# target chip and writes BENCH_PALLAS_EMBEDDING.json, whose
-# `pallas_wins_up_to_hash_size` field backs this constant.
+# ones.  The cutover is cost-model-derived (one-hot matmul does
+# batch*hash_size*dim MACs vs the gather's batch*dim loads, so the win
+# region is bounded by table size) and is MEASURABLE, not assumed:
+# scripts/bench_pallas_embedding.py sweeps table 4K→256K x batch
+# {4K,16K} on the chip, asserts bit-parity first, and writes
+# BENCH_PALLAS_EMBEDDING.json whose `pallas_wins_up_to_hash_size` field
+# replaces this constant's value whenever a chip measurement lands
+# (the tunneled bench chip was unreachable for the round-3 run; rerun
+# the script on TPU and update this number from the artifact).
 PALLAS_MAX_HASH_SIZE = 16384
 
 
