@@ -155,3 +155,23 @@ def test_zscale_stats_zero_std_guard():
     )
     _, stds = cc.zscale_stats([0])
     assert stds == [1.0]
+
+
+def test_every_tpu_conf_key_is_documented():
+    """No-drift guard: every shifu.tpu.* key constant must appear in
+    docs/operations.md's config table (new keys landing undocumented is
+    exactly how the reference accumulated dead keys)."""
+    import os
+
+    from shifu_tensorflow_tpu.config import keys as K
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(repo, "docs", "operations.md")).read()
+    tpu_keys = sorted(
+        v for n, v in vars(K).items()
+        if isinstance(v, str) and v.startswith("shifu.tpu.")
+        and not n.startswith("DEFAULT")
+    )
+    assert tpu_keys, "expected shifu.tpu.* key constants"
+    missing = [k for k in tpu_keys if k not in doc]
+    assert missing == [], f"keys missing from docs/operations.md: {missing}"
