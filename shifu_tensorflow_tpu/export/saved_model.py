@@ -229,8 +229,14 @@ def export_model(
     resolves to single-device implementations (full attention, local
     lookup); parameters are identical, so scores are too.
     """
+    import copy
+
     from shifu_tensorflow_tpu.models.factory import build_model
 
+    if feature_columns is None:
+        # the training graph's column positions ARE the serving contract;
+        # fall back to what the trainer was built with
+        feature_columns = getattr(trainer, "feature_columns", None)
     export_native_bundle(
         export_dir,
         trainer.state.params,
@@ -240,18 +246,24 @@ def export_model(
         zscale_means=zscale_means,
         zscale_stds=zscale_stds,
     )
-    serve_mc = ModelConfig.from_json(dict(trainer.model_config.raw))
-    if serve_mc.params.seq_len > 0:
+    # deep-copy: ModelConfig.from_json keeps a reference to the nested
+    # dicts, so mutating a shallow copy would rewrite the live trainer's
+    # config (and every future WorkerConfig/re-export built from it)
+    raw = copy.deepcopy(trainer.model_config.raw)
+    if trainer.model_config.params.seq_len > 0:
         # force single-device attention regardless of how training ran
-        raw = dict(serve_mc.raw)
         raw.setdefault("train", {}).setdefault("params", {})[
             "SeqAttention"
         ] = "full"
-        serve_mc = ModelConfig.from_json(raw)
+    serve_mc = ModelConfig.from_json(raw)
     serve_model = build_model(
         serve_mc,
         tuple(feature_columns) if feature_columns else None,
         shard_embeddings=False,
+        # 'auto' could resolve to the Pallas TPU kernel on a TPU backend,
+        # which jax2tf would bake (TPU-only) into the SavedModel; the
+        # portable gather is the only correct serving lookup
+        embedding_impl="xla",
     )
     from flax.core import meta as flax_meta
 

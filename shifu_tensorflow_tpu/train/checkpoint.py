@@ -25,6 +25,15 @@ from flax.core import meta as flax_meta
 from shifu_tensorflow_tpu.utils import fs
 
 
+def _host_tag() -> str:
+    """Hostname sanitized for use inside a ``.tmp.<host>.<pid>`` suffix:
+    the sweeper splits host from pid on the LAST dot, so dots inside the
+    hostname are fine, but path separators are not."""
+    import socket
+
+    return socket.gethostname().replace("/", "_") or "unknown-host"
+
+
 def _unbox(tree):
     """Strip flax AxisMetadata boxes (nn.Partitioned) so the on-disk pytree
     is canonical: whether a trainer annotates params for a 'model' mesh axis
@@ -120,10 +129,14 @@ class NpzCheckpointer:
     _TMP_MAX_AGE_S = 3600.0
 
     def _sweep_stale_tmp(self) -> None:
-        """Remove ``*.tmp.<pid>`` debris from writers that died mid-write
-        (SIGKILL'd workers — the fleet-restart drill): a dead pid's temp
-        file can never be renamed into place and would sit forever.  Local
-        directories only; pid liveness is meaningless across hosts."""
+        """Remove ``*.tmp.<host>.<pid>`` debris from writers that died
+        mid-write (SIGKILL'd workers — the fleet-restart drill): a dead
+        pid's temp file can never be renamed into place and would sit
+        forever.  A local path may still be a shared mount (NFS), so pid
+        liveness is only consulted for temps stamped with THIS hostname;
+        foreign-host temps (and legacy pid-only suffixes, whose origin is
+        unknowable) are swept purely by the max-age ceiling — a remote
+        writer's in-flight file is never unlinked inside its grace."""
         if "://" in self.directory:
             return
         import time
@@ -133,12 +146,17 @@ class NpzCheckpointer:
         except OSError:
             return
         now = time.time()
+        my_host = _host_tag()
         for name in names:
             if ".tmp." not in name:
                 continue
-            pid_part = name.rsplit(".tmp.", 1)[1]
+            part = name.rsplit(".tmp.", 1)[1]
+            if "." in part:
+                host, pid_s = part.rsplit(".", 1)
+            else:
+                host, pid_s = None, part
             try:
-                pid = int(pid_part)
+                pid = int(pid_s)
             except ValueError:
                 continue
             path = os.path.join(self.directory, name)
@@ -147,6 +165,8 @@ class NpzCheckpointer:
             except OSError:
                 continue
             if age < self._TMP_MAX_AGE_S:
+                if host != my_host:
+                    continue  # foreign/unknown writer: age ceiling only
                 if pid == os.getpid() or age < self._TMP_DEAD_GRACE_S:
                     continue
                 try:  # portable liveness: signal 0 (no /proc dependency)
@@ -213,7 +233,11 @@ class NpzCheckpointer:
     def _write(self, epoch: int, arrays: dict) -> None:
         import numpy as np
 
-        tmp = self._path(epoch) + f".tmp.{os.getpid()}"
+        # hostname in the suffix: a shared (NFS-mounted) checkpoint dir is
+        # indistinguishable from a local one by path, and pid liveness is
+        # meaningless for a writer on another host — the sweeper only
+        # pid-checks temps stamped with its own hostname
+        tmp = self._path(epoch) + f".tmp.{_host_tag()}.{os.getpid()}"
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, **arrays)
         fs.rename(tmp, self._path(epoch))  # atomic publish (local/hdfs)

@@ -207,6 +207,11 @@ class Trainer:
     ):
         self.model_config = model_config
         self.num_features = num_features
+        # retained so export_model can rebuild the serving graph with the
+        # same column positions the training graph used
+        self.feature_columns = (
+            tuple(feature_columns) if feature_columns is not None else None
+        )
         self.mesh = mesh
         self.worker_index = worker_index
         # cross-process SPMD (parallel.distributed.ProcessTopology): the
@@ -242,6 +247,13 @@ class Trainer:
 
         self.state = TrainState.create(
             apply_fn=self.model.apply, params=params, tx=self.tx
+        )
+        # strong-typed step: create() seeds step=0 as a weak-typed Python
+        # int, but every jitted step RETURNS a strong int32 state — left
+        # alone, the second dispatch retraces (and on TPU recompiles) just
+        # to promote the dtype
+        self.state = self.state.replace(
+            step=jnp.asarray(self.state.step, jnp.int32)
         )
 
         if mesh is not None:
@@ -369,16 +381,27 @@ class Trainer:
         """Chunked-scan epoch: K batches stacked per device dispatch.
 
         The last chunk pads with zero-weight no-op batches (exact no-ops by
-        the train-step body's has_rows gate) so exactly ONE scan shape ever
-        compiles.  Update semantics are identical to the per-step path —
-        same body, same order; only the dispatch granularity changes.
-        Cross-process SPMD stays in lockstep because fixed_step_batches
-        already guarantees identical per-process batch counts, hence
-        identical chunk counts and padding.
+        the train-step body's has_rows gate).  The stacked row count is
+        FIXED from the first chunk (aligned max batch within it), so a
+        constant-batch-size stream compiles exactly one scan shape and the
+        short tail batch pads into it; a stream whose batch size later
+        GROWS forces a one-time regrow, so distinct compiled shapes are
+        bounded by growths, never by the number of distinct batch sizes.
+        Update semantics are identical to the per-step path — same body,
+        same order; only the dispatch granularity changes.  Cross-process
+        SPMD stays in lockstep because fixed_step_batches already
+        guarantees identical per-process batch counts, hence identical
+        chunk counts and padding.
         """
+        import collections
+
         K = self.scan_steps
         n_real = 0
-        batch_rows = 0
+        fixed_rows: int | None = None
+        # real (unpadded) rows per emitted chunk, FIFO: prefetch runs the
+        # producer ahead of the consumer, but order is preserved, so the
+        # head entry always describes the chunk currently being consumed
+        rows_meta: collections.deque[int] = collections.deque()
 
         def _pad_rows(b: Batch, rows: int) -> Batch:
             """Zero-weight-pad a batch up to ``rows`` — free under the
@@ -396,16 +419,18 @@ class Trainer:
             }
 
         def _emit(buf: list[Batch]) -> Batch:
-            nonlocal batch_rows
-            # one stacked shape per chunk: every batch padded to the
-            # chunk's max row count, itself aligned to the mesh divisor —
-            # the scan-path equivalent of the per-step path's per-batch
-            # _pad_for_mesh (variable/indivisible batch sizes must not
-            # become a crash the moment scan_steps is raised)
+            nonlocal fixed_rows
+            # every batch padded to the fixed row count, itself aligned to
+            # the mesh divisor — the scan-path equivalent of the per-step
+            # path's per-batch _pad_for_mesh (variable/indivisible batch
+            # sizes must not become a crash the moment scan_steps is
+            # raised)
             rows = self.align_batch_size(
                 max(b["x"].shape[0] for b in buf)
             )
-            batch_rows = rows
+            if fixed_rows is None or rows > fixed_rows:
+                fixed_rows = rows
+            rows = fixed_rows
             if len(buf) < K:
                 pad = _zero_batch(rows, buf[0]["x"].shape[1],
                                   buf[0]["x"].dtype)
@@ -422,10 +447,12 @@ class Trainer:
                 buf.append(b)
                 if len(buf) == K:
                     n_real += K
+                    rows_meta.append(sum(c["x"].shape[0] for c in buf))
                     yield _emit(buf)
                     buf = []
             if buf:
                 n_real += len(buf)
+                rows_meta.append(sum(c["x"].shape[0] for c in buf))
                 yield _emit(buf)
 
         losses = []  # (K,) device arrays, chunk-pad entries NaN
@@ -434,8 +461,9 @@ class Trainer:
         ):
             self.state, chunk_losses = self._scan_epoch(self.state, stacked)
             losses.append(chunk_losses)
+            chunk_rows = rows_meta.popleft()
             if self.step_timer is not None:
-                self.step_timer.step(chunk_losses, rows=K * batch_rows)
+                self.step_timer.step(chunk_losses, rows=chunk_rows)
         if not losses:
             return float("nan"), 0
         vals = np.concatenate(

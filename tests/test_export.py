@@ -125,6 +125,54 @@ def test_saved_model_backend_applies_zscale(psv_dataset, tmp_path):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_export_does_not_mutate_trainer_config(tmp_path):
+    """Forcing SeqAttention='full' for the serving rebuild must act on a
+    deep copy: the trainer's raw config is reused for WorkerConfig
+    transport and re-exports, so a shallow-copy mutation would silently
+    swap ring/auto attention for full on the live job."""
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 1, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam",
+                              "ModelType": "sequence", "SeqLen": 8,
+                              "SeqDModel": 8, "SeqHeads": 2, "SeqBlocks": 1,
+                              "SeqAttention": "auto"}}}
+    )
+    t = Trainer(mc, 8)
+    export_model(str(tmp_path / "seq-model"), t)
+    assert t.model_config.raw["train"]["params"]["SeqAttention"] == "auto"
+    assert t.model_config.params.seq_attention == "auto"
+
+
+def test_export_defaults_feature_columns_from_trainer(tmp_path):
+    """A caller that omits feature_columns must get the TRAINING graph's
+    column positions, not a 0..n-1 default — otherwise wide_deep/embedding
+    scores silently disagree between training and serving."""
+    cols = (2, 4, 5, 7, 9, 11, 12, 14, 15, 17)
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 1, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam",
+                              "EmbeddingColumnNums": [4],
+                              "EmbeddingHashSize": 32, "EmbeddingDim": 4}}}
+    )
+    t = Trainer(mc, len(cols), feature_columns=cols)
+    export_dir = str(tmp_path / "cols-model")
+    export_model(export_dir, t)  # no feature_columns kwarg
+    arch = json.loads(
+        open(os.path.join(export_dir, "shifu_tpu_model.json")).read()
+    )
+    assert tuple(arch["feature_columns"]) == cols
+    # and the serving scores use those positions
+    x = np.random.default_rng(1).random((16, len(cols))).astype(np.float32)
+    with EvalModel(export_dir, backend="native") as em:
+        np.testing.assert_allclose(
+            em.compute_batch(x), t.predict(x), rtol=1e-5, atol=1e-6
+        )
+
+
 # ---- C++ scorer (cpp/stpu_scorer.cc — JNI-evaluator parity path) ----
 
 def _cpp_available():

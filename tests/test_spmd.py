@@ -185,25 +185,38 @@ def test_npz_checkpointer_async_roundtrip(tmp_path):
 
 
 def test_npz_checkpointer_sweeps_dead_writer_tmp(tmp_path):
-    """SIGKILL'd writers leave ckpt-N.npz.tmp.<pid> debris; construction
-    sweeps it once the pid is dead AND the file is past the in-flight
-    grace — young files and live/own pids are kept (a live writer in a
-    foreign pid namespace must never lose its in-flight file)."""
+    """SIGKILL'd writers leave ckpt-N.npz.tmp.<host>.<pid> debris;
+    construction sweeps it once the pid is dead AND the file is past the
+    in-flight grace — young files and live/own pids are kept.  Temps
+    stamped with a FOREIGN hostname (shared NFS checkpoint dir: the
+    writer's pid means nothing here) and legacy pid-only suffixes are
+    never pid-checked: only the max-age ceiling collects them."""
     import time
 
+    from shifu_tensorflow_tpu.train.checkpoint import _host_tag
+
     d = str(tmp_path)
-    dead = os.path.join(d, "ckpt-3.npz.tmp.999999")
-    young = os.path.join(d, "ckpt-4.npz.tmp.999998")
-    mine = os.path.join(d, f"ckpt-5.npz.tmp.{os.getpid()}")
-    for p in (dead, young, mine):
+    host = _host_tag()
+    dead = os.path.join(d, f"ckpt-3.npz.tmp.{host}.999999")
+    young = os.path.join(d, f"ckpt-4.npz.tmp.{host}.999998")
+    mine = os.path.join(d, f"ckpt-5.npz.tmp.{host}.{os.getpid()}")
+    foreign = os.path.join(d, "ckpt-6.npz.tmp.other-host.999999")
+    foreign_old = os.path.join(d, "ckpt-7.npz.tmp.other-host.999998")
+    legacy = os.path.join(d, "ckpt-8.npz.tmp.999997")
+    for p in (dead, young, mine, foreign, foreign_old, legacy):
         open(p, "w").write("partial")
     old_t = time.time() - 600  # past the 120s grace, under the 1h max
-    os.utime(dead, (old_t, old_t))
-    os.utime(mine, (old_t, old_t))
+    for p in (dead, mine, foreign, legacy):
+        os.utime(p, (old_t, old_t))
+    ancient = time.time() - 4000  # past the 1h debris ceiling
+    os.utime(foreign_old, (ancient, ancient))
     NpzCheckpointer(d)
-    assert not os.path.exists(dead)      # dead pid + past grace: swept
+    assert not os.path.exists(dead)      # own host, dead pid, past grace
     assert os.path.exists(young)         # young: could be in flight
     assert os.path.exists(mine)          # own pid: kept
+    assert os.path.exists(foreign)       # foreign host, inside ceiling
+    assert not os.path.exists(foreign_old)  # foreign but ancient: debris
+    assert os.path.exists(legacy)        # origin unknowable: ceiling only
 
 
 def test_sync_plan_agrees_max_steps_min_epoch(tiny_shards):

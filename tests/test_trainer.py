@@ -272,6 +272,43 @@ def test_scan_epoch_tail_padding_counts():
     np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
 
 
+def test_scan_epoch_fixed_shape_and_timer_rows():
+    """A stream with varying batch sizes compiles ONE scan shape (fixed
+    from the first chunk) as long as no later batch exceeds it, and the
+    step timer is fed each chunk's REAL row count — not a later chunk's
+    (the prefetch lookahead runs the producer ahead of the consumer)."""
+    mc = _mc(epochs=1)
+    rng_ = np.random.default_rng(9)
+
+    def mk(n):
+        return {
+            "x": rng_.normal(size=(n, 6)).astype(np.float32),
+            "y": (rng_.random((n, 1)) < 0.4).astype(np.float32),
+            "w": np.ones((n, 1), np.float32),
+        }
+
+    trainer = Trainer(mc, 6, seed=1, scan_steps=2)
+    rows_seen = []
+
+    class _Timer:
+        def step(self, loss, rows):
+            rows_seen.append(rows)
+
+    trainer.step_timer = _Timer()
+    # first chunk fixes rows=32; later smaller batches pad into it
+    batches = [mk(32), mk(32), mk(20), mk(8), mk(16)]
+    loss, n = trainer.train_epoch(iter(batches))
+    assert n == 5
+    assert rows_seen == [64, 28, 16]  # real rows per chunk, in order
+    assert np.isfinite(loss)
+    sizes = trainer._scan_epoch._cache_size()
+    assert sizes == 1, f"expected one compiled scan shape, got {sizes}"
+    # a LARGER later batch regrows once — exactly one extra compile
+    loss2, n2 = trainer.train_epoch(iter([mk(48), mk(32)]))
+    assert n2 == 2
+    assert trainer._scan_epoch._cache_size() == 2
+
+
 def test_scan_epoch_on_mesh_matches_per_step(psv_dataset):
     """Stacked chunks shard the batch dim over the data axis; mesh-sharded
     scan training equals mesh-sharded per-step training."""
