@@ -8,8 +8,10 @@ produces a working (slower) wheel — same degrade-not-break contract as the
 lazy in-tree build (_native/__init__.py).
 """
 
+import os
 import shutil
 import subprocess
+import sys
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
@@ -17,8 +19,22 @@ from setuptools.command.build_py import build_py
 
 class BuildNativeThenPy(build_py):
     def run(self) -> None:
-        if shutil.which("make") and shutil.which("g++"):
+        if not os.path.isdir("cpp"):
+            # an sdist missing cpp/ (MANIFEST.in ships it) would silently
+            # produce a pure-Python-only wheel — say so loudly
+            print(
+                "WARNING: cpp/ sources absent; wheel will contain no "
+                "native libraries (pure-Python fallbacks only)",
+                file=sys.stderr,
+            )
+        elif shutil.which("make") and shutil.which("g++"):
             subprocess.run(["make", "-C", "cpp"], check=False)
+        else:
+            print(
+                "WARNING: no make/g++ toolchain; wheel will contain no "
+                "native libraries (pure-Python fallbacks only)",
+                file=sys.stderr,
+            )
         super().run()
 
 

@@ -180,6 +180,12 @@ def _prune(args) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "build":
+        # the only subcommand that can touch jax (dataset internals);
+        # status/prune stay jax-free and fast
+        from shifu_tensorflow_tpu.utils.jaxenv import honor_cpu_pin
+
+        honor_cpu_pin()
     return {"build": _build, "status": _status, "prune": _prune}[args.cmd](args)
 
 

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    # after parse_args (--help must not pay a jax import), before any
+    # jax-touching work
+    from shifu_tensorflow_tpu.utils.jaxenv import honor_cpu_pin
+
+    honor_cpu_pin()
     paths = list_data_files(args.data_path)
     if not paths:
         print(f"no data files under {args.data_path}", file=sys.stderr)

@@ -537,6 +537,11 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    # after parse_args (--help must not pay a jax import), before any
+    # jax-touching work
+    from shifu_tensorflow_tpu.utils.jaxenv import honor_cpu_pin
+
+    honor_cpu_pin()
     conf = load_conf(args)
     if not conf.get(K.TRAINING_DATA_PATH):
         print("--training-data-path (or a globalconfig providing "

@@ -6,6 +6,14 @@ from __future__ import annotations
 import os
 
 
+def honor_cpu_pin() -> None:
+    """CLI-entry guard: when the user pinned ``JAX_PLATFORMS=cpu``, make
+    the pin robust by also dropping tunneled-TPU PJRT plugins whose init
+    can block backend discovery despite the pin.  No-op otherwise."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        force_cpu_backend()
+
+
 def force_cpu_backend(device_count: int | None = None) -> None:
     """Pin JAX to the CPU backend and drop tunneled-TPU PJRT plugins.
 
