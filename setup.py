@@ -28,7 +28,17 @@ class BuildNativeThenPy(build_py):
                 file=sys.stderr,
             )
         elif shutil.which("make") and shutil.which("g++"):
-            subprocess.run(["make", "-C", "cpp"], check=False)
+            proc = subprocess.run(["make", "-C", "cpp"], check=False)
+            built = [
+                os.path.join("shifu_tensorflow_tpu", "_native", so)
+                for so in ("libstpu_data.so", "libstpu_scorer.so")
+            ]
+            if proc.returncode != 0 or not all(map(os.path.exists, built)):
+                print(
+                    "WARNING: native compile failed; wheel will contain "
+                    "no native libraries (pure-Python fallbacks only)",
+                    file=sys.stderr,
+                )
         else:
             print(
                 "WARNING: no make/g++ toolchain; wheel will contain no "

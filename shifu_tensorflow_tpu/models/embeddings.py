@@ -35,16 +35,36 @@ hash_to_buckets = hashing.hash_to_buckets
 
 # The one-hot-matmul kernel sweeps the whole table once per lookup
 # (cost ∝ hash_size), so it wins for small tables and loses for large
-# ones.  The cutover is cost-model-derived (one-hot matmul does
-# batch*hash_size*dim MACs vs the gather's batch*dim loads, so the win
-# region is bounded by table size) and is MEASURABLE, not assumed:
+# ones.  The cutover must come from MEASUREMENT, not the cost model:
 # scripts/bench_pallas_embedding.py sweeps table 4K→256K x batch
 # {4K,16K} on the chip, asserts bit-parity first, and writes
 # BENCH_PALLAS_EMBEDDING.json whose `pallas_wins_up_to_hash_size` field
-# replaces this constant's value whenever a chip measurement lands
-# (the tunneled bench chip was unreachable for the round-3 run; rerun
-# the script on TPU and update this number from the artifact).
-PALLAS_MAX_HASH_SIZE = 16384
+# is this constant's source of truth.
+#
+# DEFAULT 0 = auto NEVER picks pallas (round-4 policy, per the round-3
+# verdict: the tunneled chip was unreachable for two straight rounds, so
+# an unmeasured fast path defaulted on is a perf liability, not a
+# feature).  ``impl="pallas"`` stays available explicitly, and a measured
+# deployment re-enables the auto cutover by setting
+# STPU_PALLAS_MAX_HASH_SIZE to the artifact's winning table size.
+import os as _os
+
+
+def _env_cutover() -> int:
+    raw = _os.environ.get("STPU_PALLAS_MAX_HASH_SIZE", "0")
+    try:
+        return int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"STPU_PALLAS_MAX_HASH_SIZE={raw!r} is not an integer; "
+            "keeping the safe default 0 (auto never picks pallas)"
+        )
+        return 0
+
+
+PALLAS_MAX_HASH_SIZE = _env_cutover()
 
 
 def _resolve_impl(impl: str, sharded: bool, hash_size: int = 0) -> str:
@@ -54,7 +74,8 @@ def _resolve_impl(impl: str, sharded: bool, hash_size: int = 0) -> str:
         # a 'model'-sharded table needs XLA's partitioned gather; the pallas
         # kernel has no partitioning rule and would force an all-gather
         return "xla"
-    if hash_size > PALLAS_MAX_HASH_SIZE:
+    if PALLAS_MAX_HASH_SIZE <= 0 or hash_size > PALLAS_MAX_HASH_SIZE:
+        # unmeasured (or out of the measured win region): portable gather
         return "xla"
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 

@@ -82,14 +82,28 @@ def test_module_pallas_impl_matches_xla():
     np.testing.assert_array_equal(np.asarray(out_pl), np.asarray(out_xla))
 
 
-def test_auto_impl_off_tpu_is_xla():
+def test_auto_impl_off_tpu_is_xla(monkeypatch):
+    from shifu_tensorflow_tpu.models import embeddings
     from shifu_tensorflow_tpu.models.embeddings import _resolve_impl
 
     assert _resolve_impl("auto", sharded=True) == "xla"
     # on the CPU test backend auto must not pick pallas
     assert _resolve_impl("auto", sharded=False) == "xla"
     assert _resolve_impl("pallas", sharded=False) == "pallas"
-    # huge tables stay on XLA's gather even on TPU (cost ∝ hash_size)
+    # UNMEASURED default (PALLAS_MAX_HASH_SIZE=0): auto never picks
+    # pallas, even for tiny tables on any backend — the cutover exists
+    # only once BENCH_PALLAS_EMBEDDING.json backs it.  (Pinned via
+    # monkeypatch: a measured host may legitimately export
+    # STPU_PALLAS_MAX_HASH_SIZE, which must not fail this suite.)
+    monkeypatch.setattr(embeddings, "PALLAS_MAX_HASH_SIZE", 0)
+    assert _resolve_impl("auto", sharded=False, hash_size=128) == "xla"
+    # malformed env values keep the safe default instead of crashing import
+    monkeypatch.setenv("STPU_PALLAS_MAX_HASH_SIZE", "16K")
+    with pytest.warns(UserWarning, match="not an integer"):
+        assert embeddings._env_cutover() == 0
+    # a measured deployment re-enables the win region: cutover honored,
+    # huge tables still stay on XLA's gather (cost ∝ hash_size)
+    monkeypatch.setattr(embeddings, "PALLAS_MAX_HASH_SIZE", 16384)
     assert _resolve_impl("auto", sharded=False, hash_size=1 << 20) == "xla"
 
 
