@@ -98,6 +98,12 @@ DEFAULT_PREFETCH_DEPTH = 2
 # shorter than dispatch (small models, tunneled/driven-from-Python hosts)
 SCAN_STEPS = TPU_PREFIX + "scan-steps"
 DEFAULT_SCAN_STEPS = 1
+# gradient accumulation: microbatches per optimizer update (1 = off).
+# The update equals a single step on the concatenated batch — effective
+# batch sizes beyond HBM.  Mutually exclusive with scan-steps (which
+# chunks UPDATES per dispatch, not microbatches per update).
+ACCUM_STEPS = TPU_PREFIX + "accum-steps"
+DEFAULT_ACCUM_STEPS = 1
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
 DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
 # background-thread checkpoint writes for the flat-file (SPMD) path: the

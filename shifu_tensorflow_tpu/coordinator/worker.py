@@ -77,6 +77,8 @@ class WorkerConfig:
     prefetch_depth: int = 2
     # batches per lax.scan dispatch (conf key shifu.tpu.scan-steps)
     scan_steps: int = 1
+    # microbatches per optimizer update (conf key shifu.tpu.accum-steps)
+    accum_steps: int = 1
     # background checkpoint writes (conf key shifu.tpu.async-checkpoint)
     async_checkpoint: bool = False
     # binary shard cache directory (data/cache.py); None = no caching
@@ -94,7 +96,8 @@ class WorkerConfig:
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
-                "scan_steps", "async_checkpoint", "cache_dir",
+                "scan_steps", "accum_steps", "async_checkpoint",
+                "cache_dir",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -275,6 +278,7 @@ def run_worker(cfg: WorkerConfig, *,
             topology=topology,
             prefetch_depth=cfg.prefetch_depth,
             scan_steps=cfg.scan_steps,
+            accum_steps=cfg.accum_steps,
             **extra,
         )
 

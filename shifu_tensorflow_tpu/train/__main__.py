@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batches per lax.scan dispatch (default 1 = one "
                         "dispatch per step; raise to amortize dispatch "
                         "latency when steps are short)")
+    p.add_argument("--accum-steps", type=int, default=None,
+                   help="microbatches per optimizer update (default 1 = "
+                        "off); the update equals one step on the "
+                        "concatenated batch — effective batch sizes "
+                        "beyond device memory")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -180,6 +185,7 @@ def trainer_extras(args, conf: Conf) -> dict:
         "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
                                        K.DEFAULT_PREFETCH_DEPTH),
         "scan_steps": resolve_scan_steps(args, conf),
+        "accum_steps": resolve_accum_steps(args, conf),
     }
 
 
@@ -191,6 +197,7 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
                                        K.DEFAULT_PREFETCH_DEPTH),
         "scan_steps": resolve_scan_steps(args, conf),
+        "accum_steps": resolve_accum_steps(args, conf),
         "async_checkpoint": conf.get_bool(K.ASYNC_CHECKPOINT,
                                           K.DEFAULT_ASYNC_CHECKPOINT),
         "cache_dir": conf.get(K.CACHE_DIR),
@@ -204,6 +211,13 @@ def resolve_scan_steps(args, conf: Conf) -> int:
     if getattr(args, "scan_steps", None) is not None:
         return args.scan_steps
     return conf.get_int(K.SCAN_STEPS, K.DEFAULT_SCAN_STEPS)
+
+
+def resolve_accum_steps(args, conf: Conf) -> int:
+    """Same precedence as resolve_scan_steps, for shifu.tpu.accum-steps."""
+    if getattr(args, "accum_steps", None) is not None:
+        return args.accum_steps
+    return conf.get_int(K.ACCUM_STEPS, K.DEFAULT_ACCUM_STEPS)
 
 
 def job_spec_kwargs(conf: Conf) -> dict:
@@ -413,10 +427,22 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
 
     n_workers = conf.get_int(K.instances_key(K.WORKER_JOB_NAME), 1)
     epochs = conf.get_int(K.EPOCHS, model_config.num_train_epochs)
-    # preflight the dtype mapping HERE: a bad shifu.tpu.dtype must be one
-    # clean error before launch, not an N-worker crash cascade after
-    # cluster bring-up
-    trainer_extras(args, conf)
+    # preflight config HERE: a bad shifu.tpu.dtype or an invalid
+    # scan/accum combination must be one clean error before launch, not
+    # an N-worker crash cascade after cluster bring-up
+    extras = trainer_extras(args, conf)
+    if extras["scan_steps"] > 1 and extras["accum_steps"] > 1:
+        raise SystemExit(
+            f"{K.SCAN_STEPS} and {K.ACCUM_STEPS} are mutually exclusive: "
+            "one chunks UPDATES per dispatch, the other chunks "
+            "microbatches per UPDATE — drop one"
+        )
+    if extras["accum_steps"] > 1 and model_config.params.algorithm == "sagn":
+        raise SystemExit(
+            f"Algorithm=sagn does not compose with {K.ACCUM_STEPS}: the "
+            "SAGN window already defines its own accumulation semantics "
+            "(UpdateWindow)"
+        )
     if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
                                              K.DEFAULT_DEVICE_RESIDENT):
         # silently training a different mode than requested is a bug; the

@@ -164,6 +164,17 @@ class SAGNTrainer(Trainer):
         local_optimizer: str | None = None,
         **kw,
     ):
+        # Gradient accumulation is REJECTED rather than ignored — and
+        # BEFORE the expensive super().__init__ (model build, param init):
+        # it would change what an "update window" means (accumulate-then-
+        # update vs local-steps-then-average), and silently training
+        # different semantics than configured is the round-1 class of bug.
+        if int(kw.get("accum_steps", 1)) > 1:
+            raise ValueError(
+                "Algorithm=sagn does not compose with "
+                "shifu.tpu.accum-steps: the SAGN window already defines "
+                "its own accumulation semantics (UpdateWindow)"
+            )
         super().__init__(model_config, num_features, **kw)
         # SAGN's window step already batches update_window microbatches per
         # dispatch — the scan_steps chunking would compose confusingly with

@@ -167,6 +167,72 @@ def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     return scan_epoch
 
 
+def make_accum_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
+                    donate: bool | None = None):
+    """Gradient accumulation: A microbatches -> ONE optimizer update,
+    mathematically equal to a single step on the concatenated batch.
+
+    The TPU-idiomatic route to effective batch sizes beyond HBM: the
+    stacked chunk ``{"x": (A, B, F), ...}`` is scanned on-device, each
+    microbatch contributing its SUM-form data loss (the weighted loss
+    times its nonzero-weight count — both losses normalize by that count,
+    ops/losses.py) and gradients; the totals divide by the union's
+    nonzero count, so the update equals the big-batch step exactly (up to
+    float associativity) — unlike SAGN's local-SGD windows (train/sagn.py),
+    which intentionally change update semantics.  Zero-weight padding
+    microbatches contribute nothing, so short tail groups stay exact.
+    """
+    if donate is None:
+        donate = donation_is_safe()
+    loss_fn = get_loss(loss_name)
+
+    def sum_form(params, mb):
+        pred = apply_fn({"params": params}, mb["x"])
+        n = jnp.sum((mb["w"] != 0.0).astype(jnp.float32))
+        loss = loss_fn(pred, mb["y"], mb["w"])
+        # loss is sum/count; recover the sum (0 for all-padding micros,
+        # where loss is 0/max(count,1) = 0 already, but guard anyway)
+        return jnp.where(n > 0, loss * n, 0.0), n
+
+    grad_fn = jax.value_and_grad(sum_form, has_aux=True)
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def accum_step(state: TrainState, stacked: Batch):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p), state.params
+        )
+
+        def body(carry, mb):
+            g_acc, s_acc, n_acc = carry
+            (s, n), g = grad_fn(state.params, mb)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, s_acc + s, n_acc + n), None
+
+        (g_sum, s_tot, n_tot), _ = jax.lax.scan(
+            body, (zeros, jnp.asarray(0.0), jnp.asarray(0.0)), stacked
+        )
+        has_rows = n_tot > 0
+        denom = jnp.where(has_rows, n_tot, 1.0)
+        grads = jax.tree_util.tree_map(lambda g: g / denom, g_sum)
+        loss = s_tot / denom
+        if l2:
+            # once per UPDATE, like the big-batch step — not per microbatch
+            l2_loss, l2_g = jax.value_and_grad(
+                lambda p: l2_penalty(p, l2)
+            )(state.params)
+            grads = jax.tree_util.tree_map(jnp.add, grads, l2_g)
+            loss = loss + l2_loss
+        state = jax.lax.cond(
+            has_rows,
+            lambda s: s.apply_gradients(grads=grads),
+            lambda s: s,
+            state,
+        )
+        return state, jnp.where(has_rows, loss, jnp.nan)
+
+    return accum_step
+
+
 def make_eval_step_body(apply_fn, loss_name: str = "mse"):
     """Un-jitted (params, batch) -> (loss, pred) — shared by the per-batch
     eval step and the device-resident scanned eval, so the all-padding
@@ -204,7 +270,20 @@ class Trainer:
         topology: "Any | None" = None,
         prefetch_depth: int = 2,
         scan_steps: int = 1,
+        accum_steps: int = 1,
     ):
+        # validate the cheap two-int invariant FIRST: a bad combination
+        # must fail in microseconds, not after model build + param init +
+        # mesh sharding
+        self.scan_steps = max(1, int(scan_steps))
+        self.accum_steps = max(1, int(accum_steps))
+        if self.scan_steps > 1 and self.accum_steps > 1:
+            raise ValueError(
+                "scan_steps and accum_steps are mutually exclusive: one "
+                "chunks UPDATES per dispatch, the other chunks "
+                "microbatches per UPDATE (shifu.tpu.scan-steps / "
+                "shifu.tpu.accum-steps)"
+            )
         self.model_config = model_config
         self.num_features = num_features
         # retained so export_model can rebuild the serving graph with the
@@ -290,14 +369,21 @@ class Trainer:
             self.model.apply, loss, model_config.params.l2_reg
         )
         self._eval_step = make_eval_step(self.model.apply, loss)
-        # chunked-scan epochs (conf key shifu.tpu.scan-steps): accumulate
-        # this many batches and run them as one lax.scan dispatch; 1 = the
-        # plain per-step path
-        self.scan_steps = max(1, int(scan_steps))
+        # chunked-scan epochs (conf key shifu.tpu.scan-steps, validated
+        # at the top of __init__): batches per lax.scan dispatch; 1 = the
+        # plain per-step path.  accum_steps (shifu.tpu.accum-steps):
+        # microbatches per ONE optimizer update — effective batch sizes
+        # beyond HBM.
         self._scan_epoch = (
             make_scan_epoch(self.model.apply, loss,
                             model_config.params.l2_reg)
             if self.scan_steps > 1
+            else None
+        )
+        self._accum_step = (
+            make_accum_step(self.model.apply, loss,
+                            model_config.params.l2_reg)
+            if self.accum_steps > 1
             else None
         )
         # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
@@ -359,6 +445,8 @@ class Trainer:
         """Run one epoch; returns (mean loss over batches, batch count)."""
         if self._scan_epoch is not None:
             return self._train_epoch_scan(batches)
+        if self._accum_step is not None:
+            return self._train_epoch_accum(batches)
         losses = []
         for batch in prefetch_to_device(batches, put=self._put,
                                         depth=self.prefetch_depth):
@@ -377,31 +465,32 @@ class Trainer:
             len(losses),
         )
 
-    def _train_epoch_scan(self, batches: Iterable[Batch]) -> tuple[float, int]:
-        """Chunked-scan epoch: K batches stacked per device dispatch.
+    def _stacked_chunks(self, batches: Iterable[Batch], K: int):
+        """Group K batches into stacked ``(K, B, ...)`` chunks for the
+        scan/accum paths; returns ``(generator, rows_meta, counts)``.
 
-        The last chunk pads with zero-weight no-op batches (exact no-ops by
-        the train-step body's has_rows gate).  The stacked row count is
-        FIXED from the first chunk (aligned max batch within it), so a
-        constant-batch-size stream compiles exactly one scan shape and the
-        short tail batch pads into it; a stream whose batch size later
-        GROWS forces a one-time regrow, so distinct compiled shapes are
-        bounded by growths, never by the number of distinct batch sizes.
-        Update semantics are identical to the per-step path — same body,
-        same order; only the dispatch granularity changes.  Cross-process
-        SPMD stays in lockstep because fixed_step_batches already
-        guarantees identical per-process batch counts, hence identical
-        chunk counts and padding.
+        The last chunk pads with zero-weight no-op batches (exact no-ops
+        by the step bodies' has_rows/zero-count gates).  The stacked row
+        count is FIXED from the first chunk (aligned max batch within
+        it), so a constant-batch-size stream compiles exactly one shape
+        and the short tail batch pads into it; a stream whose batch size
+        later GROWS forces a one-time regrow, so distinct compiled shapes
+        are bounded by growths, never by the number of distinct batch
+        sizes.  Cross-process SPMD stays in lockstep because
+        fixed_step_batches already guarantees identical per-process batch
+        counts, hence identical chunk counts and padding.
+
+        ``rows_meta`` is a FIFO of each chunk's real (unpadded) row
+        count: prefetch runs the producer ahead of the consumer, but
+        order is preserved, so the head entry always describes the chunk
+        currently being consumed.  ``counts["real"]`` accumulates the
+        real batch count.
         """
         import collections
 
-        K = self.scan_steps
-        n_real = 0
         fixed_rows: int | None = None
-        # real (unpadded) rows per emitted chunk, FIFO: prefetch runs the
-        # producer ahead of the consumer, but order is preserved, so the
-        # head entry always describes the chunk currently being consumed
         rows_meta: collections.deque[int] = collections.deque()
+        counts = {"real": 0}
 
         def _pad_rows(b: Batch, rows: int) -> Batch:
             """Zero-weight-pad a batch up to ``rows`` — free under the
@@ -421,10 +510,10 @@ class Trainer:
         def _emit(buf: list[Batch]) -> Batch:
             nonlocal fixed_rows
             # every batch padded to the fixed row count, itself aligned to
-            # the mesh divisor — the scan-path equivalent of the per-step
+            # the mesh divisor — the stacked equivalent of the per-step
             # path's per-batch _pad_for_mesh (variable/indivisible batch
-            # sizes must not become a crash the moment scan_steps is
-            # raised)
+            # sizes must not become a crash the moment chunking is
+            # enabled)
             rows = self.align_batch_size(
                 max(b["x"].shape[0] for b in buf)
             )
@@ -440,24 +529,34 @@ class Trainer:
                 for k in buf[0]
             }
 
-        def chunk_iter():
-            nonlocal n_real
+        def gen():
             buf: list[Batch] = []
             for b in batches:
                 buf.append(b)
                 if len(buf) == K:
-                    n_real += K
+                    counts["real"] += K
                     rows_meta.append(sum(c["x"].shape[0] for c in buf))
                     yield _emit(buf)
                     buf = []
             if buf:
-                n_real += len(buf)
+                counts["real"] += len(buf)
                 rows_meta.append(sum(c["x"].shape[0] for c in buf))
                 yield _emit(buf)
 
+        return gen(), rows_meta, counts
+
+    def _train_epoch_scan(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        """Chunked-scan epoch: K batches stacked per device dispatch —
+        K sequential optimizer updates in ONE dispatch.  Update semantics
+        are identical to the per-step path — same body, same order; only
+        the dispatch granularity changes (see _stacked_chunks for the
+        shape discipline)."""
+        chunks, rows_meta, counts = self._stacked_chunks(
+            batches, self.scan_steps
+        )
         losses = []  # (K,) device arrays, chunk-pad entries NaN
         for stacked in prefetch_to_device(
-            chunk_iter(), put=self._put_stacked, depth=self.prefetch_depth
+            chunks, put=self._put_stacked, depth=self.prefetch_depth
         ):
             self.state, chunk_losses = self._scan_epoch(self.state, stacked)
             losses.append(chunk_losses)
@@ -472,7 +571,35 @@ class Trainer:
         real = vals[~np.isnan(vals)]
         return (
             float(np.mean(real)) if real.size else float("nan"),
-            n_real,
+            counts["real"],
+        )
+
+    def _train_epoch_accum(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        """Accumulated epoch: A microbatches stacked per ONE optimizer
+        update (make_accum_step) — the update equals a single step on the
+        concatenated batch, so global_step advances once per group.  The
+        reported batch count stays the real microbatch count (data
+        accounting); the epoch loss is the nanmean of per-UPDATE losses
+        (a short tail group's zero-weight pad micros contribute nothing)."""
+        chunks, rows_meta, counts = self._stacked_chunks(
+            batches, self.accum_steps
+        )
+        losses = []  # scalars, one per update; all-padding groups NaN
+        for stacked in prefetch_to_device(
+            chunks, put=self._put_stacked, depth=self.prefetch_depth
+        ):
+            self.state, loss = self._accum_step(self.state, stacked)
+            losses.append(loss)
+            chunk_rows = rows_meta.popleft()
+            if self.step_timer is not None:
+                self.step_timer.step(loss, rows=chunk_rows)
+        if not losses:
+            return float("nan"), 0
+        vals = np.asarray(jax.device_get(losses))
+        real = vals[~np.isnan(vals)]
+        return (
+            float(np.mean(real)) if real.size else float("nan"),
+            counts["real"],
         )
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
@@ -590,6 +717,14 @@ class Trainer:
             raise ValueError(
                 "fit_device_resident is single-controller; multi-process "
                 "SPMD jobs stream per-process shards (fit_stream)"
+            )
+        if self.accum_steps > 1:
+            # silently training per-B updates when the user configured
+            # A-microbatch accumulation would change effective batch math
+            raise ValueError(
+                "fit_device_resident does not support "
+                "shifu.tpu.accum-steps; raise the batch size instead "
+                "(the dataset already fits in device memory)"
             )
         epochs = epochs or self.model_config.num_train_epochs
         B = self.align_batch_size(batch_size or self.model_config.batch_size)

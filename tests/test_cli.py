@@ -348,3 +348,32 @@ def test_device_resident_rejected_for_multi_worker_and_sagn(tmp_path):
         "Algorithm": "sagn"}}}))
     with pytest.raises(SystemExit, match="sagn"):
         main(base + ["--model-config", str(mc)])
+
+
+def test_multi_worker_preflight_rejects_bad_accum_configs(tmp_path):
+    """Invalid scan/accum combinations must be ONE clean error before
+    launch — not an N-worker crash cascade after cluster bring-up."""
+    import gzip
+    import json
+
+    import pytest
+
+    from shifu_tensorflow_tpu.train.__main__ import main
+
+    with gzip.open(tmp_path / "part-0.gz", "wt") as f:
+        for i in range(50):
+            f.write(f"{i % 2}|0.5|1.5|1.0\n")
+    base = [
+        "--training-data-path", str(tmp_path),
+        "--feature-columns", "1,2", "--workers", "2",
+    ]
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        main(base + ["--scan-steps", "4", "--accum-steps", "4"])
+
+    mc = tmp_path / "mc.json"
+    mc.write_text(json.dumps({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.1,
+        "Algorithm": "sagn"}}}))
+    with pytest.raises(SystemExit, match="sagn"):
+        main(base + ["--model-config", str(mc), "--accum-steps", "4"])

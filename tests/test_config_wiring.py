@@ -244,6 +244,33 @@ def test_scan_steps_key_reaches_trainer():
     assert trainer.scan_steps == 1 and trainer._scan_epoch is None
 
 
+def test_accum_steps_key_reaches_trainer():
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train import make_trainer
+    from shifu_tensorflow_tpu.train.__main__ import worker_runtime_kwargs
+
+    extras = trainer_extras(_args(), _conf({K.ACCUM_STEPS: 4}))
+    assert extras["accum_steps"] == 4
+    # CLI flag wins over conf
+    extras = trainer_extras(_args(["--accum-steps", "2"]),
+                            _conf({K.ACCUM_STEPS: 4}))
+    assert extras["accum_steps"] == 2
+    # multi-worker path resolves the same key
+    kw = worker_runtime_kwargs(_args(), _conf({K.ACCUM_STEPS: 4}))
+    assert kw["accum_steps"] == 4
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1), accum_steps=4)
+    assert trainer.accum_steps == 4
+    assert trainer._accum_step is not None
+    # default stays on the per-step path
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1))
+    assert trainer.accum_steps == 1 and trainer._accum_step is None
+
+
 def test_async_checkpoint_key_reaches_worker_config():
     """shifu.tpu.async-checkpoint drives WorkerConfig.async_checkpoint via
     the run_multi field resolution (worker_runtime_kwargs) and lands in

@@ -309,6 +309,85 @@ def test_scan_epoch_fixed_shape_and_timer_rows():
     assert trainer._scan_epoch._cache_size() == 2
 
 
+# ---- gradient accumulation (shifu.tpu.accum-steps) ----
+
+def test_accum_step_equals_big_batch_step():
+    """accum_steps=A over A microbatches must produce the SAME update as
+    one step on the concatenated batch — including the SUM_BY_NONZERO
+    normalization, the tail group (zero-weight pad micros), and the
+    l2 term applied once per update."""
+    mc = _mc(epochs=1, L2Reg=0.01)
+    rng_ = np.random.default_rng(11)
+
+    def mk(n):
+        return {
+            "x": rng_.normal(size=(n, 6)).astype(np.float32),
+            "y": (rng_.random((n, 1)) < 0.4).astype(np.float32),
+            "w": (rng_.random((n, 1)) < 0.9).astype(np.float32),  # some 0s
+        }
+
+    micros = [mk(32) for _ in range(6)]  # A=4: one full group + tail of 2
+
+    t_acc = Trainer(mc, 6, seed=2, accum_steps=4)
+    loss_acc, n = t_acc.train_epoch(iter(micros))
+    assert n == 6
+    # one update per group: 2 updates
+    assert int(jax.device_get(t_acc.state.step)) == 2
+
+    # reference: per-step trainer fed the CONCATENATED groups
+    def cat(bs):
+        return {k: np.concatenate([b[k] for b in bs]) for k in bs[0]}
+
+    t_big = Trainer(mc, 6, seed=2)
+    loss_big, n_big = t_big.train_epoch(
+        iter([cat(micros[:4]), cat(micros[4:])])
+    )
+    assert n_big == 2
+    a = jax.device_get(t_acc.state.params["shifu_output_0"]["kernel"])
+    b = jax.device_get(t_big.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(loss_acc, loss_big, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_on_mesh_matches_single_device():
+    """The stacked chunk shards the batch dim over the data axis; mesh
+    accumulation equals single-device accumulation."""
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+
+    mc = _mc(epochs=1, opt="sgd", lr=0.1)
+    rng_ = np.random.default_rng(13)
+
+    def mk(n):
+        return {
+            "x": rng_.normal(size=(n, 6)).astype(np.float32),
+            "y": (rng_.random((n, 1)) < 0.4).astype(np.float32),
+            "w": np.ones((n, 1), np.float32),
+        }
+
+    micros = [mk(64) for _ in range(4)]
+    t_mesh = Trainer(mc, 6, seed=5, accum_steps=2, mesh=make_mesh("data:-1"))
+    t_mesh.train_epoch(iter(micros))
+    t_one = Trainer(mc, 6, seed=5, accum_steps=2)
+    t_one.train_epoch(iter(micros))
+    a = jax.device_get(t_mesh.state.params["shifu_output_0"]["kernel"])
+    b = jax.device_get(t_one.state.params["shifu_output_0"]["kernel"])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_accum_and_scan_are_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Trainer(_mc(epochs=1), 6, scan_steps=4, accum_steps=4)
+
+
+def test_sagn_rejects_accum_steps():
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    sagn_mc = _mc(epochs=1, Algorithm="sagn")
+    with pytest.raises(ValueError, match="accum-steps"):
+        make_trainer(sagn_mc, 6, accum_steps=4)
+
+
 def test_scan_epoch_on_mesh_matches_per_step(psv_dataset):
     """Stacked chunks shard the batch dim over the data axis; mesh-sharded
     scan training equals mesh-sharded per-step training."""
