@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "off); the update equals one step on the "
                         "concatenated batch — effective batch sizes "
                         "beyond device memory")
+    p.add_argument("--early-stop-ks", type=float, default=None,
+                   help="stop once validation KS reaches this target "
+                        "(default 0 = off; single-process only)")
+    p.add_argument("--early-stop-patience", type=int, default=None,
+                   help="stop after N epochs without validation-loss "
+                        "improvement (default 0 = off; single-process "
+                        "only)")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -220,6 +227,23 @@ def resolve_accum_steps(args, conf: Conf) -> int:
     return conf.get_int(K.ACCUM_STEPS, K.DEFAULT_ACCUM_STEPS)
 
 
+def resolve_early_stop(args, conf: Conf):
+    """shifu.tpu.early-stop-ks / early-stop-patience -> EarlyStopper (or
+    None when both are off).  CLI flags win with the usual precedence."""
+    from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+
+    ks = (args.early_stop_ks if getattr(args, "early_stop_ks", None)
+          is not None
+          else conf.get_float(K.EARLY_STOP_KS, K.DEFAULT_EARLY_STOP_KS))
+    patience = (args.early_stop_patience
+                if getattr(args, "early_stop_patience", None) is not None
+                else conf.get_int(K.EARLY_STOP_PATIENCE,
+                                  K.DEFAULT_EARLY_STOP_PATIENCE))
+    if ks <= 0 and patience <= 0:
+        return None
+    return EarlyStopper(target_ks=ks, patience=patience)
+
+
 def job_spec_kwargs(conf: Conf) -> dict:
     """JobSpec fields driven by conf keys — the reference's backup-instance
     and heartbeat tunables (GlobalConfigurationKeys.java:75-79,148-150)
@@ -293,6 +317,23 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             "Algorithm=sagn does not support --device-resident (the scanned "
             "epoch runs plain-SSGD updates, not SAGN windows); drop one"
         )
+    if device_resident and resolve_accum_steps(args, conf) > 1:
+        raise SystemExit(
+            f"--device-resident does not support {K.ACCUM_STEPS}; raise "
+            "the batch size instead (the dataset already fits in device "
+            "memory)"
+        )
+    preflight_valid_rate = (
+        args.valid_rate if args.valid_rate is not None
+        else model_config.valid_set_rate
+    )
+    if resolve_early_stop(args, conf) is not None and preflight_valid_rate <= 0:
+        raise SystemExit(
+            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
+            "data to ever fire, but the validation rate is 0 — raise "
+            "validSetRate/--valid-rate or drop the early-stop keys "
+            "(silently training the full budget is not what you asked for)"
+        )
     data_path = conf.get(K.TRAINING_DATA_PATH)
     paths = list_data_files(data_path)
     if not paths:
@@ -335,6 +376,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         if start_epoch:
             print(f"resuming at epoch {start_epoch}", flush=True)
 
+    early_stop = resolve_early_stop(args, conf)
     t0 = time.time()
     try:
         with trace_if(args.profile_dir):
@@ -364,6 +406,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                     on_epoch=_print_epoch,
                     checkpointer=checkpointer,
                     start_epoch=start_epoch,
+                    early_stop=early_stop,
                 )
             else:
                 dataset = InMemoryDataset.load(
@@ -386,6 +429,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                     on_epoch=_print_epoch,
                     checkpointer=checkpointer,
                     start_epoch=start_epoch,
+                    early_stop=early_stop,
                 )
     finally:
         if checkpointer is not None:
@@ -402,18 +446,16 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             zscale_stds=schema.stds or None,
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
-    print(
-        json.dumps(
-            {
-                "state": "finished",
-                "epochs_run": len(history),
-                "wall_time_s": round(wall, 2),
-                "final_valid_loss": history[-1].valid_loss if history else None,
-                "final_ks": history[-1].ks if history else None,
-            }
-        ),
-        flush=True,
-    )
+    summary = {
+        "state": "finished",
+        "epochs_run": len(history),
+        "wall_time_s": round(wall, 2),
+        "final_valid_loss": history[-1].valid_loss if history else None,
+        "final_ks": history[-1].ks if history else None,
+    }
+    if trainer.stop_reason:
+        summary["stopped_early"] = trainer.stop_reason
+    print(json.dumps(summary), flush=True)
     return 0
 
 
@@ -442,6 +484,13 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             f"Algorithm=sagn does not compose with {K.ACCUM_STEPS}: the "
             "SAGN window already defines its own accumulation semantics "
             "(UpdateWindow)"
+        )
+    if resolve_early_stop(args, conf) is not None:
+        raise SystemExit(
+            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} are single-process "
+            "only: an SPMD worker stopping on its own shard's metrics "
+            "while peers enter the next epoch's collectives hangs the "
+            "fleet — drop the keys or run with one worker"
         )
     if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
                                              K.DEFAULT_DEVICE_RESIDENT):

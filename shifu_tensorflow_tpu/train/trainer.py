@@ -68,6 +68,53 @@ class EpochStats:
 MetricsCallback = Callable[[EpochStats], None]
 
 
+@dataclass
+class EarlyStopper:
+    """Epoch-loop stop criteria for the single-controller fit paths.
+
+    Two independent criteria, either disabled at 0:
+
+    - ``target_ks``: stop once validation KS reaches the target — the
+      BASELINE.md north star is wall-clock **to KS≥0.45**, so a job that
+      has reached the target should stop burning chip time (the reference
+      always trained its full fixed epoch budget, ssgd_monitor.py:274);
+    - ``patience``: stop after this many consecutive epochs without
+      validation-loss improvement (> ``min_delta``).  Epochs with NaN
+      validation loss (no validation data) don't count toward patience —
+      otherwise a valid-rate-0 job would spuriously stop.
+
+    Multi-worker SPMD jobs must NOT use this uncoordinated: one worker
+    stopping while peers enter the next epoch's collectives hangs the
+    fleet — run_multi rejects the config keys (train/__main__.py).
+    """
+
+    target_ks: float = 0.0
+    patience: int = 0
+    min_delta: float = 0.0
+    _best: float = float("inf")
+    _bad_epochs: int = 0
+
+    def should_stop(self, stats: EpochStats) -> str | None:
+        """Returns the stop reason, or None to continue."""
+        if self.target_ks > 0 and stats.ks >= self.target_ks:
+            return (
+                f"validation KS {stats.ks:.4f} reached target "
+                f"{self.target_ks:g} at epoch {stats.current_epoch}"
+            )
+        if self.patience > 0 and not np.isnan(stats.valid_loss):
+            if stats.valid_loss < self._best - self.min_delta:
+                self._best = stats.valid_loss
+                self._bad_epochs = 0
+            else:
+                self._bad_epochs += 1
+                if self._bad_epochs >= self.patience:
+                    return (
+                        f"no validation-loss improvement in "
+                        f"{self.patience} epochs (best {self._best:.6g})"
+                    )
+        return None
+
+
 def donation_is_safe() -> bool:
     """Whether donating the train state to the jitted step is a win here.
 
@@ -390,6 +437,8 @@ class Trainer:
         self.prefetch_depth = max(1, int(prefetch_depth))
         # opt-in per-step timing (utils/profiling.StepTimer); None = free
         self.step_timer = None
+        # set by the fit loops when an EarlyStopper ends training early
+        self.stop_reason: str | None = None
 
     # ---- device placement ----
     def _put(self, batch: Batch) -> Batch:
@@ -652,6 +701,7 @@ class Trainer:
         on_epoch: MetricsCallback | None = None,
         checkpointer: "Any | None" = None,
         start_epoch: int = 0,
+        early_stop: "EarlyStopper | None" = None,
     ) -> list[EpochStats]:
         """Epoch loop over an in-memory dataset (streaming fit lives in
         fit_stream).  ``start_epoch`` supports resume-with-correct-budget —
@@ -660,6 +710,7 @@ class Trainer:
         epochs = epochs or self.model_config.num_train_epochs
         batch_size = batch_size or self.model_config.batch_size
         history: list[EpochStats] = []
+        self.stop_reason = None
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
             train_loss, _ = self.train_epoch(
@@ -687,6 +738,10 @@ class Trainer:
                 on_epoch(stats)
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self.state)
+            if early_stop is not None:
+                self.stop_reason = early_stop.should_stop(stats)
+                if self.stop_reason:
+                    break
         return history
 
     def fit_device_resident(
@@ -698,6 +753,7 @@ class Trainer:
         on_epoch: MetricsCallback | None = None,
         checkpointer: "Any | None" = None,
         start_epoch: int = 0,
+        early_stop: "EarlyStopper | None" = None,
     ) -> list[EpochStats]:
         """All-in-HBM training: the reference's load-everything workload
         (ssgd_monitor.py:348-454) in its TPU-native form.
@@ -728,6 +784,7 @@ class Trainer:
             )
         epochs = epochs or self.model_config.num_train_epochs
         B = self.align_batch_size(batch_size or self.model_config.batch_size)
+        self.stop_reason = None
 
         def _padded_device(block):
             n = len(block)
@@ -808,6 +865,10 @@ class Trainer:
                 on_epoch(stats)
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self.state)
+            if early_stop is not None:
+                self.stop_reason = early_stop.should_stop(stats)
+                if self.stop_reason:
+                    break
         return history
 
     def _make_device_epoch(self, steps: int, batch_size: int):
@@ -879,11 +940,13 @@ class Trainer:
         on_epoch: MetricsCallback | None = None,
         checkpointer: "Any | None" = None,
         start_epoch: int = 0,
+        early_stop: "EarlyStopper | None" = None,
     ) -> list[EpochStats]:
         """Epoch loop over streaming shards (the 1B-row path):
         ``make_train_stream(epoch)`` returns a fresh batch iterator."""
         epochs = epochs or self.model_config.num_train_epochs
         history: list[EpochStats] = []
+        self.stop_reason = None
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
             train_loss, n = self.train_epoch(make_train_stream(epoch))
@@ -910,6 +973,10 @@ class Trainer:
                 on_epoch(stats)
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self.state)
+            if early_stop is not None:
+                self.stop_reason = early_stop.should_stop(stats)
+                if self.stop_reason:
+                    break
         return history
 
     def predict(self, features: np.ndarray, batch_size: int = 4096) -> np.ndarray:

@@ -377,3 +377,27 @@ def test_multi_worker_preflight_rejects_bad_accum_configs(tmp_path):
         "Algorithm": "sagn"}}}))
     with pytest.raises(SystemExit, match="sagn"):
         main(base + ["--model-config", str(mc), "--accum-steps", "4"])
+
+    # early stopping is single-process only: an uncoordinated stop would
+    # hang the SPMD fleet's collectives
+    with pytest.raises(SystemExit, match="single-process"):
+        main(base + ["--early-stop-ks", "0.45"])
+
+
+def test_single_process_preflight_rejects_unfireable_configs(tmp_path):
+    """Configs that could only fail late (after dataset load) or silently
+    (early stop that can never fire) must be one clean error up front."""
+    import gzip
+
+    import pytest
+
+    from shifu_tensorflow_tpu.train.__main__ import main
+
+    with gzip.open(tmp_path / "part-0.gz", "wt") as f:
+        for i in range(50):
+            f.write(f"{i % 2}|0.5|1.5|1.0\n")
+    base = ["--training-data-path", str(tmp_path), "--feature-columns", "1,2"]
+    with pytest.raises(SystemExit, match="accum"):
+        main(base + ["--device-resident", "--accum-steps", "2"])
+    with pytest.raises(SystemExit, match="validation"):
+        main(base + ["--early-stop-ks", "0.45", "--valid-rate", "0"])

@@ -271,6 +271,20 @@ def test_accum_steps_key_reaches_trainer():
     assert trainer.accum_steps == 1 and trainer._accum_step is None
 
 
+def test_early_stop_keys_reach_fit_loop():
+    from shifu_tensorflow_tpu.train.__main__ import resolve_early_stop
+
+    assert resolve_early_stop(_args(), _conf({})) is None
+    es = resolve_early_stop(_args(), _conf({K.EARLY_STOP_KS: 0.45}))
+    assert es is not None and es.target_ks == 0.45
+    es = resolve_early_stop(_args(), _conf({K.EARLY_STOP_PATIENCE: 3}))
+    assert es is not None and es.patience == 3
+    # CLI flags win over conf
+    es = resolve_early_stop(_args(["--early-stop-ks", "0.3"]),
+                            _conf({K.EARLY_STOP_KS: 0.45}))
+    assert es.target_ks == 0.3
+
+
 def test_async_checkpoint_key_reaches_worker_config():
     """shifu.tpu.async-checkpoint drives WorkerConfig.async_checkpoint via
     the run_multi field resolution (worker_runtime_kwargs) and lands in

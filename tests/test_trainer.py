@@ -388,6 +388,47 @@ def test_sagn_rejects_accum_steps():
         make_trainer(sagn_mc, 6, accum_steps=4)
 
 
+# ---- early stopping (shifu.tpu.early-stop-ks / early-stop-patience) ----
+
+def test_early_stop_on_target_ks(psv_dataset):
+    """Once validation KS reaches the target the fit loop stops, records
+    the reason, and history is shorter than the epoch budget."""
+    from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+
+    ds = _dataset(psv_dataset)
+    t = Trainer(_mc(epochs=50), ds.schema.num_features, seed=1)
+    hist = t.fit(ds, batch_size=100,
+                 early_stop=EarlyStopper(target_ks=0.2))
+    assert len(hist) < 50
+    assert t.stop_reason and "reached target" in t.stop_reason
+    assert hist[-1].ks >= 0.2
+
+
+def test_early_stop_patience_counts_only_real_valid_epochs():
+    """NaN validation loss (no validation data) must not feed patience —
+    and with real validation, patience stops after N bad epochs."""
+    from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+    from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+    def stats(epoch, valid_loss, ks=0.0):
+        return EpochStats(0, epoch, 0.1, valid_loss, 0.0, 0.0, epoch, ks)
+
+    es = EarlyStopper(patience=2)
+    assert es.should_stop(stats(0, float("nan"))) is None
+    assert es.should_stop(stats(1, float("nan"))) is None  # NaN never counts
+    assert es.should_stop(stats(2, 0.5)) is None   # first real: improves inf
+    assert es.should_stop(stats(3, 0.6)) is None   # bad 1
+    reason = es.should_stop(stats(4, 0.55))        # bad 2 -> stop
+    assert reason and "improvement" in reason
+    # improvement resets the counter
+    es2 = EarlyStopper(patience=2)
+    assert es2.should_stop(stats(0, 0.5)) is None
+    assert es2.should_stop(stats(1, 0.6)) is None  # bad 1
+    assert es2.should_stop(stats(2, 0.4)) is None  # improves -> reset
+    assert es2.should_stop(stats(3, 0.5)) is None  # bad 1
+    assert es2.should_stop(stats(4, 0.5)) is not None  # bad 2 -> stop
+
+
 def test_scan_epoch_on_mesh_matches_per_step(psv_dataset):
     """Stacked chunks shard the batch dim over the data axis; mesh-sharded
     scan training equals mesh-sharded per-step training."""
