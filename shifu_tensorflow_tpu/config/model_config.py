@@ -68,6 +68,14 @@ class TrainParams:
             (len(self.embedding_columns) > 0 and self.embedding_hash_size > 0)
             or self.cross_hash_size > 0
         )
+    # ---- learning-rate schedule (beyond the reference's fixed LR) ----
+    # constant | cosine | exponential; warmup_steps applies to any of them
+    # (linear 0 -> LearningRate over that many optimizer steps)
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    decay_steps: int = 0  # required > 0 for cosine/exponential
+    decay_rate: float = 0.1  # exponential: LR multiplier per decay_steps;
+    # cosine: alpha (final LR fraction)
     # local-update DP: >1 reproduces SAGN's communication window of local
     # steps before the global update (reference: SAGN.py:110-176)
     update_window: int = 1
@@ -104,6 +112,11 @@ class TrainParams:
             seq_heads=int(params.get("SeqHeads", 4)),
             seq_blocks=int(params.get("SeqBlocks", 2)),
             seq_attention=str(params.get("SeqAttention", "auto")).lower(),
+            lr_schedule=str(params.get("LearningRateSchedule",
+                                       "constant")).lower(),
+            warmup_steps=int(params.get("WarmupSteps", 0)),
+            decay_steps=int(params.get("DecaySteps", 0)),
+            decay_rate=float(params.get("DecayRate", 0.1)),
             update_window=int(params.get("UpdateWindow", 1)),
             algorithm=str(params.get("Algorithm", "ssgd")).lower(),
         )

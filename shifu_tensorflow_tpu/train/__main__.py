@@ -323,11 +323,12 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             "the batch size instead (the dataset already fits in device "
             "memory)"
         )
-    preflight_valid_rate = (
+    valid_rate = (
         args.valid_rate if args.valid_rate is not None
         else model_config.valid_set_rate
     )
-    if resolve_early_stop(args, conf) is not None and preflight_valid_rate <= 0:
+    early_stop = resolve_early_stop(args, conf)
+    if early_stop is not None and valid_rate <= 0:
         raise SystemExit(
             f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
             "data to ever fire, but the validation rate is 0 — raise "
@@ -358,11 +359,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     batch_size = trainer.align_batch_size(
         conf.get_int(K.BATCH_SIZE, model_config.batch_size)
     )
-    valid_rate = (
-        args.valid_rate
-        if args.valid_rate is not None
-        else model_config.valid_set_rate
-    )
+    # valid_rate and early_stop were resolved once in the preflight block
 
     checkpointer = None
     start_epoch = 0
@@ -376,7 +373,6 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         if start_epoch:
             print(f"resuming at epoch {start_epoch}", flush=True)
 
-    early_stop = resolve_early_stop(args, conf)
     t0 = time.time()
     try:
         with trace_if(args.profile_dir):

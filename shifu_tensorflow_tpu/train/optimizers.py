@@ -13,6 +13,11 @@ Local-update DP (the reference's SAGN communication window,
 SAGN.py:110-176) is expressed as ``optax.MultiSteps`` gradient accumulation:
 ``update_window`` micro-steps accumulate before one apply — same averaging
 semantics, no local/global variable mirroring.
+
+Learning-rate schedules (beyond the reference's fixed LR) are plain optax
+schedules compiled into the update — data-independent control flow, so the
+jitted step stays a single compiled program (``LearningRateSchedule``:
+constant | cosine | exponential, plus ``WarmupSteps`` for any of them).
 """
 
 from __future__ import annotations
@@ -22,11 +27,83 @@ import optax
 from shifu_tensorflow_tpu.config.model_config import TrainParams
 
 
+def make_schedule(params: TrainParams):
+    """TrainParams -> a float LR or an optax schedule.
+
+    - constant: the bare LearningRate (with optional linear warmup);
+    - cosine: decay to ``DecayRate``·LR (alpha) over ``DecaySteps``;
+    - exponential: multiply by ``DecayRate`` every ``DecaySteps``
+      (staircase=False, TF-style continuous decay).
+
+    Steps count OPTIMIZER updates — with accum-steps or UpdateWindow the
+    schedule advances once per applied update, not per microbatch.
+    """
+    kind = params.lr_schedule
+    lr = params.learning_rate
+    if kind in ("constant", ""):
+        sched = lr
+    elif kind == "cosine":
+        if params.decay_steps <= 0:
+            raise ValueError(
+                "LearningRateSchedule=cosine requires DecaySteps > 0"
+            )
+        sched = optax.cosine_decay_schedule(
+            init_value=lr,
+            decay_steps=params.decay_steps,
+            alpha=params.decay_rate,
+        )
+    elif kind == "exponential":
+        if params.decay_steps <= 0:
+            raise ValueError(
+                "LearningRateSchedule=exponential requires DecaySteps > 0"
+            )
+        sched = optax.exponential_decay(
+            init_value=lr,
+            transition_steps=params.decay_steps,
+            decay_rate=params.decay_rate,
+        )
+    else:
+        raise ValueError(
+            f"unknown LearningRateSchedule {kind!r} "
+            "(constant | cosine | exponential)"
+        )
+    if params.warmup_steps > 0:
+        peak = sched if isinstance(sched, (int, float)) else None
+        if peak is not None:
+            sched = optax.linear_schedule(
+                init_value=0.0, end_value=peak,
+                transition_steps=params.warmup_steps,
+            )
+        else:
+            sched = optax.join_schedules(
+                [
+                    optax.linear_schedule(
+                        init_value=0.0, end_value=lr,
+                        transition_steps=params.warmup_steps,
+                    ),
+                    # the decay schedule starts AFTER warmup completes
+                    make_schedule(
+                        _replace(params, warmup_steps=0)
+                    ),
+                ],
+                boundaries=[params.warmup_steps],
+            )
+    return sched
+
+
+def _replace(params: TrainParams, **kw) -> TrainParams:
+    from dataclasses import replace
+
+    return replace(params, **kw)
+
+
 def make_base_optimizer(
-    name: str, lr: float
+    name: str, lr
 ) -> optax.GradientTransformation:
     """The inner optimizer, unwrapped — shared by the plain trainer, the
-    MultiSteps accumulation wrapper, and SAGN's local/global pair."""
+    MultiSteps accumulation wrapper, and SAGN's local/global pair.  ``lr``
+    may be a float or an optax schedule (schedules step once per applied
+    update)."""
     name = name.lower()
     if name in ("adadelta",):
         # TF1 AdadeltaOptimizer defaults: rho=0.95, eps=1e-8
@@ -41,7 +118,7 @@ def make_base_optimizer(
 
 
 def make_optimizer(params: TrainParams) -> optax.GradientTransformation:
-    tx = make_base_optimizer(params.optimizer, params.learning_rate)
+    tx = make_base_optimizer(params.optimizer, make_schedule(params))
     if params.update_window > 1 and params.algorithm != "sagn":
         # plain trainer: the window is optax-level gradient accumulation.
         # SAGN handles the window inside its own step (local drifting

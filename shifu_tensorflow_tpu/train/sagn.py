@@ -175,6 +175,18 @@ class SAGNTrainer(Trainer):
                 "shifu.tpu.accum-steps: the SAGN window already defines "
                 "its own accumulation semantics (UpdateWindow)"
             )
+        p0 = model_config.params
+        if p0.lr_schedule not in ("constant", "") or p0.warmup_steps > 0:
+            # the schedule would apply only to the GLOBAL apply while the
+            # window's local drift steps keep the flat LR — half-applied
+            # semantics that match neither scheduled SSGD nor constant
+            # SAGN; reject rather than train something nobody configured
+            raise ValueError(
+                "Algorithm=sagn does not support LearningRateSchedule/"
+                "WarmupSteps: the window's local steps would keep the "
+                "flat LearningRate while only the global apply followed "
+                "the schedule"
+            )
         super().__init__(model_config, num_features, **kw)
         # SAGN's window step already batches update_window microbatches per
         # dispatch — the scan_steps chunking would compose confusingly with
