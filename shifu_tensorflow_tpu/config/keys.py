@@ -104,12 +104,14 @@ DEFAULT_SCAN_STEPS = 1
 # chunks UPDATES per dispatch, not microbatches per update).
 ACCUM_STEPS = TPU_PREFIX + "accum-steps"
 DEFAULT_ACCUM_STEPS = 1
-# early stopping (single-process fits only; run_multi rejects these —
-# an uncoordinated stop would hang the SPMD fleet's collectives).
-# early-stop-ks: stop once validation KS reaches the target (the
-# BASELINE.md north star is wall-clock TO KS, so keep training past it
-# only if you ask to); early-stop-patience: stop after N epochs without
-# validation-loss improvement.  0 disables each.
+# early stopping.  early-stop-ks: stop once validation KS reaches the
+# target (the BASELINE.md north star is wall-clock TO KS, so keep
+# training past it only if you ask to); early-stop-patience: stop after
+# N epochs without validation-loss improvement.  0 disables each.
+# Single-process fits stop locally; multi-worker fleets stop
+# COORDINATED — the coordinator evaluates quorum epoch aggregates and
+# the per-epoch barrier (force-enabled) delivers one decision to every
+# worker, because an uncoordinated stop would hang SPMD collectives.
 EARLY_STOP_KS = TPU_PREFIX + "early-stop-ks"
 DEFAULT_EARLY_STOP_KS = 0.0
 EARLY_STOP_PATIENCE = TPU_PREFIX + "early-stop-patience"

@@ -108,6 +108,14 @@ class JobSpec:
     # workers count their own shard once and the coordinator caches it so
     # fleet restarts never re-read 1B-row shards just to size their epochs)
     shard_lines: list | None = None
+    # fleet-coordinated early stopping (shifu.tpu.early-stop-*): evaluated
+    # by the COORDINATOR on full-quorum epoch aggregates (mean shard-local
+    # KS / valid loss across workers) and delivered through the epoch
+    # barrier, so every worker stops after the SAME epoch — an
+    # uncoordinated per-worker stop would hang SPMD collectives.  Enabling
+    # either forces sync_epochs.
+    early_stop_ks: float = 0.0
+    early_stop_patience: int = 0
 
 
 class Coordinator:
@@ -140,6 +148,31 @@ class Coordinator:
         self.aggregator = EpochAggregator(
             spec.n_workers, board_path=spec.board_path
         )
+        # fleet early stopping: decided HERE on full-quorum epoch
+        # aggregates, delivered via the epoch barrier so every worker
+        # stops after the same epoch (see JobSpec.early_stop_*)
+        self._early_stopper = None
+        self._stop_after_epoch: int | None = None
+        self.stop_reason: str | None = None
+        # non-SPMD: per-epoch chief stats — the criteria must judge the
+        # model that gets EXPORTED, not a fleet mean of independent models
+        self._chief_stats: dict[int, EpochStats] = {}
+        if spec.early_stop_ks > 0 or spec.early_stop_patience > 0:
+            if not spec.sync_epochs:
+                # validated, not silently mutated: the builder of the spec
+                # owns the invariant (early_stop_spec_kwargs sets it), and
+                # a direct API user must opt in knowingly
+                raise ValueError(
+                    "JobSpec.early_stop_* requires sync_epochs=True: the "
+                    "stop decision is delivered through the per-epoch "
+                    "barrier so every worker stops after the same epoch"
+                )
+            from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+
+            self._early_stopper = EarlyStopper(
+                target_ks=spec.early_stop_ks,
+                patience=spec.early_stop_patience,
+            )
         self.liveness = LivenessMonitor(
             interval_ms=spec.heartbeat_interval_ms,
             max_missed=spec.max_missed_heartbeats,
@@ -421,7 +454,55 @@ class Coordinator:
 
     def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
         stats = EpochStats(**stats_dict)
-        self.aggregator.report(stats)
+        if (
+            self._early_stopper is not None
+            and not self.spec.spmd
+            and stats.worker_index == 0
+        ):
+            with self._lock:
+                self._chief_stats[stats.current_epoch] = stats
+        summary = self.aggregator.report(stats)
+        if summary is not None and self._early_stopper is not None:
+            # full quorum for this epoch: evaluate the FLEET criteria.
+            # Runs in the LAST reporter's request, before the barrier
+            # notify below — so by the time the barrier releases, the
+            # decision is already visible to every waiter.
+            #
+            # SPMD (one shared model): the quorum MEAN of shard-local
+            # KS/valid-loss is a fair estimate of the one model.
+            # Non-SPMD (independent models): judge the CHIEF's own stats —
+            # only the chief's model is exported, and a fleet mean could
+            # clear the target while the exported model is below it.
+            with self._lock:
+                if self._stop_after_epoch is None:
+                    if self.spec.spmd:
+                        eval_stats = EpochStats(
+                            worker_index=-1,
+                            current_epoch=summary.epoch,
+                            training_loss=summary.mean_training_loss,
+                            valid_loss=summary.mean_valid_loss,
+                            training_time_s=summary.mean_training_time_s,
+                            valid_time_s=summary.mean_valid_time_s,
+                            global_step=0,
+                            ks=summary.ks,
+                            auc=summary.auc,
+                        )
+                    else:
+                        # partial-quorum flushes without the chief skip
+                        # evaluation (nothing exported to judge)
+                        eval_stats = self._chief_stats.pop(
+                            summary.epoch, None
+                        )
+                    reason = (
+                        self._early_stopper.should_stop(eval_stats)
+                        if eval_stats is not None
+                        else None
+                    )
+                    if reason:
+                        self._stop_after_epoch = summary.epoch
+                        self.stop_reason = reason
+                        log.info("fleet early stop after epoch %d: %s",
+                                 summary.epoch, reason)
         with self._epoch_cond:
             prev = self._last_epoch.get(stats.worker_index, -1)
             self._last_epoch[stats.worker_index] = max(prev, stats.current_epoch)
@@ -440,17 +521,30 @@ class Coordinator:
             if timeout_s is not None
             else self.spec.epoch_barrier_timeout_s
         )
+        def _ok() -> dict[str, Any]:
+            out = {"ok": True, "state": self.state.value}
+            if self._stop_after_epoch is not None:
+                # same value for every worker — the whole fleet stops
+                # after the same epoch.  Attached to EVERY success
+                # return, including the FINISHED fast path: the chief
+                # stopping early flips the job FINISHED, and a peer
+                # whose barrier lands after that must still see the
+                # stop instead of training its remaining budget
+                out["stop_after_epoch"] = self._stop_after_epoch
+                out["stop_reason"] = self.stop_reason
+            return out
+
         with self._epoch_cond:
             while True:
                 if self.state == JobState.FAILED:
                     return {"ok": False, "abort": True, "error": self.failure_reason}
                 if self.state == JobState.FINISHED:
-                    return {"ok": True, "state": self.state.value}
+                    return _ok()
                 if all(
                     self._last_epoch.get(i, -1) >= epoch
                     for i in range(self.spec.n_workers)
                 ):
-                    return {"ok": True, "state": self.state.value}
+                    return _ok()
                 if time.monotonic() >= deadline:
                     missing = [
                         i

@@ -64,6 +64,8 @@ class JobResult:
     epoch_summaries: list
     restarts_used: int
     wall_time_s: float
+    # coordinator's fleet early-stop reason, None if the budget ran out
+    stop_reason: str | None = None
 
 
 class JobSubmitter:
@@ -402,6 +404,7 @@ class JobSubmitter:
                 epoch_summaries=list(self.coordinator.aggregator.summaries),
                 restarts_used=self.coordinator._failed_restarts,
                 wall_time_s=wall,
+                stop_reason=self.coordinator.stop_reason,
             )
             self._kill_fleet()
             self.coordinator.shutdown()

@@ -306,6 +306,7 @@ def run_worker(cfg: WorkerConfig, *,
                 valid_rate=valid_rate,
                 fail_at_epoch=fail_at_epoch,
                 shard_lines=reg.get("shard_lines"),
+                sync_epochs=sync_epochs,
             )
         else:
             exit_code = _run_local_training(
@@ -352,6 +353,24 @@ def run_worker(cfg: WorkerConfig, *,
     return exit_code
 
 
+class _FleetStopSignal:
+    """Adapter between the coordinator's fleet early-stop decision and the
+    fit loops' ``early_stop`` hook: the epoch-barrier reply fills it in
+    (same value for every worker at the same barrier), and the loop then
+    breaks through its normal path — AFTER the epoch's checkpoint save,
+    with ``trainer.stop_reason`` recorded — instead of via an exception
+    that would skip both."""
+
+    def __init__(self):
+        self.stop_after: int | None = None
+        self.reason: str | None = None
+
+    def should_stop(self, stats) -> str | None:
+        if self.stop_after is not None and stats.current_epoch >= self.stop_after:
+            return self.reason or "fleet early stop"
+        return None
+
+
 def _epoch_callback(
     cfg: WorkerConfig,
     client: CoordinatorClient,
@@ -359,6 +378,7 @@ def _epoch_callback(
     *,
     sync_epochs: bool,
     fail_at_epoch: int | None,
+    fleet_stop: "_FleetStopSignal | None" = None,
 ) -> Callable:
     def on_epoch(stats) -> None:
         if hb.abort.is_set():
@@ -374,6 +394,9 @@ def _epoch_callback(
                 raise _JobAborted()
             if not resp.get("ok"):
                 raise RuntimeError(resp.get("error", "epoch barrier failed"))
+            if fleet_stop is not None and "stop_after_epoch" in resp:
+                fleet_stop.stop_after = int(resp["stop_after_epoch"])
+                fleet_stop.reason = resp.get("stop_reason")
 
     return on_epoch
 
@@ -385,8 +408,10 @@ def _run_local_training(
 ) -> int:
     """Independent-model path (non-SPMD): each worker trains on its shard;
     only the chief's checkpoint is exported."""
+    fleet_stop = _FleetStopSignal() if sync_epochs else None
     on_epoch = _epoch_callback(
-        cfg, client, hb, sync_epochs=sync_epochs, fail_at_epoch=fail_at_epoch
+        cfg, client, hb, sync_epochs=sync_epochs,
+        fail_at_epoch=fail_at_epoch, fleet_stop=fleet_stop,
     )
     start_epoch = 0
     if checkpointer is not None:
@@ -412,6 +437,7 @@ def _run_local_training(
             on_epoch=on_epoch,
             checkpointer=save_ckpt,
             start_epoch=start_epoch,
+            early_stop=fleet_stop,
         )
     else:
         dataset = InMemoryDataset.load(
@@ -424,6 +450,7 @@ def _run_local_training(
             on_epoch=on_epoch,
             checkpointer=save_ckpt,
             start_epoch=start_epoch,
+            early_stop=fleet_stop,
         )
     if save_ckpt is not None:
         # surface a failed background write of the FINAL checkpoint here,
@@ -454,12 +481,19 @@ def _feature_dtype_for(cfg) -> str:
 def _run_spmd_training(
     cfg, client, trainer, hb, checkpointer, *,
     worker_index, shard_paths, epochs, valid_rate, fail_at_epoch,
-    shard_lines=None,
+    shard_lines=None, sync_epochs=False,
 ) -> int:
     """One-model path: this process is one SPMD participant.  Every process
     must execute identical step sequences, so the fleet agrees per-epoch
     step counts and the restore epoch through the coordinator's sync_plan
-    barrier before training starts."""
+    barrier before training starts.
+
+    ``sync_epochs`` engages the coordinator's per-epoch barrier here too:
+    SPMD collectives already keep steps in lockstep, but fleet-level
+    per-epoch DECISIONS (early stopping) need a rendezvous where every
+    process sees the same answer at the same epoch — without it, a worker
+    whose report completed the quorum could stop while a peer that
+    reported earlier has already entered the next epoch's collectives."""
     local_batch = trainer.align_batch_size(cfg.batch_size)
     num_features = cfg.schema.num_features
 
@@ -553,8 +587,10 @@ def _run_spmd_training(
         def make_valid():
             return dataset.valid_batches_fixed(local_batch, valid_steps)
 
+    fleet_stop = _FleetStopSignal() if sync_epochs else None
     on_epoch = _epoch_callback(
-        cfg, client, hb, sync_epochs=False, fail_at_epoch=fail_at_epoch
+        cfg, client, hb, sync_epochs=sync_epochs,
+        fail_at_epoch=fail_at_epoch, fleet_stop=fleet_stop,
     )
     trainer.fit_stream(
         make_train,
@@ -563,6 +599,7 @@ def _run_spmd_training(
         on_epoch=on_epoch,
         checkpointer=checkpointer if worker_index == 0 else None,
         start_epoch=start_epoch,
+        early_stop=fleet_stop,
     )
     if worker_index == 0 and checkpointer is not None:
         checkpointer.wait()  # see _run_local_training: no silent ckpt loss

@@ -95,11 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "beyond device memory")
     p.add_argument("--early-stop-ks", type=float, default=None,
                    help="stop once validation KS reaches this target "
-                        "(default 0 = off; single-process only)")
+                        "(default 0 = off); multi-worker fleets stop "
+                        "coordinated via the epoch barrier")
     p.add_argument("--early-stop-patience", type=int, default=None,
                    help="stop after N epochs without validation-loss "
-                        "improvement (default 0 = off; single-process "
-                        "only)")
+                        "improvement (default 0 = off); multi-worker "
+                        "fleets stop coordinated via the epoch barrier")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -259,6 +260,22 @@ def job_spec_kwargs(conf: Conf) -> dict:
             K.TASK_MAX_MISSED_HEARTBEATS, K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
         ),
         "sync_epochs": conf.get_bool(K.SYNC_EPOCHS, K.DEFAULT_SYNC_EPOCHS),
+    }
+
+
+def early_stop_spec_kwargs(args, conf: Conf) -> dict:
+    """JobSpec fields for fleet-coordinated early stopping (the
+    coordinator evaluates quorum aggregates; the barrier delivers the
+    decision fleet-wide)."""
+    es = resolve_early_stop(args, conf)
+    if es is None:
+        return {}
+    return {
+        "early_stop_ks": es.target_ks,
+        "early_stop_patience": es.patience,
+        # the invariant lives where the spec is BUILT: the stop decision
+        # rides the per-epoch barrier, so it must be on
+        "sync_epochs": True,
     }
 
 
@@ -481,13 +498,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             "SAGN window already defines its own accumulation semantics "
             "(UpdateWindow)"
         )
-    if resolve_early_stop(args, conf) is not None:
-        raise SystemExit(
-            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} are single-process "
-            "only: an SPMD worker stopping on its own shard's metrics "
-            "while peers enter the next epoch's collectives hangs the "
-            "fleet — drop the keys or run with one worker"
-        )
+    # fleet early stopping is COORDINATED: the coordinator evaluates the
+    # criteria on full-quorum epoch aggregates and delivers the decision
+    # through the per-epoch barrier (which it force-enables), so every
+    # worker stops after the same epoch — see JobSpec.early_stop_*
     if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
                                              K.DEFAULT_DEVICE_RESIDENT):
         # silently training a different mode than requested is a bug; the
@@ -501,13 +515,16 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # launches — the reference's defining capability; thread workers can't
     # host it (one process cannot be N jax.distributed participants)
     use_spmd = args.spmd if args.spmd is not None else args.launcher == "process"
+    # merged dict (not two ** expansions): early-stop forces sync_epochs
+    # True over whatever the conf key says — a keyword collision otherwise
+    spec_kw = {**job_spec_kwargs(conf), **early_stop_spec_kwargs(args, conf)}
     spec = make_job_spec(
         conf.get(K.TRAINING_DATA_PATH),
         n_workers,
         epochs=epochs,
         board_path=args.board_path,
         spmd=use_spmd,
-        **job_spec_kwargs(conf),
+        **spec_kw,
     )
 
     def make_cfg(worker_id: str, addr) -> WorkerConfig:
@@ -550,18 +567,16 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     def print_summary() -> None:
         # the JSON summary is the last line of output — a stable contract
         # for scripts wrapping the CLI
-        print(
-            json.dumps(
-                {
-                    "state": result.state.value,
-                    "failure_reason": result.failure_reason,
-                    "epochs_run": len(result.epoch_summaries),
-                    "restarts_used": result.restarts_used,
-                    "wall_time_s": round(result.wall_time_s, 2),
-                }
-            ),
-            flush=True,
-        )
+        summary = {
+            "state": result.state.value,
+            "failure_reason": result.failure_reason,
+            "epochs_run": len(result.epoch_summaries),
+            "restarts_used": result.restarts_used,
+            "wall_time_s": round(result.wall_time_s, 2),
+        }
+        if result.stop_reason:
+            summary["stopped_early"] = result.stop_reason
+        print(json.dumps(summary), flush=True)
 
     prune_cache_if_configured(conf)
     if result.state != JobState.FINISHED:

@@ -83,9 +83,13 @@ class EarlyStopper:
       validation loss (no validation data) don't count toward patience —
       otherwise a valid-rate-0 job would spuriously stop.
 
-    Multi-worker SPMD jobs must NOT use this uncoordinated: one worker
-    stopping while peers enter the next epoch's collectives hangs the
-    fleet — run_multi rejects the config keys (train/__main__.py).
+    Multi-worker fleets must NOT use this per-worker/uncoordinated: one
+    worker stopping while peers enter the next epoch's collectives hangs
+    the fleet.  run_multi instead passes the criteria to the COORDINATOR
+    (JobSpec.early_stop_*), which evaluates them on full-quorum epoch
+    aggregates and delivers the decision through the per-epoch barrier;
+    workers receive it as a _FleetStopSignal through this same
+    ``early_stop`` hook (coordinator/worker.py).
     """
 
     target_ks: float = 0.0

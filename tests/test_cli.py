@@ -378,10 +378,32 @@ def test_multi_worker_preflight_rejects_bad_accum_configs(tmp_path):
     with pytest.raises(SystemExit, match="sagn"):
         main(base + ["--model-config", str(mc), "--accum-steps", "4"])
 
-    # early stopping is single-process only: an uncoordinated stop would
-    # hang the SPMD fleet's collectives
-    with pytest.raises(SystemExit, match="single-process"):
-        main(base + ["--early-stop-ks", "0.45"])
+def test_cli_multi_worker_fleet_early_stop(
+    tmp_path, capsys, psv_dataset, model_config_json
+):
+    """Fleet-coordinated early stopping: the coordinator evaluates quorum
+    epoch aggregates and every worker stops after the SAME epoch, well
+    short of the budget."""
+    # adam, not the fixture's default adadelta: per-shard KS must actually
+    # clear the target within the budget for the stop to have a trigger
+    mcj = dict(model_config_json)
+    mcj["train"] = dict(mcj["train"])
+    mcj["train"]["params"] = dict(mcj["train"]["params"], Optimizer="adam")
+    mc = _write_model_config(tmp_path, mcj, epochs=30)
+    argv = [
+        "--training-data-path", psv_dataset["root"],
+        "--model-config", mc,
+        "--feature-columns", ",".join(map(str, psv_dataset["feature_cols"])),
+        "--target-column", str(psv_dataset["target_col"]),
+        "--weight-column", str(psv_dataset["weight_col"]),
+        "--workers", "2",
+        "--early-stop-ks", "0.2",
+    ]
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["state"] == "finished"
+    assert tail["epochs_run"] < 30, tail
 
 
 def test_single_process_preflight_rejects_unfireable_configs(tmp_path):

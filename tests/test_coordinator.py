@@ -306,6 +306,63 @@ def test_submitter_chief_fault_fails_job(psv_dataset, tmp_path, job_model_config
     assert "chief" in result.failure_reason
 
 
+def _es_stats(worker, epoch, ks):
+    return dict(
+        worker_index=worker, current_epoch=epoch, training_loss=0.4,
+        valid_loss=0.4, training_time_s=1.0, valid_time_s=0.1,
+        global_step=epoch + 1, ks=ks, auc=0.5,
+    )
+
+
+def test_coordinator_fleet_early_stop_via_barrier():
+    """Fleet early stopping (non-SPMD): criteria evaluate only on FULL-
+    quorum epochs, judge the CHIEF's stats (only the chief's model is
+    exported — a fleet mean could clear the target while the exported
+    model is below it), and the decision appears in the epoch barrier
+    reply — the same value for every worker."""
+    with pytest.raises(ValueError, match="sync_epochs"):
+        Coordinator(_spec(n=2, early_stop_ks=0.5))  # barrier is mandatory
+    spec = _spec(n=2, early_stop_ks=0.5, sync_epochs=True)
+    coord = Coordinator(spec)
+    coord.register("a", 0, host="127.0.0.1")
+    coord.register("b", 1, host="127.0.0.1")
+
+    # epoch 0: chief ks 0.3 < 0.5 -> no stop (peer at 0.9 is irrelevant:
+    # its independently trained model is not the one exported)
+    coord.report_epoch(_es_stats(0, 0, 0.3))
+    coord.report_epoch(_es_stats(1, 0, 0.9))
+    r = coord.epoch_barrier("a", 0, timeout_s=5.0)
+    assert r["ok"] and "stop_after_epoch" not in r
+    # partial quorum never triggers, even past the target
+    coord.report_epoch(_es_stats(0, 1, 0.9))
+    r = coord.epoch_barrier("a", 0, timeout_s=5.0)
+    assert "stop_after_epoch" not in r
+    # epoch 1 quorum completes with chief ks 0.9 >= 0.5 -> stop after 1,
+    # visible identically to both workers
+    coord.report_epoch(_es_stats(1, 1, 0.2))
+    ra = coord.epoch_barrier("a", 1, timeout_s=5.0)
+    rb = coord.epoch_barrier("b", 1, timeout_s=5.0)
+    assert ra["stop_after_epoch"] == 1 == rb["stop_after_epoch"]
+    assert "KS" in ra["stop_reason"]
+    assert coord.stop_reason == ra["stop_reason"]
+    coord.shutdown()
+
+
+def test_coordinator_spmd_early_stop_uses_quorum_mean():
+    """SPMD trains ONE model: shard-local KS differ only by shard, so the
+    quorum mean is the fair estimate the criteria judge."""
+    spec = _spec(n=2, early_stop_ks=0.5, sync_epochs=True, spmd=True)
+    coord = Coordinator(spec)
+    coord.register("a", 0, host="127.0.0.1", jax_port=9999)
+    coord.register("b", 1, host="127.0.0.1")
+    # chief alone below target, but mean (0.4+0.8)/2 >= 0.5 -> stop
+    coord.report_epoch(_es_stats(0, 0, 0.4))
+    coord.report_epoch(_es_stats(1, 0, 0.8))
+    r = coord.epoch_barrier("a", 0, timeout_s=5.0)
+    assert r["stop_after_epoch"] == 0
+    coord.shutdown()
+
+
 def test_epoch_aggregator_partial_flush_on_resume_hole():
     # worker 1 died before reporting epoch 0; after restart it resumed at
     # epoch 1 — epoch 0 must flush with partial quorum when epoch 1 closes
