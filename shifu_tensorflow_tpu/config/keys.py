@@ -116,6 +116,13 @@ EARLY_STOP_KS = TPU_PREFIX + "early-stop-ks"
 DEFAULT_EARLY_STOP_KS = 0.0
 EARLY_STOP_PATIENCE = TPU_PREFIX + "early-stop-patience"
 DEFAULT_EARLY_STOP_PATIENCE = 0
+# keep-best ("" = off; "valid_loss" | "ks"): snapshot params at the best
+# validation epoch; export serves that epoch instead of the last.
+# Single-process only: the fleet export path restores from the LAST
+# checkpoint, so run_multi rejects the key rather than silently
+# exporting something other than the best.
+KEEP_BEST = TPU_PREFIX + "keep-best"
+DEFAULT_KEEP_BEST = ""
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"
 DEFAULT_CHECKPOINT_EVERY_EPOCHS = 1
 # background-thread checkpoint writes for the flat-file (SPMD) path: the

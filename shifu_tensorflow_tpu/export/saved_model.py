@@ -237,9 +237,14 @@ def export_model(
         # the training graph's column positions ARE the serving contract;
         # fall back to what the trainer was built with
         feature_columns = getattr(trainer, "feature_columns", None)
+    # keep-best (Trainer(keep_best=...)): serve the best validation epoch,
+    # not the last — that is what "keep best" promises
+    export_params = trainer.state.params
+    if getattr(trainer, "best_params", None) is not None:
+        export_params = trainer.best_params
     export_native_bundle(
         export_dir,
-        trainer.state.params,
+        export_params,
         trainer.model_config,
         trainer.num_features,
         feature_columns=feature_columns,
@@ -269,7 +274,7 @@ def export_model(
 
     serve_params = jax.tree_util.tree_map(
         lambda x: x.unbox() if isinstance(x, flax_meta.AxisMetadata) else x,
-        trainer.state.params,
+        export_params,  # same tree both artifacts: best epoch when kept
         is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata),
     )
     ok_tf = export_saved_model(

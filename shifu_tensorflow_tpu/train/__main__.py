@@ -101,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after N epochs without validation-loss "
                         "improvement (default 0 = off); multi-worker "
                         "fleets stop coordinated via the epoch barrier")
+    p.add_argument("--keep-best", default=None,
+                   choices=["valid_loss", "ks"],
+                   help="snapshot params at the best validation epoch and "
+                        "export THAT model instead of the last epoch's "
+                        "(single-process only)")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -194,7 +199,15 @@ def trainer_extras(args, conf: Conf) -> dict:
                                        K.DEFAULT_PREFETCH_DEPTH),
         "scan_steps": resolve_scan_steps(args, conf),
         "accum_steps": resolve_accum_steps(args, conf),
+        "keep_best": resolve_keep_best(args, conf),
     }
+
+
+def resolve_keep_best(args, conf: Conf) -> str:
+    """shifu.tpu.keep-best with the usual CLI-wins precedence."""
+    if getattr(args, "keep_best", None) is not None:
+        return args.keep_best
+    return conf.get(K.KEEP_BEST, K.DEFAULT_KEEP_BEST) or ""
 
 
 def worker_runtime_kwargs(args, conf: Conf) -> dict:
@@ -352,6 +365,13 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             "validSetRate/--valid-rate or drop the early-stop keys "
             "(silently training the full budget is not what you asked for)"
         )
+    if resolve_keep_best(args, conf) and valid_rate <= 0:
+        raise SystemExit(
+            f"{K.KEEP_BEST} needs validation data to rank epochs, but the "
+            "validation rate is 0 — with keep-best=ks every epoch ties at "
+            "0.0 and the FIRST epoch would be exported as 'best'; raise "
+            "validSetRate/--valid-rate or drop the key"
+        )
     data_path = conf.get(K.TRAINING_DATA_PATH)
     paths = list_data_files(data_path)
     if not paths:
@@ -468,6 +488,9 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     }
     if trainer.stop_reason:
         summary["stopped_early"] = trainer.stop_reason
+    if trainer.keep_best and trainer.best_epoch is not None:
+        summary["best_epoch"] = trainer.best_epoch
+        summary["best_metric"] = trainer.best_metric
     print(json.dumps(summary), flush=True)
     return 0
 
@@ -502,6 +525,25 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # criteria on full-quorum epoch aggregates and delivers the decision
     # through the per-epoch barrier (which it force-enables), so every
     # worker stops after the same epoch — see JobSpec.early_stop_*
+    if extras["keep_best"]:
+        raise SystemExit(
+            f"{K.KEEP_BEST} is single-process only: the fleet export path "
+            "restores from the LAST checkpoint, so keeping a best snapshot "
+            "in worker memory could not be exported — drop the key or run "
+            "with one worker"
+        )
+    fleet_valid_rate = (
+        args.valid_rate if args.valid_rate is not None
+        else model_config.valid_set_rate
+    )
+    if resolve_early_stop(args, conf) is not None and fleet_valid_rate <= 0:
+        # same unfireable-config rejection as run_single: every worker
+        # would report ks=0/NaN and the fleet would burn the full budget
+        raise SystemExit(
+            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
+            "data to ever fire, but the validation rate is 0 — raise "
+            "validSetRate/--valid-rate or drop the early-stop keys"
+        )
     if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
                                              K.DEFAULT_DEVICE_RESIDENT):
         # silently training a different mode than requested is a bug; the

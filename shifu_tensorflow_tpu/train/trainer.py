@@ -119,6 +119,17 @@ class EarlyStopper:
         return None
 
 
+def _unbox_params(tree):
+    """Strip flax partitioning boxes so host snapshots are plain arrays."""
+    from flax.core import meta as flax_meta
+
+    return jax.tree_util.tree_map(
+        lambda x: x.unbox() if isinstance(x, flax_meta.AxisMetadata) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata),
+    )
+
+
 def donation_is_safe() -> bool:
     """Whether donating the train state to the jitted step is a win here.
 
@@ -322,6 +333,7 @@ class Trainer:
         prefetch_depth: int = 2,
         scan_steps: int = 1,
         accum_steps: int = 1,
+        keep_best: str = "",
     ):
         # validate the cheap two-int invariant FIRST: a bad combination
         # must fail in microseconds, not after model build + param init +
@@ -443,6 +455,19 @@ class Trainer:
         self.step_timer = None
         # set by the fit loops when an EarlyStopper ends training early
         self.stop_reason: str | None = None
+        # keep-best (conf key shifu.tpu.keep-best): snapshot params to
+        # host whenever the chosen validation metric improves; export
+        # then serves the BEST epoch, not the last (with patience-based
+        # early stopping the last epoch is by construction patience
+        # epochs past the best).  "" = off; "valid_loss" | "ks".
+        if keep_best not in ("", "valid_loss", "ks"):
+            raise ValueError(
+                f"unknown keep_best {keep_best!r} (valid_loss | ks)"
+            )
+        self.keep_best = keep_best
+        self.best_params = None
+        self.best_epoch: int | None = None
+        self.best_metric = float("inf") if keep_best == "valid_loss" else float("-inf")
 
     # ---- device placement ----
     def _put(self, batch: Batch) -> Batch:
@@ -655,6 +680,79 @@ class Trainer:
             counts["real"],
         )
 
+    #: best-snapshot persistence filename inside the checkpoint directory
+    _BEST_FILE = "keep-best.npz"
+
+    def _maybe_snapshot_best(self, stats: EpochStats,
+                             checkpointer=None) -> None:
+        """Host-snapshot the params when the keep-best metric improves.
+        Host memory only (tabular nets are MBs); no collectives, so under
+        SPMD each process snapshots locally without synchronization — the
+        chief's snapshot is the one that matters (it exports).  With a
+        checkpointer present the snapshot also persists to the checkpoint
+        directory, so a resumed run keeps competing against the TRUE best
+        instead of restarting the race from scratch."""
+        if not self.keep_best:
+            return
+        if self.keep_best == "valid_loss":
+            m = stats.valid_loss
+            improved = not np.isnan(m) and m < self.best_metric
+        else:  # ks
+            m = stats.ks
+            improved = m > self.best_metric
+        if improved:
+            self.best_metric = float(m)
+            self.best_epoch = stats.current_epoch
+            self.best_params = jax.device_get(_unbox_params(self.state.params))
+            if checkpointer is not None:
+                self._persist_best(checkpointer.directory)
+
+    def _persist_best(self, directory: str) -> None:
+        """Atomic write of the best snapshot (tmp + rename, like the
+        checkpointers); path->array keys so restore needs no treedef."""
+        import json as _json
+        import os as _os
+
+        from shifu_tensorflow_tpu.export.saved_model import _flatten_params
+        from shifu_tensorflow_tpu.utils import fs
+
+        meta = _json.dumps({
+            "epoch": self.best_epoch,
+            "metric": self.best_metric,
+            "keep_best": self.keep_best,
+        })
+        base = f"{directory.rstrip('/')}/{self._BEST_FILE}"
+        tmp = f"{base}.tmp.{_os.getpid()}"
+        with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
+            np.savez(f, __meta__=np.frombuffer(meta.encode(), np.uint8),
+                     **_flatten_params(self.best_params))
+        fs.rename(tmp, base)
+
+    def _restore_best(self, directory: str) -> None:
+        """Load a persisted best snapshot (resume path).  Ignored when
+        absent or recorded under a DIFFERENT metric — comparing a ks best
+        against valid_loss improvements would be meaningless."""
+        import io
+        import json as _json
+
+        from shifu_tensorflow_tpu.export.saved_model import _unflatten_params
+        from shifu_tensorflow_tpu.utils import fs
+
+        base = f"{directory.rstrip('/')}/{self._BEST_FILE}"
+        try:
+            with fs.filesystem_for(base).open_read(fs.strip_local(base)) as f:
+                data = np.load(io.BytesIO(f.read()))
+        except (OSError, ValueError):
+            return
+        meta = _json.loads(bytes(data["__meta__"]).decode())
+        if meta.get("keep_best") != self.keep_best:
+            return
+        self.best_params = _unflatten_params(
+            {k: data[k] for k in data.files if k != "__meta__"}
+        )
+        self.best_epoch = int(meta["epoch"])
+        self.best_metric = float(meta["metric"])
+
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
         losses, scores, labels, weights = [], [], [], []
         if self._cross_process:
@@ -737,6 +835,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:
                 on_epoch(stats)
@@ -864,6 +963,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:
                 on_epoch(stats)
@@ -972,6 +1072,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:
                 on_epoch(stats)
@@ -994,8 +1095,13 @@ class Trainer:
         return np.concatenate(out, axis=0) if out else np.empty((0, 1), np.float32)
 
     def restore(self, checkpointer: "Any") -> int:
-        """Restore latest checkpoint; returns the next epoch to run."""
+        """Restore latest checkpoint; returns the next epoch to run.  With
+        keep-best configured, the persisted best snapshot restores too —
+        a resumed run must compete against the TRUE best, not restart the
+        race (else export silently serves best-since-resume)."""
         restored, next_epoch = checkpointer.restore_latest(self.state)
         if restored is not None:
             self.state = restored
+        if self.keep_best:
+            self._restore_best(checkpointer.directory)
         return next_epoch

@@ -423,3 +423,6 @@ def test_single_process_preflight_rejects_unfireable_configs(tmp_path):
         main(base + ["--device-resident", "--accum-steps", "2"])
     with pytest.raises(SystemExit, match="validation"):
         main(base + ["--early-stop-ks", "0.45", "--valid-rate", "0"])
+    # keep-best cannot be exported by the fleet path (restores LAST ckpt)
+    with pytest.raises(SystemExit, match="keep-best"):
+        main(base + ["--workers", "2", "--keep-best", "ks"])

@@ -271,6 +271,19 @@ def test_accum_steps_key_reaches_trainer():
     assert trainer.accum_steps == 1 and trainer._accum_step is None
 
 
+def test_keep_best_key_reaches_trainer():
+    from shifu_tensorflow_tpu.train.__main__ import resolve_keep_best
+
+    assert resolve_keep_best(_args(), _conf({})) == ""
+    assert resolve_keep_best(_args(), _conf({K.KEEP_BEST: "ks"})) == "ks"
+    # CLI flag wins over conf
+    assert resolve_keep_best(
+        _args(["--keep-best", "valid_loss"]), _conf({K.KEEP_BEST: "ks"})
+    ) == "valid_loss"
+    extras = trainer_extras(_args(), _conf({K.KEEP_BEST: "ks"}))
+    assert extras["keep_best"] == "ks"
+
+
 def test_early_stop_keys_reach_fit_loop():
     from shifu_tensorflow_tpu.train.__main__ import resolve_early_stop
 

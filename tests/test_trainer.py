@@ -468,6 +468,107 @@ def test_lr_schedule_trains_and_decays():
     assert moved_s < moved_c * 0.6, (moved_s, moved_c)
 
 
+# ---- keep-best (shifu.tpu.keep-best) ----
+
+def test_keep_best_snapshots_and_export_serves_it(tmp_path):
+    """The best-validation epoch's params are snapshotted and the export
+    serves THEM — scores must match the snapshot, not the (worse) final
+    params."""
+    import pytest
+
+    from shifu_tensorflow_tpu.export.eval_model import EvalModel
+    from shifu_tensorflow_tpu.export.saved_model import export_model
+    from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+    with pytest.raises(ValueError, match="keep_best"):
+        Trainer(_mc(), 6, keep_best="auc")
+
+    t = Trainer(_mc(epochs=1), 6, seed=2, keep_best="valid_loss")
+
+    def stats(epoch, valid_loss):
+        return EpochStats(0, epoch, 0.2, valid_loss, 1.0, 0.1, epoch)
+
+    t._maybe_snapshot_best(stats(0, 0.5))
+    assert t.best_epoch == 0 and t.best_metric == 0.5
+    best_kernel = t.best_params["shifu_output_0"]["kernel"].copy()
+    # make the live params drift (simulates further, worse epochs)
+    t.state = t.state.replace(
+        params=jax.tree_util.tree_map(lambda p: p + 1.0, t.state.params)
+    )
+    t._maybe_snapshot_best(stats(1, 0.7))  # worse: no new snapshot
+    assert t.best_epoch == 0
+    np.testing.assert_array_equal(
+        t.best_params["shifu_output_0"]["kernel"], best_kernel
+    )
+    t._maybe_snapshot_best(stats(2, float("nan")))  # NaN never wins
+    assert t.best_epoch == 0
+
+    export_dir = str(tmp_path / "best-model")
+    export_model(export_dir, t)
+    x = np.random.default_rng(0).random((16, 6)).astype(np.float32)
+    want = t.model.apply({"params": t.best_params}, x)
+    with EvalModel(export_dir, backend="native") as em:
+        np.testing.assert_allclose(em.compute_batch(x), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+    # and NOT the drifted live params
+    live = np.asarray(t.model.apply({"params": t.state.params}, x))
+    assert not np.allclose(np.asarray(want), live)
+
+
+def test_keep_best_survives_resume(psv_dataset, tmp_path):
+    """The best snapshot persists beside the checkpoints: a resumed run
+    competes against the TRUE best, not best-since-resume — otherwise the
+    export after a crash+resume silently serves a worse model."""
+    from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+    ds = _dataset(psv_dataset)
+    ckpt_dir = str(tmp_path / "ckpt")
+    t1 = Trainer(_mc(epochs=2), ds.schema.num_features, seed=1,
+                 keep_best="valid_loss")
+    ck = Checkpointer(ckpt_dir)
+    t1.fit(ds, batch_size=100, checkpointer=ck)
+    assert t1.best_params is not None
+    # simulate a much better epoch than a resumed run will ever see
+    t1.best_metric = 1e-9
+    t1.best_epoch = 1
+    t1._persist_best(ck.directory)
+    ck.close()
+
+    t2 = Trainer(_mc(epochs=4), ds.schema.num_features, seed=1,
+                 keep_best="valid_loss")
+    ck2 = Checkpointer(ckpt_dir)
+    start = t2.restore(ck2)
+    assert start == 2
+    assert t2.best_metric == 1e-9 and t2.best_epoch == 1  # true best kept
+    np.testing.assert_array_equal(
+        t2.best_params["shifu_output_0"]["kernel"],
+        t1.best_params["shifu_output_0"]["kernel"],
+    )
+    # further epochs cannot beat 1e-9: the persisted best stays exported
+    t2.fit(ds, batch_size=100, checkpointer=ck2, start_epoch=start)
+    assert t2.best_epoch == 1
+    ck2.close()
+    # a DIFFERENT metric ignores the stale snapshot instead of comparing
+    # apples to oranges
+    t3 = Trainer(_mc(epochs=4), ds.schema.num_features, seed=1,
+                 keep_best="ks")
+    t3._restore_best(ckpt_dir)
+    assert t3.best_params is None
+
+
+def test_keep_best_ks_tracks_improvements(psv_dataset):
+    """End-to-end fit with keep_best='ks': the snapshot tracks the best-KS
+    epoch seen in history."""
+    ds = _dataset(psv_dataset)
+    t = Trainer(_mc(epochs=4), ds.schema.num_features, seed=1,
+                keep_best="ks")
+    hist = t.fit(ds, batch_size=100)
+    assert t.best_params is not None
+    best = max(hist, key=lambda h: h.ks)
+    assert t.best_epoch == best.current_epoch
+    assert t.best_metric == pytest.approx(best.ks)
+
+
 # ---- early stopping (shifu.tpu.early-stop-ks / early-stop-patience) ----
 
 def test_early_stop_on_target_ks(psv_dataset):
