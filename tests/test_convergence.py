@@ -132,6 +132,44 @@ def test_single_process_reaches_ks_gate(strong_dataset, algorithm):
     )
 
 
+def test_round4_training_features_reach_ks_gate(strong_dataset):
+    """The round-4 training features composed — gradient accumulation,
+    warmup+cosine LR schedule, keep-best, early-stop-at-target — must
+    still clear the north-star gate (and the early stop must fire AT or
+    above it, by definition of the criterion)."""
+    params = {
+        "NumHiddenLayers": 2,
+        "NumHiddenNodes": [16, 8],
+        "ActivationFunc": ["relu", "tanh"],
+        "LearningRate": 0.1,
+        "Optimizer": "adam",
+        "LearningRateSchedule": "cosine",
+        "WarmupSteps": 10,
+        "DecaySteps": 200,
+        "DecayRate": 0.1,
+    }
+    mc = ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 12, "validSetRate": 0.2,
+                   "params": params}}
+    )
+    dataset = InMemoryDataset.load(
+        strong_dataset["paths"], _schema(), mc.valid_set_rate, salt=0
+    )
+    from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+
+    trainer = make_trainer(
+        mc, N_FEATURES, feature_columns=_schema().feature_columns,
+        accum_steps=2, keep_best="ks",
+    )
+    history = trainer.fit(
+        dataset, batch_size=64,
+        early_stop=EarlyStopper(target_ks=KS_GATE),
+    )
+    assert trainer.stop_reason, "never reached the gate within the budget"
+    assert history[-1].ks >= KS_GATE
+    assert trainer.best_metric >= KS_GATE  # keep-best tracked the gate run
+
+
 @pytest.mark.parametrize("algorithm", ["ssgd", "sagn"])
 def test_two_process_spmd_reaches_ks_gate(strong_dataset, tmp_path,
                                           algorithm):
