@@ -117,10 +117,11 @@ DEFAULT_EARLY_STOP_KS = 0.0
 EARLY_STOP_PATIENCE = TPU_PREFIX + "early-stop-patience"
 DEFAULT_EARLY_STOP_PATIENCE = 0
 # keep-best ("" = off; "valid_loss" | "ks"): snapshot params at the best
-# validation epoch; export serves that epoch instead of the last.
-# Single-process only: the fleet export path restores from the LAST
-# checkpoint, so run_multi rejects the key rather than silently
-# exporting something other than the best.
+# validation epoch; export serves that epoch instead of the last.  In a
+# fleet the CHIEF persists its snapshot beside the shared checkpoints
+# (keep-best.npz) and the export trainer restores it; needs validation
+# data, and --export-dir with workers>1 additionally needs
+# --checkpoint-dir (both preflighted).
 KEEP_BEST = TPU_PREFIX + "keep-best"
 DEFAULT_KEEP_BEST = ""
 CHECKPOINT_EVERY_EPOCHS = TPU_PREFIX + "checkpoint-every-epochs"

@@ -79,6 +79,9 @@ class WorkerConfig:
     scan_steps: int = 1
     # microbatches per optimizer update (conf key shifu.tpu.accum-steps)
     accum_steps: int = 1
+    # keep-best metric ("" = off; conf key shifu.tpu.keep-best); the
+    # chief persists its best snapshot beside the shared checkpoints
+    keep_best: str = ""
     # background checkpoint writes (conf key shifu.tpu.async-checkpoint)
     async_checkpoint: bool = False
     # binary shard cache directory (data/cache.py); None = no caching
@@ -96,8 +99,8 @@ class WorkerConfig:
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
-                "scan_steps", "accum_steps", "async_checkpoint",
-                "cache_dir",
+                "scan_steps", "accum_steps", "keep_best",
+                "async_checkpoint", "cache_dir",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -279,6 +282,7 @@ def run_worker(cfg: WorkerConfig, *,
             prefetch_depth=cfg.prefetch_depth,
             scan_steps=cfg.scan_steps,
             accum_steps=cfg.accum_steps,
+            keep_best=cfg.keep_best,
             **extra,
         )
 
@@ -546,6 +550,15 @@ def _run_spmd_training(
             agreed_epoch, trainer.state
         )
         trainer.state = state
+    if cfg.keep_best and checkpointer is not None:
+        # resumed fleets compete against the TRUE best, not
+        # best-since-restart (trainer.restore does this for the non-SPMD
+        # path; SPMD restores through restore_epoch).  Unconditional on
+        # the agreed epoch: a relaunch BEFORE the first checkpoint
+        # (agreed_epoch -1) may still have a persisted best from the
+        # previous generation's epoch 0 — restarting the race would let
+        # a worse post-relaunch epoch overwrite it.
+        trainer._restore_best(checkpointer.directory)
 
     def _warn_dropped(rows: int) -> None:
         log.warning(

@@ -219,6 +219,7 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
                                        K.DEFAULT_PREFETCH_DEPTH),
         "scan_steps": resolve_scan_steps(args, conf),
         "accum_steps": resolve_accum_steps(args, conf),
+        "keep_best": resolve_keep_best(args, conf),
         "async_checkpoint": conf.get_bool(K.ASYNC_CHECKPOINT,
                                           K.DEFAULT_ASYNC_CHECKPOINT),
         "cache_dir": conf.get(K.CACHE_DIR),
@@ -525,13 +526,6 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # criteria on full-quorum epoch aggregates and delivers the decision
     # through the per-epoch barrier (which it force-enables), so every
     # worker stops after the same epoch — see JobSpec.early_stop_*
-    if extras["keep_best"]:
-        raise SystemExit(
-            f"{K.KEEP_BEST} is single-process only: the fleet export path "
-            "restores from the LAST checkpoint, so keeping a best snapshot "
-            "in worker memory could not be exported — drop the key or run "
-            "with one worker"
-        )
     fleet_valid_rate = (
         args.valid_rate if args.valid_rate is not None
         else model_config.valid_set_rate
@@ -544,6 +538,25 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             "data to ever fire, but the validation rate is 0 — raise "
             "validSetRate/--valid-rate or drop the early-stop keys"
         )
+    if extras["keep_best"]:
+        # supported for fleets: the CHIEF persists its best snapshot
+        # beside the shared checkpoints (keep-best.npz), and the export
+        # trainer restores it — but it needs both validation data and a
+        # checkpoint dir to have anywhere to live
+        if fleet_valid_rate <= 0:
+            raise SystemExit(
+                f"{K.KEEP_BEST} needs validation data to rank epochs — "
+                "raise validSetRate/--valid-rate or drop the key"
+            )
+        if not args.checkpoint_dir:
+            # without a shared checkpoint dir the snapshot has nowhere to
+            # live: the chief's in-memory best dies with its process and
+            # keep-best would be a silent no-op
+            raise SystemExit(
+                f"{K.KEEP_BEST} with --workers>1 needs --checkpoint-dir: "
+                "the chief persists the best snapshot beside the shared "
+                "checkpoints"
+            )
     if args.device_resident or conf.get_bool(K.DEVICE_RESIDENT,
                                              K.DEFAULT_DEVICE_RESIDENT):
         # silently training a different mode than requested is a bug; the
@@ -645,6 +658,12 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             schema.num_features,
             feature_columns=schema.feature_columns,
             seed=args.seed,
+            # restore() then also loads the chief's persisted best
+            # snapshot, and export_model serves it over the last epoch
+            # (extras: single resolution — the export trainer must agree
+            # with the fleet on the metric, or _restore_best rejects the
+            # snapshot)
+            keep_best=extras["keep_best"],
         )
         # SPMD jobs checkpoint through the flat-file format (see
         # NpzCheckpointer); restore with the matching reader

@@ -161,6 +161,45 @@ def test_cli_multi_worker_end_to_end(
     assert (export_dir / "shifu_tpu_weights.npz").exists()
 
 
+def test_cli_multi_worker_keep_best_exports_chief_snapshot(
+    tmp_path, capsys, psv_dataset, model_config_json
+):
+    """Fleet keep-best: the chief persists its best snapshot beside the
+    shared checkpoints and the export serves exactly those parameters."""
+    import numpy as np
+
+    mcj = dict(model_config_json)
+    mcj["train"] = dict(mcj["train"])
+    mcj["train"]["params"] = dict(mcj["train"]["params"], Optimizer="adam")
+    mc = _write_model_config(tmp_path, mcj, epochs=3)
+    export_dir = tmp_path / "export-best"
+    ckpt_dir = tmp_path / "ckpt-best"
+    argv = [
+        "--training-data-path", psv_dataset["root"],
+        "--model-config", mc,
+        "--feature-columns", ",".join(map(str, psv_dataset["feature_cols"])),
+        "--target-column", str(psv_dataset["target_col"]),
+        "--weight-column", str(psv_dataset["weight_col"]),
+        "--workers", "2",
+        "--keep-best", "ks",
+        "--checkpoint-dir", str(ckpt_dir),
+        "--export-dir", str(export_dir),
+    ]
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["state"] == "finished"
+    best_file = ckpt_dir / "keep-best.npz"
+    assert best_file.exists(), "chief never persisted its best snapshot"
+    best = np.load(best_file)
+    exported = np.load(export_dir / "shifu_tpu_weights.npz")
+    # identical param trees: the export IS the best snapshot
+    keys = [k for k in best.files if k != "__meta__"]
+    assert sorted(keys) == sorted(exported.files)
+    for k in keys:
+        np.testing.assert_array_equal(best[k], exported[k])
+
+
 def test_cli_resume_from_checkpoint(
     tmp_path, capsys, psv_dataset, model_config_json
 ):
@@ -423,6 +462,11 @@ def test_single_process_preflight_rejects_unfireable_configs(tmp_path):
         main(base + ["--device-resident", "--accum-steps", "2"])
     with pytest.raises(SystemExit, match="validation"):
         main(base + ["--early-stop-ks", "0.45", "--valid-rate", "0"])
-    # keep-best cannot be exported by the fleet path (restores LAST ckpt)
-    with pytest.raises(SystemExit, match="keep-best"):
+    # fleet keep-best needs the shared checkpoint dir the chief persists
+    # the snapshot into — without it the key would be a silent no-op
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
         main(base + ["--workers", "2", "--keep-best", "ks"])
+    # and, like early stop, it needs validation data to rank epochs
+    with pytest.raises(SystemExit, match="validation"):
+        main(base + ["--workers", "2", "--keep-best", "ks",
+                     "--valid-rate", "0"])
