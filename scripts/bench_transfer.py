@@ -1,0 +1,90 @@
+"""Host->device transfer micro-bench: fp32 vs bf16 vs uint16-view+bitcast.
+
+Diagnoses the BENCH_BUILDER_r03 anomaly: end-to-end bf16 streaming ran
+2.3x SLOWER than fp32 through the tunneled chip (4.2M vs 9.8M rows/s)
+even though bf16 halves the bytes, while host-side memmap drains show
+bf16 1.5x FASTER (BENCH_INGEST_HOST.json).  The suspect is the transfer
+path for ml_dtypes bfloat16 numpy arrays; if so, shipping the same bits
+as a uint16 view and bitcasting on device is the fix, and this artifact
+is the evidence for (or against) building it.
+
+Run on the TPU host (the watcher battery does):
+    python scripts/bench_transfer.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_TRANSFER_ROWS", 65536))
+COLS = 30
+REPS = 30
+
+
+def _rate(fn) -> float:
+    """Calls/sec -> rows/sec, synchronized per call."""
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        jax.block_until_ready(fn())
+    return REPS * ROWS / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    a32 = rng.normal(size=(ROWS, COLS)).astype(np.float32)
+    a16 = a32.astype(ml_dtypes.bfloat16)
+    a16u = a16.view(np.uint16)
+
+    bitcast = jax.jit(
+        lambda u: jax.lax.bitcast_convert_type(u, jnp.bfloat16)
+    )
+    out = {
+        "bench": "transfer",
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "rows": ROWS,
+        "cols": COLS,
+        "date": time.strftime("%Y-%m-%d"),
+        "device_put_f32_rows_s": round(_rate(lambda: jax.device_put(a32))),
+        "device_put_bf16_rows_s": round(_rate(lambda: jax.device_put(a16))),
+        "device_put_u16_bitcast_rows_s": round(
+            _rate(lambda: bitcast(jax.device_put(a16u)))
+        ),
+    }
+    out["bf16_vs_f32"] = round(
+        out["device_put_bf16_rows_s"] / out["device_put_f32_rows_s"], 2
+    )
+    out["u16_vs_bf16"] = round(
+        out["device_put_u16_bitcast_rows_s"] / out["device_put_bf16_rows_s"],
+        2,
+    )
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

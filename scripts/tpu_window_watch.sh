@@ -40,6 +40,10 @@ for i in $(seq 1 "$MAX_LOOPS"); do
         timeout 1200 python scripts/bench_models.py \
             --out "$REPO/BENCH_MODELS_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) models done rc=$?" >>"$LOG"
+        # 4. transfer-path diagnosis (bf16 vs fp32 vs u16+bitcast)
+        timeout 300 python scripts/bench_transfer.py \
+            --out "$REPO/BENCH_TRANSFER.json" >>"$LOG" 2>&1
+        echo "$(date +%T) transfer done rc=$?" >>"$LOG"
         echo "$(date +%T) battery complete" >>"$LOG"
         exit 0
     fi
