@@ -104,8 +104,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep-best", default=None,
                    choices=["valid_loss", "ks"],
                    help="snapshot params at the best validation epoch and "
-                        "export THAT model instead of the last epoch's "
-                        "(single-process only)")
+                        "export THAT model instead of the last epoch's; "
+                        "fleets persist the chief's snapshot beside the "
+                        "shared checkpoints")
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
@@ -242,6 +243,45 @@ def resolve_accum_steps(args, conf: Conf) -> int:
     return conf.get_int(K.ACCUM_STEPS, K.DEFAULT_ACCUM_STEPS)
 
 
+def resolve_valid_rate(args, model_config: ModelConfig) -> float:
+    """--valid-rate wins; else the ModelConfig's validSetRate.  ONE
+    resolver shared by both run paths' preflights and fit loops, so a
+    guard can never judge a different rate than training uses."""
+    return (
+        args.valid_rate if args.valid_rate is not None
+        else model_config.valid_set_rate
+    )
+
+
+def reject_unfireable_validation_configs(args, conf: Conf,
+                                         valid_rate: float,
+                                         early_stop=None) -> None:
+    """Shared preflight: early stopping and keep-best both need validation
+    data to ever act; with a zero validation rate they would silently do
+    nothing (or worse, keep-best=ks would crown the FIRST epoch).  One
+    clean error up front beats a silent no-op — in a fleet, beats N
+    workers burning the full budget.  ``early_stop``: pass the already-
+    resolved stopper to avoid re-resolving; None resolves here."""
+    if valid_rate > 0:
+        return
+    if early_stop is None:
+        early_stop = resolve_early_stop(args, conf)
+    if early_stop is not None:
+        raise SystemExit(
+            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
+            "data to ever fire, but the validation rate is 0 — raise "
+            "validSetRate/--valid-rate or drop the early-stop keys "
+            "(silently training the full budget is not what you asked for)"
+        )
+    if resolve_keep_best(args, conf):
+        raise SystemExit(
+            f"{K.KEEP_BEST} needs validation data to rank epochs, but the "
+            "validation rate is 0 — with keep-best=ks every epoch ties at "
+            "0.0 and the FIRST epoch would be exported as 'best'; raise "
+            "validSetRate/--valid-rate or drop the key"
+        )
+
+
 def resolve_early_stop(args, conf: Conf):
     """shifu.tpu.early-stop-ks / early-stop-patience -> EarlyStopper (or
     None when both are off).  CLI flags win with the usual precedence."""
@@ -354,25 +394,10 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             "the batch size instead (the dataset already fits in device "
             "memory)"
         )
-    valid_rate = (
-        args.valid_rate if args.valid_rate is not None
-        else model_config.valid_set_rate
-    )
+    valid_rate = resolve_valid_rate(args, model_config)
     early_stop = resolve_early_stop(args, conf)
-    if early_stop is not None and valid_rate <= 0:
-        raise SystemExit(
-            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
-            "data to ever fire, but the validation rate is 0 — raise "
-            "validSetRate/--valid-rate or drop the early-stop keys "
-            "(silently training the full budget is not what you asked for)"
-        )
-    if resolve_keep_best(args, conf) and valid_rate <= 0:
-        raise SystemExit(
-            f"{K.KEEP_BEST} needs validation data to rank epochs, but the "
-            "validation rate is 0 — with keep-best=ks every epoch ties at "
-            "0.0 and the FIRST epoch would be exported as 'best'; raise "
-            "validSetRate/--valid-rate or drop the key"
-        )
+    reject_unfireable_validation_configs(args, conf, valid_rate,
+                                         early_stop=early_stop)
     data_path = conf.get(K.TRAINING_DATA_PATH)
     paths = list_data_files(data_path)
     if not paths:
@@ -526,28 +551,13 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # criteria on full-quorum epoch aggregates and delivers the decision
     # through the per-epoch barrier (which it force-enables), so every
     # worker stops after the same epoch — see JobSpec.early_stop_*
-    fleet_valid_rate = (
-        args.valid_rate if args.valid_rate is not None
-        else model_config.valid_set_rate
+    reject_unfireable_validation_configs(
+        args, conf, resolve_valid_rate(args, model_config)
     )
-    if resolve_early_stop(args, conf) is not None and fleet_valid_rate <= 0:
-        # same unfireable-config rejection as run_single: every worker
-        # would report ks=0/NaN and the fleet would burn the full budget
-        raise SystemExit(
-            f"{K.EARLY_STOP_KS}/{K.EARLY_STOP_PATIENCE} need validation "
-            "data to ever fire, but the validation rate is 0 — raise "
-            "validSetRate/--valid-rate or drop the early-stop keys"
-        )
     if extras["keep_best"]:
         # supported for fleets: the CHIEF persists its best snapshot
         # beside the shared checkpoints (keep-best.npz), and the export
-        # trainer restores it — but it needs both validation data and a
-        # checkpoint dir to have anywhere to live
-        if fleet_valid_rate <= 0:
-            raise SystemExit(
-                f"{K.KEEP_BEST} needs validation data to rank epochs — "
-                "raise validSetRate/--valid-rate or drop the key"
-            )
+        # trainer restores it
         if not args.checkpoint_dir:
             # without a shared checkpoint dir the snapshot has nowhere to
             # live: the chief's in-memory best dies with its process and
@@ -602,7 +612,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
                 K.TASK_HEARTBEAT_INTERVAL_MS,
                 K.DEFAULT_TASK_HEARTBEAT_INTERVAL_MS,
             ) / 1000.0,
-            valid_rate=args.valid_rate,
+            # the RESOLVED rate, so the worker trains at exactly what the
+            # preflight judged (its own None-fallback stays for direct
+            # WorkerConfig users)
+            valid_rate=resolve_valid_rate(args, model_config),
             seed=args.seed,
             dtype=args.dtype or conf.get(K.DTYPE, K.DEFAULT_DTYPE),
             mesh_spec=conf.get(K.MESH_SHAPE),
