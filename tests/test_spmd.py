@@ -437,6 +437,51 @@ def test_spmd_sigkill_recovers_via_fleet_restart(psv_dataset, tmp_path):
     assert ckpt.latest_epoch() == 2
 
 
+def test_spmd_sigkill_keep_best_survives_fleet_restart(psv_dataset, tmp_path):
+    """SIGKILL recovery with keep-best on: the chief's persisted best
+    snapshot (keep-best.npz) must survive the fleet restart — the
+    relaunched generation competes against the TRUE best, and the final
+    snapshot's metric can never be worse than any pre-crash epoch's."""
+    mc = _model_config(epochs=3)
+    shards = split_training_data(psv_dataset["root"], 2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    spec = _spec(
+        shards, 2, epochs=3,
+        spare_restarts=1,
+        heartbeat_interval_ms=200,
+        max_missed_heartbeats=5,
+    )
+    submitter = JobSubmitter(
+        spec,
+        _worker_cfg_factory(psv_dataset, mc, ckpt_dir, keep_best="ks"),
+        launcher="process",
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        kill_injections={"worker-1": 0},
+    )
+    result = submitter.run(timeout_s=300.0)
+    assert result.state == JobState.FINISHED, result.failure_reason
+    assert result.restarts_used == 1
+    best_file = os.path.join(ckpt_dir, "keep-best.npz")
+    assert os.path.exists(best_file), "chief never persisted a best snapshot"
+    import json as _json
+
+    data = np.load(best_file)
+    meta = _json.loads(bytes(data["__meta__"]).decode())
+    assert meta["keep_best"] == "ks"
+    assert 0 <= meta["epoch"] < 3 and meta["metric"] > 0
+    # the snapshot round-trips into a fresh export trainer (the fleet
+    # export path): restore must accept it under the same metric
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    t = make_trainer(mc, _schema(psv_dataset).num_features,
+                     feature_columns=_schema(psv_dataset).feature_columns,
+                     keep_best="ks")
+    t._restore_best(ckpt_dir)
+    assert t.best_params is not None
+    assert t.best_epoch == meta["epoch"]
+
+
 def test_spmd_streaming_sigkill_during_cold_cache_build(psv_dataset, tmp_path):
     """SIGKILL a worker while the fleet is streaming its FIRST epoch — the
     cold pass that parses text shards and writes binary cache entries.
