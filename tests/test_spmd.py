@@ -445,6 +445,27 @@ def test_spmd_sigkill_keep_best_survives_fleet_restart(psv_dataset, tmp_path):
     mc = _model_config(epochs=3)
     shards = split_training_data(psv_dataset["root"], 2)
     ckpt_dir = str(tmp_path / "ckpt")
+    schema = _schema(psv_dataset)
+    # DISCRIMINATOR: pre-seed the snapshot with an unbeatable metric.  If
+    # the chief restores it at every (re)launch — including the relaunch
+    # whose sync_plan agrees ckpt_epoch=-1 — no real epoch can improve on
+    # it and the file survives both generations untouched.  If the
+    # restore is broken, the race restarts and the first real epoch
+    # OVERWRITES it with its own (lower) KS: the assertions below fail.
+    os.makedirs(ckpt_dir, exist_ok=True)
+    seed_trainer = make_trainer(mc, schema.num_features,
+                                feature_columns=schema.feature_columns,
+                                keep_best="ks")
+    import jax
+
+    seed_trainer.best_metric = 0.999
+    seed_trainer.best_epoch = 0
+    seed_trainer.best_params = jax.device_get(seed_trainer.state.params)
+    seed_trainer._persist_best(ckpt_dir)
+    seed_kernel = np.asarray(
+        seed_trainer.best_params["shifu_output_0"]["kernel"]
+    )
+
     spec = _spec(
         shards, 2, epochs=3,
         spare_restarts=1,
@@ -463,23 +484,24 @@ def test_spmd_sigkill_keep_best_survives_fleet_restart(psv_dataset, tmp_path):
     assert result.state == JobState.FINISHED, result.failure_reason
     assert result.restarts_used == 1
     best_file = os.path.join(ckpt_dir, "keep-best.npz")
-    assert os.path.exists(best_file), "chief never persisted a best snapshot"
     import json as _json
 
     data = np.load(best_file)
     meta = _json.loads(bytes(data["__meta__"]).decode())
     assert meta["keep_best"] == "ks"
-    assert 0 <= meta["epoch"] < 3 and meta["metric"] > 0
-    # the snapshot round-trips into a fresh export trainer (the fleet
-    # export path): restore must accept it under the same metric
-    from shifu_tensorflow_tpu.train import make_trainer
-
-    t = make_trainer(mc, _schema(psv_dataset).num_features,
-                     feature_columns=_schema(psv_dataset).feature_columns,
+    assert meta["metric"] == 0.999, (
+        "a real epoch overwrote the seeded best: the (re)launch restore "
+        f"lost the race state ({meta})"
+    )
+    # and the snapshot the fleet export would restore is byte-identical
+    # to the seeded one
+    t = make_trainer(mc, schema.num_features,
+                     feature_columns=schema.feature_columns,
                      keep_best="ks")
     t._restore_best(ckpt_dir)
-    assert t.best_params is not None
-    assert t.best_epoch == meta["epoch"]
+    np.testing.assert_array_equal(
+        np.asarray(t.best_params["shifu_output_0"]["kernel"]), seed_kernel
+    )
 
 
 def test_spmd_streaming_sigkill_during_cold_cache_build(psv_dataset, tmp_path):
