@@ -460,7 +460,10 @@ class Coordinator:
             and stats.worker_index == 0
         ):
             with self._lock:
-                self._chief_stats[stats.current_epoch] = stats
+                # nothing left to decide once the stop is set — storing
+                # further epochs would only leak
+                if self._stop_after_epoch is None:
+                    self._chief_stats[stats.current_epoch] = stats
         summary = self.aggregator.report(stats)
         if summary is not None and self._early_stopper is not None:
             # full quorum for this epoch: evaluate the FLEET criteria.
@@ -493,6 +496,12 @@ class Coordinator:
                         eval_stats = self._chief_stats.pop(
                             summary.epoch, None
                         )
+                        # prune entries this epoch leapfrogged (epochs
+                        # flushed at partial quorum are never evaluated;
+                        # without pruning, restart-heavy jobs leak them)
+                        for e in [k for k in self._chief_stats
+                                  if k <= summary.epoch]:
+                            del self._chief_stats[e]
                     reason = (
                         self._early_stopper.should_stop(eval_stats)
                         if eval_stats is not None
@@ -501,6 +510,7 @@ class Coordinator:
                     if reason:
                         self._stop_after_epoch = summary.epoch
                         self.stop_reason = reason
+                        self._chief_stats.clear()  # decided: free the rest
                         log.info("fleet early stop after epoch %d: %s",
                                  summary.epoch, reason)
         with self._epoch_cond:

@@ -205,10 +205,19 @@ def trainer_extras(args, conf: Conf) -> dict:
 
 
 def resolve_keep_best(args, conf: Conf) -> str:
-    """shifu.tpu.keep-best with the usual CLI-wins precedence."""
+    """shifu.tpu.keep-best with the usual CLI-wins precedence.  Validated
+    HERE so a typo'd conf value (the CLI flag has argparse choices, the
+    conf key does not) is one clean pre-launch error in both run paths —
+    not an N-worker crash cascade inside Trainer.__init__."""
     if getattr(args, "keep_best", None) is not None:
-        return args.keep_best
-    return conf.get(K.KEEP_BEST, K.DEFAULT_KEEP_BEST) or ""
+        value = args.keep_best
+    else:
+        value = conf.get(K.KEEP_BEST, K.DEFAULT_KEEP_BEST) or ""
+    if value not in ("", "valid_loss", "ks"):
+        raise SystemExit(
+            f"unknown {K.KEEP_BEST} value {value!r} (valid_loss | ks)"
+        )
+    return value
 
 
 def worker_runtime_kwargs(args, conf: Conf) -> dict:
@@ -546,6 +555,12 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             f"Algorithm=sagn does not compose with {K.ACCUM_STEPS}: the "
             "SAGN window already defines its own accumulation semantics "
             "(UpdateWindow)"
+        )
+    if extras["accum_steps"] > 1 and model_config.params.update_window > 1:
+        raise SystemExit(
+            f"{K.ACCUM_STEPS} does not compose with "
+            "train.params.UpdateWindow > 1: both define gradient "
+            "accumulation — drop one"
         )
     # fleet early stopping is COORDINATED: the coordinator evaluates the
     # criteria on full-quorum epoch aggregates and delivers the decision

@@ -335,8 +335,8 @@ class Trainer:
         accum_steps: int = 1,
         keep_best: str = "",
     ):
-        # validate the cheap two-int invariant FIRST: a bad combination
-        # must fail in microseconds, not after model build + param init +
+        # validate the cheap invariants FIRST: a bad combination must
+        # fail in microseconds, not after model build + param init +
         # mesh sharding
         self.scan_steps = max(1, int(scan_steps))
         self.accum_steps = max(1, int(accum_steps))
@@ -346,6 +346,20 @@ class Trainer:
                 "chunks UPDATES per dispatch, the other chunks "
                 "microbatches per UPDATE (shifu.tpu.scan-steps / "
                 "shifu.tpu.accum-steps)"
+            )
+        if self.accum_steps > 1 and model_config.params.update_window > 1:
+            # MultiSteps would wrap each accumulated group's apply in a
+            # SECOND accumulation window — nested semantics nobody
+            # configured, and the equal-weight window mean breaks the
+            # exact big-batch equality accum-steps promises
+            raise ValueError(
+                "accum_steps does not compose with UpdateWindow > 1: both "
+                "define gradient accumulation (shifu.tpu.accum-steps / "
+                "train.params.UpdateWindow) — drop one"
+            )
+        if keep_best not in ("", "valid_loss", "ks"):
+            raise ValueError(
+                f"unknown keep_best {keep_best!r} (valid_loss | ks)"
             )
         self.model_config = model_config
         self.num_features = num_features
@@ -455,15 +469,11 @@ class Trainer:
         self.step_timer = None
         # set by the fit loops when an EarlyStopper ends training early
         self.stop_reason: str | None = None
-        # keep-best (conf key shifu.tpu.keep-best): snapshot params to
-        # host whenever the chosen validation metric improves; export
-        # then serves the BEST epoch, not the last (with patience-based
-        # early stopping the last epoch is by construction patience
-        # epochs past the best).  "" = off; "valid_loss" | "ks".
-        if keep_best not in ("", "valid_loss", "ks"):
-            raise ValueError(
-                f"unknown keep_best {keep_best!r} (valid_loss | ks)"
-            )
+        # keep-best (conf key shifu.tpu.keep-best, validated at the top
+        # of __init__): snapshot params to host whenever the chosen
+        # validation metric improves; export then serves the BEST epoch,
+        # not the last (with patience-based early stopping the last epoch
+        # is by construction patience epochs past the best).
         self.keep_best = keep_best
         self.best_params = None
         self.best_epoch: int | None = None
@@ -683,6 +693,28 @@ class Trainer:
     #: best-snapshot persistence filename inside the checkpoint directory
     _BEST_FILE = "keep-best.npz"
 
+    def _warn_if_validation_empty(self, stats: EpochStats,
+                                  early_stop) -> None:
+        """The preflights guard the configured validation RATE, but the
+        REALIZED split can still be empty (tiny shard, unlucky content-
+        hash salt): evaluate() then reports ks=0.0 / NaN loss every
+        epoch, keep-best=ks crowns the first epoch, and early stopping
+        never fires.  Say so once instead of silently doing the wrong
+        thing for the whole budget."""
+        if getattr(self, "_warned_empty_valid", False):
+            return
+        if not (self.keep_best or early_stop is not None):
+            return
+        if stats.ks == 0.0 and np.isnan(stats.valid_loss):
+            import warnings
+
+            self._warned_empty_valid = True
+            warnings.warn(
+                "validation produced no scored rows (ks=0, loss=NaN): "
+                "keep-best/early-stop cannot act — check validSetRate "
+                "and the split salt against the shard size"
+            )
+
     def _maybe_snapshot_best(self, stats: EpochStats,
                              checkpointer=None) -> None:
         """Host-snapshot the params when the keep-best metric improves.
@@ -693,6 +725,11 @@ class Trainer:
         directory, so a resumed run keeps competing against the TRUE best
         instead of restarting the race from scratch."""
         if not self.keep_best:
+            return
+        if stats.ks == 0.0 and np.isnan(stats.valid_loss):
+            # no scored validation rows: ks=0 here is absence of a
+            # measurement, not a measurement of 0 — crowning it would
+            # export the first epoch as "best"
             return
         if self.keep_best == "valid_loss":
             m = stats.valid_loss
@@ -716,13 +753,18 @@ class Trainer:
         from shifu_tensorflow_tpu.export.saved_model import _flatten_params
         from shifu_tensorflow_tpu.utils import fs
 
+        from shifu_tensorflow_tpu.train.checkpoint import _host_tag
+
         meta = _json.dumps({
             "epoch": self.best_epoch,
             "metric": self.best_metric,
             "keep_best": self.keep_best,
         })
         base = f"{directory.rstrip('/')}/{self._BEST_FILE}"
-        tmp = f"{base}.tmp.{_os.getpid()}"
+        # same .tmp.<host>.<pid> convention as the checkpointers, so the
+        # stale-temp sweeper's host-aware pid-liveness rules apply to a
+        # chief SIGKILLed mid-write here too
+        tmp = f"{base}.tmp.{_host_tag()}.{_os.getpid()}"
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, __meta__=np.frombuffer(meta.encode(), np.uint8),
                      **_flatten_params(self.best_params))
@@ -741,17 +783,34 @@ class Trainer:
         base = f"{directory.rstrip('/')}/{self._BEST_FILE}"
         try:
             with fs.filesystem_for(base).open_read(fs.strip_local(base)) as f:
-                data = np.load(io.BytesIO(f.read()))
-        except (OSError, ValueError):
+                raw = f.read()
+        except OSError:
+            return  # no snapshot (the common case): silently none
+        try:
+            data = np.load(io.BytesIO(raw))
+            meta = _json.loads(bytes(data["__meta__"]).decode())
+            if meta.get("keep_best") != self.keep_best:
+                return
+            best_params = _unflatten_params(
+                {k: data[k] for k in data.files if k != "__meta__"}
+            )
+            best_epoch = int(meta["epoch"])
+            best_metric = float(meta["metric"])
+        except Exception as e:
+            # an UNUSABLE snapshot (truncated zip, missing keys, bad
+            # JSON — e.g. a non-atomic remote rename died mid-write) must
+            # degrade to "no best yet", never brick every subsequent
+            # resume and the fleet export
+            import warnings
+
+            warnings.warn(
+                f"ignoring unreadable keep-best snapshot {base}: "
+                f"{type(e).__name__}: {e}"
+            )
             return
-        meta = _json.loads(bytes(data["__meta__"]).decode())
-        if meta.get("keep_best") != self.keep_best:
-            return
-        self.best_params = _unflatten_params(
-            {k: data[k] for k in data.files if k != "__meta__"}
-        )
-        self.best_epoch = int(meta["epoch"])
-        self.best_metric = float(meta["metric"])
+        self.best_params = best_params
+        self.best_epoch = best_epoch
+        self.best_metric = best_metric
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
         losses, scores, labels, weights = [], [], [], []
@@ -835,6 +894,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:
@@ -963,6 +1023,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:
@@ -1072,6 +1133,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
             if on_epoch:

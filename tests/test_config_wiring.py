@@ -272,8 +272,14 @@ def test_accum_steps_key_reaches_trainer():
 
 
 def test_keep_best_key_reaches_trainer():
+    import pytest
+
     from shifu_tensorflow_tpu.train.__main__ import resolve_keep_best
 
+    # the conf-key path has no argparse choices guard: a typo must be one
+    # clean pre-launch error, not an N-worker Trainer crash cascade
+    with pytest.raises(SystemExit, match="keep-best"):
+        resolve_keep_best(_args(), _conf({K.KEEP_BEST: "auc"}))
     assert resolve_keep_best(_args(), _conf({})) == ""
     assert resolve_keep_best(_args(), _conf({K.KEEP_BEST: "ks"})) == "ks"
     # CLI flag wins over conf

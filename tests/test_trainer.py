@@ -380,6 +380,47 @@ def test_accum_and_scan_are_mutually_exclusive():
         Trainer(_mc(epochs=1), 6, scan_steps=4, accum_steps=4)
 
 
+def test_accum_rejects_update_window():
+    """Both knobs define gradient accumulation; composing them would wrap
+    each accumulated group's apply in a SECOND MultiSteps window — nested
+    semantics nobody configured."""
+    with pytest.raises(ValueError, match="UpdateWindow"):
+        Trainer(_mc(epochs=1, UpdateWindow=4), 6, accum_steps=8)
+
+
+def test_keep_best_ignores_unreadable_snapshot(tmp_path):
+    """A truncated/corrupt keep-best.npz degrades to 'no best yet' with a
+    warning — it must never brick resume or the fleet export."""
+    d = str(tmp_path)
+    (tmp_path / "keep-best.npz").write_bytes(b"not a zip at all")
+    t = Trainer(_mc(epochs=1), 6, keep_best="ks")
+    with pytest.warns(UserWarning, match="unreadable keep-best"):
+        t._restore_best(d)
+    assert t.best_params is None
+    # absent file: silently none, no warning
+    t2 = Trainer(_mc(epochs=1), 6, keep_best="ks")
+    t2._restore_best(str(tmp_path / "nowhere"))
+    assert t2.best_params is None
+
+
+def test_keep_best_skips_empty_validation_epochs():
+    """ks=0 with NaN valid loss means NO scored rows — absence of a
+    measurement must not crown the first epoch as 'best', and the fit
+    loop warns once."""
+    from shifu_tensorflow_tpu.train.trainer import EpochStats
+
+    t = Trainer(_mc(epochs=1), 6, keep_best="ks")
+    empty = EpochStats(0, 0, 0.2, float("nan"), 1.0, 0.1, 1, ks=0.0)
+    t._maybe_snapshot_best(empty)
+    assert t.best_params is None  # not crowned
+    with pytest.warns(UserWarning, match="no scored rows"):
+        t._warn_if_validation_empty(empty, None)
+    # real 0-KS epochs (with a real loss) still participate
+    real = EpochStats(0, 1, 0.2, 0.4, 1.0, 0.1, 2, ks=0.0)
+    t._maybe_snapshot_best(real)
+    assert t.best_params is not None
+
+
 def test_sagn_rejects_accum_steps():
     from shifu_tensorflow_tpu.train import make_trainer
 
