@@ -17,8 +17,9 @@ lookup implementations:
   (ops/pallas/embedding.py) for the replicated-table case, keeping the
   gather on the MXU.
 
-``impl="auto"`` picks pallas on TPU when the table is not mesh-sharded,
-xla everywhere else.
+``impl="auto"`` picks pallas only on TPU, only for a non-mesh-sharded
+table, and only within a MEASURED win region (``PALLAS_MAX_HASH_SIZE``,
+default 0 = never — see the constant's docstring); xla everywhere else.
 """
 
 from __future__ import annotations
