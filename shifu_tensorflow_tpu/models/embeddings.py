@@ -42,12 +42,14 @@ hash_to_buckets = hashing.hash_to_buckets
 # BENCH_PALLAS_EMBEDDING.json whose `pallas_wins_up_to_hash_size` field
 # is this constant's source of truth.
 #
-# DEFAULT 0 = auto NEVER picks pallas (round-4 policy, per the round-3
-# verdict: the tunneled chip was unreachable for two straight rounds, so
-# an unmeasured fast path defaulted on is a perf liability, not a
-# feature).  ``impl="pallas"`` stays available explicitly, and a measured
-# deployment re-enables the auto cutover by setting
-# STPU_PALLAS_MAX_HASH_SIZE to the artifact's winning table size.
+# DEFAULT 0 = auto NEVER picks pallas.  This is now the MEASURED value:
+# the round-4 sweep ran on the real chip (TPU v5 lite, 2026-07-31,
+# BENCH_PALLAS_EMBEDDING.json) and XLA's gather wins the fwd+bwd regime
+# at every point in the grid (pallas 1.5x-100x slower; its only fwd-only
+# win, 1.84x at table 4K / batch 16K, is erased by the backward's
+# one-hot matmul transpose).  ``impl="pallas"`` stays available
+# explicitly, and STPU_PALLAS_MAX_HASH_SIZE can re-enable the auto
+# cutover if a future chip/kernel revision changes the verdict.
 import os as _os
 
 
