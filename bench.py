@@ -150,23 +150,34 @@ def bench_step_rows_per_sec(dtype: str = "float32",
         "y": (rng.random((rows, 1)) < 0.3).astype(np.float32),
         "w": np.ones((rows, 1), np.float32),
     }
+    # function-local on purpose (here and in the other sections):
+    # importing the package pulls jax, and bench.py's PARENT process must
+    # never touch jax — a hanging PJRT plugin would take down the
+    # orchestrator instead of one timed-out child
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
     dev_batch = trainer._put(batch)
     step = trainer._train_step
     state = trainer.state
     for _ in range(WARMUP_STEPS):
         state, loss = step(state, dev_batch)
-    jax.block_until_ready(loss)
+    true_sync(loss)
 
+    # sync by VALUE FETCH, not block_until_ready: through the axon
+    # tunnel the latter acknowledges enqueue, so this loop would time
+    # dispatch, not execution (see utils/profiling.true_sync).  The
+    # fetched loss threads through the whole state chain, so one fetch
+    # proves every step before it ran.
     n_steps = 0
     t0 = time.perf_counter()
     while True:
         state, loss = step(state, dev_batch)
         n_steps += 1
         if n_steps % 50 == 0:
-            jax.block_until_ready(loss)
+            true_sync(loss)
             if time.perf_counter() - t0 >= measure_seconds:
                 break
-    jax.block_until_ready(loss)
+    true_sync(loss)
     elapsed = time.perf_counter() - t0
     rows_per_sec = n_steps * rows / elapsed
     return rows_per_sec / jax.local_device_count()
@@ -191,22 +202,27 @@ def bench_scan_rows_per_sec(measure_seconds: float) -> float:
         "y": (rng.random((S, rows, 1)) < 0.3).astype(np.float32),
         "w": np.ones((S, rows, 1), np.float32),
     }
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
     dev = trainer._put_stacked(stacked)
     scan = trainer._scan_epoch
     state = trainer.state
     for _ in range(2):
         state, losses = scan(state, dev)
-    jax.block_until_ready(losses)
+    true_sync(losses)
+    # value-fetch sync (see bench_step_rows_per_sec): the r04 open-window
+    # run measured 1.42B rows/s here with block_until_ready — over 2× the
+    # chip's physical peak FLOPs, i.e. pure enqueue rate
     n_calls = 0
     t0 = time.perf_counter()
     while True:
         state, losses = scan(state, dev)
         n_calls += 1
         if n_calls % 5 == 0:
-            jax.block_until_ready(losses)
+            true_sync(losses)
             if time.perf_counter() - t0 >= measure_seconds:
                 break
-    jax.block_until_ready(losses)
+    true_sync(losses)
     elapsed = time.perf_counter() - t0
     return n_calls * S * rows / elapsed / jax.local_device_count()
 
@@ -328,14 +344,18 @@ def bench_stream_rows_per_sec() -> dict:
             # warmup/compile on the first batch, then measure wall-clock
             # over the rest of the stream; the state threads through
             # tr.state because the step may donate its input buffers
+            from shifu_tensorflow_tpu.utils.profiling import true_sync
+
             it = prefetch_to_device(iter(stream), put=tr._put)
             tr.state, loss = step(tr.state, next(it))
-            jax.block_until_ready(loss)
+            true_sync(loss)
             t0 = time.perf_counter()
             for batch in it:
                 tr.state, loss = step(tr.state, batch)
                 rows += batch_size
-            jax.block_until_ready(loss)
+            # value fetch: the final loss depends on every step of the
+            # epoch, so the elapsed window provably contains them all
+            true_sync(loss)
             return rows / (time.perf_counter() - t0)
 
         cold = one_epoch()
@@ -414,11 +434,22 @@ def _stream_stage_breakdown(paths, schema, cache_dir, trainer,
         "y": np.zeros((batch_size, 1), np.float32),
         "w": np.ones((batch_size, 1), np.float32),
     }
-    jax.block_until_ready(trainer._put(batch))
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
+    true_sync(trainer._put(batch))
     t0 = time.perf_counter()
     reps = 20
+    # enqueue all puts (overlapping, as training's prefetch does) and
+    # chain one element of every leaf of every put into an on-device
+    # accumulator; ONE final fetch proves all transfers landed inside
+    # the elapsed window without serializing a round trip per put
+    acc = None
     for _ in range(reps):
-        jax.block_until_ready(trainer._put(batch))
+        for leaf in jax.tree_util.tree_leaves(trainer._put(batch)):
+            probe = (leaf.reshape(-1)[0] if leaf.ndim else leaf)
+            probe = probe.astype("float32")
+            acc = probe if acc is None else acc + probe
+    true_sync(acc)
     out["device_put_rows_s"] = round(
         reps * batch_size / (time.perf_counter() - t0), 0)
     return out

@@ -139,19 +139,23 @@ def bench_family(name: str, params: dict, rows: int, batch: int,
     })
     state = trainer.state
     step = trainer._train_step
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
     for _ in range(3):
         state, loss = step(state, dev)
-    jax.block_until_ready(loss)
+    true_sync(loss)
+    # value-fetch sync: block_until_ready only acknowledges enqueue
+    # through the tunneled axon backend (utils/profiling.true_sync)
     n = 0
     t0 = time.perf_counter()
     while True:
         state, loss = step(state, dev)
         n += 1
         if n % 20 == 0:
-            jax.block_until_ready(loss)
+            true_sync(loss)
             if time.perf_counter() - t0 >= step_seconds:
                 break
-    jax.block_until_ready(loss)
+    true_sync(loss)
     out["step_rows_per_sec"] = round(
         n * B / (time.perf_counter() - t0) / jax.local_device_count(), 1
     )

@@ -47,12 +47,22 @@ def _xla_lookup(table, cats, hash_size):
 
 
 def _time(fn, *args) -> float:
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    true_sync(out)
+    # chain one element of every rep's output into an accumulator and
+    # fetch THAT: each dispatch's whole program must execute before its
+    # output can be sliced, so one final round trip proves all REPS ran
+    # inside the window (block_until_ready through the axon tunnel
+    # acknowledges enqueue only — see utils/profiling.true_sync)
+    acc = jnp.zeros((), jnp.float32)
     t0 = time.perf_counter()
     for _ in range(REPS):
         out = fn(*args)
-    jax.block_until_ready(out)
+        first = jax.tree_util.tree_leaves(out)[0]
+        acc = acc + first.reshape(-1)[0].astype(jnp.float32)
+    true_sync(acc)
     return (time.perf_counter() - t0) / REPS * 1e6  # us
 
 

@@ -1,0 +1,133 @@
+"""Sequence-family train-step throughput across sequence lengths.
+
+The sequence transformer (models/sequence.py, ModelType=sequence) is the
+framework's beyond-parity long-context family (SURVEY.md §5.7); its ring
+and Ulysses attention paths need a multi-device 'seq' mesh axis and are
+exercised on the 8-device CPU mesh (tests/test_ring.py) and in the
+driver's dryrun.  What a single chip CAN measure — and what this script
+does — is the on-chip full-attention step across sequence lengths at a
+fixed token budget per step, which is the compute baseline the ring path
+trades collectives against.
+
+Model: SequenceClassifier d_model=128, 4 heads, 2 blocks, F=4 features
+per step, bf16 compute / fp32 params.  Per seq length S the batch is
+TOKENS_PER_STEP / S so every case runs the same token count per step;
+reported are steps/s, rows/s and tokens/s for a full fwd+bwd+adam update.
+
+Run on the TPU host (the watcher battery does):
+    python scripts/bench_sequence.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from shifu_tensorflow_tpu.models.sequence import SequenceClassifier
+from shifu_tensorflow_tpu.parallel import ring
+
+SEQ_LENS = tuple(
+    int(s) for s in os.environ.get(
+        "BENCH_SEQ_LENS", "256,1024,4096").split(",")
+)
+TOKENS_PER_STEP = int(os.environ.get("BENCH_SEQ_TOKENS", 131072))
+F_PER_STEP = 4
+D_MODEL = 128
+HEADS = 4
+BLOCKS = 2
+REPS = int(os.environ.get("BENCH_SEQ_REPS", 20))
+
+
+def _case(seq_len: int) -> dict:
+    batch = max(1, TOKENS_PER_STEP // seq_len)
+    model = SequenceClassifier(
+        seq_len=seq_len, d_model=D_MODEL, num_heads=HEADS,
+        num_blocks=BLOCKS, attention=ring.full_attention,
+        dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(seq_len)
+    x = jnp.asarray(
+        rng.normal(size=(batch, seq_len * F_PER_STEP)).astype(np.float32)
+    )
+    y = jnp.asarray(
+        (rng.random(size=(batch, 1)) < 0.5).astype(np.float32)
+    )
+    params = model.init(jax.random.PRNGKey(0), x)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, xb, yb):
+        pred = model.apply(p, xb)
+        return jnp.mean((pred.astype(jnp.float32) - yb) ** 2)
+
+    @jax.jit
+    def step(p, s, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
+    params, opt_state, loss = step(params, opt_state, x, y)
+    true_sync(loss)
+    # value-fetch sync: the final loss depends on every step through the
+    # params chain, so one fetch proves all REPS executed in the window
+    # (block_until_ready through the axon tunnel acknowledges enqueue
+    # only — the first run of this bench measured 542M tokens/s at
+    # seq 256, an implied 1.4 PFLOP/s, 7x the chip's peak)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    true_sync(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "seq_len": seq_len,
+        "batch": batch,
+        "steps_per_sec": round(REPS / dt, 2),
+        "rows_per_sec": round(REPS * batch / dt),
+        "tokens_per_sec": round(REPS * batch * seq_len / dt),
+        "final_loss": round(float(loss), 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    out = {
+        "bench": "sequence_family",
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "date": time.strftime("%Y-%m-%d"),
+        "d_model": D_MODEL,
+        "heads": HEADS,
+        "blocks": BLOCKS,
+        "tokens_per_step": TOKENS_PER_STEP,
+        "attention": "full (single device; ring/ulysses need a seq mesh)",
+        "cases": [_case(s) for s in SEQ_LENS],
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

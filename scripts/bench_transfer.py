@@ -38,11 +38,24 @@ REPS = 30
 
 
 def _rate(fn) -> float:
-    """Calls/sec -> rows/sec, synchronized per call."""
-    jax.block_until_ready(fn())
+    """Calls/sec -> rows/sec, completion proven by value fetch.
+
+    Transfers are enqueued back-to-back (overlapping, as training's
+    prefetch does); one element of each result is chained into an
+    on-device accumulator, and ONE final fetch of the accumulator proves
+    every transfer landed inside the elapsed window — a single round
+    trip, not REPS serialized ones.  Plain block_until_ready
+    acknowledges enqueue only through the axon tunnel
+    (utils/profiling.true_sync)."""
+    from shifu_tensorflow_tpu.utils.profiling import true_sync
+
+    true_sync(fn())
     t0 = time.perf_counter()
+    acc = None
     for _ in range(REPS):
-        jax.block_until_ready(fn())
+        probe = fn().reshape(-1)[0].astype(jnp.float32)
+        acc = probe if acc is None else acc + probe
+    true_sync(acc)
     return REPS * ROWS / (time.perf_counter() - t0)
 
 

@@ -168,12 +168,25 @@ def main() -> None:
                 "y": np.zeros((16384, 1), np.float32),
                 "w": np.ones((16384, 1), np.float32),
             }
+            from shifu_tensorflow_tpu.utils.profiling import true_sync
+
             nbytes = sum(v.nbytes for v in batch.values())
-            jax.block_until_ready(jax.device_put(batch, dev))
+            true_sync(jax.device_put(batch, dev))
             t0 = time.perf_counter()
             reps = 50
+            # overlapped puts; one element of every leaf of every put is
+            # chained into an on-device accumulator so a SINGLE final
+            # fetch proves all transfers completed inside the window
+            # (block_until_ready acknowledges enqueue only through the
+            # axon tunnel — utils/profiling.true_sync)
+            acc = None
             for _ in range(reps):
-                jax.block_until_ready(jax.device_put(batch, dev))
+                for leaf in jax.tree_util.tree_leaves(
+                        jax.device_put(batch, dev)):
+                    probe = (leaf.reshape(-1)[0] if leaf.ndim else leaf)
+                    probe = probe.astype("float32")
+                    acc = probe if acc is None else acc + probe
+            true_sync(acc)
             dt = time.perf_counter() - t0
             out["device_put_mb_s"] = round(reps * nbytes / dt / 1e6, 1)
             out["device_put_rows_s"] = round(reps * 16384 / dt, 0)

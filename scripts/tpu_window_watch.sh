@@ -44,6 +44,10 @@ for i in $(seq 1 "$MAX_LOOPS"); do
         timeout 300 python scripts/bench_transfer.py \
             --out "$REPO/BENCH_TRANSFER.json" >>"$LOG" 2>&1
         echo "$(date +%T) transfer done rc=$?" >>"$LOG"
+        # 5. sequence-family step across seq lengths (full attention)
+        timeout 600 python scripts/bench_sequence.py \
+            --out "$REPO/BENCH_SEQUENCE_TPU.json" >>"$LOG" 2>&1
+        echo "$(date +%T) sequence done rc=$?" >>"$LOG"
         echo "$(date +%T) battery complete" >>"$LOG"
         exit 0
     fi

@@ -43,13 +43,14 @@ hash_to_buckets = hashing.hash_to_buckets
 # is this constant's source of truth.
 #
 # DEFAULT 0 = auto NEVER picks pallas.  This is now the MEASURED value:
-# the round-4 sweep ran on the real chip (TPU v5 lite, 2026-07-31,
-# BENCH_PALLAS_EMBEDDING.json) and XLA's gather wins the fwd+bwd regime
-# at every point in the grid (pallas 1.5x-82x slower; its only fwd-only
-# win, 1.84x at table 4K / batch 16K, is erased by the backward's
-# one-hot matmul transpose).  ``impl="pallas"`` stays available
-# explicitly, and STPU_PALLAS_MAX_HASH_SIZE can re-enable the auto
-# cutover if a future chip/kernel revision changes the verdict.
+# the round-4 sweep ran on the real chip (TPU v5 lite, 2026-07-31, with
+# value-fetch-proven timing — BENCH_PALLAS_EMBEDDING.json) and XLA's
+# gather wins at every point in the grid, forward and fwd+bwd (pallas
+# 1.3x slower at table 4K up to 44x at 256K, growing with table size
+# exactly as the one-hot-matmul cost model predicts).  ``impl="pallas"``
+# stays available explicitly, and STPU_PALLAS_MAX_HASH_SIZE can
+# re-enable the auto cutover if a future chip/kernel revision changes
+# the verdict.
 import os as _os
 
 

@@ -43,6 +43,42 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def true_sync(x: Any) -> None:
+    """Force REAL completion of ``x``'s computation — not just enqueue.
+
+    ``jax.block_until_ready`` is NOT a completion barrier through the
+    tunneled axon PJRT plugin: it acknowledges enqueue.  Measured on the
+    round-4 open window (2026-07-31): 20 chained 8192³ bf16 matmuls were
+    "ready" in 0.4 ms — an implied 65 PFLOP/s, 330× the chip's physical
+    peak — and fetching a single element of the result then took 16.4 s,
+    which is where the work actually happened.  Every timing loop that
+    synced with ``block_until_ready`` on that backend measured DISPATCH
+    rate, not execution rate.
+
+    A device→host value fetch cannot lie: the scalar's bytes exist only
+    after everything it depends on has executed.  This fetches ONE
+    element of EVERY array leaf (each leaf of a pytree is an independent
+    device buffer — e.g. ``device_put`` of a batch dict issues one
+    transfer per leaf, so probing only one leaf would leave the others'
+    completion unproven), batched into a single ``device_get`` call.
+    Amortize the round trip by syncing every N steps, and make sure the
+    fetched values depend on the whole computation being timed (a loss
+    carried through the step chain does; an output that XLA can slice
+    out early may not).
+    """
+    import jax
+    import numpy as np
+
+    leaves = [l for l in jax.tree_util.tree_leaves(x)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return
+    probes = [l.reshape(-1)[0] if getattr(l, "ndim", 0) else l
+              for l in leaves]
+    for p in jax.device_get(probes):
+        np.asarray(p)
+
+
 @dataclass
 class StepTimer:
     """Amortized step-rate measurement.
@@ -77,9 +113,11 @@ class StepTimer:
 
     def _sync(self) -> None:
         if self._pending is not None:
-            import jax
-
-            jax.block_until_ready(self._pending)
+            # true_sync, not block_until_ready: through the tunneled
+            # axon backend the latter acknowledges enqueue, not
+            # completion (see true_sync) — which would make this timer
+            # report dispatch rate
+            true_sync(self._pending)
             self._pending = None
         if self._t0 is not None:
             self._elapsed = time.perf_counter() - self._t0
