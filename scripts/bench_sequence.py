@@ -28,6 +28,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# share bench.py's persistent compile cache: the tunnel's remote-compile
+# helper is flaky, so a case that compiled once must never recompile
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
 
@@ -39,7 +47,6 @@ import numpy as np
 import optax
 
 from shifu_tensorflow_tpu.models.sequence import SequenceClassifier
-from shifu_tensorflow_tpu.parallel import ring
 
 SEQ_LENS = tuple(
     int(s) for s in os.environ.get(
@@ -51,13 +58,21 @@ D_MODEL = 128
 HEADS = 4
 BLOCKS = 2
 REPS = int(os.environ.get("BENCH_SEQ_REPS", 20))
+IMPLS = tuple(os.environ.get(
+    "BENCH_SEQ_IMPLS", "full,chunked,flash").split(","))
 
 
-def _case(seq_len: int) -> dict:
+def _case(seq_len: int, impl: str = "full") -> dict:
+    from shifu_tensorflow_tpu.models.sequence import make_attention
+
     batch = max(1, TOKENS_PER_STEP // seq_len)
     model = SequenceClassifier(
         seq_len=seq_len, d_model=D_MODEL, num_heads=HEADS,
-        num_blocks=BLOCKS, attention=ring.full_attention,
+        num_blocks=BLOCKS,
+        # one dispatch table: the bench measures exactly what a
+        # SeqAttention=<impl> user gets, defaults included
+        attention=make_attention(impl, None, seq_len=seq_len,
+                                 num_heads=HEADS),
         dtype=jnp.bfloat16,
     )
     rng = np.random.default_rng(seq_len)
@@ -97,12 +112,22 @@ def _case(seq_len: int) -> dict:
     dt = time.perf_counter() - t0
     return {
         "seq_len": seq_len,
+        "attention": impl,
         "batch": batch,
         "steps_per_sec": round(REPS / dt, 2),
         "rows_per_sec": round(REPS * batch / dt),
         "tokens_per_sec": round(REPS * batch * seq_len / dt),
         "final_loss": round(float(loss), 4),
     }
+
+
+def _case_or_error(seq_len: int, impl: str) -> dict:
+    """One case; a flaky remote-compile failure poisons only itself."""
+    try:
+        return _case(seq_len, impl)
+    except Exception as e:  # noqa: BLE001 — record and move on
+        return {"seq_len": seq_len, "attention": impl,
+                "error": f"{type(e).__name__}: {e}"}
 
 
 def main() -> None:
@@ -119,8 +144,13 @@ def main() -> None:
         "heads": HEADS,
         "blocks": BLOCKS,
         "tokens_per_step": TOKENS_PER_STEP,
-        "attention": "full (single device; ring/ulysses need a seq mesh)",
-        "cases": [_case(s) for s in SEQ_LENS],
+        "note": ("single device; ring/ulysses need a seq mesh. "
+                 "Each case is a full fwd+bwd+adam train step; the "
+                 "attention impl sweep sets STPU_CHUNKED_MIN_SEQ "
+                 "(models/sequence.py auto cutover) from data."),
+        "cases": [_case_or_error(s, impl)
+                  for s in SEQ_LENS
+                  for impl in IMPLS],
     }
     line = json.dumps(out)
     print(line, flush=True)

@@ -14,12 +14,21 @@ streaming, fixed-shape batching) is unchanged; the model reshapes
 ``(B, seq_len*F) -> (B, seq_len, F)`` on device.
 
 Attention selection (``train.params.SeqAttention``):
-- ``full``  — single-device reference attention;
-- ``ring``  — K/V rotation via ppermute + online softmax, O(S/P) memory
-  per chip (parallel/ring.py ring_attention), sequence sharded over the
-  mesh 'seq' axis;
+- ``full``    — single-device reference attention;
+- ``chunked`` — single-device flash-style online-softmax scan over K/V
+  blocks (parallel/ring.py chunked_attention): O(S·block) memory, no
+  S×S materialization — for sequence lengths where full attention's
+  score matrix approaches HBM;
+- ``flash``   — the Pallas TPU fused kernel
+  (ops/pallas/flash_attention.py), same memory property on-chip;
+- ``ring``    — K/V rotation via ppermute + online softmax, O(S/P)
+  memory per chip (parallel/ring.py ring_attention), sequence sharded
+  over the mesh 'seq' axis;
 - ``ulysses`` — all-to-all head-parallel attention (requires P | heads);
-- ``auto`` — ring when the mesh has a 'seq' axis of size > 1, else full.
+- ``auto``  — ring when the mesh has a 'seq' axis of size > 1, else
+  full (the measured single-device winner, BENCH_SEQUENCE_TPU.json;
+  ``STPU_CHUNKED_MIN_SEQ`` re-enables the chunked cutover from data —
+  see ``_chunked_min_seq``).
 """
 
 from __future__ import annotations
@@ -130,9 +139,28 @@ def make_attention(
     seq_axis = mesh.shape.get(ring.SEQ_AXIS, 1) if mesh is not None else 1
     has_seq = seq_axis > 1
     if impl == "auto":
-        impl = "ring" if has_seq else "full"
+        cut = _chunked_min_seq()
+        if has_seq:
+            impl = "ring"
+        elif seq_len and cut > 0 and seq_len >= cut:
+            impl = "chunked"
+        else:
+            impl = "full"
     if impl == "full":
         return ring.full_attention
+    if impl == "chunked":
+        def attention(q, k, v):
+            return ring.chunked_attention(
+                q, k, v, block_size=_chunked_block())
+
+        return attention
+    if impl == "flash":
+        from shifu_tensorflow_tpu.ops.pallas import flash_attention as fa
+
+        def attention(q, k, v, _f=fa.flash_attention):
+            return _f(q, k, v)
+
+        return attention
     if impl in ("ring", "ulysses"):
         if not has_seq:
             raise ValueError(
@@ -162,5 +190,36 @@ def make_attention(
 
         return attention
     raise ValueError(
-        f"unknown SeqAttention {impl!r} (auto | full | ring | ulysses)"
+        f"unknown SeqAttention {impl!r} "
+        "(auto | full | chunked | flash | ring | ulysses)"
     )
+
+
+# Single-device attention cutover, measured not guessed (same policy as
+# the Pallas embedding constant, models/embeddings.py).  DEFAULT 0 =
+# ``auto`` NEVER swaps full -> chunked: the on-chip sweep
+# (BENCH_SEQUENCE_TPU.json, TPU v5 lite 2026-07-31) shows XLA's fused
+# full attention WINNING at every size it could compile — chunked is
+# 2.9× slower at S=1024 (scan overhead dominates while the score matrix
+# still fits) and the ≥4096 cases hit tunnel compile failures, so no
+# measured win region exists yet.  chunked/flash stay as explicit
+# SeqAttention opt-ins: their value is MEMORY (no S×S materialization —
+# full attention physically cannot run once B·H·S² bytes approach HBM),
+# and a measured deployment sets STPU_CHUNKED_MIN_SEQ to its own
+# feasibility/win boundary.
+def _chunked_min_seq() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("STPU_CHUNKED_MIN_SEQ", "0"))
+    except ValueError:
+        return 0
+
+
+def _chunked_block() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("STPU_CHUNKED_BLOCK", "512"))
+    except ValueError:
+        return 512

@@ -1,0 +1,180 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The sequence family's measured bottleneck is attention-score
+materialization: BENCH_SEQUENCE_TPU.json shows a 7× tokens/s falloff
+from S=256 to S=4096 at a fixed token budget (full attention builds the
+(S, S) score matrix in HBM; at S=4096 that is gigabytes).  The reference
+has no attention at all (fixed-width tabular vectors — SURVEY.md §5.7);
+this kernel serves the beyond-parity sequence/long-context family.
+
+Design — the standard flash decomposition, Pallas-TPU idioms:
+
+- grid ``(B·H, S/BQ, S/BK)`` with the K/V axis innermost; VMEM scratch
+  (running numerator ``acc``, running max ``m``, normalizer ``l``)
+  persists across the sequential K/V steps of one (batch·head, q-block);
+- each step computes a (BQ, BK) score tile on the MXU
+  (``preferred_element_type=f32``), applies the online-softmax update,
+  and accumulates ``p @ v`` — the (S, S) matrix never exists anywhere;
+- the last K/V step normalizes and writes the output block;
+- causal + padding masks come from ``broadcasted_iota`` positions, so
+  arbitrary (non-multiple-of-block) S works via zero-padding.
+
+The backward pass is the chunked XLA path (`parallel.ring.
+chunked_attention`) through ``jax.vjp`` — same O(S·block) memory
+property, exact attention gradients, no second kernel to maintain.
+Parity vs full attention is asserted in tests/test_flash.py (interpret
+mode on CPU, real kernel on TPU).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, s_real: int,
+                  block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # (BQ, BK) score tile on the MXU; accumulate in f32 regardless of
+    # the input dtype so bf16 inputs keep full-precision statistics
+    scores = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = k_pos < s_real  # zero-padded keys must not attend
+    if causal:
+        valid = jnp.logical_and(valid, k_pos <= q_pos)
+    scores = jnp.where(valid, scores, -jnp.inf)
+
+    m_prev = m_ref[:]
+    l_prev = l_ref[:]
+    m_blk = jnp.max(scores, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # nothing seen yet where m_new is still -inf: keep correction at 0
+    corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m_prev - m_new))
+    p = jnp.exp(scores - m_new)
+    p = jnp.where(valid, p, 0.0)
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool | None):
+    import math
+
+    b, s, h, d = q.shape
+    scale = d ** -0.5
+    dp = _round_up(d, 128)
+    # pad S to a common multiple of BOTH blocks: rounding to only the
+    # larger one truncates the grid for the smaller (sp // block floors),
+    # silently dropping trailing query rows or key blocks
+    sp = _round_up(s, math.lcm(block_q, block_k))
+    bq = min(block_q, sp)
+    bk = min(block_k, sp)
+
+    def prep(x):  # (B, S, H, D) -> (B*H, Sp, Dp), zero-padded
+        x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0), (0, dp - d)))
+        return x.transpose(0, 2, 1, 3).reshape(b * h, sp, dp)
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    grid = (b * h, sp // bq, sp // bk)
+    out = pl.pallas_call(
+        partial(_flash_kernel, scale=scale, causal=causal, s_real=s,
+                block_q=bq, block_k=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sp, dp), q.dtype),
+        scratch_shapes=[
+            _vmem((bq, dp)),
+            _vmem((bq, 1)),
+            _vmem((bq, 1)),
+        ],
+        interpret=_resolve_interpret(interpret),
+    )(qp, kp, vp)
+    out = out.reshape(b, h, sp, dp).transpose(0, 2, 1, 3)
+    return out[:, :s, :, :d]
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused flash attention, shapes (B, S, H, D).
+
+    Forward: the Pallas kernel above (interpret mode off-TPU).
+    Backward: exact attention gradients via the chunked XLA path —
+    same no-S×S-materialization property, one kernel to maintain.
+    """
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    from shifu_tensorflow_tpu.parallel.ring import chunked_attention
+
+    q, k, v = res
+    # chunked_attention self-adjusts block_size to a divisor of S, so no
+    # fallback here — falling back to S would mean full attention in the
+    # backward, materializing the S×S matrix this kernel exists to avoid
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: chunked_attention(
+            q_, k_, v_, causal=causal, block_size=512),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
