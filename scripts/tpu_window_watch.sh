@@ -45,13 +45,15 @@ for i in $(seq 1 "$MAX_LOOPS"); do
             --out "$REPO/BENCH_TRANSFER.json" >>"$LOG" 2>&1
         echo "$(date +%T) transfer done rc=$?" >>"$LOG"
         # 5. sequence-family step: seq lengths x attention impls
-        timeout 600 python scripts/bench_sequence.py \
+        #    (cases run in subprocesses and the artifact is written
+        #    after every case, so the outer timeout keeps whatever
+        #    completed)
+        timeout 900 python scripts/bench_sequence.py \
             --out "$REPO/BENCH_SEQUENCE_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) sequence done rc=$?" >>"$LOG"
         # 6. long-S feasibility: full attention's S×S matrix vs chunked
-        #    (compile-helper flaky 2026-07-31 — retry each open window)
         BENCH_SEQ_LENS=8192,16384 BENCH_SEQ_IMPLS=full,chunked \
-        BENCH_SEQ_REPS=5 timeout 600 python scripts/bench_sequence.py \
+        BENCH_SEQ_REPS=5 timeout 900 python scripts/bench_sequence.py \
             --out "$REPO/BENCH_SEQUENCE_LONG_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) sequence-long done rc=$?" >>"$LOG"
         echo "$(date +%T) battery complete" >>"$LOG"

@@ -86,16 +86,23 @@ def chunked_attention(
     falloff from S=256 to S=4096 at a fixed token budget, where score
     materialization takes over.  Differentiable through scan (wrap in
     ``jax.checkpoint`` for O(S) backward memory if needed).  Shapes
-    (B, S, H, D); ``block_size`` is adjusted down to the largest divisor
-    of S, so any sequence length works.
+    (B, S, H, D); K/V are zero-padded up to a block multiple with the
+    padded keys masked out, so any sequence length works.
     """
     b, s, h, d = k.shape
     blk = min(block_size, s)
-    while s % blk:  # largest divisor of S not above the requested block
-        blk -= 1
-    nblk = s // blk
+    # pad K/V up to a block multiple rather than shrinking the block to
+    # a divisor of S: for prime-ish S a divisor search collapses to
+    # blk=1 — an S-step scan whose checkpointed backward stores S copies
+    # of the carry, worse than the score matrix this path avoids.
+    # Padded keys are masked out below exactly like causal masking.
+    sp = -(-s // blk) * blk
+    nblk = sp // blk
     if nblk == 1:
         return full_attention(q, k, v, causal=causal)
+    if sp != s:
+        k = jnp.pad(k, ((0, 0), (0, sp - s), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sp - s), (0, 0), (0, 0)))
     scale = q.shape[-1] ** -0.5
     sq = q.shape[1]
     qf = q.astype(jnp.float32)
@@ -109,15 +116,22 @@ def chunked_attention(
     m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
 
+    padded = sp != s
+
     def step(carry, xs):
         acc, m, l = carry
         blk_idx, kb, vb = xs
         mask = None
-        if causal:
+        if causal or padded:
             q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, blk), 0)
             k_pos = blk_idx * blk + jax.lax.broadcasted_iota(
                 jnp.int32, (sq, blk), 1)
-            mask = (k_pos <= q_pos)[None, None]
+            mask = jnp.ones((sq, blk), bool)
+            if padded:
+                mask = jnp.logical_and(mask, k_pos < s)
+            if causal:
+                mask = jnp.logical_and(mask, k_pos <= q_pos)
+            mask = mask[None, None]
         acc, m, l = _block_update(qf, kb, vb, acc, m, l,
                                   scale=scale, mask=mask)
         return (acc, m, l), None

@@ -41,6 +41,18 @@ def test_chunked_matches_full(causal, block):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_chunked_prime_seq_pads_instead_of_collapsing(causal):
+    # S=127 (prime): a largest-divisor block search would collapse to
+    # blk=1 — an S-step scan with an S×carry backward; the padding path
+    # must keep the requested block and mask the padded keys
+    q, k, v = _qkv(s=127)
+    want = full_attention(q, k, v, causal=causal)
+    got = chunked_attention(q, k, v, causal=causal, block_size=32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_chunked_grads_match_full(causal):
     q, k, v = _qkv()
 
