@@ -77,77 +77,147 @@ def chunked_attention(
     causal: bool = False,
     block_size: int = 512,
 ) -> jax.Array:
-    """Single-device flash-style attention: O(S·block) memory, no S×S
-    materialization.
+    """Single-device flash-style attention: O(S·block) working memory,
+    no S×S materialization, in EITHER direction.
 
-    ``lax.scan`` over K/V blocks with the same online softmax the ring
-    path uses (`_block_update`), so the (S, S) score matrix never exists
-    — the measured motivation is BENCH_SEQUENCE_TPU.json's 7× tokens/s
-    falloff from S=256 to S=4096 at a fixed token budget, where score
-    materialization takes over.  Differentiable through scan (wrap in
-    ``jax.checkpoint`` for O(S) backward memory if needed).  Shapes
-    (B, S, H, D); K/V are zero-padded up to a block multiple with the
-    padded keys masked out, so any sequence length works.
+    Forward: ``lax.scan`` over K/V blocks with the same online softmax
+    the ring path uses (`_block_update`) — the measured motivation is
+    BENCH_SEQUENCE_TPU.json's 7× tokens/s falloff from S=256 to S=4096
+    at a fixed token budget, where score materialization takes over.
+    Backward: a custom VJP (the standard flash decomposition) that
+    saves only ``out`` and the per-row logsumexp — O(B·S·H·D) residuals
+    — and recomputes each block's softmax weights inside a second scan.
+    (custom_vjp means NO forward-mode autodiff — ``jax.jvp``/``jacfwd``
+    through this path raises; use ``full_attention`` for that.)
+    Shapes (B, S, H, D); K/V are zero-padded up to a block multiple
+    with the padded keys masked out, so any sequence length works.
     """
+    s = k.shape[1]
+    if s <= block_size:  # a single block IS full attention
+        return full_attention(q, k, v, causal=causal)
+    return _chunked(q, k, v, causal, min(block_size, s))
+
+
+def _block_mask(blk_idx, sq: int, blk: int, s_real: int,
+                causal: bool, padded: bool):
+    """(1, 1, sq, blk) validity mask for one K/V block, or None."""
+    if not (causal or padded):
+        return None
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, blk), 0)
+    k_pos = blk_idx * blk + jax.lax.broadcasted_iota(
+        jnp.int32, (sq, blk), 1)
+    mask = jnp.ones((sq, blk), bool)
+    if padded:
+        mask = jnp.logical_and(mask, k_pos < s_real)
+    if causal:
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    return mask[None, None]
+
+
+def _split_blocks(x, nblk: int, blk: int):
+    """(B, nblk·blk, H, D) -> f32 (nblk, B, blk, H, D) for scan."""
+    b, _, h, d = x.shape
+    return x.astype(jnp.float32).reshape(b, nblk, blk, h, d).transpose(
+        1, 0, 2, 3, 4)
+
+
+def _prep_blocks(q, k, v, blk: int):
+    """Shared fwd/bwd preamble: pad K/V up to a block multiple (rather
+    than shrinking the block to a divisor of S — for prime-ish S that
+    collapses to blk=1, an S-step scan), split into scan-major blocks,
+    cast to f32.  ONE implementation so forward and backward can never
+    disagree about the block layout."""
     b, s, h, d = k.shape
-    blk = min(block_size, s)
-    # pad K/V up to a block multiple rather than shrinking the block to
-    # a divisor of S: for prime-ish S a divisor search collapses to
-    # blk=1 — an S-step scan whose checkpointed backward stores S copies
-    # of the carry, worse than the score matrix this path avoids.
-    # Padded keys are masked out below exactly like causal masking.
     sp = -(-s // blk) * blk
     nblk = sp // blk
-    if nblk == 1:
-        return full_attention(q, k, v, causal=causal)
-    if sp != s:
+    padded = sp != s
+    if padded:
         k = jnp.pad(k, ((0, 0), (0, sp - s), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, sp - s), (0, 0), (0, 0)))
-    scale = q.shape[-1] ** -0.5
+    return (q.astype(jnp.float32), _split_blocks(k, nblk, blk),
+            _split_blocks(v, nblk, blk), q.shape[-1] ** -0.5,
+            nblk, padded, sp, s)
+
+
+def _chunked_fwd_impl(q, k, v, causal: bool, blk: int):
+    b, _, h, d = k.shape
+    qf, ks, vs, scale, nblk, padded, sp, s = _prep_blocks(q, k, v, blk)
     sq = q.shape[1]
-    qf = q.astype(jnp.float32)
-    # (nblk, B, blk, H, D) — scan walks the leading axis
-    ks = k.astype(jnp.float32).reshape(b, nblk, blk, h, d).transpose(
-        1, 0, 2, 3, 4)
-    vs = v.astype(jnp.float32).reshape(b, nblk, blk, h, d).transpose(
-        1, 0, 2, 3, 4)
 
     acc = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
 
-    padded = sp != s
-
     def step(carry, xs):
         acc, m, l = carry
         blk_idx, kb, vb = xs
-        mask = None
-        if causal or padded:
-            q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, blk), 0)
-            k_pos = blk_idx * blk + jax.lax.broadcasted_iota(
-                jnp.int32, (sq, blk), 1)
-            mask = jnp.ones((sq, blk), bool)
-            if padded:
-                mask = jnp.logical_and(mask, k_pos < s)
-            if causal:
-                mask = jnp.logical_and(mask, k_pos <= q_pos)
-            mask = mask[None, None]
+        mask = _block_mask(blk_idx, sq, blk, s, causal, padded)
         acc, m, l = _block_update(qf, kb, vb, acc, m, l,
                                   scale=scale, mask=mask)
         return (acc, m, l), None
 
-    # checkpoint the block step: without it, reverse-mode AD saves every
-    # block's (B, H, Sq, blk) softmax weights — O(S²) residuals, BIGGER
-    # than the score matrix this path exists to avoid.  With it, the
-    # backward stores the per-step carry chain instead
-    # (nblk · B·Sq·H·D — a D/blk fraction of the score matrix) and
-    # recomputes each block's weights on the fly.
     (acc, m, l), _ = jax.lax.scan(
-        jax.checkpoint(step), (acc, m, l), (jnp.arange(nblk), ks, vs)
+        step, (acc, m, l), (jnp.arange(nblk), ks, vs)
     )
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    seen = l > 0.0
+    l_safe = jnp.where(seen, l, 1.0)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    # logsumexp per row; +inf where a row saw NO valid key, so the
+    # backward's exp(scores - lse) is exactly 0 for those rows
+    lse = jnp.where(seen, m + jnp.log(l_safe), jnp.inf)
+    return out.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _chunked(q, k, v, causal: bool, blk: int):
+    out, _ = _chunked_fwd_impl(q, k, v, causal, blk)
+    return out
+
+
+def _chunked_fwd(q, k, v, causal: bool, blk: int):
+    out, lse = _chunked_fwd_impl(q, k, v, causal, blk)
+    return out, (q, k, v, out, lse)
+
+
+def _chunked_bwd(causal: bool, blk: int, res, g):
+    """Flash backward: recompute each block's weights from (q, k, lse).
+
+    dS = p ∘ (g·vᵀ − D) with D = rowsum(g ∘ out); dq accumulates as the
+    scan carry, dk/dv emit per block.  Residual memory is O(B·S·H·D) —
+    out + lse + inputs — never the (S, S) matrix.
+    """
+    q, k, v, out, lse = res
+    b, _, h, d = k.shape
+    qf, ks, vs, scale, nblk, padded, sp, s = _prep_blocks(q, k, v, blk)
+    sq = q.shape[1]
+    gf = g.astype(jnp.float32)
+    # D_i = Σ_d g_id · out_id, laid out (B, H, Sq) like lse
+    D = jnp.sum(gf * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
+
+    def step(dq, xs):
+        blk_idx, kb, vb = xs
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
+        p = jnp.exp(scores - lse[..., None])
+        mask = _block_mask(blk_idx, sq, blk, s, causal, padded)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_b = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vb)
+        dS = p * (dp - D[..., None])
+        dq = dq + scale * jnp.einsum("bhqk,bkhd->bqhd", dS, kb)
+        dk_b = scale * jnp.einsum("bhqk,bqhd->bkhd", dS, qf)
+        return dq, (dk_b, dv_b)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        step, jnp.zeros(q.shape, jnp.float32),
+        (jnp.arange(nblk), ks, vs),
+    )
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, sp, h, d)[:, :s]
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, sp, h, d)[:, :s]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_chunked.defvjp(_chunked_fwd, _chunked_bwd)
 
 
 def ring_attention(

@@ -53,8 +53,9 @@ def test_chunked_prime_seq_pads_instead_of_collapsing(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_chunked_grads_match_full(causal):
-    q, k, v = _qkv()
+@pytest.mark.parametrize("s", [96, 127])  # 127: the padded bwd branch
+def test_chunked_grads_match_full(causal, s):
+    q, k, v = _qkv(s=s)
 
     def loss(fn):
         return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
