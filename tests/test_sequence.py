@@ -67,6 +67,19 @@ def test_factory_builds_sequence_model_and_forward_shape():
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))
 
 
+@pytest.mark.parametrize("attention", ["chunked", "flash"])
+def test_config_level_memory_safe_attention_trains(attention):
+    """SeqAttention=chunked|flash resolve from ModelConfig params and
+    train end-to-end through the Trainer (the long-S single-device
+    paths; parity is pinned in tests/test_flash.py — here the wiring)."""
+    ds = _seq_dataset(rows=400)
+    trainer = Trainer(_mc(epochs=2, attention=attention), NUM_FEATURES,
+                      seed=1)
+    history = trainer.fit(ds, batch_size=64)
+    assert len(history) == 2
+    assert np.isfinite(history[-1].valid_loss)
+
+
 def test_sequence_model_learns_sequence_signal():
     # 5K rows: transformers are data-hungry; at 600 rows this plateaus at
     # AUC ~0.55, at 5K it reaches ~0.98 by epoch 8 (measured)
