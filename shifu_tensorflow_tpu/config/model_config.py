@@ -23,6 +23,14 @@ from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
 
+def _parse_bool(v: Any) -> bool:
+    """Same token set as Conf.get_bool (config/conf.py): a value that
+    counts as true in one config surface must count everywhere."""
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
 @dataclass(frozen=True)
 class TrainParams:
     """``train.params`` — network-shape hyperparameters."""
@@ -55,7 +63,13 @@ class TrainParams:
     seq_heads: int = 4
     seq_blocks: int = 2
     # "auto": ring attention when the mesh has a seq axis >1, else full
-    seq_attention: str = "auto"  # auto | full | ring | ulysses
+    # (the measured single-device winner; STPU_CHUNKED_MIN_SEQ opts into
+    # the chunked cutover — models/sequence.py)
+    seq_attention: str = "auto"  # auto|full|chunked|flash|ring|ulysses
+    # rematerialize encoder blocks: backward recomputes each block's
+    # activations instead of storing them — the standard long-context
+    # memory lever (jax.checkpoint via nn.remat)
+    seq_remat: bool = False
 
     @property
     def uses_feature_hashing(self) -> bool:
@@ -112,6 +126,7 @@ class TrainParams:
             seq_heads=int(params.get("SeqHeads", 4)),
             seq_blocks=int(params.get("SeqBlocks", 2)),
             seq_attention=str(params.get("SeqAttention", "auto")).lower(),
+            seq_remat=_parse_bool(params.get("SeqRemat", False)),
             lr_schedule=str(params.get("LearningRateSchedule",
                                        "constant")).lower(),
             warmup_steps=int(params.get("WarmupSteps", 0)),

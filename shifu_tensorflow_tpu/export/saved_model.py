@@ -125,8 +125,11 @@ def export_native_bundle(
                     "SeqDModel": model_config.params.seq_d_model,
                     "SeqHeads": model_config.params.seq_heads,
                     "SeqBlocks": model_config.params.seq_blocks,
-                    # serving is single-device: full attention always
+                    # serving is single-device: full attention always,
+                    # and no remat (a training-only memory lever —
+                    # jax2tf should not trace through jax.checkpoint)
                     "SeqAttention": "full",
+                    "SeqRemat": False,
                 },
             }
         },
@@ -256,10 +259,12 @@ def export_model(
     # config (and every future WorkerConfig/re-export built from it)
     raw = copy.deepcopy(trainer.model_config.raw)
     if trainer.model_config.params.seq_len > 0:
-        # force single-device attention regardless of how training ran
-        raw.setdefault("train", {}).setdefault("params", {})[
-            "SeqAttention"
-        ] = "full"
+        # force single-device attention regardless of how training ran,
+        # and drop remat (training-only; jax2tf shouldn't trace through
+        # jax.checkpoint)
+        serve_params = raw.setdefault("train", {}).setdefault("params", {})
+        serve_params["SeqAttention"] = "full"
+        serve_params["SeqRemat"] = False
     serve_mc = ModelConfig.from_json(raw)
     serve_model = build_model(
         serve_mc,

@@ -100,6 +100,7 @@ def build_model(
                 seq_len=p.seq_len, num_heads=p.seq_heads,
             ),
             dtype=dtype,
+            remat=p.seq_remat,
         )
 
     if p.model_type == "wide_deep":

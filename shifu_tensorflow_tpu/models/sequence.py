@@ -88,6 +88,10 @@ class SequenceClassifier(nn.Module):
     num_blocks: int
     attention: AttentionFn
     dtype: jnp.dtype = jnp.float32
+    #: rematerialize blocks: the backward recomputes each block instead
+    #: of storing its activations — pair with SeqAttention=chunked for
+    #: long-S training (``SeqRemat`` in ModelConfig params)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:  # (B, seq_len * F)
@@ -106,8 +110,9 @@ class SequenceClassifier(nn.Module):
             self.dtype,
         )
         h = h + pos[None, :, :]
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.num_blocks):
-            h = EncoderBlock(
+            h = block_cls(
                 d_model=self.d_model, num_heads=self.num_heads,
                 attention=self.attention, dtype=self.dtype,
                 name=f"block_{i}",

@@ -67,6 +67,46 @@ def test_factory_builds_sequence_model_and_forward_shape():
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))
 
 
+def test_seq_remat_is_numerically_invisible():
+    """SeqRemat changes WHERE activations come from in the backward
+    (recompute vs store), never the numbers: loss and grads must match
+    the non-remat model exactly on the same params."""
+    from shifu_tensorflow_tpu.models.factory import build_model as bm
+
+    cols = tuple(range(1, NUM_FEATURES + 1))
+    base = bm(_mc(), cols)
+    remat = bm(_mc(SeqRemat="true"), cols)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, NUM_FEATURES)).astype(np.float32)
+    y = (rng.random((32, 1)) < 0.5).astype(np.float32)
+    params = base.init(jax.random.key(0), x)
+
+    def loss(model):
+        def f(p):
+            out = model.apply(p, x)
+            return ((out - y) ** 2).mean()
+
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(base))(params)
+    l1, g1 = jax.value_and_grad(loss(remat))(params)
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_seq_remat_config_parsing():
+    assert _mc(SeqRemat="true").params.seq_remat is True
+    assert _mc(SeqRemat=True).params.seq_remat is True
+    # same token set as Conf.get_bool: "on"/"1" are true everywhere
+    assert _mc(SeqRemat="on").params.seq_remat is True
+    assert _mc(SeqRemat="1").params.seq_remat is True
+    assert _mc(SeqRemat="false").params.seq_remat is False
+    assert _mc().params.seq_remat is False
+
+
 @pytest.mark.parametrize("attention", ["chunked", "flash"])
 def test_config_level_memory_safe_attention_trains(attention):
     """SeqAttention=chunked|flash resolve from ModelConfig params and
