@@ -160,6 +160,12 @@ def test_sequence_trains_on_seq_parallel_mesh():
                      mesh=mesh, seed=3)
     h = t_auto.fit(ds, batch_size=64)
     assert np.isfinite(h[-1].training_loss)
+    # SeqRemat composes with ring: jax.checkpoint over the shard_map'd
+    # attention — the one remat composition not covered elsewhere
+    t_remat = Trainer(_mc(epochs=1, attention="ring", SeqRemat="true"),
+                      NUM_FEATURES, mesh=mesh, seed=3)
+    hr = t_remat.fit(ds, batch_size=64)
+    assert np.isfinite(hr[-1].training_loss)
 
 
 def test_sequence_config_errors():
