@@ -67,6 +67,22 @@ def test_factory_builds_sequence_model_and_forward_shape():
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))
 
 
+def test_sequence_composes_with_keep_best_and_early_stop():
+    """The round-4 training features are family-agnostic: the sequence
+    family under keep-best=ks + early-stop must track its best epoch and
+    stop at the target like the DNN gate test does."""
+    from shifu_tensorflow_tpu.train.trainer import EarlyStopper
+
+    ds = _seq_dataset(rows=5000)
+    trainer = Trainer(_mc(epochs=10, LearningRate=0.003), NUM_FEATURES,
+                      seed=3, keep_best="ks")
+    history = trainer.fit(ds, batch_size=128,
+                          early_stop=EarlyStopper(target_ks=0.45))
+    assert trainer.stop_reason, "sequence family never hit KS 0.45"
+    assert history[-1].ks >= 0.45
+    assert trainer.best_metric >= 0.45
+
+
 def test_seq_remat_is_numerically_invisible():
     """SeqRemat changes WHERE activations come from in the backward
     (recompute vs store), never the numbers: loss and grads must match
