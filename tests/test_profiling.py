@@ -3,10 +3,34 @@
 import glob
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from shifu_tensorflow_tpu.utils.profiling import StepTimer, annotate, trace_if
+from shifu_tensorflow_tpu.utils.profiling import (
+    StepTimer,
+    annotate,
+    trace_if,
+    true_sync,
+)
+
+
+def test_true_sync_probes_every_array_leaf():
+    """true_sync is the measurement-integrity primitive (block_until_ready
+    acknowledges enqueue only through the tunneled backend): it must
+    fetch one element of EVERY array leaf — each leaf is an independent
+    device buffer — and tolerate every pytree shape benches throw at it."""
+    true_sync(jnp.ones(()))                       # scalar
+    true_sync(jnp.arange(12).reshape(3, 4))       # array
+    true_sync({"x": jnp.ones((8, 3)), "y": jnp.zeros((8, 1)),
+               "w": jnp.ones((8, 1))})            # device_put-style batch
+    true_sync([jnp.ones((2, 2)), jnp.zeros(())])  # list
+    true_sync([])                                 # no leaves: no-op
+    true_sync((1.0, "x", None))                   # no array leaves
+    # forces REAL completion: the fetched value must be correct
+    out = jax.jit(lambda a: a * 3.0)(jnp.full((4,), 2.0))
+    true_sync(out)
+    assert float(out[0]) == 6.0
 
 
 def test_step_timer_counts_and_rates():
