@@ -66,6 +66,18 @@ def _time(fn, *args) -> float:
     return (time.perf_counter() - t0) / REPS * 1e6  # us
 
 
+def probe_overhead_us() -> float:
+    """Cost of the slice+accumulate probe itself: time the same REPS loop
+    around an identity dispatch on a tiny array.  The probe adds one fixed
+    dispatch per rep inside the timed window, which inflates ABSOLUTE
+    us/call for microsecond-scale lookups (the pallas-vs-xla ratio is
+    unaffected — both sides carry it).  The artifact reports this baseline
+    so readers can net it out of the absolute numbers."""
+    tiny = jnp.zeros((8,), jnp.float32)
+    ident = jax.jit(lambda x: x)
+    return _time(ident, tiny)
+
+
 def bench_case(hash_size: int, batch: int) -> dict:
     rng = np.random.default_rng(0)
     table = jnp.asarray(
@@ -131,6 +143,9 @@ def main() -> None:
         "dim": DIM,
         "n_cols": N_COLS,
         "reps": REPS,
+        # fixed per-rep probe dispatch cost, measured with an identity jit:
+        # subtract from any absolute us/call; ratios are unaffected
+        "probe_overhead_us": round(probe_overhead_us(), 1),
         "cases": results,
         "pallas_wins_up_to_hash_size": max(winning) if winning else 0,
     }

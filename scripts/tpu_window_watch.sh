@@ -28,34 +28,53 @@ echo "$(date +%T) watcher start (max $MAX_LOOPS probes)" >>"$LOG"
 for i in $(seq 1 "$MAX_LOOPS"); do
     if probe; then
         echo "$(date +%T) probe $i: TPU WINDOW OPEN — running battery" >>"$LOG"
-        # 1. the headline bench (its own 540s budget; TPU attempt first)
+        # 1. the headline bench FIRST (its own 540s budget; TPU attempt
+        #    first; flushes the primary metric as a complete parsed record
+        #    before optional sections — r04 verdict item 1)
         BENCH_TPU_ATTEMPTS=1 timeout 600 python bench.py \
             >"$REPO/BENCH_TPU_WINDOW.json" 2>>"$LOG"
         echo "$(date +%T) bench done rc=$?" >>"$LOG"
-        # 2. Pallas embedding cutover sweep (verdict item 3; writes
-        #    BENCH_PALLAS_EMBEDDING.json at the repo root itself)
-        timeout 900 python scripts/bench_pallas_embedding.py >>"$LOG" 2>&1
-        echo "$(date +%T) pallas done rc=$?" >>"$LOG"
-        # 3. BASELINE config-matrix families (verdict item 4)
+        # 2. infeed-overlap profiler trace (r04 verdict item 6)
+        if [ -f scripts/trace_infeed.py ]; then
+            timeout 600 python scripts/trace_infeed.py \
+                --out "$REPO/BENCH_INFEED_TRACE.json" >>"$LOG" 2>&1
+            echo "$(date +%T) trace done rc=$?" >>"$LOG"
+        fi
+        # 3. end-to-end at-scale run (r04 verdict item 2) — if landed yet
+        if [ -f scripts/bench_e2e.py ]; then
+            timeout 1800 python scripts/bench_e2e.py \
+                --out "$REPO/BENCH_E2E_TPU.json" >>"$LOG" 2>&1
+            echo "$(date +%T) e2e done rc=$?" >>"$LOG"
+        fi
+        # 4. BASELINE config-matrix families
         timeout 1200 python scripts/bench_models.py \
             --out "$REPO/BENCH_MODELS_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) models done rc=$?" >>"$LOG"
-        # 4. transfer-path diagnosis (bf16 vs fp32 vs u16+bitcast)
+        # 5. transfer-path diagnosis (bf16 vs fp32 vs u16+bitcast)
         timeout 300 python scripts/bench_transfer.py \
             --out "$REPO/BENCH_TRANSFER.json" >>"$LOG" 2>&1
         echo "$(date +%T) transfer done rc=$?" >>"$LOG"
-        # 5. sequence-family step: seq lengths x attention impls
+        # 6. flash-backward block sweep (r04 verdict item 5) — if landed
+        if [ -f scripts/bench_flash_sweep.py ]; then
+            timeout 1200 python scripts/bench_flash_sweep.py \
+                --out "$REPO/BENCH_FLASH_SWEEP.json" >>"$LOG" 2>&1
+            echo "$(date +%T) flash-sweep done rc=$?" >>"$LOG"
+        fi
+        # 7. sequence-family step: seq lengths x attention impls
         #    (cases run in subprocesses and the artifact is written
         #    after every case, so the outer timeout keeps whatever
         #    completed)
         timeout 900 python scripts/bench_sequence.py \
             --out "$REPO/BENCH_SEQUENCE_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) sequence done rc=$?" >>"$LOG"
-        # 6. long-S feasibility: full attention's S×S matrix vs chunked
+        # 8. long-S feasibility: full attention's S×S matrix vs chunked
         BENCH_SEQ_LENS=8192,16384 BENCH_SEQ_IMPLS=full,chunked \
         BENCH_SEQ_REPS=5 timeout 900 python scripts/bench_sequence.py \
             --out "$REPO/BENCH_SEQUENCE_LONG_TPU.json" >>"$LOG" 2>&1
         echo "$(date +%T) sequence-long done rc=$?" >>"$LOG"
+        # 9. Pallas embedding cutover sweep
+        timeout 900 python scripts/bench_pallas_embedding.py >>"$LOG" 2>&1
+        echo "$(date +%T) pallas done rc=$?" >>"$LOG"
         echo "$(date +%T) battery complete" >>"$LOG"
         exit 0
     fi

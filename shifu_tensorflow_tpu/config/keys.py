@@ -91,6 +91,13 @@ BATCH_SIZE = TPU_PREFIX + "batch-size"  # global batch size
 DEFAULT_BATCH_SIZE = 100  # parity with reference BATCH_SIZE (ssgd_monitor.py:33)
 DTYPE = TPU_PREFIX + "dtype"
 DEFAULT_DTYPE = "float32"  # tabular nets are tiny; bf16 is opt-in
+# streaming TRANSPORT dtype for features (decoupled from compute dtype):
+# "auto" ships bf16 over the host->device link whenever no column feeds a
+# hash (4.6x the fp32 device_put rate, BENCH_TRANSFER.json) and the jitted
+# step widens back to the params' precision on device; "float32"/"bfloat16"
+# force it
+STREAM_FEATURE_DTYPE = TPU_PREFIX + "stream-feature-dtype"
+DEFAULT_STREAM_FEATURE_DTYPE = "auto"
 PREFETCH_DEPTH = TPU_PREFIX + "prefetch-depth"
 DEFAULT_PREFETCH_DEPTH = 2
 # chunked-scan epochs: batches per lax.scan dispatch (1 = per-step path).

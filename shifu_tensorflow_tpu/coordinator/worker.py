@@ -86,6 +86,9 @@ class WorkerConfig:
     async_checkpoint: bool = False
     # binary shard cache directory (data/cache.py); None = no caching
     cache_dir: str | None = None
+    # streaming transport dtype for features (conf key
+    # shifu.tpu.stream-feature-dtype): auto = bf16 unless hashing
+    stream_feature_dtype: str = "auto"
 
     def to_json(self) -> dict:
         """JSON transport for subprocess workers (worker_main)."""
@@ -100,7 +103,7 @@ class WorkerConfig:
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
                 "scan_steps", "accum_steps", "keep_best",
-                "async_checkpoint", "cache_dir",
+                "async_checkpoint", "cache_dir", "stream_feature_dtype",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -472,14 +475,15 @@ def _np_feature_dtype(cfg):
 
 
 def _feature_dtype_for(cfg) -> str:
-    """bf16 runs stream bf16 features — half the cache-slab reads and
-    host->device bytes — EXCEPT when any column feeds a hash (embedding /
-    wide-cross models): bucket ids are computed from raw float bits, and
-    bf16 rounding of category codes > 256 would re-bucket them, skewing
-    training against the f32-hashing exported scorer."""
-    if cfg.dtype == "bfloat16" and not cfg.model_config.params.uses_feature_hashing:
-        return "bfloat16"
-    return "float32"
+    """Streaming transport dtype — bf16 by default (compact transfer, the
+    jitted step widens on device), float32 when any column feeds a hash;
+    see data/dataset.py resolve_stream_feature_dtype."""
+    from shifu_tensorflow_tpu.data.dataset import resolve_stream_feature_dtype
+
+    return resolve_stream_feature_dtype(
+        cfg.stream_feature_dtype,
+        uses_feature_hashing=cfg.model_config.params.uses_feature_hashing,
+    )
 
 
 def _run_spmd_training(

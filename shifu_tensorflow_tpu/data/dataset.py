@@ -35,6 +35,42 @@ from shifu_tensorflow_tpu.utils import fs
 
 Batch = dict[str, np.ndarray]  # {"x": (B,F), "y": (B,1), "w": (B,1)}
 
+
+def resolve_stream_feature_dtype(setting: str | None, *,
+                                 uses_feature_hashing: bool) -> str:
+    """Streaming TRANSPORT dtype for features (conf key
+    shifu.tpu.stream-feature-dtype), decoupled from the compute dtype.
+
+    ``auto`` (the default) ships bf16 whenever it is safe: half the cache
+    slab bytes and 4.6× the fp32 host→device rate measured through the
+    tunneled backend (BENCH_TRANSFER.json); the jitted step widens back to
+    the params' precision on device (train/trainer.py _widen_features), so
+    an fp32 model still computes fp32 — bf16 is transport-only.
+
+    The one unsafe case: models that HASH feature columns (embedding /
+    wide-cross).  Bucket ids are computed from raw float bits; bf16
+    rounding of category codes > 256 would re-bucket them, skewing
+    training against the f32-hashing exported scorer — auto keeps those
+    runs at float32, and an explicit bfloat16 request refuses loudly
+    rather than silently skewing.
+    """
+    s = (setting or "auto").lower()
+    if s == "auto":
+        return "float32" if uses_feature_hashing else "bfloat16"
+    if s == "bfloat16" and uses_feature_hashing:
+        raise ValueError(
+            "shifu.tpu.stream-feature-dtype=bfloat16 is unsafe with "
+            "hashed feature columns: bucket ids are computed from raw "
+            "float bits, and bf16 rounding re-buckets category codes "
+            "> 256 — use auto (streams float32 for hashing models)"
+        )
+    if s not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown stream-feature-dtype {setting!r} "
+            "(auto | float32 | bfloat16)"
+        )
+    return s
+
 # reader-thread end marker: (_TAIL, leftover ParsedBlock)
 _TAIL = object()
 

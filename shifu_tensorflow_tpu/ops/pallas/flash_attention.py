@@ -168,10 +168,17 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v = res
     # chunked_attention self-adjusts block_size to a divisor of S, so no
     # fallback here — falling back to S would mean full attention in the
-    # backward, materializing the S×S matrix this kernel exists to avoid
+    # backward, materializing the S×S matrix this kernel exists to avoid.
+    # The block is never SMALLER than 512 — the sweet spot measured in
+    # BENCH_SEQUENCE_TPU.json (and the default callers pass
+    # block_q=block_k=128, which must not shrink the backward chunk) —
+    # but a caller tuning the forward blocks LARGER raises it too.  For
+    # S <= block the chunked path degenerates to one block — i.e. full
+    # attention — which at that scale is the memory-optimal choice.
+    block = max(512, block_q, block_k)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: chunked_attention(
-            q_, k_, v_, causal=causal, block_size=512),
+            q_, k_, v_, causal=causal, block_size=block),
         q, k, v,
     )
     return vjp(g)

@@ -233,6 +233,8 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         "async_checkpoint": conf.get_bool(K.ASYNC_CHECKPOINT,
                                           K.DEFAULT_ASYNC_CHECKPOINT),
         "cache_dir": conf.get(K.CACHE_DIR),
+        "stream_feature_dtype": conf.get(K.STREAM_FEATURE_DTYPE,
+                                         K.DEFAULT_STREAM_FEATURE_DTYPE),
     }
 
 
@@ -450,12 +452,17 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
         with trace_if(args.profile_dir):
             if args.stream:
                 cache_dir = conf.get(K.CACHE_DIR)
-                # same gate as the worker: hashed columns must see f32 bits
-                feature_dtype = (
-                    "bfloat16"
-                    if dtype_name == "bfloat16"
-                    and not model_config.params.uses_feature_hashing
-                    else "float32"
+                # streaming transport dtype (decoupled from compute): bf16
+                # by default, f32 when hashed columns need raw float bits
+                from shifu_tensorflow_tpu.data.dataset import (
+                    resolve_stream_feature_dtype,
+                )
+
+                feature_dtype = resolve_stream_feature_dtype(
+                    conf.get(K.STREAM_FEATURE_DTYPE,
+                             K.DEFAULT_STREAM_FEATURE_DTYPE),
+                    uses_feature_hashing=(
+                        model_config.params.uses_feature_hashing),
                 )
                 history = trainer.fit_stream(
                     lambda epoch: ShardStream(

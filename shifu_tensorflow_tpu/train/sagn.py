@@ -72,7 +72,12 @@ def make_sagn_step(
     loss_fn = get_loss(loss_name)
 
     def compute_loss(params, micro):
-        pred = apply_fn({"params": params}, micro["x"])
+        # same compact-transport seam as the plain step: bf16-streamed
+        # features widen to the params' precision on device
+        from shifu_tensorflow_tpu.train.trainer import _widen_features
+
+        pred = apply_fn({"params": params},
+                        _widen_features(params, micro["x"]))
         loss = loss_fn(pred, micro["y"], micro["w"])
         if l2:
             loss = loss + l2_penalty(params, l2)

@@ -153,6 +153,21 @@ def donation_is_safe() -> bool:
     return "axon" not in version.lower()
 
 
+def _widen_features(params, x):
+    """Compact-transport seam: the streaming default ships features bf16
+    over the host→device link (4.6× the fp32 device_put rate through the
+    tunneled backend — BENCH_TRANSFER.json) and widens HERE, on device,
+    inside the jitted step, so an fp32 model still computes fp32
+    throughout.  bf16 is transport-only: the quantization happened on the
+    host; this cast just keeps every matmul/accumulation at the params'
+    precision.  A bf16 model keeps bf16 x (no-op).  Dtypes are static at
+    trace time, so the branch costs nothing."""
+    p_dtype = jax.tree_util.tree_leaves(params)[0].dtype
+    if x.dtype == jnp.bfloat16 and p_dtype == jnp.float32:
+        return x.astype(jnp.float32)
+    return x
+
+
 def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0):
     """The un-jitted (state, batch) -> (state, loss) transition — jitted
     per-batch by make_train_step, lax.scan'ed over stacked batches by
@@ -160,7 +175,7 @@ def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0):
     loss_fn = get_loss(loss_name)
 
     def compute_loss(params, batch):
-        pred = apply_fn({"params": params}, batch["x"])
+        pred = apply_fn({"params": params}, _widen_features(params, batch["x"]))
         loss = loss_fn(pred, batch["y"], batch["w"])
         if l2:
             loss = loss + l2_penalty(params, l2)
@@ -249,7 +264,7 @@ def make_accum_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     loss_fn = get_loss(loss_name)
 
     def sum_form(params, mb):
-        pred = apply_fn({"params": params}, mb["x"])
+        pred = apply_fn({"params": params}, _widen_features(params, mb["x"]))
         n = jnp.sum((mb["w"] != 0.0).astype(jnp.float32))
         loss = loss_fn(pred, mb["y"], mb["w"])
         # loss is sum/count; recover the sum (0 for all-padding micros,
@@ -302,7 +317,7 @@ def make_eval_step_body(apply_fn, loss_name: str = "mse"):
     loss_fn = get_loss(loss_name)
 
     def eval_step(params, batch: Batch):
-        pred = apply_fn({"params": params}, batch["x"])
+        pred = apply_fn({"params": params}, _widen_features(params, batch["x"]))
         loss = loss_fn(pred, batch["y"], batch["w"])
         has_rows = jnp.sum(batch["w"] != 0.0) > 0
         return jnp.where(has_rows, loss, jnp.nan), pred

@@ -69,8 +69,11 @@ def true_sync(x: Any) -> None:
     import jax
     import numpy as np
 
+    # size-0 leaves (e.g. an empty final batch slice) have no element to
+    # probe — and nothing to wait for: a zero-byte buffer's "completion"
+    # is vacuous, so skipping it cannot unprove the sync
     leaves = [l for l in jax.tree_util.tree_leaves(x)
-              if hasattr(l, "dtype")]
+              if hasattr(l, "dtype") and getattr(l, "size", 1) != 0]
     if not leaves:
         return
     probes = [l.reshape(-1)[0] if getattr(l, "ndim", 0) else l

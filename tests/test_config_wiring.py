@@ -324,3 +324,34 @@ def test_async_checkpoint_key_reaches_worker_config():
             assert ck._executor is not None
         with NpzCheckpointer(d) as ck:
             assert ck._executor is None
+
+
+def test_stream_feature_dtype_key_reaches_worker_config():
+    """shifu.tpu.stream-feature-dtype drives WorkerConfig through
+    worker_runtime_kwargs and resolves through the hashing-aware gate."""
+    from shifu_tensorflow_tpu.train.__main__ import worker_runtime_kwargs
+
+    kw = worker_runtime_kwargs(_args(), _conf({}))
+    assert kw["stream_feature_dtype"] == "auto"
+    kw = worker_runtime_kwargs(
+        _args(), _conf({K.STREAM_FEATURE_DTYPE: "float32"}))
+    assert kw["stream_feature_dtype"] == "float32"
+
+
+def test_stream_feature_dtype_survives_worker_json_bridge():
+    """The field must survive to_json/from_json — subprocess workers get
+    their config over this bridge, so an omitted field silently reverts
+    an operator's explicit opt-out to the bf16 default."""
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    mc = ModelConfig.from_json({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.1}}})
+    schema = RecordSchema(feature_columns=(1, 2), target_column=0)
+    cfg = WorkerConfig(
+        worker_id="w", coordinator_host="h", coordinator_port=1,
+        model_config=mc, schema=schema, stream_feature_dtype="float32",
+    )
+    rt = WorkerConfig.from_json(cfg.to_json())
+    assert rt.stream_feature_dtype == "float32"

@@ -823,3 +823,79 @@ def test_device_resident_bf16(psv_dataset):
     history = trainer.fit_device_resident(ds, batch_size=64)
     assert np.isfinite(history[-1].training_loss)
     assert 0.0 <= history[-1].auc <= 1.0
+
+
+# ---- compact bf16 transport, fp32 compute ----
+
+def test_bf16_transport_widens_on_device_fp32_compute():
+    """The streaming default ships bf16 features to an fp32 model; the
+    jitted step widens on device (_widen_features), so params stay fp32
+    and the loss trajectory tracks the fp32-transport run to bf16 input
+    quantization error (r04 verdict item 3: transport is KS-neutral)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(7)
+    n, f = 512, 6
+    x32 = rng.normal(size=(n, f)).astype(np.float32)
+    y = (rng.random((n, 1)) < 0.4).astype(np.float32)
+    w = np.ones((n, 1), np.float32)
+    x16 = x32.astype(ml_dtypes.bfloat16)
+
+    def run(x):
+        tr = Trainer(_mc(epochs=1), f, seed=3)
+        losses = []
+        for i in range(0, n, 128):
+            sl = slice(i, i + 128)
+            batch = tr._put({"x": x[sl], "y": y[sl], "w": w[sl]})
+            tr.state, loss = tr._train_step(tr.state, batch)
+            losses.append(float(loss))
+        return tr, losses
+
+    tr32, l32 = run(x32)
+    tr16, l16 = run(x16)
+    # params computed fp32 in both runs
+    leaves = jax.tree_util.tree_leaves(tr16.state.params)
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    # bf16 transport tracks fp32 transport closely (input quantization
+    # is ~0.4% relative; trajectories stay within a small tolerance)
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=5e-3)
+    # eval path widens too
+    ev16 = tr16._eval_step(
+        tr16.state.params,
+        tr16._put({"x": x16[:128], "y": y[:128], "w": w[:128]}))
+    assert np.isfinite(float(ev16[0]))
+
+
+def test_bf16_transport_ks_parity_streaming(psv_dataset):
+    """KS-parity gate for the compact-transport default: streaming the
+    demo set with bf16 features yields the same validation KS/AUC as fp32
+    transport to within noise (r04 verdict item 3 done-criterion)."""
+    from shifu_tensorflow_tpu.data.dataset import ShardStream
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+
+    def run(feature_dtype):
+        tr = Trainer(_mc(epochs=3), schema.num_features, seed=4)
+        history = tr.fit_stream(
+            lambda epoch: ShardStream(
+                psv_dataset["paths"], schema, 64, valid_rate=0.2,
+                emit="train", n_readers=1, feature_dtype=feature_dtype,
+            ),
+            (lambda: ShardStream(
+                psv_dataset["paths"], schema, 64, valid_rate=0.2,
+                emit="valid", n_readers=1, feature_dtype=feature_dtype,
+            )),
+            epochs=3,
+        )
+        return history[-1]
+
+    f32 = run("float32")
+    b16 = run("bfloat16")
+    assert np.isfinite(b16.ks) and np.isfinite(b16.auc)
+    assert abs(b16.ks - f32.ks) < 0.05
+    assert abs(b16.auc - f32.auc) < 0.03
