@@ -9,16 +9,27 @@
 // loads float32 arrays out of the (stored, uncompressed) npz, applies
 // ZSCALE normalization, and runs the config-driven DNN forward pass.
 //
-// Scope: the plain DNN family (the only family the reference's evaluator
-// supported).  Wide&deep / multi-task / embedding-augmented bundles are
-// rejected at load with a message — callers fall back to the Python scorer
-// (export/eval_model.py), which rebuilds any family through the model
-// factory.
+// Scope: ALL FOUR bundle families (r04 verdict item 4) — plain DNN,
+// wide&deep (wide slice + hashed-cross table), multi-task (shared trunk,
+// T sigmoid heads), and the embedding-augmented wrapper around any base
+// (hashed per-column tables concatenated to the features).  Feature
+// hashing reproduces ops/hashing.py bit-for-bit (same multiplicative
+// constants over raw float bits), so bucket assignment is identical to
+// the jitted model's.  The reference's evaluator is architecture-agnostic
+// because it runs the exported graph (TensorflowModel.java:53-94); this
+// scorer reaches the same coverage by implementing each family's forward.
+//
+// Throughput: rows are processed in blocks with an i-outer blocked GEMM
+// (each weight row loaded once per block, reused across rows; inner loop
+// contiguous over the output dim for vectorization) and threaded across
+// row ranges — the per-row GEMV of the v1 scorer re-streamed W per row.
 //
 // C ABI (ctypes-friendly; see export/native_scorer.py):
 //   void* stpu_scorer_load(const char* dir, char* err, long errlen);
 //   long  stpu_scorer_num_features(void* h);
+//   long  stpu_scorer_num_outputs(void* h);
 //   long  stpu_scorer_score(void* h, const float* rows, long n, float* out);
+//         (out: n * num_outputs floats, row-major)
 //   void  stpu_scorer_free(void* h);
 
 #include <cctype>
@@ -380,7 +391,7 @@ bool load_npz(const std::string& path, std::map<std::string, Array>* out,
 }
 
 // --------------------------------------------------------------- model ----
-enum class Act { kSigmoid, kTanh, kRelu, kLeakyRelu };
+enum class Act { kSigmoid, kTanh, kRelu, kLeakyRelu, kLinear };
 
 Act act_from(const std::string& name) {
   // reference fallback semantics: unknown -> leakyrelu (ssgd_monitor.py:74-88)
@@ -398,20 +409,108 @@ inline float apply_act(Act a, float x) {
     case Act::kTanh: return std::tanh(x);
     case Act::kRelu: return x > 0 ? x : 0.0f;
     case Act::kLeakyRelu: return x > 0 ? x : 0.01f * x;  // flax default slope
+    case Act::kLinear: return x;
   }
   return x;
 }
+
+constexpr long kRT = 4;   // rows per register tile
+constexpr long kJT = 16;  // output cols per register tile (1 zmm / 2 ymm)
 
 struct Layer {
   Array W;  // (in, out)
   Array b;  // (out,)
   Act act;
+
+  // Tile-packed weights (finalize()): the register-tiled GEMM walks W
+  // column-blocks with a 4*out-byte stride, which turns every load into
+  // its own cache line (and aliases in L1 for power-of-two widths); the
+  // classic fix is packing the B matrix tile-major once so the reduction
+  // loop streams contiguously.  Block t holds cols [t*kJT, t*kJT+kJT)
+  // as in*kJT consecutive floats (zero-padded past out).
+  std::vector<float> Wp;   // (out_pad/kJT, in, kJT)
+  std::vector<float> bp;   // (out_pad,) zero-padded bias
+  long out_pad = 0;
+
+  void finalize() {
+    long in = W.shape[0], outd = W.shape[1];
+    out_pad = (outd + kJT - 1) / kJT * kJT;
+    Wp.assign(static_cast<size_t>(out_pad / kJT) * in * kJT, 0.0f);
+    bp.assign(static_cast<size_t>(out_pad), 0.0f);
+    std::memcpy(bp.data(), b.data.data(), static_cast<size_t>(outd) * 4);
+    for (long t = 0; t < out_pad / kJT; ++t)
+      for (long i = 0; i < in; ++i)
+        for (long j = 0; j < kJT; ++j) {
+          long col = t * kJT + j;
+          if (col < outd)
+            Wp[static_cast<size_t>(t) * in * kJT + i * kJT + j] =
+                W.data[static_cast<size_t>(i) * outd + col];
+        }
+  }
 };
 
+// ------------------------------------------------------------- hashing ----
+// Bit-identical to shifu_tensorflow_tpu/ops/hashing.py: multiplicative
+// (Fibonacci) hashing over raw float32 bits, uint32 arithmetic throughout.
+constexpr uint32_t kHashMult = 2654435761u;   // HASH_MULT
+constexpr uint32_t kHashMult2 = 40503u;       // HASH_MULT2
+constexpr uint32_t kColumnSalt = 0x9E3779B9u; // COLUMN_SALT
+
+inline uint32_t float_bits(float v) {
+  uint32_t b;
+  std::memcpy(&b, &v, 4);
+  return b;
+}
+
+inline uint32_t hash_mix(uint32_t bits) {
+  uint32_t h = bits * kHashMult;
+  h ^= h >> 16;
+  return h * kHashMult2;
+}
+
+// salted_bucket_ids for one value at sliced-column index c
+inline long salted_bucket_id(float v, long c, long hash_size) {
+  uint32_t salted =
+      float_bits(v) ^ (static_cast<uint32_t>(c) * kColumnSalt);
+  return static_cast<long>(hash_mix(salted) %
+                           static_cast<uint32_t>(hash_size));
+}
+
+// crossed_bucket_ids over a row's sliced columns
+inline long crossed_bucket_id(const float* vals, long n, long hash_size) {
+  uint32_t h = 0;
+  for (long c = 0; c < n; ++c) {
+    h = (h ^ float_bits(vals[c])) * kHashMult;
+    h ^= h >> 13;
+  }
+  return static_cast<long>(h % static_cast<uint32_t>(hash_size));
+}
+
 struct Scorer {
-  long num_features = 0;
+  long num_features = 0;   // raw input width f
+  long num_outputs = 1;    // 1 (dnn / wide&deep) or NumTasks (multi-task)
   std::vector<float> means, stds;
-  std::vector<Layer> layers;
+
+  // embedding-augmented wrapper (may wrap any base family)
+  std::vector<long> embed_idx;  // positions in the feature vector
+  Array embed_table;            // (hash, dim)
+  long embed_hash = 0, embed_dim = 0;
+
+  // base family
+  enum class Family { kDnn, kWideDeep, kMultiTask } family = Family::kDnn;
+  std::vector<Layer> trunk;  // hidden stack (trunk/ or deep/)
+  Layer head;                // shifu_output_0 / deep_logit / task_heads
+
+  // wide&deep extras
+  std::vector<long> wide_idx;  // empty = the whole (augmented) input
+  Array wide_W;                // (wide_in, 1), no bias
+  Array cross_table;           // (cross_hash, 1); empty = no cross
+  long cross_hash = 0;
+
+  long base_input_dim() const {
+    return num_features +
+           static_cast<long>(embed_idx.size()) * embed_dim;
+  }
 };
 
 std::string read_file(const std::string& path, std::string* err) {
@@ -446,17 +545,17 @@ Scorer* build_scorer(const std::string& dir, std::string* err) {
   auto num_of = [](const JValue* v, double d) {
     return v && v->kind == JValue::NUM ? v->num : d;
   };
+  auto longs_of = [](const JValue* v) {
+    std::vector<long> out;
+    if (v && v->kind == JValue::ARR)
+      for (const auto& e : v->arr)
+        if (e.kind == JValue::NUM) out.push_back(static_cast<long>(e.num));
+    return out;
+  };
   std::string model_type = str_of(params->get("ModelType"), "dnn");
-  if (model_type != "dnn") {
-    *err = "native scorer supports the dnn family only (got " + model_type +
-           "); use the python scorer";
-    return nullptr;
-  }
-  const JValue* emb = params->get("EmbeddingColumnNums");
-  if (emb && emb->kind == JValue::ARR && !emb->arr.empty() &&
-      num_of(params->get("EmbeddingHashSize"), 0) > 0) {
-    *err = "embedding-augmented bundles unsupported natively; use the python "
-           "scorer";
+  if (model_type == "sequence") {
+    *err = "native scorer does not cover the sequence family (attention "
+           "serving goes through the python/jitted scorer)";
     return nullptr;
   }
 
@@ -491,41 +590,135 @@ Scorer* build_scorer(const std::string& dir, std::string* err) {
   std::map<std::string, Array> weights;
   if (!load_npz(dir + "/shifu_tpu_weights.npz", &weights, err)) return nullptr;
 
+  // positions of absolute column numbers within the selected feature
+  // vector (models/factory.py _column_positions): features arrive already
+  // projected to feature_columns order; absent columns are skipped
+  std::vector<long> feature_columns =
+      longs_of(arch.get("feature_columns"));
+  auto positions_of = [&](const std::vector<long>& nums) {
+    std::vector<long> out;
+    for (long c : nums)
+      for (size_t i = 0; i < feature_columns.size(); ++i)
+        if (feature_columns[i] == c) {
+          out.push_back(static_cast<long>(i));
+          break;
+        }
+    return out;
+  };
+
+  // embedding-augmented wrapper: engaged exactly when the factory engages
+  // it (EmbeddingColumnNums nonempty, hash size > 0, some column maps)
+  std::string prefix;  // weight-path prefix for the base family
+  std::vector<long> emb_nums = longs_of(params->get("EmbeddingColumnNums"));
+  long emb_hash = static_cast<long>(num_of(params->get("EmbeddingHashSize"), 0));
+  if (!emb_nums.empty() && emb_hash > 0) {
+    std::vector<long> idx = feature_columns.empty()
+        ? [&] {  // no feature_columns: positions 0..C-1 (factory fallback)
+            std::vector<long> v(emb_nums.size());
+            for (size_t i = 0; i < v.size(); ++i) v[i] = static_cast<long>(i);
+            return v;
+          }()
+        : positions_of(emb_nums);
+    if (!idx.empty()) {
+      auto tk = weights.find("/hashed_columns/table");
+      if (tk == weights.end()) {
+        *err = "weights missing /hashed_columns/table";
+        return nullptr;
+      }
+      scorer->embed_table = tk->second;
+      if (scorer->embed_table.shape.size() != 2 ||
+          scorer->embed_table.shape[0] != emb_hash) {
+        *err = "embedding table shape != (EmbeddingHashSize, dim)";
+        return nullptr;
+      }
+      scorer->embed_idx = std::move(idx);
+      scorer->embed_hash = emb_hash;
+      scorer->embed_dim = scorer->embed_table.shape[1];
+      prefix = "/base";
+    }
+  }
+
+  auto take = [&](const std::string& name, Array* out) {
+    auto it = weights.find(prefix + name);
+    if (it == weights.end()) {
+      *err = "weights missing " + prefix + name;
+      return false;
+    }
+    *out = it->second;
+    return true;
+  };
+
   long n_layers = static_cast<long>(num_of(params->get("NumHiddenLayers"), 0));
   const JValue* acts = params->get("ActivationFunc");
+  std::string tower = model_type == "wide_deep" ? "/deep/" : "/trunk/";
   for (long i = 0; i < n_layers; ++i) {
-    std::string base = "/trunk/hidden_layer" + std::to_string(i) + "/";
-    auto wk = weights.find(base + "kernel");
-    auto bk = weights.find(base + "bias");
-    if (wk == weights.end() || bk == weights.end()) {
-      *err = "weights missing " + base + "kernel|bias";
-      return nullptr;
-    }
+    std::string base = tower + "hidden_layer" + std::to_string(i) + "/";
     Layer layer;
-    layer.W = wk->second;
-    layer.b = bk->second;
+    if (!take(base + "kernel", &layer.W) || !take(base + "bias", &layer.b))
+      return nullptr;
     layer.act = act_from(
         acts && acts->kind == JValue::ARR &&
                 static_cast<size_t>(i) < acts->arr.size()
             ? acts->arr[static_cast<size_t>(i)].str
             : "");
-    scorer->layers.push_back(std::move(layer));
+    scorer->trunk.push_back(std::move(layer));
   }
-  auto wk = weights.find("/shifu_output_0/kernel");
-  auto bk = weights.find("/shifu_output_0/bias");
-  if (wk == weights.end() || bk == weights.end()) {
-    *err = "weights missing /shifu_output_0/kernel|bias";
-    return nullptr;
-  }
-  Layer head;
-  head.W = wk->second;
-  head.b = bk->second;
-  head.act = Act::kSigmoid;
-  scorer->layers.push_back(std::move(head));
 
-  // shape sanity: chain must start at num_features
-  long in_dim = scorer->num_features;
-  for (const auto& l : scorer->layers) {
+  if (model_type == "wide_deep") {
+    scorer->family = Scorer::Family::kWideDeep;
+    if (!take("/deep_logit/kernel", &scorer->head.W) ||
+        !take("/deep_logit/bias", &scorer->head.b))
+      return nullptr;
+    scorer->head.act = Act::kSigmoid;  // applied after wide+cross sum
+    if (!take("/wide_logit/kernel", &scorer->wide_W)) return nullptr;
+    std::vector<long> wide_nums = longs_of(params->get("WideColumnNums"));
+    scorer->wide_idx = positions_of(wide_nums);  // empty = whole input
+    long cross = static_cast<long>(num_of(params->get("CrossHashSize"), 0));
+    // factory gates the cross on WideColumnNums being present
+    if (cross > 0 && !wide_nums.empty()) {
+      if (!take("/wide_cross/table", &scorer->cross_table)) return nullptr;
+      if (scorer->cross_table.shape.size() != 2 ||
+          scorer->cross_table.shape[0] != cross ||
+          scorer->cross_table.shape[1] != 1) {
+        *err = "wide_cross table shape != (CrossHashSize, 1)";
+        return nullptr;
+      }
+      scorer->cross_hash = cross;
+    }
+    long wide_in = scorer->wide_idx.empty()
+                       ? scorer->base_input_dim()
+                       : static_cast<long>(scorer->wide_idx.size());
+    if (scorer->wide_W.shape.size() != 2 ||
+        scorer->wide_W.shape[0] != wide_in ||
+        scorer->wide_W.shape[1] != 1) {
+      *err = "wide_logit kernel shape mismatch";
+      return nullptr;
+    }
+  } else if (model_type == "multi_task") {
+    scorer->family = Scorer::Family::kMultiTask;
+    if (!take("/task_heads/kernel", &scorer->head.W) ||
+        !take("/task_heads/bias", &scorer->head.b))
+      return nullptr;
+    scorer->head.act = Act::kSigmoid;
+    long tasks = static_cast<long>(num_of(params->get("NumTasks"), 1));
+    if (scorer->head.W.shape.size() != 2 ||
+        scorer->head.W.shape[1] != tasks) {
+      *err = "task_heads kernel width != NumTasks";
+      return nullptr;
+    }
+    scorer->num_outputs = tasks;
+  } else {
+    scorer->family = Scorer::Family::kDnn;
+    if (!take("/shifu_output_0/kernel", &scorer->head.W) ||
+        !take("/shifu_output_0/bias", &scorer->head.b))
+      return nullptr;
+    scorer->head.act = Act::kSigmoid;
+  }
+
+  // shape sanity: hidden chain must start at the (augmented) input width
+  // and flow into the head
+  long in_dim = scorer->base_input_dim();
+  for (const auto& l : scorer->trunk) {
     if (l.W.shape.size() != 2 || l.W.shape[0] != in_dim ||
         l.b.shape.size() != 1 || l.b.shape[0] != l.W.shape[1]) {
       *err = "weight shape chain mismatch";
@@ -533,34 +726,182 @@ Scorer* build_scorer(const std::string& dir, std::string* err) {
     }
     in_dim = l.W.shape[1];
   }
-  if (in_dim != 1) {
+  if (scorer->head.W.shape.size() != 2 || scorer->head.W.shape[0] != in_dim ||
+      scorer->head.b.shape.size() != 1 ||
+      scorer->head.b.shape[0] != scorer->head.W.shape[1]) {
+    *err = "head shape mismatch";
+    return nullptr;
+  }
+  if (scorer->family != Scorer::Family::kMultiTask &&
+      scorer->head.W.shape[1] != 1) {
     *err = "output head is not 1-unit";
     return nullptr;
   }
+  for (auto& l : scorer->trunk) l.finalize();
+  scorer->head.finalize();
   return scorer.release();
 }
 
+// Blocked dense: C (R, out) = X (R, in) @ W (in, out) + b, then act.
+//
+// Register-tiled GEMM over PACKED weights: kRT×kJT accumulators live in
+// registers across the whole i (reduction) loop — the naive i-outer/axpy
+// form reads and writes the C row from memory on EVERY i step (2 memory
+// ops per FMA).  The packed layout (Layer::finalize) makes the per-tile
+// reduction stream W contiguously; per i step the full tile loads kJT
+// weight floats + kRT x floats for kRT*kJT FMAs, and the compile-time
+// tile bounds let the compiler keep the accumulators in ymm/zmm
+// registers and emit FMA over the contiguous j dimension.
+
+// one full kRT×kJT tile; wblk = packed block base (in * kJT floats)
+void dense_tile_full(const float* X, long in, long outd, const float* wblk,
+                     const float* bp, long r0, long j0, float* C) {
+  float acc[kRT][kJT];
+  for (long r = 0; r < kRT; ++r)
+    for (long j = 0; j < kJT; ++j) acc[r][j] = bp[j0 + j];
+  const float* x0 = X + r0 * in;
+  for (long i = 0; i < in; ++i) {
+    const float* w = wblk + i * kJT;
+    for (long r = 0; r < kRT; ++r) {
+      float xi = x0[r * in + i];
+      // g++12 -O3 alone picks 16-byte vectors here (measured 3.7 GFLOP/s);
+      // the simd pragma gets the full-width FMA form (65 GFLOP/s)
+#pragma omp simd
+      for (long j = 0; j < kJT; ++j) acc[r][j] += xi * w[j];
+    }
+  }
+  long Jj = std::min(kJT, outd - j0);  // drop zero-padded cols on store
+  for (long r = 0; r < kRT; ++r)
+    std::memcpy(C + (r0 + r) * outd + j0, acc[r],
+                static_cast<size_t>(Jj) * 4);
+}
+
+// row remainder (R % kRT rows), same packed walk
+void dense_tile_rows(const float* X, long in, long outd, const float* wblk,
+                     const float* bp, long r0, long Rr, long j0, float* C) {
+  float acc[kRT][kJT];
+  for (long r = 0; r < Rr; ++r)
+    for (long j = 0; j < kJT; ++j) acc[r][j] = bp[j0 + j];
+  const float* x0 = X + r0 * in;
+  for (long i = 0; i < in; ++i) {
+    const float* w = wblk + i * kJT;
+    for (long r = 0; r < Rr; ++r) {
+      float xi = x0[r * in + i];
+#pragma omp simd
+      for (long j = 0; j < kJT; ++j) acc[r][j] += xi * w[j];
+    }
+  }
+  long Jj = std::min(kJT, outd - j0);
+  for (long r = 0; r < Rr; ++r)
+    std::memcpy(C + (r0 + r) * outd + j0, acc[r],
+                static_cast<size_t>(Jj) * 4);
+}
+
+void dense_block(const float* X, long R, const Layer& L, Act act, float* C) {
+  long in = L.W.shape[0], outd = L.W.shape[1];
+  long Rfull = R - R % kRT;
+  for (long t = 0; t < L.out_pad / kJT; ++t) {
+    const float* wblk = L.Wp.data() + static_cast<size_t>(t) * in * kJT;
+    long j0 = t * kJT;
+    for (long r0 = 0; r0 < Rfull; r0 += kRT)
+      dense_tile_full(X, in, outd, wblk, L.bp.data(), r0, j0, C);
+    if (Rfull < R)
+      dense_tile_rows(X, in, outd, wblk, L.bp.data(), Rfull, R - Rfull,
+                      j0, C);
+  }
+  for (long r = 0; r < R; ++r)
+    for (long j = 0; j < outd; ++j)
+      C[r * outd + j] = apply_act(act, C[r * outd + j]);
+}
+
+constexpr long kBlockRows = 64;
+
 void score_rows(const Scorer& s, const float* rows, long n, float* out) {
   long f = s.num_features;
-  std::vector<float> h, h2;
-  for (long r = 0; r < n; ++r) {
-    h.assign(rows + r * f, rows + (r + 1) * f);
-    if (!s.means.empty()) {
-      for (long j = 0; j < f; ++j) h[j] = (h[j] - s.means[j]) / s.stds[j];
+  long D = s.base_input_dim();
+  long max_w = D;
+  for (const auto& l : s.trunk) max_w = std::max(max_w, l.W.shape[1]);
+  max_w = std::max(max_w, s.head.W.shape[1]);
+  std::vector<float> xbuf(static_cast<size_t>(kBlockRows) * D);
+  std::vector<float> h(static_cast<size_t>(kBlockRows) * max_w);
+  std::vector<float> h2(static_cast<size_t>(kBlockRows) * max_w);
+  std::vector<float> widebuf;
+
+  for (long r0 = 0; r0 < n; r0 += kBlockRows) {
+    long R = std::min(kBlockRows, n - r0);
+    // 1. normalize the raw features into the block input buffer
+    for (long r = 0; r < R; ++r) {
+      const float* src = rows + (r0 + r) * f;
+      float* dst = xbuf.data() + r * D;
+      if (!s.means.empty())
+        for (long j = 0; j < f; ++j)
+          dst[j] = (src[j] - s.means[j]) / s.stds[j];
+      else
+        std::memcpy(dst, src, static_cast<size_t>(f) * 4);
     }
-    for (const auto& layer : s.layers) {
-      long in = layer.W.shape[0], outd = layer.W.shape[1];
-      h2.assign(layer.b.data.begin(), layer.b.data.end());
-      // (1,in) @ (in,out): row-major W, walk inputs outer for locality
-      for (long i = 0; i < in; ++i) {
-        float xi = h[i];
-        const float* wrow = layer.W.data.data() + i * outd;
-        for (long j = 0; j < outd; ++j) h2[j] += xi * wrow[j];
+    // 2. embedding wrapper: gather per-column hashed embeddings and
+    //    append them to the features (models/factory.EmbeddingAugmented)
+    if (s.embed_hash > 0) {
+      long C = static_cast<long>(s.embed_idx.size());
+      for (long r = 0; r < R; ++r) {
+        float* x = xbuf.data() + r * D;
+        float* e = x + f;
+        for (long c = 0; c < C; ++c) {
+          long id = salted_bucket_id(x[s.embed_idx[c]], c, s.embed_hash);
+          std::memcpy(e + c * s.embed_dim,
+                      s.embed_table.data.data() + id * s.embed_dim,
+                      static_cast<size_t>(s.embed_dim) * 4);
+        }
       }
-      for (long j = 0; j < outd; ++j) h2[j] = apply_act(layer.act, h2[j]);
-      h.swap(h2);
     }
-    out[r] = h[0];
+    // 3. hidden stack
+    const float* cur = xbuf.data();
+    long cur_w = D;
+    for (const auto& layer : s.trunk) {
+      dense_block(cur, R, layer, layer.act, h2.data());
+      h.swap(h2);
+      cur = h.data();
+      cur_w = layer.W.shape[1];
+    }
+    (void)cur_w;
+    // 4. head (+ wide&deep extras), sigmoid applied after summing logits
+    long T = s.head.W.shape[1];
+    if (s.family == Scorer::Family::kWideDeep) {
+      // deep_logit WITHOUT activation yet
+      dense_block(cur, R, s.head, Act::kLinear, h2.data());
+      for (long r = 0; r < R; ++r) {
+        const float* x = xbuf.data() + r * D;
+        float logit = h2[r * T];
+        // wide linear over the designated slice (or the whole input)
+        if (s.wide_idx.empty()) {
+          for (long i = 0; i < D; ++i)
+            logit += x[i] * s.wide_W.data[static_cast<size_t>(i)];
+        } else {
+          for (size_t i = 0; i < s.wide_idx.size(); ++i)
+            logit += x[s.wide_idx[i]] * s.wide_W.data[i];
+        }
+        // crossed categorical: joint hash of the wide slice
+        if (s.cross_hash > 0) {
+          widebuf.resize(s.wide_idx.empty() ? static_cast<size_t>(D)
+                                            : s.wide_idx.size());
+          if (s.wide_idx.empty())
+            std::memcpy(widebuf.data(), x, static_cast<size_t>(D) * 4);
+          else
+            for (size_t i = 0; i < s.wide_idx.size(); ++i)
+              widebuf[i] = x[s.wide_idx[i]];
+          long id = crossed_bucket_id(
+              widebuf.data(), static_cast<long>(widebuf.size()),
+              s.cross_hash);
+          logit += s.cross_table.data[static_cast<size_t>(id)];
+        }
+        out[(r0 + r)] = apply_act(Act::kSigmoid, logit);
+      }
+    } else {
+      dense_block(cur, R, s.head, s.head.act, h2.data());
+      for (long r = 0; r < R; ++r)
+        std::memcpy(out + (r0 + r) * T, h2.data() + r * T,
+                    static_cast<size_t>(T) * 4);
+    }
   }
 }
 
@@ -589,8 +930,13 @@ long stpu_scorer_num_features(void* handle) {
   return handle ? static_cast<Scorer*>(handle)->num_features : -1;
 }
 
-// rows: n * num_features raw (un-normalized) float32; out: n scores.
-// Multi-threads across row blocks for large batches.  Returns n or -1.
+long stpu_scorer_num_outputs(void* handle) {
+  return handle ? static_cast<Scorer*>(handle)->num_outputs : -1;
+}
+
+// rows: n * num_features raw (un-normalized) float32; out: n * num_outputs
+// scores, row-major.  Multi-threads across row blocks for large batches.
+// Returns n or -1.
 long stpu_scorer_score(void* handle, const float* rows, long n, float* out) {
   if (!handle || !rows || !out || n < 0) return -1;
   const Scorer& s = *static_cast<Scorer*>(handle);
@@ -609,7 +955,8 @@ long stpu_scorer_score(void* handle, const float* rows, long n, float* out) {
     long count = std::min(per, n - begin);
     if (count <= 0) break;
     threads.emplace_back([&s, rows, out, begin, count] {
-      score_rows(s, rows + begin * s.num_features, count, out + begin);
+      score_rows(s, rows + begin * s.num_features, count,
+                 out + begin * s.num_outputs);
     });
   }
   for (auto& th : threads) th.join();

@@ -205,6 +205,15 @@ def main() -> None:
     trainer = Trainer(bench._model_config(), NUM_FEATURES, mesh=mesh)
     batch_size = trainer.align_batch_size(
         int(os.environ.get("TRACE_BATCH", 65536)))
+    # both traced loops must run the SAME step count: the busy-fraction
+    # comparison is biased if fixed trace overhead weighs differently in
+    # the two windows.  The stream yields floor(rows/batch) batches
+    # (drop_remainder), so cap steps to what the data can actually serve.
+    avail = args.rows // batch_size
+    if avail < args.steps:
+        _note(f"capping steps {args.steps} -> {avail} "
+              f"({args.rows} rows / batch {batch_size})")
+        args.steps = max(1, avail)
     rng = np.random.default_rng(0)
     warm = {
         "x": rng.normal(size=(batch_size, NUM_FEATURES)).astype(np.float32),
@@ -229,6 +238,17 @@ def main() -> None:
 
     trace_root = (os.path.abspath("trace_infeed_out") if args.keep_trace
                   else tempfile.mkdtemp(prefix="stpu-trace-"))
+    if not args.keep_trace:
+        # raw XPlane traces are large and the watcher runs this on every
+        # open window — clean up even on SIGTERM/timeout kills (the
+        # SIGTERM handler routes through sys.exit so atexit fires; the
+        # partial artifact is already flushed incrementally)
+        import atexit
+        import shutil
+        import signal
+
+        atexit.register(shutil.rmtree, trace_root, ignore_errors=True)
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(1))
 
     def flush() -> None:
         # incremental artifact writes: the watcher runs this under a hard

@@ -80,7 +80,11 @@ class TrainParams:
         train/serve skew against the f32-hashing exported scorer."""
         return (
             (len(self.embedding_columns) > 0 and self.embedding_hash_size > 0)
-            or self.cross_hash_size > 0
+            # the factory only engages the wide cross when WideColumnNums
+            # is present (models/factory.py passes cross_hash_size=0
+            # otherwise) — a bare CrossHashSize hashes nothing, and
+            # counting it here would wrongly block bf16 transport
+            or (self.cross_hash_size > 0 and len(self.wide_column_nums) > 0)
         )
     # ---- learning-rate schedule (beyond the reference's fixed LR) ----
     # constant | cosine | exponential; warmup_steps applies to any of them

@@ -13,8 +13,9 @@ lifecycle (init → compute/compute_batch → release) with three backends:
   available, scoring through the exact signature the Java evaluator uses —
   this is the cross-check that the exported artifact honors the contract;
 - ``cpp``: the C++ scorer (cpp/stpu_scorer.cc via ctypes) — the
-  zero-Python-runtime path matching the reference's JNI evaluator; DNN
-  family only.
+  zero-Python-runtime path matching the reference's JNI evaluator; covers
+  every exported family except sequence (dnn, wide&deep, multi-task,
+  embedding-augmented).
 """
 
 from __future__ import annotations

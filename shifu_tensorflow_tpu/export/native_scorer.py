@@ -1,9 +1,11 @@
 """ctypes binding for the C++ scorer (cpp/stpu_scorer.cc).
 
 The zero-Python-runtime scoring path: parity with the reference's
-Java→libtensorflow JNI evaluator (TensorflowModel.java:112-172) for the
-plain DNN family.  ``EvalModel(backend="cpp")`` routes here; other model
-families raise at load and callers use the Python scorer.
+Java→libtensorflow JNI evaluator (TensorflowModel.java:112-172) across
+all four exported families (dnn, wide&deep, multi-task, and the
+embedding-augmented wrapper).  ``EvalModel(backend="cpp")`` routes here;
+only the sequence family raises at load (attention serving goes through
+the Python/jitted scorer).
 """
 
 from __future__ import annotations
@@ -30,6 +32,8 @@ def _load():
                 ]
                 lib.stpu_scorer_num_features.restype = ctypes.c_long
                 lib.stpu_scorer_num_features.argtypes = [ctypes.c_void_p]
+                lib.stpu_scorer_num_outputs.restype = ctypes.c_long
+                lib.stpu_scorer_num_outputs.argtypes = [ctypes.c_void_p]
                 lib.stpu_scorer_score.restype = ctypes.c_long
                 lib.stpu_scorer_score.argtypes = [
                     ctypes.c_void_p,
@@ -68,6 +72,9 @@ class NativeScorer:
                 f"native scorer load failed: {err.value.decode()}"
             )
         self.num_features = int(lib.stpu_scorer_num_features(self._handle))
+        # (n, num_outputs) scores: 1 for dnn/wide&deep, NumTasks for the
+        # multi-task family
+        self.num_outputs = int(lib.stpu_scorer_num_outputs(self._handle))
 
     def score(self, rows: np.ndarray) -> np.ndarray:
         rows = np.ascontiguousarray(rows, np.float32)
@@ -76,7 +83,7 @@ class NativeScorer:
                 f"expected (n, {self.num_features}) rows, got {rows.shape}"
             )
         n = rows.shape[0]
-        out = np.empty((n, 1), np.float32)
+        out = np.empty((n, self.num_outputs), np.float32)
         got = self._lib.stpu_scorer_score(
             self._handle,
             rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

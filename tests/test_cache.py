@@ -345,3 +345,28 @@ def test_fp32_worker_defaults_to_bf16_transport():
         model_config=mc, schema=SCHEMA, stream_feature_dtype="float32",
     )
     assert _feature_dtype_for(cfg2) == "float32"
+
+
+def test_bare_cross_hash_size_does_not_block_bf16():
+    """CrossHashSize without WideColumnNums builds a model with NO cross
+    (models/factory.py gates it), so it must not count as feature hashing:
+    auto keeps the compact bf16 transport, and an explicit bfloat16 must
+    not be rejected."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.data.dataset import resolve_stream_feature_dtype
+
+    mc = ModelConfig.from_json({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.1,
+        "CrossHashSize": 32}}})
+    assert not mc.params.uses_feature_hashing
+    assert resolve_stream_feature_dtype(
+        "auto", uses_feature_hashing=mc.params.uses_feature_hashing
+    ) == "bfloat16"
+    # WITH wide columns the cross is real and the gate engages
+    mc2 = ModelConfig.from_json({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.1,
+        "ModelType": "wide_deep", "WideColumnNums": [1],
+        "CrossHashSize": 32}}})
+    assert mc2.params.uses_feature_hashing
