@@ -1,0 +1,198 @@
+"""At-scale end-to-end run: cold ingest → shard-cache build → N epochs →
+KS → export, through the REAL CLI, with one wall-clock artifact.
+
+r04 verdict item 2: the 1B-row north star was extrapolated from stage
+microbenches; the largest measured training run was 200K rows.  This
+composes the whole pipeline at the largest feasible scale (default 20M
+rows of gzip PSV on disk) and records per-phase times — the honest
+cold/warm split (epoch 1 parses gzip + writes the binary shard cache;
+epochs 2+ serve memmap'd slabs), KS from a real signal, and the export.
+
+Dataset: rows carry a logistic signal (KS is meaningful, unlike the
+throughput bench's random labels).  Formatting 20M rows in Python is
+prohibitive, so E2E_DISTINCT rows are formatted once and shards repeat
+the formatted block — repetition is irrelevant to ingest/step throughput
+and the artifact records ``distinct_rows`` so nobody mistakes the KS for
+a 20M-unique-row result.  Replaces: the reference's all-in-RAM loader
+(ssgd_monitor.py:348-454), which cannot run at this scale at all.
+
+Env knobs: E2E_ROWS (2e7), E2E_DISTINCT (1e6), E2E_SHARDS (16),
+E2E_EPOCHS (3), E2E_BATCH (16384), E2E_VALID (0.1), E2E_SCAN_STEPS (0).
+Writes --out (default BENCH_E2E.json) incrementally after every phase.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROWS = int(float(os.environ.get("E2E_ROWS", 20_000_000)))
+DISTINCT = int(float(os.environ.get("E2E_DISTINCT", 1_000_000)))
+SHARDS = int(os.environ.get("E2E_SHARDS", 16))
+EPOCHS = int(os.environ.get("E2E_EPOCHS", 3))
+BATCH = int(os.environ.get("E2E_BATCH", 16384))
+VALID = float(os.environ.get("E2E_VALID", 0.1))
+SCAN_STEPS = int(os.environ.get("E2E_SCAN_STEPS", 0))
+NUM_FEATURES = 30
+
+EPOCH_RE = re.compile(
+    r"epoch (\d+): train_loss=(\S+) valid_loss=(\S+) ks=(\S+) auc=(\S+) "
+    r"epoch_time=(\S+)s valid_time=(\S+)s"
+)
+
+
+def generate_shards(root: str) -> tuple[list[str], float, int]:
+    """Signal-bearing gzip PSV shards; returns (paths, seconds, bytes)."""
+    rng = np.random.default_rng(7)
+    w_true = rng.normal(size=NUM_FEATURES) * 0.7
+    x = rng.normal(size=(DISTINCT, NUM_FEATURES)).astype(np.float32)
+    logits = x @ w_true
+    y = (rng.random(DISTINCT) < 1.0 / (1.0 + np.exp(-logits))).astype(np.int32)
+    t0 = time.perf_counter()
+    # vectorized-ish formatting: join per row, build the block bytes once
+    lines = []
+    for i in range(DISTINCT):
+        lines.append(
+            str(y[i]) + "|" + "|".join(f"{v:.5f}" for v in x[i]) + "|1.0"
+        )
+        if i % 200_000 == 0:
+            print(f"  formatted {i}/{DISTINCT}", file=sys.stderr, flush=True)
+    block = ("\n".join(lines) + "\n").encode()
+    del lines
+    rows_per_shard = ROWS // SHARDS
+    reps = max(1, rows_per_shard // DISTINCT)
+    paths = []
+    total_bytes = 0
+    for s in range(SHARDS):
+        path = os.path.join(root, f"part-{s:05d}.gz")
+        with gzip.open(path, "wb", compresslevel=1) as f:
+            for _ in range(reps):
+                f.write(block)
+        total_bytes += os.path.getsize(path)
+        paths.append(path)
+    return paths, time.perf_counter() - t0, total_bytes
+
+
+def dir_bytes(d: str) -> int:
+    total = 0
+    for name in os.listdir(d):
+        total += os.path.getsize(os.path.join(d, name))
+    return total
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_E2E.json"))
+    args = ap.parse_args()
+
+    result: dict = {
+        "metric": "e2e_pipeline",
+        "rows": ROWS,
+        "distinct_rows": DISTINCT,
+        "shards": SHARDS,
+        "epochs": EPOCHS,
+        "batch": BATCH,
+        "scan_steps": SCAN_STEPS,
+    }
+
+    def flush() -> None:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+    with tempfile.TemporaryDirectory(prefix="stpu-e2e-") as work:
+        data_dir = os.path.join(work, "data")
+        os.makedirs(data_dir)
+        print("generating shards...", file=sys.stderr, flush=True)
+        paths, gen_s, raw_bytes = generate_shards(data_dir)
+        result["generate_s"] = round(gen_s, 1)
+        result["gzip_bytes"] = raw_bytes
+        flush()
+
+        cache_dir = os.path.join(work, "cache")
+        export_dir = os.path.join(work, "export")
+        cmd = [
+            sys.executable, "-m", "shifu_tensorflow_tpu.train",
+            "--training-data-path", data_dir,
+            "--feature-columns", ",".join(str(i) for i in range(1, 31)),
+            "--target-column", "0", "--weight-column", "31",
+            "--stream", "--cache-dir", cache_dir,
+            "--epochs", str(EPOCHS), "--batch-size", str(BATCH),
+            "--valid-rate", str(VALID), "--export-dir", export_dir,
+        ]
+        if SCAN_STEPS > 1:
+            cmd += ["--scan-steps", str(SCAN_STEPS)]
+        env = dict(os.environ)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        print("training (cold)...", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, cwd=work, env=env,
+                                text=True)
+        epochs = []
+        summary = None
+        for line in proc.stdout:
+            line = line.strip()
+            m = EPOCH_RE.match(line)
+            if m:
+                epochs.append({
+                    "epoch": int(m.group(1)),
+                    "train_loss": float(m.group(2)),
+                    "valid_loss": float(m.group(3)),
+                    "ks": float(m.group(4)),
+                    "auc": float(m.group(5)),
+                    "epoch_time_s": float(m.group(6)),
+                    "valid_time_s": float(m.group(7)),
+                    "rows_per_sec": round(
+                        ROWS * (1 - VALID) / float(m.group(6)), 0),
+                })
+                result["epoch_stats"] = epochs
+                print(f"  {line}", file=sys.stderr, flush=True)
+                flush()
+            elif line.startswith("{"):
+                try:
+                    summary = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        proc.wait()
+        train_wall = time.perf_counter() - t0
+        result["train_wall_s"] = round(train_wall, 1)
+        result["cli_rc"] = proc.returncode
+        if summary:
+            result["platform"] = summary.get("platform")
+            result["final_ks"] = summary.get("final_ks")
+            result["final_valid_loss"] = summary.get("final_valid_loss")
+        result["cache_bytes"] = (
+            dir_bytes(cache_dir) if os.path.isdir(cache_dir) else 0)
+        result["exported"] = (
+            sorted(os.listdir(export_dir)) if os.path.isdir(export_dir)
+            else [])
+        # the honest cold/warm split: epoch 1 parses gzip and writes the
+        # cache; later epochs serve memmap'd slabs
+        if len(epochs) >= 2:
+            cold = epochs[0]["epoch_time_s"]
+            warm = float(np.median([e["epoch_time_s"] for e in epochs[1:]]))
+            result["cold_epoch_s"] = round(cold, 2)
+            result["warm_epoch_s"] = round(warm, 2)
+            result["cold_over_warm"] = round(cold / warm, 2)
+            result["warm_rows_per_sec"] = round(ROWS * (1 - VALID) / warm, 0)
+        flush()
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,14 @@ for i in $(seq 1 "$MAX_LOOPS"); do
                 --out "$REPO/BENCH_E2E_TPU.json" >>"$LOG" 2>&1
             echo "$(date +%T) e2e done rc=$?" >>"$LOG"
         fi
+        # 3b. checkpoint at scale (r04 verdict item 8): the 1GB-table
+        #     gather runs device->host THROUGH THE TUNNEL here — the
+        #     round-trip the single-writer design must justify
+        if [ -f scripts/bench_checkpoint.py ]; then
+            CKPT_HASH_SIZE=4194304 timeout 900 \
+                python scripts/bench_checkpoint.py --out "$REPO/BENCH_CHECKPOINT_TPU.json" >>"$LOG" 2>&1
+            echo "$(date +%T) checkpoint done rc=$?" >>"$LOG"
+        fi
         # 4. BASELINE config-matrix families
         timeout 1200 python scripts/bench_models.py \
             --out "$REPO/BENCH_MODELS_TPU.json" >>"$LOG" 2>&1

@@ -235,7 +235,8 @@ def run_worker(cfg: WorkerConfig, *,
         mesh = None
         if spmd:
             topology = dist.ProcessTopology.from_cluster_info(
-                started.get("cluster") or {}, worker_index
+                started.get("cluster") or {}, worker_index,
+                local_host=cfg.host,
             )
             if port_hold is not None:
                 port_hold.release()  # chief: initialize rebinds it NOW

@@ -19,11 +19,14 @@ Design — the standard flash decomposition, Pallas-TPU idioms:
 - causal + padding masks come from ``broadcasted_iota`` positions, so
   arbitrary (non-multiple-of-block) S works via zero-padding.
 
-The backward pass is the chunked XLA path (`parallel.ring.
-chunked_attention`) through ``jax.vjp`` — same O(S·block) memory
-property, exact attention gradients, no second kernel to maintain.
-Parity vs full attention is asserted in tests/test_flash.py (interpret
-mode on CPU, real kernel on TPU).
+The backward pass is a true Pallas FlashAttention-2 backward (new in
+r05; the forward now also emits per-row logsumexp): one kernel
+accumulates dQ over key blocks, a second accumulates dK/dV over query
+blocks, P reconstructed per tile from the saved logsumexp — no S×S
+matrix in either pass.  ``STPU_FLASH_BWD=chunked`` selects the previous
+chunked-XLA-scan gradient for A/B measurement
+(scripts/bench_flash_sweep.py).  Parity vs full attention is asserted
+in tests/test_flash.py (interpret mode on CPU, real kernel on TPU).
 """
 
 from __future__ import annotations
@@ -45,8 +48,8 @@ def _resolve_interpret(interpret: bool | None) -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, s_real: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, scale: float, causal: bool, s_real: int,
                   block_q: int, block_k: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -93,14 +96,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _():
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # logsumexp per query row, for the backward kernels: rows with no
+        # valid key (l == 0, e.g. zero-padding) get +inf so that
+        # exp(S - L) reconstructs P = 0 there instead of NaN
+        lse = jnp.where(l_ref[:] > 0.0,
+                        m_ref[:] + jnp.log(l_ref[:]), jnp.inf)
+        lse_ref[0, :] = lse[:, 0]
 
 
-def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                   interpret: bool | None):
+def _pad_geom(q, block_q: int, block_k: int):
     import math
 
     b, s, h, d = q.shape
-    scale = d ** -0.5
     dp = _round_up(d, 128)
     # pad S to a common multiple of BOTH blocks: rounding to only the
     # larger one truncates the grid for the smaller (sp // block floors),
@@ -108,14 +115,29 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
     sp = _round_up(s, math.lcm(block_q, block_k))
     bq = min(block_q, sp)
     bk = min(block_k, sp)
+    return b, s, h, d, dp, sp, bq, bk
 
-    def prep(x):  # (B, S, H, D) -> (B*H, Sp, Dp), zero-padded
-        x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0), (0, dp - d)))
-        return x.transpose(0, 2, 1, 3).reshape(b * h, sp, dp)
 
-    qp, kp, vp = prep(q), prep(k), prep(v)
+def _prep(x, b, s, h, d, dp, sp):
+    """(B, S, H, D) -> (B*H, Sp, Dp), zero-padded."""
+    x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0), (0, dp - d)))
+    return x.transpose(0, 2, 1, 3).reshape(b * h, sp, dp)
+
+
+def _unprep(xp, b, s, h, d, dp, sp):
+    return xp.reshape(b, h, sp, dp).transpose(0, 2, 1, 3)[:, :s, :, :d]
+
+
+def _flash_forward_with_stats(q, k, v, *, causal: bool, block_q: int,
+                              block_k: int, interpret: bool | None):
+    """Returns (out (B,S,H,D), lse (B*H, Sp) padded-layout logsumexp)."""
+    b, s, h, d, dp, sp, bq, bk = _pad_geom(q, block_q, block_k)
+    scale = d ** -0.5
+    qp = _prep(q, b, s, h, d, dp, sp)
+    kp = _prep(k, b, s, h, d, dp, sp)
+    vp = _prep(v, b, s, h, d, dp, sp)
     grid = (b * h, sp // bq, sp // bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         partial(_flash_kernel, scale=scale, causal=causal, s_real=s,
                 block_q=bq, block_k=bk),
         grid=grid,
@@ -124,8 +146,14 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sp, dp), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sp, dp), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sp), jnp.float32),
+        ],
         scratch_shapes=[
             _vmem((bq, dp)),
             _vmem((bq, 1)),
@@ -133,8 +161,15 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         interpret=_resolve_interpret(interpret),
     )(qp, kp, vp)
-    out = out.reshape(b, h, sp, dp).transpose(0, 2, 1, 3)
-    return out[:, :s, :, :d]
+    return _unprep(out, b, s, h, d, dp, sp), lse
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool | None):
+    out, _ = _flash_forward_with_stats(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    return out
 
 
 def _vmem(shape):
@@ -143,45 +178,208 @@ def _vmem(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
+def _bwd_masks(qi, ki, block_q, block_k, s_real, causal):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = k_pos < s_real
+    if causal:
+        valid = jnp.logical_and(valid, k_pos <= q_pos)
+    return valid
+
+
+def _bwd_p_ds(qf, kf, vf, dof, lse, dvec, valid, scale):
+    """Shared tile math: reconstruct P from the forward's logsumexp, then
+    dS = P * (dP - D).  All f32; (bq, bk) tiles on the MXU."""
+    s = jax.lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    # rows with no valid key carry lse=+inf -> exp(-inf)=0, NaN-free
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        dof, vf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - dvec)
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                         dq_ref, acc_ref, *, scale: float, causal: bool,
+                         s_real: int, block_q: int, block_k: int):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qf = q_ref[0].astype(jnp.float32)
+    kf = k_ref[0].astype(jnp.float32)
+    vf = v_ref[0].astype(jnp.float32)
+    dof = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :][:, None]   # (bq, 1)
+    dvec = d_ref[0, :][:, None]    # (bq, 1)
+    valid = _bwd_masks(pl.program_id(1), ki, block_q, block_k, s_real,
+                       causal)
+    _, ds = _bwd_p_ds(qf, kf, vf, dof, lse, dvec, valid, scale)
+    acc_ref[:] += jax.lax.dot_general(
+        ds, kf, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, d_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, s_real: int, block_q: int,
+                          block_k: int):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    qf = q_ref[0].astype(jnp.float32)
+    kf = k_ref[0].astype(jnp.float32)
+    vf = v_ref[0].astype(jnp.float32)
+    dof = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :][:, None]
+    dvec = d_ref[0, :][:, None]
+    valid = _bwd_masks(qi, pl.program_id(1), block_q, block_k, s_real,
+                       causal)
+    p, ds = _bwd_p_ds(qf, kf, vf, dof, lse, dvec, valid, scale)
+    # dV += P^T @ dO ; dK += dS^T @ Q * scale  (both (bk, dp))
+    dv_acc[:] += jax.lax.dot_general(
+        p, dof, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dk_acc[:] += jax.lax.dot_general(
+        ds, qf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool, block_q: int,
+                    block_k: int, interpret: bool | None):
+    """True Pallas flash backward: P is reconstructed per tile from the
+    forward's logsumexp (no S×S matrix anywhere), dQ accumulates over key
+    blocks, dK/dV over query blocks — the FlashAttention-2 decomposition.
+    """
+    b, s, h, d, dp, sp, bq, bk = _pad_geom(q, block_q, block_k)
+    scale = d ** -0.5
+    qp = _prep(q, b, s, h, d, dp, sp)
+    kp = _prep(k, b, s, h, d, dp, sp)
+    vp = _prep(v, b, s, h, d, dp, sp)
+    dop = _prep(g, b, s, h, d, dp, sp)
+    outp = _prep(out, b, s, h, d, dp, sp)
+    # D_i = sum_d dO_i * O_i — cheap elementwise+reduce, XLA does it well
+    dvec = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32),
+                   axis=-1)  # (BH, Sp)
+    interp = _resolve_interpret(interpret)
+
+    dq = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                s_real=s, block_q=bq, block_k=bk),
+        grid=(b * h, sp // bq, sp // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dp), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sp, dp), q.dtype),
+        scratch_shapes=[_vmem((bq, dp))],
+        interpret=interp,
+    )(qp, kp, vp, dop, lse, dvec)
+
+    dk, dv = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                s_real=s, block_q=bq, block_k=bk),
+        grid=(b * h, sp // bk, sp // bq),
+        in_specs=[
+            pl.BlockSpec((1, bk, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, dp), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, dp), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, dp), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sp, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sp, dp), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, dp)), _vmem((bk, dp))],
+        interpret=interp,
+    )(kp, vp, qp, dop, lse, dvec)
+
+    un = lambda xp: _unprep(xp, b, s, h, d, dp, sp)  # noqa: E731
+    return un(dq), un(dk), un(dv)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128, interpret: bool | None = None):
     """Fused flash attention, shapes (B, S, H, D).
 
     Forward: the Pallas kernel above (interpret mode off-TPU).
-    Backward: exact attention gradients via the chunked XLA path —
-    same no-S×S-materialization property, one kernel to maintain.
+    Backward: the Pallas FlashAttention-2 backward (_flash_backward) —
+    P reconstructed per tile from the forward's saved logsumexp, dQ/dK/dV
+    accumulated blockwise, no S×S matrix in either pass.  Set
+    ``STPU_FLASH_BWD=chunked`` to fall back to the chunked-XLA-scan
+    gradient (the pre-r05 behavior) for A/B measurement
+    (scripts/bench_flash_sweep.py).
     """
     return _flash_forward(q, k, v, causal=causal, block_q=block_q,
                           block_k=block_k, interpret=interpret)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                         block_k=block_k, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward_with_stats(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    from shifu_tensorflow_tpu.parallel.ring import chunked_attention
+    import os
 
-    q, k, v = res
-    # chunked_attention self-adjusts block_size to a divisor of S, so no
-    # fallback here — falling back to S would mean full attention in the
-    # backward, materializing the S×S matrix this kernel exists to avoid.
-    # The block is never SMALLER than 512 — the sweet spot measured in
-    # BENCH_SEQUENCE_TPU.json (and the default callers pass
-    # block_q=block_k=128, which must not shrink the backward chunk) —
-    # but a caller tuning the forward blocks LARGER raises it too.  For
-    # S <= block the chunked path degenerates to one block — i.e. full
-    # attention — which at that scale is the memory-optimal choice.
-    block = max(512, block_q, block_k)
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: chunked_attention(
-            q_, k_, v_, causal=causal, block_size=block),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    if os.environ.get("STPU_FLASH_BWD", "pallas") == "chunked":
+        from shifu_tensorflow_tpu.parallel.ring import chunked_attention
+
+        # chunked fallback: never SMALLER than 512 — the sweet spot
+        # measured in BENCH_SEQUENCE_TPU.json (default callers pass
+        # block_q=block_k=128, which must not shrink the backward chunk)
+        block = max(512, block_q, block_k)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: chunked_attention(
+                q_, k_, v_, causal=causal, block_size=block),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

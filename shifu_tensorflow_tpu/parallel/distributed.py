@@ -34,6 +34,13 @@ class ProcessTopology:
     coordinator_address: str | None = None  # "host:port"; None = single process
     num_processes: int = 1
     process_id: int = 0
+    #: THIS process's routable address (WorkerConfig.host).  When set to a
+    #: non-loopback IP, initialize() makes the collective transport
+    #: advertise it (see _pin_collective_transport) — without the pin,
+    #: CPU-backend Gloo advertises the hostname-resolved address, which
+    #: inside a container / network namespace is 127.0.0.1: every peer
+    #: then dials its OWN loopback and times out.
+    local_host: str | None = None
 
     @property
     def is_distributed(self) -> bool:
@@ -58,7 +65,8 @@ class ProcessTopology:
         )
 
     @classmethod
-    def from_cluster_info(cls, info: dict, worker_index: int
+    def from_cluster_info(cls, info: dict, worker_index: int,
+                          local_host: str | None = None
                           ) -> "ProcessTopology":
         """Derive from the coordinator's cluster info (carried on the
         ``await_start`` reply once every worker has registered): the worker
@@ -74,10 +82,50 @@ class ProcessTopology:
             coordinator_address=f"{host}:{port}" if n > 1 else None,
             num_processes=n,
             process_id=int(worker_index),
+            local_host=local_host,
         )
 
 
 _initialized = False
+
+LOOPBACK_ADDRS = ("127.0.0.1", "localhost", "::1")
+
+
+def _pin_collective_transport(local_host: str | None) -> None:
+    """Make the CPU-backend collective transport (Gloo) advertise this
+    process's ROUTABLE address.  Gloo derives its advertised endpoint from
+    the machine hostname, which inside containers / network namespaces
+    resolves to loopback — every peer then dials its OWN 127.0.0.1 and
+    times out (found by tests/test_netns_spmd.py, the first
+    genuinely-multi-address run of this stack).  jax's xla_bridge builds
+    the Gloo collectives without passing the hostname/interface kwargs the
+    factory accepts, so this wraps the factory to inject ``local_host``.
+    TPU-backend runs are unaffected (TPU collectives ride ICI, not Gloo);
+    if a future jaxlib drops or renames the factory this degrades to a
+    no-op — the pin is an optimization of correctness only for CPU
+    multi-host, which is also where the tests exercise it.
+    """
+    if not local_host or local_host in LOOPBACK_ADDRS:
+        return
+    try:
+        from jaxlib import xla_client as _xc
+
+        orig = _xc._xla.make_gloo_tcp_collectives
+    except Exception:
+        return
+    if getattr(orig, "_stpu_pinned_host", None) is not None:
+        return
+
+    def pinned(*args, hostname=None, **kwargs):
+        # pass-through signature: a future jaxlib adding kwargs must
+        # degrade gracefully, not TypeError inside CPU client creation
+        return orig(*args, hostname=hostname or local_host, **kwargs)
+
+    pinned._stpu_pinned_host = local_host
+    try:
+        _xc._xla.make_gloo_tcp_collectives = pinned
+    except Exception:
+        pass
 
 
 def initialize(topology: ProcessTopology) -> None:
@@ -97,6 +145,7 @@ def initialize(topology: ProcessTopology) -> None:
             f"process_id {topology.process_id} out of range for "
             f"{topology.num_processes} processes"
         )
+    _pin_collective_transport(topology.local_host)
     jax.distributed.initialize(
         coordinator_address=topology.coordinator_address,
         num_processes=topology.num_processes,

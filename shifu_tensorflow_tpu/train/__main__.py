@@ -521,12 +521,17 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             zscale_stds=schema.stds or None,
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
+    import jax as _jax
+
     summary = {
         "state": "finished",
         "epochs_run": len(history),
         "wall_time_s": round(wall, 2),
         "final_valid_loss": history[-1].valid_loss if history else None,
         "final_ks": history[-1].ks if history else None,
+        # which backend actually trained — scripts wrapping the CLI (e.g.
+        # bench_e2e) record it in their artifacts
+        "platform": _jax.devices()[0].platform,
     }
     if trainer.stop_reason:
         summary["stopped_early"] = trainer.stop_reason

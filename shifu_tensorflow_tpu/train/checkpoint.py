@@ -217,9 +217,21 @@ class NpzCheckpointer:
         )
         leaves = jax.tree_util.tree_leaves(tree)
         # the host fetch happens HERE, in the caller's thread: after save()
-        # returns the trainer's next step may donate these device buffers
-        arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
-                  for i, x in enumerate(leaves)}
+        # returns the trainer's next step may donate these device buffers.
+        # On the CPU backend device_get is ZERO-COPY — the numpy array is a
+        # view of the live XLA buffer (verified: owndata=False), so a later
+        # donated step could reuse that memory while the BACKGROUND thread
+        # is still writing it; copy when (and only when) the fetch aliased
+        # AND a background writer exists — the sync path finishes its write
+        # before save() returns, so no step can donate mid-write there.
+        # On TPU the fetch already lands in fresh host memory — no copy.
+        def fetch(x):
+            h = np.asarray(jax.device_get(x))
+            if self._executor is not None and not h.flags["OWNDATA"]:
+                h = h.copy()
+            return h
+
+        arrays = {f"leaf_{i}": fetch(x) for i, x in enumerate(leaves)}
         if self._executor is None:
             self._write(epoch, arrays)
             return

@@ -181,3 +181,64 @@ def test_sequence_model_trains_with_chunked_attention():
     for _ in range(20):
         params, opt, l = step(params, opt)
     assert float(l) < float(l0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [128, 96, 200])  # 96/200: padded S
+def test_pallas_flash_backward_matches_full(causal, s):
+    """The r05 Pallas FlashAttention-2 backward (dQ over key blocks,
+    dK/dV over query blocks, P from saved logsumexp): gradients must
+    match full attention including zero-padded tails."""
+    q, k, v = _qkv(s=s, seed=3)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(
+            full_attention(q, k, v, causal=causal) ** 2), (0, 1, 2)
+    )(q, k, v)
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal) ** 2), (0, 1, 2)
+    )(q, k, v)
+    for w, g in zip(want, got):
+        assert not np.isnan(np.asarray(g)).any()
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_flash_backward_ab_matches_chunked_fallback(monkeypatch):
+    """STPU_FLASH_BWD=chunked is the A/B seam the sweep uses: both
+    gradient paths must agree on the same inputs."""
+    q, k, v = _qkv(s=128, seed=5)
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, True) ** 2), (0, 1, 2)
+        )(q, k, v)
+
+    pallas_g = grads()
+    monkeypatch.setenv("STPU_FLASH_BWD", "chunked")
+    chunked_g = grads()
+    for a, b in zip(pallas_g, chunked_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_flash_backward_bf16():
+    """bf16 inputs: the backward computes f32 internally and casts the
+    grads back; values track the f32 reference at bf16 tolerance."""
+    rng = np.random.default_rng(9)
+    qf, kf, vf = (jnp.asarray(rng.normal(size=(2, 128, 2, 32)),
+                              jnp.float32) for _ in range(3))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True).astype(jnp.float32) ** 2),
+        (0, 1, 2))(q, k, v)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(
+            full_attention(q, k, v, causal=True) ** 2), (0, 1, 2)
+    )(qf, kf, vf)
+    for w, g in zip(want, got):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(w), rtol=0.1, atol=0.1)

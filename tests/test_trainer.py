@@ -899,3 +899,40 @@ def test_bf16_transport_ks_parity_streaming(psv_dataset):
     assert np.isfinite(b16.ks) and np.isfinite(b16.auc)
     assert abs(b16.ks - f32.ks) < 0.05
     assert abs(b16.auc - f32.auc) < 0.03
+
+
+def test_npz_checkpoint_arrays_do_not_alias_device_buffers(tmp_path):
+    """CPU-backend device_get is zero-copy: without an explicit copy the
+    async checkpoint writer would stream a VIEW of the live XLA buffer
+    that the next donated train step may reuse mid-write.  The saved
+    bytes must be a stable snapshot: mutate the state with donated steps
+    after an async save; the restored checkpoint equals the pre-step
+    snapshot."""
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+    from shifu_tensorflow_tpu.train.trainer import make_train_step
+
+    tr = Trainer(_mc(epochs=1), 6, seed=11)
+    rng = np.random.default_rng(0)
+    batch = tr._put({
+        "x": rng.normal(size=(64, 6)).astype(np.float32),
+        "y": (rng.random((64, 1)) < 0.4).astype(np.float32),
+        "w": np.ones((64, 1), np.float32),
+    })
+    snapshot = jax.tree_util.tree_map(
+        lambda l: np.array(l, copy=True), jax.device_get(tr.state.params))
+    step = make_train_step(tr.model.apply, donate=True)
+    with NpzCheckpointer(str(tmp_path), async_save=True) as ck:
+        ck.save(0, tr.state)
+        # donated steps churn the buffers while the write may be in flight
+        for _ in range(10):
+            tr.state, _ = step(tr.state, batch)
+        ck.wait()
+        restored, _next = ck.restore_latest(tr.state)
+    got = jax.device_get(restored.params)
+    for path in (("trunk", "hidden_layer0", "kernel"),
+                 ("shifu_output_0", "kernel")):
+        want = snapshot
+        have = got
+        for k in path:
+            want, have = want[k], have[k]
+        np.testing.assert_array_equal(np.asarray(have), want)
