@@ -55,6 +55,11 @@ class TrainParams:
     embedding_columns: tuple[int, ...] = ()  # high-cardinality hashed cols
     embedding_hash_size: int = 0  # rows per hashed table (0 = disabled)
     embedding_dim: int = 8
+    # "device" (default): table in HBM, sharded over the mesh 'model' axis
+    # (capacity = N x HBM).  "host": table in host RAM with host-side
+    # hashed gather + sparse Adagrad updates (SURVEY §7.2-6's spill tier —
+    # capacity = host memory; per-step training path only).
+    embedding_placement: str = "device"
     # ModelType "sequence": transformer encoder over event sequences.  Each
     # PSV row carries seq_len steps x (features/seq_len) values flattened,
     # so the whole ingest pipeline (schema, cache, streaming) is unchanged.
@@ -125,6 +130,8 @@ class TrainParams:
             embedding_columns=tuple(int(c) for c in params.get("EmbeddingColumnNums", [])),
             embedding_hash_size=int(params.get("EmbeddingHashSize", 0)),
             embedding_dim=int(params.get("EmbeddingDim", 8)),
+            embedding_placement=str(
+                params.get("EmbeddingPlacement", "device")).lower(),
             seq_len=int(params.get("SeqLen", 0)),
             seq_d_model=int(params.get("SeqDModel", 64)),
             seq_heads=int(params.get("SeqHeads", 4)),

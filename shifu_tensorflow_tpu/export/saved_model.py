@@ -243,8 +243,23 @@ def export_model(
     # keep-best (Trainer(keep_best=...)): serve the best validation epoch,
     # not the last — that is what "keep best" promises
     export_params = trainer.state.params
-    if getattr(trainer, "best_params", None) is not None:
+    using_best = getattr(trainer, "best_params", None) is not None
+    if using_best:
         export_params = trainer.best_params
+    if getattr(trainer, "_host_emb", None) is not None:
+        # EmbeddingPlacement=host: serving has no host process, so the
+        # artifact converts to the standard DEVICE-embedding bundle — the
+        # table becomes /hashed_columns/table and the arch (which never
+        # carries the placement key) rebuilds EmbeddingAugmented; hashing
+        # is bit-identical host/device (models/host_embedding.bucket_ids
+        # vs ops/hashing), so scores match across every backend.
+        table = (trainer.best_host_table
+                 if using_best and trainer.best_host_table is not None
+                 else trainer._host_emb.table)
+        export_params = {
+            "hashed_columns": {"table": np.asarray(table)},
+            "base": export_params,
+        }
     export_native_bundle(
         export_dir,
         export_params,
@@ -258,6 +273,10 @@ def export_model(
     # dicts, so mutating a shallow copy would rewrite the live trainer's
     # config (and every future WorkerConfig/re-export built from it)
     raw = copy.deepcopy(trainer.model_config.raw)
+    if getattr(trainer, "_host_emb", None) is not None:
+        # the serving graph embeds on-device (the converted bundle above)
+        raw.setdefault("train", {}).setdefault(
+            "params", {})["EmbeddingPlacement"] = "device"
     if trainer.model_config.params.seq_len > 0:
         # force single-device attention regardless of how training ran,
         # and drop remat (training-only; jax2tf shouldn't trace through

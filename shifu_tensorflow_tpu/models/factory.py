@@ -122,7 +122,8 @@ def build_model(
     else:
         base = ShifuDNN(hidden_nodes=nodes, activations=acts, dtype=dtype)
 
-    if p.embedding_columns and p.embedding_hash_size > 0:
+    if (p.embedding_columns and p.embedding_hash_size > 0
+            and p.embedding_placement != "host"):
         embed_idx = (
             _column_positions(p.embedding_columns, feature_columns)
             if feature_columns
@@ -135,4 +136,8 @@ def build_model(
                 dtype=dtype, shard_table=shard_embeddings,
                 embedding_impl=embedding_impl,
             )
+    # EmbeddingPlacement=host: the gather happens on the HOST (the table
+    # exceeds HBM by assumption — models/host_embedding.py); the Trainer
+    # widens the base model's input with the gathered embeddings, so the
+    # device graph here is just the base net over the augmented features
     return base

@@ -1,0 +1,130 @@
+"""Host-resident hashed embedding table: the capacity tier past HBM.
+
+SURVEY §7.2-6 names three embedding capacity tiers; this is the third:
+
+1. replicated table in HBM (``models/embeddings.py``, small tables);
+2. table sharded over the mesh 'model' axis — capacity = N × HBM;
+3. **host-resident spill** (this module) — the table lives in host RAM
+   (capacity = host memory, typically 10–100× HBM), the device never
+   sees it: per batch the host hashes the category columns, gathers the
+   touched rows, and ships only the ``(B, C, dim)`` slice to the device;
+   the jitted step returns the gradient of that slice, and the host
+   applies a SPARSE Adagrad update to exactly the touched rows.
+
+This is the TPU-honest form of the reference's parameter-server
+heritage: dense tables that cannot fit device memory stay put, and only
+working-set rows cross the link — per step, ``B·C·dim`` floats each way
+instead of the full table.  Adagrad is the standard PS choice for
+sparse embedding updates (per-row adaptive rates; momentumless, so a
+row touched once is updated once); the dense net keeps whatever
+optimizer ``ModelConfig`` configured.
+
+Bucket assignment is BIT-IDENTICAL to the device path: ``bucket_ids``
+reimplements ``ops/hashing.salted_bucket_ids`` in uint32 numpy (parity
+pinned by tests/test_host_embedding.py), so a table trained host-side
+exports into the standard device-embedding bundle and every scorer
+(jitted / C++ / SavedModel) reproduces the lookups exactly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from shifu_tensorflow_tpu.ops.hashing import (
+    COLUMN_SALT,
+    HASH_MULT,
+    HASH_MULT2,
+)
+
+__all__ = ["HostEmbeddingTable", "bucket_ids"]
+
+
+def _mix(bits: np.ndarray) -> np.ndarray:
+    """uint32 finalizer — ops/hashing.mix in numpy (wrapping arithmetic)."""
+    h = (bits * np.uint32(HASH_MULT)).astype(np.uint32)
+    h = h ^ (h >> np.uint32(16))
+    return (h * np.uint32(HASH_MULT2)).astype(np.uint32)
+
+
+def bucket_ids(x: np.ndarray, hash_size: int) -> np.ndarray:
+    """(B, C) float categories -> (B, C) int32 bucket ids; bit-identical
+    to ops/hashing.salted_bucket_ids (column-salted float-bits hash)."""
+    bits = np.ascontiguousarray(x, np.float32).view(np.uint32)
+    cols = (np.arange(x.shape[-1], dtype=np.uint32)
+            * np.uint32(COLUMN_SALT))
+    salted = bits ^ cols  # broadcasts over rows
+    return (_mix(salted) % np.uint32(hash_size)).astype(np.int32)
+
+
+class HostEmbeddingTable:
+    """(hash_size, dim) fp32 table per category column set, host RAM.
+
+    ``lookup`` gathers per-column embeddings; ``apply_grads`` scatter-adds
+    a sparse Adagrad update for the touched rows.  State (table + Adagrad
+    accumulator) saves/loads as one npz for checkpoint sidecars.
+    """
+
+    def __init__(self, hash_size: int, dim: int, *, lr: float,
+                 seed: int = 0, eps: float = 1e-8):
+        if hash_size <= 0 or dim <= 0:
+            raise ValueError(f"bad table shape ({hash_size}, {dim})")
+        rng = np.random.default_rng(seed)
+        # same init family as the device table (normal, stddev 0.05 —
+        # models/embeddings.HashedEmbedding)
+        self.table = (rng.standard_normal((hash_size, dim))
+                      .astype(np.float32) * 0.05)
+        self.accum = np.zeros((hash_size,), np.float32)
+        self.hash_size = hash_size
+        self.dim = dim
+        self.lr = float(lr)
+        self.eps = float(eps)
+
+    @property
+    def nbytes(self) -> int:
+        return self.table.nbytes + self.accum.nbytes
+
+    def lookup(self, x_cols: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(B, C) raw category floats -> ((B, C, dim) embeddings, ids)."""
+        ids = bucket_ids(x_cols, self.hash_size)
+        return self.table[ids], ids
+
+    def apply_grads(self, ids: np.ndarray, grad: np.ndarray) -> None:
+        """Sparse Adagrad: ids (B, C), grad (B, C, dim) — dL/d(gathered).
+
+        Dense-equivalent semantics: duplicate ids within a batch sum
+        their gradients FIRST (what a dense scatter-add gradient on the
+        table would produce), and the per-row Adagrad accumulator sees
+        the squared norm of that SUMMED row gradient — identical to
+        running dense row-Adagrad over the scatter-added gradient, at
+        sparse cost.
+        """
+        flat_ids = ids.reshape(-1)
+        flat_g = grad.reshape(-1, self.dim).astype(np.float32)
+        # scatter-add grads per UNIQUE touched row (never a dense sweep)
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        g_sum = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(g_sum, inv, flat_g)
+        self.accum[uniq] += np.sum(g_sum * g_sum, axis=-1)
+        denom = np.sqrt(self.accum[uniq]) + self.eps
+        self.table[uniq] -= self.lr * g_sum / denom[:, None]
+
+    # ---- persistence (checkpoint sidecar) ----
+    def save(self, path: str) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, table=self.table, accum=self.accum,
+                     lr=np.float32(self.lr))
+        os.replace(tmp, path)  # atomic publish, NpzCheckpointer-style
+
+    def load(self, path: str) -> None:
+        with np.load(path) as z:
+            table = z["table"]
+            accum = z["accum"]
+        if table.shape != self.table.shape:
+            raise ValueError(
+                f"host table shape {table.shape} != configured "
+                f"{self.table.shape}")
+        self.table = table.astype(np.float32)
+        self.accum = accum.astype(np.float32)

@@ -219,6 +219,45 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     return partial(jax.jit, donate_argnums=(0,) if donate else ())(body)
 
 
+def make_host_emb_train_step(apply_fn, raw_width: int,
+                             loss_name: str = "mse", l2: float = 0.0,
+                             donate: bool | None = None):
+    """Train step for host-resident embeddings (EmbeddingPlacement=host):
+    ``batch["x"]`` arrives as ``[raw features | host-gathered embeddings]``
+    and the step ALSO returns dLoss/d(embedding slice) so the host can
+    apply the sparse Adagrad update (models/host_embedding.py).  Same
+    no-op gate for all-padding batches as make_train_step — zero-weight
+    rows produce zero embedding grads, so padded rows update nothing."""
+    if donate is None:
+        donate = donation_is_safe()
+    loss_fn = get_loss(loss_name)
+
+    def compute(params, x, batch):
+        pred = apply_fn({"params": params}, _widen_features(params, x))
+        loss = loss_fn(pred, batch["y"], batch["w"])
+        if l2:
+            loss = loss + l2_penalty(params, l2)
+        return loss
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state: TrainState, batch: Batch):
+        x = batch["x"]
+        loss, (gp, gx) = jax.value_and_grad(compute, argnums=(0, 1))(
+            state.params, x, batch
+        )
+        has_rows = jnp.sum(batch["w"] != 0.0) > 0
+        state = jax.lax.cond(
+            has_rows,
+            lambda s: s.apply_gradients(grads=gp),
+            lambda s: s,
+            state,
+        )
+        g_emb = jnp.where(has_rows, gx[:, raw_width:], 0.0)
+        return state, jnp.where(has_rows, loss, jnp.nan), g_emb
+
+    return step
+
+
 def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
                     donate: bool | None = None):
     """Compiled multi-step run: lax.scan the train-step body over a stacked
@@ -396,6 +435,82 @@ class Trainer:
         # multi-process runs
         self._topology = topology
         self._cross_process = topology is not None and mesh is not None
+        # ---- host-resident embedding spill (EmbeddingPlacement=host) ----
+        # the capacity tier past N x HBM: table in host RAM, per-batch
+        # hashed gather on the host, sparse Adagrad updates from the
+        # step's embedding-slice gradient (models/host_embedding.py)
+        p = model_config.params
+        if p.embedding_placement not in ("device", "host"):
+            raise ValueError(
+                f"unknown EmbeddingPlacement {p.embedding_placement!r} "
+                "(device | host)"
+            )
+        self._host_emb = None
+        self._host_emb_pos: tuple[int, ...] = ()
+        if (p.embedding_placement == "host" and p.embedding_columns
+                and p.embedding_hash_size > 0):
+            if self.scan_steps > 1 or self.accum_steps > 1:
+                raise ValueError(
+                    "EmbeddingPlacement=host runs the per-step path only: "
+                    "the host applies a sparse table update after every "
+                    "step, which a scanned/accumulated dispatch cannot "
+                    "surface — drop scan-steps/accum-steps"
+                )
+            if topology is not None and getattr(
+                    topology, "is_distributed", False):
+                raise ValueError(
+                    "EmbeddingPlacement=host is single-process for now: "
+                    "each process would train a private table copy on its "
+                    "own shard's gradients, silently diverging — use "
+                    "device placement (table sharded over the mesh "
+                    "'model' axis) for multi-process jobs"
+                )
+            if p.algorithm == "sagn":
+                raise ValueError(
+                    "EmbeddingPlacement=host does not compose with "
+                    "Algorithm=sagn (local-SGD windows never surface "
+                    "per-step embedding grads)"
+                )
+            if p.model_type == "sequence":
+                raise ValueError(
+                    "EmbeddingPlacement=host applies to tabular families "
+                    "only")
+            from shifu_tensorflow_tpu.models.factory import _column_positions
+            from shifu_tensorflow_tpu.models.host_embedding import (
+                HostEmbeddingTable,
+            )
+
+            pos = (
+                _column_positions(p.embedding_columns, feature_columns)
+                if feature_columns
+                else tuple(range(len(p.embedding_columns)))
+            )
+            if pos:
+                self._host_emb = HostEmbeddingTable(
+                    p.embedding_hash_size, p.embedding_dim,
+                    lr=p.learning_rate, seed=seed,
+                )
+                self._host_emb_pos = pos
+                if p.l2_reg > 0:
+                    import warnings
+
+                    # dense L2 would touch EVERY table row per step,
+                    # defeating the sparse-update design; device
+                    # placement DOES regularize its table (it lives in
+                    # params) — say so instead of silently diverging
+                    warnings.warn(
+                        "L2Reg applies to the dense net only under "
+                        "EmbeddingPlacement=host: the host table "
+                        "updates sparsely and is exempt (device "
+                        "placement regularizes its table)"
+                    )
+        import collections
+
+        self._emb_ids: "collections.deque" = collections.deque()
+        self._collect_emb_ids = False
+        #: keep-best snapshot of the host table (parallel to best_params)
+        self.best_host_table = None
+
         # shard embedding tables only when a >1 'model' axis exists; the
         # fused Pallas lookup is only eligible single-device — it has no
         # GSPMD partitioning rule, so under a multi-device mesh (even pure
@@ -412,8 +527,16 @@ class Trainer:
         self.loss_name = loss
         self.seed = seed
 
+        # host-embedding runs widen the device model's input with the
+        # gathered embeddings; num_features stays the RAW feature count
+        # (the public/export contract)
+        self._model_input_width = num_features + (
+            len(self._host_emb_pos) * p.embedding_dim
+            if self._host_emb is not None else 0
+        )
         params = self.model.init(
-            jax.random.key(seed), jnp.zeros((1, num_features), dtype)
+            jax.random.key(seed),
+            jnp.zeros((1, self._model_input_width), dtype)
         )["params"]
 
         self.state = TrainState.create(
@@ -460,6 +583,13 @@ class Trainer:
         self._train_step = make_train_step(
             self.model.apply, loss, model_config.params.l2_reg
         )
+        self._host_emb_step = (
+            make_host_emb_train_step(
+                self.model.apply, num_features, loss,
+                model_config.params.l2_reg,
+            )
+            if self._host_emb is not None else None
+        )
         self._eval_step = make_eval_step(self.model.apply, loss)
         # chunked-scan epochs (conf key shifu.tpu.scan-steps, validated
         # at the top of __init__): batches per lax.scan dispatch; 1 = the
@@ -495,7 +625,24 @@ class Trainer:
         self.best_metric = float("inf") if keep_best == "valid_loss" else float("-inf")
 
     # ---- device placement ----
+    def _augment_host_emb(self, batch: Batch) -> Batch:
+        """Host-side gather for EmbeddingPlacement=host: hash the
+        designated columns, gather their table rows, and append the
+        embeddings to the features — only the working set crosses the
+        link.  During a training epoch (``_collect_emb_ids``) the bucket
+        ids queue up FIFO so the epoch loop can pair each step's
+        embedding gradient with its rows; prefetch preserves order."""
+        x = np.asarray(batch["x"], np.float32)
+        emb, ids = self._host_emb.lookup(x[:, list(self._host_emb_pos)])
+        if self._collect_emb_ids:
+            self._emb_ids.append(ids)
+        return {**batch,
+                "x": np.concatenate([x, emb.reshape(x.shape[0], -1)],
+                                    axis=1)}
+
     def _put(self, batch: Batch) -> Batch:
+        if self._host_emb is not None:
+            batch = self._augment_host_emb(batch)
         if self._cross_process:
             from shifu_tensorflow_tpu.parallel.distributed import (
                 put_process_local,
@@ -546,6 +693,8 @@ class Trainer:
     # ---- core loops ----
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count)."""
+        if self._host_emb is not None:
+            return self._train_epoch_host_emb(batches)
         if self._scan_epoch is not None:
             return self._train_epoch_scan(batches)
         if self._accum_step is not None:
@@ -562,6 +711,52 @@ class Trainer:
         vals = np.asarray(jax.device_get(losses))
         # all-padding batches report NaN by contract (make_train_step);
         # exclude them from the epoch mean instead of biasing it
+        real = vals[~np.isnan(vals)]
+        return (
+            float(np.mean(real)) if real.size else float("nan"),
+            len(losses),
+        )
+
+    def _train_epoch_host_emb(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        """Per-step epoch for host-resident embeddings: each step returns
+        the gradient of its gathered-embedding slice; the host pairs it
+        with the FIFO'd bucket ids (queued by _augment_host_emb under
+        prefetch, order-preserving) and applies the sparse Adagrad update
+        before the ids of the NEXT consumed batch are popped.  The
+        device_get per step serializes the pipeline on the gradient
+        fetch — the price of a table the device cannot hold.
+
+        STALENESS CONTRACT: the gather for batch N happens on the
+        prefetch producer thread, overlapping step N-1 — so a batch may
+        read table values at most ONE update old.  Prefetch depth is
+        pinned to 1 here regardless of ``shifu.tpu.prefetch-depth``:
+        deeper lookahead would silently scale that staleness with a knob
+        documented as an infeed setting.  Staleness-1 is strictly tighter
+        than the reference's fully-async PS reads (arbitrary staleness,
+        ssgd_monitor's PS architecture); the device-placement path has
+        none (its gather is inside the differentiated step)."""
+        losses = []
+        self._emb_ids.clear()
+        self._collect_emb_ids = True
+        try:
+            for batch in prefetch_to_device(batches, put=self._put,
+                                            depth=1):
+                self.state, loss, g_emb = self._host_emb_step(
+                    self.state, batch)
+                ids = self._emb_ids.popleft()
+                g = np.asarray(jax.device_get(g_emb))[: ids.shape[0]]
+                self._host_emb.apply_grads(
+                    ids, g.reshape(ids.shape[0], len(self._host_emb_pos),
+                                   self._host_emb.dim))
+                losses.append(loss)
+                if self.step_timer is not None:
+                    self.step_timer.step(loss, rows=ids.shape[0])
+        finally:
+            self._collect_emb_ids = False
+            self._emb_ids.clear()
+        if not losses:
+            return float("nan"), 0
+        vals = np.asarray(jax.device_get(losses))
         real = vals[~np.isnan(vals)]
         return (
             float(np.mean(real)) if real.size else float("nan"),
@@ -707,6 +902,67 @@ class Trainer:
 
     #: best-snapshot persistence filename inside the checkpoint directory
     _BEST_FILE = "keep-best.npz"
+    #: host-embedding sidecar name pattern (checkpoint directory)
+    _HOST_EMB_FILE = "host-emb-{epoch}.npz"
+
+    def _maybe_save_with_sidecar(self, checkpointer, epoch: int) -> bool:
+        """checkpointer.maybe_save plus, for EmbeddingPlacement=host, the
+        table sidecar (table + Adagrad accumulator) published atomically
+        beside the state checkpoint — the table IS model state, and a
+        resume that silently re-initialized it would train a fresh table
+        against converged dense weights."""
+        saved = checkpointer.maybe_save(epoch, self.state)
+        if not saved or self._host_emb is None:
+            return saved
+        import os as _os
+        import re as _re
+
+        directory = checkpointer.directory
+        if "://" in directory:
+            import warnings
+
+            warnings.warn(
+                "EmbeddingPlacement=host checkpoints its table sidecar to "
+                "LOCAL directories only in this version; the table will "
+                f"not persist under {directory}"
+            )
+            return saved
+        self._host_emb.save(_os.path.join(
+            directory, self._HOST_EMB_FILE.format(epoch=epoch)))
+        # prune in lockstep with the checkpointer's own retention — a
+        # sidecar pruned ahead of its state checkpoint would turn a
+        # rollback into the fresh-table failure this method exists to
+        # prevent
+        keep = int(getattr(checkpointer, "max_to_keep", 3))
+        pat = _re.compile(r"host-emb-(\d+)\.npz$")
+        found = sorted(
+            int(m.group(1))
+            for name in _os.listdir(directory)
+            if (m := pat.match(name))
+        )
+        for old in found[: -keep]:
+            try:
+                _os.remove(_os.path.join(
+                    directory, self._HOST_EMB_FILE.format(epoch=old)))
+            except OSError:
+                pass
+        return saved
+
+    def _restore_host_emb(self, directory: str, latest_epoch: int) -> None:
+        import os as _os
+
+        path = _os.path.join(
+            directory, self._HOST_EMB_FILE.format(epoch=latest_epoch))
+        if _os.path.exists(path):
+            self._host_emb.load(path)
+        else:
+            import warnings
+
+            warnings.warn(
+                f"no host-embedding sidecar for epoch {latest_epoch} in "
+                f"{directory}: the table restarts from init while the "
+                "dense net resumes — expect a KS dip until it re-trains"
+            )
 
     def _warn_if_validation_empty(self, stats: EpochStats,
                                   early_stop) -> None:
@@ -756,6 +1012,10 @@ class Trainer:
             self.best_metric = float(m)
             self.best_epoch = stats.current_epoch
             self.best_params = jax.device_get(_unbox_params(self.state.params))
+            if self._host_emb is not None:
+                # the table is model state: a "best" without it would pair
+                # the best dense net with the LAST epoch's embeddings
+                self.best_host_table = self._host_emb.table.copy()
             if checkpointer is not None:
                 self._persist_best(checkpointer.directory)
 
@@ -780,9 +1040,14 @@ class Trainer:
         # stale-temp sweeper's host-aware pid-liveness rules apply to a
         # chief SIGKILLed mid-write here too
         tmp = f"{base}.tmp.{_host_tag()}.{_os.getpid()}"
+        extra = {}
+        if self.best_host_table is not None:
+            # host-embedding best rides along (reserved __ prefix keys are
+            # filtered out of the params unflatten on restore)
+            extra["__host_table__"] = self.best_host_table
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, __meta__=np.frombuffer(meta.encode(), np.uint8),
-                     **_flatten_params(self.best_params))
+                     **extra, **_flatten_params(self.best_params))
         fs.rename(tmp, base)
 
     def _restore_best(self, directory: str) -> None:
@@ -807,7 +1072,12 @@ class Trainer:
             if meta.get("keep_best") != self.keep_best:
                 return
             best_params = _unflatten_params(
-                {k: data[k] for k in data.files if k != "__meta__"}
+                {k: data[k] for k in data.files
+                 if not k.startswith("__")}
+            )
+            best_host_table = (
+                data["__host_table__"] if "__host_table__" in data.files
+                else None
             )
             best_epoch = int(meta["epoch"])
             best_metric = float(meta["metric"])
@@ -826,6 +1096,8 @@ class Trainer:
         self.best_params = best_params
         self.best_epoch = best_epoch
         self.best_metric = best_metric
+        if best_host_table is not None:
+            self.best_host_table = best_host_table
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
         losses, scores, labels, weights = [], [], [], []
@@ -915,7 +1187,7 @@ class Trainer:
             if on_epoch:
                 on_epoch(stats)
             if checkpointer is not None:
-                checkpointer.maybe_save(epoch, self.state)
+                self._maybe_save_with_sidecar(checkpointer, epoch)
             if early_stop is not None:
                 self.stop_reason = early_stop.should_stop(stats)
                 if self.stop_reason:
@@ -951,6 +1223,12 @@ class Trainer:
             raise ValueError(
                 "fit_device_resident is single-controller; multi-process "
                 "SPMD jobs stream per-process shards (fit_stream)"
+            )
+        if self._host_emb is not None:
+            raise ValueError(
+                "EmbeddingPlacement=host contradicts --device-resident: "
+                "the table exceeds device memory by assumption — use the "
+                "streaming or in-memory fit paths"
             )
         if self.accum_steps > 1:
             # silently training per-B updates when the user configured
@@ -1044,7 +1322,7 @@ class Trainer:
             if on_epoch:
                 on_epoch(stats)
             if checkpointer is not None:
-                checkpointer.maybe_save(epoch, self.state)
+                self._maybe_save_with_sidecar(checkpointer, epoch)
             if early_stop is not None:
                 self.stop_reason = early_stop.should_stop(stats)
                 if self.stop_reason:
@@ -1154,7 +1432,7 @@ class Trainer:
             if on_epoch:
                 on_epoch(stats)
             if checkpointer is not None:
-                checkpointer.maybe_save(epoch, self.state)
+                self._maybe_save_with_sidecar(checkpointer, epoch)
             if early_stop is not None:
                 self.stop_reason = early_stop.should_stop(stats)
                 if self.stop_reason:
@@ -1179,6 +1457,9 @@ class Trainer:
         restored, next_epoch = checkpointer.restore_latest(self.state)
         if restored is not None:
             self.state = restored
+            if self._host_emb is not None and "://" not in checkpointer.directory:
+                self._restore_host_emb(checkpointer.directory,
+                                       next_epoch - 1)
         if self.keep_best:
             self._restore_best(checkpointer.directory)
         return next_epoch

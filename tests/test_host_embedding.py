@@ -1,0 +1,224 @@
+"""Host-resident embedding spill (EmbeddingPlacement=host) — the capacity
+tier past HBM (SURVEY §7.2-6): host-side hashed gather, sparse Adagrad,
+bit-identical bucket assignment to the device path, standard-bundle
+export."""
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.models.host_embedding import (
+    HostEmbeddingTable,
+    bucket_ids,
+)
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+
+def _mc(placement="host", epochs=2, **extra):
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": epochs, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05, "Optimizer": "adam",
+                              "EmbeddingColumnNums": [2, 5],
+                              "EmbeddingHashSize": 128,
+                              "EmbeddingDim": 4,
+                              "EmbeddingPlacement": placement,
+                              **extra}}}
+    )
+
+
+def _dataset(psv_dataset):
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    return InMemoryDataset.load(psv_dataset["paths"], schema, 0.2), schema
+
+
+def test_host_hash_parity_with_device():
+    """bucket_ids (numpy) must be BIT-IDENTICAL to ops/hashing
+    salted_bucket_ids (jax) — the whole export story rests on it."""
+    import jax.numpy as jnp
+
+    from shifu_tensorflow_tpu.ops import hashing
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.normal(size=(500, 4)).astype(np.float32) * 1000,
+        rng.integers(0, 10_000_000, size=(500, 4)).astype(np.float32),
+        np.zeros((1, 4), np.float32),
+        -np.ones((1, 4), np.float32),
+    ])
+    for hash_size in (128, 65536, 1_000_003):
+        want = np.asarray(hashing.salted_bucket_ids(
+            jnp.asarray(x), hash_size))
+        got = bucket_ids(x, hash_size)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_adagrad_duplicate_ids_accumulate():
+    """Two occurrences of the same bucket in one batch must behave like
+    their summed gradient (np.add.at semantics), not last-wins."""
+    t = HostEmbeddingTable(8, 2, lr=0.1, seed=0)
+    before = t.table.copy()
+    ids = np.array([[3], [3]], np.int32)
+    g = np.array([[[1.0, 0.0]], [[1.0, 0.0]]], np.float32)
+    t.apply_grads(ids, g)
+    # dense-equivalent: grads SUM first, the accumulator sees the summed
+    # row's squared norm (||g1+g2||^2 = 4), update -lr*2/sqrt(4)
+    assert t.accum[3] == pytest.approx(4.0)
+    expected = before[3, 0] - 0.1 * 2.0 / (np.sqrt(4.0) + t.eps)
+    assert t.table[3, 0] == pytest.approx(expected, rel=1e-6)
+    # untouched rows stay untouched
+    np.testing.assert_array_equal(t.table[:3], before[:3])
+
+
+def test_host_placement_trains_and_moves_table(psv_dataset):
+    ds, schema = _dataset(psv_dataset)
+    tr = Trainer(_mc(), schema.num_features,
+                 feature_columns=schema.feature_columns, seed=1)
+    assert tr._host_emb is not None
+    t0 = tr._host_emb.table.copy()
+    history = tr.fit(ds, batch_size=64)
+    assert len(history) == 2
+    assert np.isfinite(history[-1].training_loss)
+    assert np.isfinite(history[-1].valid_loss)
+    assert 0.0 <= history[-1].auc <= 1.0
+    # the table actually learned (rows moved) and ONLY via sparse updates
+    assert not np.array_equal(tr._host_emb.table, t0)
+    # loss went down across epochs
+    assert history[-1].training_loss <= history[0].training_loss + 1e-3
+
+
+def test_host_placement_export_scores_match_all_backends(
+        psv_dataset, tmp_path):
+    """A host-trained model exports as a standard device-embedding bundle;
+    the jitted scorer and (when built) the C++ scorer reproduce the
+    host-side lookups exactly — end-to-end proof of hash parity."""
+    from shifu_tensorflow_tpu.export.eval_model import EvalModel
+    from shifu_tensorflow_tpu.export.saved_model import export_model
+
+    ds, schema = _dataset(psv_dataset)
+    tr = Trainer(_mc(), schema.num_features,
+                 feature_columns=schema.feature_columns, seed=1)
+    tr.fit(ds, batch_size=64)
+    export_dir = str(tmp_path / "host-model")
+    export_model(export_dir, tr, feature_columns=schema.feature_columns)
+
+    x = ds.valid.features[:96]
+    # reference scores computed through the TRAINING path: host gather +
+    # device base net
+    batch = tr._put({"x": x,
+                     "y": np.zeros((len(x), 1), np.float32),
+                     "w": np.ones((len(x), 1), np.float32)})
+    _, want = tr._eval_step(tr.state.params, batch)
+    want = np.asarray(want)
+
+    with EvalModel(export_dir, backend="native") as em:
+        got = em.compute_batch(x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    from shifu_tensorflow_tpu.export import native_scorer
+
+    if native_scorer.available():
+        with EvalModel(export_dir, backend="cpp") as em:
+            got_cpp = em.compute_batch(x)
+        np.testing.assert_allclose(got_cpp, want, rtol=2e-5, atol=2e-6)
+
+
+def test_host_placement_guards():
+    mc = _mc()
+    with pytest.raises(ValueError, match="per-step path"):
+        Trainer(mc, 10, feature_columns=tuple(range(1, 11)), scan_steps=4)
+    with pytest.raises(ValueError, match="per-step path"):
+        Trainer(mc, 10, feature_columns=tuple(range(1, 11)), accum_steps=4)
+    with pytest.raises(ValueError, match="sagn"):
+        Trainer(_mc(Algorithm="sagn"), 10,
+                feature_columns=tuple(range(1, 11)))
+    with pytest.raises(ValueError, match="unknown EmbeddingPlacement"):
+        Trainer(_mc(placement="hbm"), 10,
+                feature_columns=tuple(range(1, 11)))
+
+    from shifu_tensorflow_tpu.parallel.distributed import ProcessTopology
+
+    with pytest.raises(ValueError, match="single-process"):
+        Trainer(mc, 10, feature_columns=tuple(range(1, 11)),
+                topology=ProcessTopology(
+                    coordinator_address="h:1", num_processes=2,
+                    process_id=0))
+
+
+def test_host_placement_device_resident_refused(psv_dataset):
+    ds, schema = _dataset(psv_dataset)
+    tr = Trainer(_mc(), schema.num_features,
+                 feature_columns=schema.feature_columns)
+    with pytest.raises(ValueError, match="device-resident"):
+        tr.fit_device_resident(ds, batch_size=64)
+
+
+def test_host_table_checkpoint_sidecar_roundtrip(psv_dataset, tmp_path):
+    """The table is model state: maybe_save publishes a sidecar beside
+    the checkpoint, restore() loads it, and the restored trainer's table
+    equals the original's."""
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+    ds, schema = _dataset(psv_dataset)
+    ckpt_dir = str(tmp_path / "ckpt")
+    tr = Trainer(_mc(epochs=2), schema.num_features,
+                 feature_columns=schema.feature_columns, seed=3)
+    with NpzCheckpointer(ckpt_dir) as ck:
+        tr.fit(ds, batch_size=64, checkpointer=ck)
+    import os
+
+    assert any(f.startswith("host-emb-") for f in os.listdir(ckpt_dir))
+
+    tr2 = Trainer(_mc(epochs=2), schema.num_features,
+                  feature_columns=schema.feature_columns, seed=99)
+    with NpzCheckpointer(ckpt_dir) as ck:
+        next_epoch = tr2.restore(ck)
+    assert next_epoch == 2
+    np.testing.assert_array_equal(tr2._host_emb.table, tr._host_emb.table)
+    np.testing.assert_array_equal(tr2._host_emb.accum, tr._host_emb.accum)
+
+
+def test_host_table_keep_best_snapshot(psv_dataset, tmp_path):
+    """keep-best must snapshot the TABLE with the dense params — exporting
+    the best dense net against the last epoch's embeddings would serve a
+    model that never existed."""
+    ds, schema = _dataset(psv_dataset)
+    tr = Trainer(_mc(epochs=3), schema.num_features,
+                 feature_columns=schema.feature_columns, seed=2,
+                 keep_best="ks")
+    tr.fit(ds, batch_size=64)
+    assert tr.best_params is not None
+    assert tr.best_host_table is not None
+    # the snapshot is a COPY, not a live alias of the training table
+    assert tr.best_host_table is not tr._host_emb.table
+
+
+def test_stream_fit_with_host_placement(psv_dataset):
+    """fit_stream composes: augmentation happens in _put, so the
+    streaming path needs no special handling (and the hashing gate keeps
+    the stream transport at f32)."""
+    from shifu_tensorflow_tpu.data.dataset import ShardStream
+
+    _, schema = _dataset(psv_dataset)
+    tr = Trainer(_mc(epochs=2), schema.num_features,
+                 feature_columns=schema.feature_columns, seed=5)
+    history = tr.fit_stream(
+        lambda epoch: ShardStream(
+            psv_dataset["paths"], schema, 64, valid_rate=0.2,
+            emit="train", n_readers=1,
+        ),
+        (lambda: ShardStream(
+            psv_dataset["paths"], schema, 64, valid_rate=0.2,
+            emit="valid", n_readers=1,
+        )),
+        epochs=2,
+    )
+    assert len(history) == 2
+    assert np.isfinite(history[-1].valid_loss)
