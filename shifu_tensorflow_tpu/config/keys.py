@@ -92,10 +92,11 @@ DEFAULT_BATCH_SIZE = 100  # parity with reference BATCH_SIZE (ssgd_monitor.py:33
 DTYPE = TPU_PREFIX + "dtype"
 DEFAULT_DTYPE = "float32"  # tabular nets are tiny; bf16 is opt-in
 # streaming TRANSPORT dtype for features (decoupled from compute dtype):
-# "auto" ships bf16 over the host->device link whenever no column feeds a
-# hash (4.6x the fp32 device_put rate, BENCH_TRANSFER.json) and the jitted
-# step widens back to the params' precision on device; "float32"/"bfloat16"
-# force it
+# "auto" ships bf16 over the host->device link whenever it is SAFE — no
+# column feeds a hash AND ZSCALE normalization stats exist (raw
+# un-normalized magnitudes would lose mantissa silently) — at 4.6x the
+# fp32 device_put rate (BENCH_TRANSFER.json); the jitted step widens back
+# to the params' precision on device; "float32"/"bfloat16" force it
 STREAM_FEATURE_DTYPE = TPU_PREFIX + "stream-feature-dtype"
 DEFAULT_STREAM_FEATURE_DTYPE = "auto"
 PREFETCH_DEPTH = TPU_PREFIX + "prefetch-depth"
@@ -149,6 +150,25 @@ CACHE_DIR = TPU_PREFIX + "cache-dir"
 # (0 = unbounded)
 CACHE_MAX_BYTES = TPU_PREFIX + "cache-max-bytes"
 DEFAULT_CACHE_MAX_BYTES = 0
+
+# ---- transient-fault retry envelope (utils/retry.py) ----
+# The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib
+# network planes (WebHDFS/GCS clients, coordinator RPC, remote checkpoint
+# writes) carry their own classify-retry-with-backoff discipline, tuned
+# here.  retry-max-attempts=1 disables retries (the chaos drill's control
+# arm); retry-deadline caps the wall clock across all attempts of one call
+# so a seam can never outlast the liveness monitor's patience.
+RETRY_MAX_ATTEMPTS = TPU_PREFIX + "retry-max-attempts"
+DEFAULT_RETRY_MAX_ATTEMPTS = 5
+RETRY_BASE_DELAY_MS = TPU_PREFIX + "retry-base-delay"  # ms, backoff base
+DEFAULT_RETRY_BASE_DELAY_MS = 50
+RETRY_MAX_DELAY_MS = TPU_PREFIX + "retry-max-delay"  # ms, per-sleep cap
+DEFAULT_RETRY_MAX_DELAY_MS = 2000
+# ms, cap on a call's CUMULATIVE backoff sleep (the stall retry itself
+# adds) — not on the attempts' own runtime, so long-blocking barrier RPCs
+# keep their reconnect budget
+RETRY_DEADLINE_MS = TPU_PREFIX + "retry-deadline"
+DEFAULT_RETRY_DEADLINE_MS = 60_000
 
 # ---- fault-tolerance envelope (reference: Constants.java:87-89; the ps
 # threshold has no analogue — there is no PS role) ----

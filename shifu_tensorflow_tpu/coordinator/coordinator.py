@@ -30,6 +30,8 @@ import socket
 import socketserver
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any
@@ -38,7 +40,8 @@ from shifu_tensorflow_tpu.config import keys as K
 from shifu_tensorflow_tpu.coordinator.heartbeat import LivenessMonitor
 from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
 from shifu_tensorflow_tpu.train.trainer import EpochStats
-from shifu_tensorflow_tpu.utils import logs
+from shifu_tensorflow_tpu.utils import faults, logs
+from shifu_tensorflow_tpu.utils import retry as retry_util
 
 log = logs.get("coordinator")
 
@@ -180,6 +183,14 @@ class Coordinator:
         )
         self._failed_restarts = 0
         self._server: "_Server | None" = None
+        # at-most-once delivery for retried non-idempotent ops: the client
+        # stamps register/epoch/complete with a per-LOGICAL-call token; a
+        # redelivery (reply lost, transport retried) replays the cached
+        # response instead of re-applying — a retried `complete(exit=1)`
+        # must not burn two restart-budget units, a retried register must
+        # not re-count a worker
+        self._op_cache: OrderedDict[str, dict] = OrderedDict()
+        self.op_replays = 0
 
     # ---- policy ----
     @property
@@ -761,7 +772,33 @@ class Coordinator:
         t.start()
         return self._server.server_address[:2]
 
+    _OP_CACHE_MAX = 4096
+
     def dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
+        """Route one request; replays the cached response for a duplicate
+        delivery token (see _op_cache).  The replay window assumes retries
+        are SERIAL per logical call — the client only re-sends after its
+        previous attempt failed — so two in-flight deliveries of one token
+        cannot race the cache."""
+        token = msg.get("token")
+        if token is not None:
+            with self._lock:
+                cached = self._op_cache.get(token)
+                if cached is not None:
+                    self.op_replays += 1  # under the lock: handler threads
+            if cached is not None:
+                log.info("replaying cached response for duplicate %s "
+                         "delivery (token %s)", msg.get("op"), token)
+                return cached
+        resp = self._dispatch(msg)
+        if token is not None:
+            with self._lock:
+                self._op_cache[token] = resp
+                while len(self._op_cache) > self._OP_CACHE_MAX:
+                    self._op_cache.popitem(last=False)
+        return resp
+
+    def _dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
         op = msg.get("op")
         if op == "register":
             return self.register(
@@ -808,24 +845,54 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class CoordinatorClient:
-    """Worker-side client: one JSON-line request per short connection."""
+    """Worker-side client: one JSON-line request per short connection.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 600.0):
+    Transient transport failures (refused connects during a coordinator
+    restart, resets when the listener sheds connections mid-barrier, lost
+    replies) retry with backoff under ``retry_policy`` — every op is safe
+    to redeliver because the non-idempotent ones (``register``,
+    ``report_epoch``, ``complete``) carry a per-logical-call dedup token
+    the server replays from its response cache.  Barrier ops
+    (``await_start``/``sync_plan``/``epoch_barrier``) reconnect and
+    re-enter their server-side wait; the server's own deadline, measured
+    from job/generation start, still governs.
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 600.0,
+                 retry_policy: "retry_util.RetryPolicy | None" = None):
         self.addr = (host, port)
         self.timeout_s = timeout_s
+        # None = resolve the process default per call (set_default_policy)
+        self._retry_policy = retry_policy
 
     def call(
         self, msg: dict[str, Any], timeout_s: float | str = "default"
     ) -> dict[str, Any]:
         timeout = self.timeout_s if timeout_s == "default" else timeout_s
-        with socket.create_connection(self.addr, timeout=timeout) as s:
-            f = s.makefile("rwb")
-            f.write((json.dumps(msg) + "\n").encode())
-            f.flush()
-            line = f.readline()
-            if not line:
-                raise ConnectionError("coordinator closed connection")
-            return json.loads(line)
+        payload = (json.dumps(msg) + "\n").encode()
+
+        def attempt() -> dict[str, Any]:
+            faults.check("rpc.connect")
+            with socket.create_connection(self.addr, timeout=timeout) as s:
+                f = s.makefile("rwb")
+                f.write(payload)
+                f.flush()
+                # "rpc.recv" models the reply lost AFTER the server applied
+                # the op — the delivery the dedup tokens exist for
+                faults.check("rpc.recv")
+                line = f.readline()
+                if not line:
+                    raise ConnectionError("coordinator closed connection")
+                if not line.endswith(b"\n"):
+                    # torn mid-reply: transport failure, not a protocol error
+                    raise ConnectionError("truncated coordinator reply")
+                return json.loads(line)
+
+        policy = (self._retry_policy if self._retry_policy is not None
+                  else retry_util.default_policy())
+        return retry_util.call(
+            attempt, policy=policy, site=f"rpc.{msg.get('op', '?')}"
+        )
 
     def register(
         self,
@@ -841,6 +908,7 @@ class CoordinatorClient:
                 "worker_index": worker_index,
                 "host": host,
                 "jax_port": jax_port,
+                "token": uuid.uuid4().hex,
             }
         )
 
@@ -862,7 +930,8 @@ class CoordinatorClient:
         return self.call({"op": "heartbeat", "worker_id": worker_id})
 
     def report_epoch(self, stats: EpochStats) -> dict[str, Any]:
-        return self.call({"op": "epoch", "stats": stats.__dict__})
+        return self.call({"op": "epoch", "stats": stats.__dict__,
+                          "token": uuid.uuid4().hex})
 
     def epoch_barrier(self, worker_id: str, epoch: int) -> dict[str, Any]:
         # no socket timeout: the server enforces its own barrier deadline
@@ -873,7 +942,8 @@ class CoordinatorClient:
 
     def complete(self, worker_id: str, exit_code: int = 0) -> dict[str, Any]:
         return self.call(
-            {"op": "complete", "worker_id": worker_id, "exit_code": exit_code}
+            {"op": "complete", "worker_id": worker_id,
+             "exit_code": exit_code, "token": uuid.uuid4().hex}
         )
 
     def request_restart(self, worker_id: str, why: str) -> dict[str, Any]:

@@ -87,8 +87,13 @@ class WorkerConfig:
     # binary shard cache directory (data/cache.py); None = no caching
     cache_dir: str | None = None
     # streaming transport dtype for features (conf key
-    # shifu.tpu.stream-feature-dtype): auto = bf16 unless hashing
+    # shifu.tpu.stream-feature-dtype): auto = bf16 unless hashing or
+    # un-normalized features (no ZSCALE stats)
     stream_feature_dtype: str = "auto"
+    # transient-fault retry envelope (shifu.tpu.retry-* keys) as a
+    # RetryPolicy dict; None keeps the process default.  Carried in the
+    # JSON transport so subprocess workers inherit the submit-side conf.
+    retry: dict | None = None
 
     def to_json(self) -> dict:
         """JSON transport for subprocess workers (worker_main)."""
@@ -104,6 +109,7 @@ class WorkerConfig:
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
                 "scan_steps", "accum_steps", "keep_best",
                 "async_checkpoint", "cache_dir", "stream_feature_dtype",
+                "retry",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -192,6 +198,14 @@ def run_worker(cfg: WorkerConfig, *,
     from shifu_tensorflow_tpu.parallel import distributed as dist
 
     logs.set_worker(cfg.worker_id)
+    if cfg.retry is not None:
+        # subprocess workers inherit the submit-side retry envelope; the
+        # fs backends and checkpointer resolve the default lazily per call
+        from shifu_tensorflow_tpu.utils import retry as retry_util
+
+        retry_util.set_default_policy(
+            retry_util.RetryPolicy.from_dict(cfg.retry)
+        )
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
     # reserve a port for the jax coordination service up front: only the
     # chief's is used, but index assignment happens at registration.  The
@@ -476,14 +490,16 @@ def _np_feature_dtype(cfg):
 
 
 def _feature_dtype_for(cfg) -> str:
-    """Streaming transport dtype — bf16 by default (compact transfer, the
-    jitted step widens on device), float32 when any column feeds a hash;
-    see data/dataset.py resolve_stream_feature_dtype."""
+    """Streaming transport dtype — bf16 when safe (compact transfer, the
+    jitted step widens on device), float32 when any column feeds a hash or
+    the schema carries no ZSCALE stats (raw-magnitude features would lose
+    precision); see data/dataset.py resolve_stream_feature_dtype."""
     from shifu_tensorflow_tpu.data.dataset import resolve_stream_feature_dtype
 
     return resolve_stream_feature_dtype(
         cfg.stream_feature_dtype,
         uses_feature_hashing=cfg.model_config.params.uses_feature_hashing,
+        has_normalization_stats=bool(cfg.schema.means),
     )
 
 

@@ -37,7 +37,8 @@ Batch = dict[str, np.ndarray]  # {"x": (B,F), "y": (B,1), "w": (B,1)}
 
 
 def resolve_stream_feature_dtype(setting: str | None, *,
-                                 uses_feature_hashing: bool) -> str:
+                                 uses_feature_hashing: bool,
+                                 has_normalization_stats: bool = True) -> str:
     """Streaming TRANSPORT dtype for features (conf key
     shifu.tpu.stream-feature-dtype), decoupled from the compute dtype.
 
@@ -47,16 +48,26 @@ def resolve_stream_feature_dtype(setting: str | None, *,
     the params' precision on device (train/trainer.py _widen_features), so
     an fp32 model still computes fp32 — bf16 is transport-only.
 
-    The one unsafe case: models that HASH feature columns (embedding /
-    wide-cross).  Bucket ids are computed from raw float bits; bf16
-    rounding of category codes > 256 would re-bucket them, skewing
-    training against the f32-hashing exported scorer — auto keeps those
-    runs at float32, and an explicit bfloat16 request refuses loudly
-    rather than silently skewing.
+    Two unsafe cases keep ``auto`` at float32:
+
+    - models that HASH feature columns (embedding / wide-cross): bucket
+      ids are computed from raw float bits; bf16 rounding of category
+      codes > 256 would re-bucket them, skewing training against the
+      f32-hashing exported scorer.  An explicit bfloat16 request refuses
+      loudly rather than silently skewing;
+    - no ZSCALE normalization stats (``has_normalization_stats=False``):
+      z-scaled features are O(1) where bf16's 8-bit mantissa is plenty,
+      but RAW features (un-normalized numeric codes, large-magnitude
+      amounts fed densely) lose low-order digits with no warning — the
+      KS-parity evidence behind the bf16 default only covers normalized
+      pipelines.  An explicit ``bfloat16`` still forces it (the operator
+      owns the precision claim); ``auto`` stays conservative.
     """
     s = (setting or "auto").lower()
     if s == "auto":
-        return "float32" if uses_feature_hashing else "bfloat16"
+        if uses_feature_hashing or not has_normalization_stats:
+            return "float32"
+        return "bfloat16"
     if s == "bfloat16" and uses_feature_hashing:
         raise ValueError(
             "shifu.tpu.stream-feature-dtype=bfloat16 is unsafe with "

@@ -348,6 +348,13 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     ``STPU_FLASH_BWD=chunked`` to fall back to the chunked-XLA-scan
     gradient (the pre-r05 behavior) for A/B measurement
     (scripts/bench_flash_sweep.py).
+
+    ``STPU_FLASH_BWD`` is read at TRACE time: when the gradient is taken
+    inside a jitted train step, the chosen branch is baked into the cached
+    jaxpr, so flipping the env var mid-process silently keeps whichever
+    backward was traced first.  To actually switch, start a new process
+    (how bench_flash_sweep.py runs its subprocess-per-case A/B) or clear
+    the jit caches (``jax.clear_caches()``) before the next call.
     """
     return _flash_forward(q, k, v, causal=causal, block_q=block_q,
                           block_k=block_k, interpret=interpret)
@@ -364,6 +371,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     import os
 
     q, k, v, out, lse = res
+    # trace-time read: under jit this branch is frozen into the cached
+    # jaxpr — see the flash_attention docstring for the switching contract
     if os.environ.get("STPU_FLASH_BWD", "pallas") == "chunked":
         from shifu_tensorflow_tpu.parallel.ring import chunked_attention
 

@@ -25,6 +25,9 @@ from typing import Any
 import jax
 
 from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("distributed")
 
 
 @dataclass(frozen=True)
@@ -102,8 +105,11 @@ def _pin_collective_transport(local_host: str | None) -> None:
     factory accepts, so this wraps the factory to inject ``local_host``.
     TPU-backend runs are unaffected (TPU collectives ride ICI, not Gloo);
     if a future jaxlib drops or renames the factory this degrades to a
-    no-op — the pin is an optimization of correctness only for CPU
-    multi-host, which is also where the tests exercise it.
+    no-op — but NOT silently: the caller asked for a non-loopback
+    advertise address, so the degradation is logged loudly.  A jaxlib
+    upgrade that renames the factory would otherwise reintroduce the
+    loopback-advertise hang this pin fixes, with nothing to debug from
+    but a barrier timeout.
     """
     if not local_host or local_host in LOOPBACK_ADDRS:
         return
@@ -111,7 +117,14 @@ def _pin_collective_transport(local_host: str | None) -> None:
         from jaxlib import xla_client as _xc
 
         orig = _xc._xla.make_gloo_tcp_collectives
-    except Exception:
+    except Exception as e:
+        log.warning(
+            "cannot pin the Gloo collective transport to %s (%s: %s); on a "
+            "CPU multi-host run whose hostname resolves to loopback, peers "
+            "will dial their own 127.0.0.1 and hang to a barrier timeout — "
+            "a jaxlib change likely moved make_gloo_tcp_collectives",
+            local_host, type(e).__name__, e,
+        )
         return
     if getattr(orig, "_stpu_pinned_host", None) is not None:
         return
@@ -124,8 +137,12 @@ def _pin_collective_transport(local_host: str | None) -> None:
     pinned._stpu_pinned_host = local_host
     try:
         _xc._xla.make_gloo_tcp_collectives = pinned
-    except Exception:
-        pass
+    except Exception as e:
+        log.warning(
+            "cannot install the Gloo transport pin for %s (%s: %s); CPU "
+            "multi-host collectives may advertise loopback and hang",
+            local_host, type(e).__name__, e,
+        )
 
 
 def initialize(topology: ProcessTopology) -> None:

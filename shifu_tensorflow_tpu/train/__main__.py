@@ -26,6 +26,7 @@ from shifu_tensorflow_tpu.config import keys as K
 from shifu_tensorflow_tpu.config.conf import Conf
 from shifu_tensorflow_tpu.config.model_config import ColumnConfig, ModelConfig
 from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.utils import retry as _retry_util
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -235,6 +236,9 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         "cache_dir": conf.get(K.CACHE_DIR),
         "stream_feature_dtype": conf.get(K.STREAM_FEATURE_DTYPE,
                                          K.DEFAULT_STREAM_FEATURE_DTYPE),
+        # subprocess workers inherit the submit-side retry envelope
+        # (shifu.tpu.retry-*) through the WorkerConfig JSON bridge
+        "retry": _retry_util.policy_from_conf(conf).to_dict(),
     }
 
 
@@ -463,6 +467,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                              K.DEFAULT_STREAM_FEATURE_DTYPE),
                     uses_feature_hashing=(
                         model_config.params.uses_feature_hashing),
+                    has_normalization_stats=bool(schema.means),
                 )
                 history = trainer.fit_stream(
                     lambda epoch: ShardStream(
@@ -730,6 +735,10 @@ def main(argv: list[str] | None = None) -> int:
 
     honor_cpu_pin()
     conf = load_conf(args)
+    # install the conf-resolved retry envelope as the process default so
+    # the fs backends / RPC client / checkpointer (which auto-construct
+    # with no conf in scope) all honor shifu.tpu.retry-* keys
+    _retry_util.set_default_policy(_retry_util.policy_from_conf(conf))
     if not conf.get(K.TRAINING_DATA_PATH):
         print("--training-data-path (or a globalconfig providing "
               f"{K.TRAINING_DATA_PATH}) is required", file=sys.stderr)

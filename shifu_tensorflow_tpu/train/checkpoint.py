@@ -22,7 +22,7 @@ import jax
 import orbax.checkpoint as ocp
 from flax.core import meta as flax_meta
 
-from shifu_tensorflow_tpu.utils import fs
+from shifu_tensorflow_tpu.utils import faults, fs
 
 
 def _host_tag() -> str:
@@ -250,14 +250,25 @@ class NpzCheckpointer:
         # meaningless for a writer on another host — the sweeper only
         # pid-checks temps stamped with its own hostname
         tmp = self._path(epoch) + f".tmp.{_host_tag()}.{os.getpid()}"
+        faults.check("ckpt.write")
+        # the tmp upload is idempotent (whole-file PUT under a name only
+        # this process writes) — transient failures retry inside the fs
+        # backends (utils/retry.py); only the rename COMMIT below needs
+        # at-most-once care
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, **arrays)
-        fs.rename(tmp, self._path(epoch))  # atomic publish (local/hdfs)
+        self._commit_rename(tmp, self._path(epoch))
         for old in self._epochs()[: -self.max_to_keep]:
             try:
                 fs.delete(self._path(old))
             except OSError:
                 pass
+
+    @staticmethod
+    def _commit_rename(tmp: str, final: str) -> None:
+        """The verified rename-commit (at-most-once EFFECT, never blindly
+        re-issued) — see fs.commit_rename for the protocol."""
+        fs.commit_rename(tmp, final)
 
     def _reap_pending(self, block: bool) -> None:
         """Collect finished background writes; re-raise the first failure

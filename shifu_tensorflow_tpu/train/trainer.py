@@ -726,15 +726,22 @@ class Trainer:
         device_get per step serializes the pipeline on the gradient
         fetch — the price of a table the device cannot hold.
 
-        STALENESS CONTRACT: the gather for batch N happens on the
-        prefetch producer thread, overlapping step N-1 — so a batch may
-        read table values at most ONE update old.  Prefetch depth is
-        pinned to 1 here regardless of ``shifu.tpu.prefetch-depth``:
-        deeper lookahead would silently scale that staleness with a knob
-        documented as an infeed setting.  Staleness-1 is strictly tighter
-        than the reference's fully-async PS reads (arbitrary staleness,
-        ssgd_monitor's PS architecture); the device-placement path has
-        none (its gather is inside the differentiated step)."""
+        STALENESS CONTRACT: ZERO.  ``prefetch_to_device`` is an
+        unthreaded generator (data/dataset.py) — there is no producer
+        thread — so at depth 1 the gather for batch N runs strictly
+        AFTER step N-1's gradient fetch and table update complete in
+        this same thread.  Every batch reads fully-updated table values;
+        the price is that gather and step never overlap (no infeed
+        pipelining on this path).  Prefetch depth is pinned to 1 here
+        regardless of ``shifu.tpu.prefetch-depth``: a deeper (or ever
+        threaded) lookahead would introduce staleness scaled by a knob
+        documented as an infeed setting — any future move of the gather
+        onto a real producer thread must bring a synchronization story
+        for the numpy table it would then share with ``apply_grads``.
+        Zero staleness is strictly tighter than the reference's
+        fully-async PS reads (arbitrary staleness, ssgd_monitor's PS
+        architecture); the device-placement path also has none (its
+        gather is inside the differentiated step)."""
         losses = []
         self._emb_ids.clear()
         self._collect_emb_ids = True
@@ -1048,7 +1055,9 @@ class Trainer:
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
             np.savez(f, __meta__=np.frombuffer(meta.encode(), np.uint8),
                      **extra, **_flatten_params(self.best_params))
-        fs.rename(tmp, base)
+        # verified commit, never blindly re-issued: a lost response after a
+        # remote rename applied must read as success (fs.commit_rename)
+        fs.commit_rename(tmp, base)
 
     def _restore_best(self, directory: str) -> None:
         """Load a persisted best snapshot (resume path).  Ignored when

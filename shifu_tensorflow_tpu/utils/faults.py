@@ -1,0 +1,180 @@
+"""Deterministic fault injection — the chaos half of the resilience layer.
+
+Parity surface: the reference's only fault tooling was a commented-out
+"kill the PS after 80 seconds" hack (CommonUtils.java:265-273); this
+framework already grew two purpose-built hooks — ``run_worker``'s
+``fail_at_epoch`` and the submitter's kill-at-epoch injection keyed on
+``Coordinator.last_reported_epochs()`` — which prove PROCESS-death
+recovery.  This module generalizes that into a seam-level chaos facility
+for TRANSIENT faults: the network errors (503s, connection resets,
+timeouts) that must be absorbed by utils/retry.py rather than escalated
+to a fleet restart.
+
+Activation: ``$STPU_FAULT_PLAN`` (or ``set_plan`` programmatically), e.g.::
+
+    STPU_FAULT_PLAN="fs.read:503@0.2,rpc:reset@0.1" STPU_FAULT_SEED=7 ...
+
+Grammar: comma-separated ``site:kind@rate`` terms.  ``site`` matches a
+check-point exactly or as a dot-prefix ("rpc" fires at "rpc.connect" and
+"rpc.recv"; "fs" at "fs.read"/"fs.write").  ``kind`` is an HTTP status
+(``503``, ``429``...) raised as :class:`InjectedHttpError`, or one of
+``reset`` / ``refused`` / ``timeout`` mapped to the stdlib exception the
+real failure would raise.  ``rate`` is the per-check fire probability.
+
+Determinism: each term owns a :class:`random.Random` seeded from
+``(seed, site, kind)``, so a fixed seed plus a fixed sequence of checks
+fires the SAME faults every run — a failing chaos drill replays exactly.
+
+Instrumented seams (each consults :func:`check` before the real I/O):
+
+==============  ============================================================
+site            where
+==============  ============================================================
+``fs.read``     WebHDFS / GCS GET requests (metadata + data)
+``fs.write``    WebHDFS / GCS mutating requests (PUT/POST/DELETE)
+``rpc.connect`` CoordinatorClient before dialing the coordinator
+``rpc.recv``    CoordinatorClient after the request is written, before the
+                reply is read — models "op applied server-side, response
+                lost", the case the dedup tokens exist for
+``ckpt.write``  NpzCheckpointer, once per checkpoint tmp-file write
+==============  ============================================================
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("faults")
+
+_ENV_PLAN = "STPU_FAULT_PLAN"
+_ENV_SEED = "STPU_FAULT_SEED"
+
+
+class InjectedHttpError(OSError):
+    """Synthetic HTTP-status failure; ``code`` drives the retry classifier
+    exactly like WebHdfsError/GcsError."""
+
+    def __init__(self, code: int, site: str):
+        super().__init__(f"injected fault: HTTP {code} at {site}")
+        self.code = code
+
+
+_KINDS = {
+    "reset": lambda site: ConnectionResetError(
+        f"injected fault: connection reset at {site}"),
+    "refused": lambda site: ConnectionRefusedError(
+        f"injected fault: connection refused at {site}"),
+    "timeout": lambda site: TimeoutError(
+        f"injected fault: timeout at {site}"),
+}
+
+
+class _Term:
+    def __init__(self, site: str, kind: str, rate: float, seed: int):
+        self.site = site
+        self.kind = kind
+        self.rate = rate
+        # per-term RNG: adding/removing one term never reshuffles another's
+        # fire pattern, so drills compose
+        self._rng = random.Random(f"{seed}:{site}:{kind}")
+        self.fired = 0
+
+    def matches(self, site: str) -> bool:
+        return site == self.site or site.startswith(self.site + ".")
+
+    def roll(self, site: str) -> BaseException | None:
+        if self._rng.random() >= self.rate:
+            return None
+        self.fired += 1
+        if self.kind.isdigit():
+            return InjectedHttpError(int(self.kind), site)
+        return _KINDS[self.kind](site)
+
+
+class FaultPlan:
+    """Parsed plan; thread-safe (the RPC and checkpoint seams check from
+    worker threads)."""
+
+    def __init__(self, terms: list[_Term]):
+        self._terms = terms
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        terms: list[_Term] = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                head, rate_s = raw.rsplit("@", 1)
+                site, kind = head.rsplit(":", 1)
+                rate = float(rate_s)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad fault term {raw!r} (want site:kind@rate)") from e
+            if not kind.isdigit() and kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {raw!r} "
+                    f"(HTTP status | {' | '.join(sorted(_KINDS))})")
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"fault rate out of [0,1] in {raw!r}")
+            terms.append(_Term(site.strip(), kind, rate, seed))
+        return cls(terms)
+
+    def check(self, site: str) -> None:
+        """Raise the planned fault for ``site`` if a matching term fires."""
+        with self._lock:
+            for term in self._terms:
+                if term.matches(site):
+                    exc = term.roll(site)
+                    if exc is not None:
+                        log.info("injecting %s at %s (term %s:%s@%g, "
+                                 "fire #%d)", type(exc).__name__, site,
+                                 term.site, term.kind, term.rate, term.fired)
+                        raise exc
+
+    def fired(self) -> dict[str, int]:
+        """``"site:kind" -> fire count`` — drills assert faults actually
+        landed (a drill that injected nothing proves nothing)."""
+        with self._lock:
+            return {f"{t.site}:{t.kind}": t.fired for t in self._terms}
+
+
+_active: FaultPlan | None = None
+_loaded_env = False
+_state_lock = threading.Lock()
+
+
+def set_plan(plan: FaultPlan | None) -> None:
+    """Install (or clear) the process fault plan; overrides the env."""
+    global _active, _loaded_env
+    with _state_lock:
+        _active = plan
+        _loaded_env = True
+
+
+def active() -> FaultPlan | None:
+    global _active, _loaded_env
+    with _state_lock:
+        if not _loaded_env:
+            _loaded_env = True
+            spec = os.environ.get(_ENV_PLAN)
+            if spec:
+                _active = FaultPlan.parse(
+                    spec, seed=int(os.environ.get(_ENV_SEED, "0")))
+                log.warning("fault plan active from $%s: %r", _ENV_PLAN, spec)
+        return _active
+
+
+def check(site: str) -> None:
+    """Seam entry point: no-op unless a plan is active and a term fires.
+    Placed INSIDE the retried callable at every seam, so each re-attempt
+    re-rolls — exactly how a real flaky dependency behaves."""
+    plan = active()
+    if plan is not None:
+        plan.check(site)

@@ -325,6 +325,49 @@ def rename(src: str, dst: str) -> None:
     filesystem_for(src).rename(strip_local(src), strip_local(dst))
 
 
+def commit_rename(tmp: str, final: str, attempts: int = 3) -> None:
+    """Atomic publish (tmp → final) with at-most-once-EFFECT semantics.
+
+    The rename is a NON-idempotent commit: its first delivery may apply
+    remotely even when the response is lost, so the remote backends
+    deliberately never transport-retry it (fs_webhdfs.rename issues
+    RENAME exactly once per call).  Recovery here is by VERIFICATION
+    instead of blind re-issue: after a failure, destination present +
+    temp gone means the commit actually landed (lost response) —
+    success; temp present + destination absent means it provably did
+    NOT apply, and only then is a re-issue safe.  Anything ambiguous
+    propagates the original error.  Callers publishing via tmp+rename
+    (checkpoints, keep-best snapshots) must use this, not ``rename``.
+    """
+    from shifu_tensorflow_tpu.utils import logs
+
+    log = logs.get("fs")
+    for i in range(attempts):
+        try:
+            rename(tmp, final)
+            return
+        except OSError as e:
+            try:
+                final_there = exists(final)
+                tmp_there = exists(tmp)
+            except OSError:
+                raise e  # can't verify: surface the commit error
+            if final_there and not tmp_there:
+                log.warning(
+                    "commit %s: rename reported %s but the destination "
+                    "exists and the temp is gone — commit landed, response "
+                    "was lost", final, e,
+                )
+                return
+            if tmp_there and not final_there and i + 1 < attempts:
+                log.warning(
+                    "commit %s: rename failed (%s) and verifiably did not "
+                    "apply; re-issuing (%d/%d)", final, e, i + 2, attempts,
+                )
+                continue
+            raise
+
+
 def strip_local(path: str) -> str:
     """file:///x -> /x; other schemes keep the full path for their handler."""
     if path.startswith("file://"):

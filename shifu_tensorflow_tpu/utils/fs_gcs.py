@@ -12,6 +12,17 @@ GCS, not HDFS).  Speaks the GCS JSON API with stdlib urllib:
 Endpoint override for tests/emulators: $STPU_GCS_ENDPOINT (e.g. a local
 fake server).  Auth: Bearer token from $STPU_GCS_TOKEN when set (from
 metadata-service or gcloud outside this module); anonymous otherwise.
+
+Resilience (utils/retry.py): every request classifies-and-retries with
+backoff — GCS throttles with 429 and sheds with 503, both retried;
+4xx (auth, not-found) propagate so ``exists``'s "ONLY not-found means
+absent" contract holds.  Reads are RESUMABLE: a connection dropped
+mid-body re-issues the media GET with ``Range: bytes=<received>-``
+instead of restarting the object.  Mutating ops here are idempotent
+(media upload replaces the whole object; rewriteTo re-copies; DELETE of
+an already-deleted object reads 404 and is absorbed inside ``rename``'s
+cleanup half only).  Fault-injection points (utils/faults.py) sit inside
+the retried callables at sites ``fs.read``/``fs.write``.
 """
 
 from __future__ import annotations
@@ -23,6 +34,7 @@ import urllib.parse
 import urllib.request
 from typing import BinaryIO
 
+from shifu_tensorflow_tpu.utils import faults, retry
 from shifu_tensorflow_tpu.utils.fs import FileSystem, UploadOnClose
 
 _DEFAULT_ENDPOINT = "https://storage.googleapis.com"
@@ -43,21 +55,31 @@ def _split(path: str) -> tuple[str, str]:
 
 
 class GcsFileSystem(FileSystem):
-    def __init__(self, endpoint: str | None = None, timeout_s: float = 60.0):
+    def __init__(self, endpoint: str | None = None, timeout_s: float = 60.0,
+                 retry_policy: "retry.RetryPolicy | None" = None):
         self.endpoint = (
             endpoint
             or os.environ.get("STPU_GCS_ENDPOINT")
             or _DEFAULT_ENDPOINT
         ).rstrip("/")
         self.timeout_s = timeout_s
+        # None = resolve the process default PER CALL (see fs_webhdfs)
+        self._retry_policy = retry_policy
+
+    def _policy(self) -> "retry.RetryPolicy":
+        return (self._retry_policy if self._retry_policy is not None
+                else retry.default_policy())
 
     # ---- REST plumbing ----
-    def _request(self, url: str, method: str = "GET",
-                 data: bytes | None = None):
+    def _open_raw(self, url: str, method: str, data: bytes | None,
+                  headers: dict | None, site: str):
+        faults.check(site)
         req = urllib.request.Request(url, method=method, data=data)
         token = os.environ.get("STPU_GCS_TOKEN")
         if token:
             req.add_header("Authorization", f"Bearer {token}")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         try:
             return urllib.request.urlopen(req, timeout=self.timeout_s)
         except urllib.error.HTTPError as e:
@@ -65,6 +87,14 @@ class GcsFileSystem(FileSystem):
                            code=e.code) from e
         except urllib.error.URLError as e:
             raise GcsError(f"gcs {method} {url}: {e.reason}") from e
+
+    def _request(self, url: str, method: str = "GET",
+                 data: bytes | None = None, headers: dict | None = None):
+        site = "fs.read" if method == "GET" else "fs.write"
+        return retry.call(
+            lambda: self._open_raw(url, method, data, headers, site),
+            policy=self._policy(), site=f"gcs.{site}",
+        )
 
     def _obj_url(self, path: str, **params) -> str:
         bucket, obj = _split(path)
@@ -76,9 +106,22 @@ class GcsFileSystem(FileSystem):
             url += "?" + urllib.parse.urlencode(params)
         return url
 
+    def _json_request(self, url: str, method: str = "GET",
+                      data: bytes | None = None) -> dict:
+        site = "fs.read" if method == "GET" else "fs.write"
+
+        def attempt() -> dict:
+            # body read inside the retried callable: a truncated response
+            # (IncompleteRead) re-attempts the op instead of escaping
+            with self._open_raw(url, method, data, None, site) as r:
+                body = r.read()
+            return json.loads(body) if body else {}
+
+        return retry.call(attempt, policy=self._policy(),
+                          site=f"gcs.{site}")
+
     def _meta(self, path: str) -> dict:
-        with self._request(self._obj_url(path)) as r:
-            return json.loads(r.read())
+        return self._json_request(self._obj_url(path))
 
     def _upload(self, path: str, data: bytes) -> None:
         bucket, obj = _split(path)
@@ -92,8 +135,25 @@ class GcsFileSystem(FileSystem):
 
     # ---- FileSystem surface ----
     def open_read(self, path: str) -> BinaryIO:
-        return self._request(  # type: ignore[return-value]
-            self._obj_url(path, **{"alt": "media"})
+        url = self._obj_url(path, **{"alt": "media"})
+
+        def reopen(offset: int):
+            if not offset:
+                return self._request(url)
+            resp = self._request(url, headers={"Range": f"bytes={offset}-"})
+            # a server that ignores Range answers 200 with the full body;
+            # skip the already-received prefix rather than duplicating it
+            if getattr(resp, "status", 206) == 200:
+                remaining = offset
+                while remaining > 0:
+                    chunk = resp.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+            return resp
+
+        return retry.ResumableReader(  # type: ignore[return-value]
+            reopen, policy=self._policy(), site="gcs.fs.read"
         )
 
     def open_write(self, path: str) -> BinaryIO:
@@ -144,8 +204,7 @@ class GcsFileSystem(FileSystem):
                 f"{urllib.parse.quote(bucket)}/o?"
                 + urllib.parse.urlencode(params)
             )
-            with self._request(url) as r:
-                listing = json.loads(r.read())
+            listing = self._json_request(url)
             out.extend(
                 f"gs://{bucket}/{item['name']}"
                 for item in listing.get("items", [])
@@ -161,7 +220,11 @@ class GcsFileSystem(FileSystem):
     def rename(self, src: str, dst: str) -> None:
         """Copy-then-delete — GCS has no atomic rename.  Callers needing
         atomic publish (the shard cache) write locally; checkpoints rely on
-        the whole-object atomicity of the final upload instead."""
+        the whole-object atomicity of the final upload instead.  Both
+        halves tolerate duplicate delivery: rewriteTo re-copies the same
+        source bytes, and a cleanup DELETE whose first delivery already
+        landed reads 404 — absorbed here, because the rename DID complete
+        (dst exists, src gone)."""
         bucket_s, obj_s = _split(src)
         bucket_d, obj_d = _split(dst)
         url = (
@@ -178,15 +241,18 @@ class GcsFileSystem(FileSystem):
             u = url
             if token:
                 u += "?" + urllib.parse.urlencode({"rewriteToken": token})
-            with self._request(u, "POST", data=b"") as r:
-                body = json.loads(r.read() or b"{}")
+            body = self._json_request(u, "POST", data=b"")
             if body.get("done", True):
                 break
             token = body.get("rewriteToken")
             if not token:
                 raise GcsError(f"gcs rewrite {src} -> {dst}: not done and "
                                f"no rewriteToken")
-        self.delete(src)
+        try:
+            self.delete(src)
+        except GcsError as e:
+            if e.code != 404:
+                raise
 
     def listdir(self, path: str) -> list[str]:
         bucket, prefix = _split(path)

@@ -12,6 +12,19 @@ Path convention: ``hdfs://<host>:<port>/path`` — host:port is the namenode
 **HTTP** (WebHDFS) endpoint, e.g. the 9870/50070 port, not the 8020 RPC
 port the Java client uses.  ``webhdfs://`` is accepted as an alias.
 Optional ``user.name`` for simple auth comes from $STPU_HDFS_USER.
+
+Resilience (utils/retry.py): every request classifies-and-retries with
+backoff — transport failures and 5xx/429 re-attempt, 4xx propagate, so the
+"ONLY not-found means absent" contract in ``exists`` is preserved (a 404
+is never masked by a retry, and never retried into a timeout).  Reads are
+RESUMABLE: a connection dropped mid-body re-issues ``OPEN`` with
+``offset=<bytes already received>`` instead of restarting a multi-GB
+shard.  The ONE exception is ``RENAME`` — a non-idempotent commit (its
+first delivery may have applied even when the response was lost), so it is
+issued exactly once here and recovery is by VERIFICATION at the caller
+(train/checkpoint.py commits via rename and re-checks the destination
+rather than ever re-issuing).  Fault-injection points (utils/faults.py)
+sit inside the retried callables at sites ``fs.read``/``fs.write``.
 """
 
 from __future__ import annotations
@@ -23,6 +36,7 @@ import urllib.parse
 import urllib.request
 from typing import BinaryIO
 
+from shifu_tensorflow_tpu.utils import faults, retry
 from shifu_tensorflow_tpu.utils.fs import FileSystem, UploadOnClose
 
 
@@ -41,9 +55,17 @@ def _split(path: str) -> tuple[str, str]:
 
 
 class WebHdfsFileSystem(FileSystem):
-    def __init__(self, timeout_s: float = 60.0, user: str | None = None):
+    def __init__(self, timeout_s: float = 60.0, user: str | None = None,
+                 retry_policy: "retry.RetryPolicy | None" = None):
         self.timeout_s = timeout_s
         self.user = user if user is not None else os.environ.get("STPU_HDFS_USER")
+        # None = resolve the process default PER CALL, so a policy the CLI
+        # installs after this backend auto-registered still applies
+        self._retry_policy = retry_policy
+
+    def _policy(self) -> "retry.RetryPolicy":
+        return (self._retry_policy if self._retry_policy is not None
+                else retry.default_policy())
 
     # ---- REST plumbing ----
     def _url(self, path: str, op: str, **params) -> str:
@@ -56,8 +78,11 @@ class WebHdfsFileSystem(FileSystem):
             f"?{urllib.parse.urlencode(q)}"
         )
 
-    def _request(self, url: str, method: str = "GET",
-                 data: bytes | None = None):
+    def _open_raw(self, url: str, method: str, data: bytes | None,
+                  site: str):
+        """One un-retried request attempt; faults + error wrapping live
+        HERE so every retry re-rolls the injection and re-classifies."""
+        faults.check(site)
         req = urllib.request.Request(url, method=method, data=data)
         try:
             return urllib.request.urlopen(req, timeout=self.timeout_s)
@@ -72,10 +97,33 @@ class WebHdfsFileSystem(FileSystem):
         except urllib.error.URLError as e:
             raise WebHdfsError(f"webhdfs {method} {url}: {e.reason}") from e
 
-    def _json(self, path: str, op: str, method: str = "GET", **params) -> dict:
-        with self._request(self._url(path, op, **params), method) as r:
-            body = r.read()
-        return json.loads(body) if body else {}
+    def _request(self, url: str, method: str = "GET",
+                 data: bytes | None = None, retryable: bool = True):
+        site = "fs.read" if method == "GET" else "fs.write"
+        if not retryable:
+            return self._open_raw(url, method, data, site)
+        return retry.call(
+            lambda: self._open_raw(url, method, data, site),
+            policy=self._policy(), site=f"webhdfs.{site}",
+        )
+
+    def _json(self, path: str, op: str, method: str = "GET",
+              retryable: bool = True, **params) -> dict:
+        url = self._url(path, op, **params)
+        site = "fs.read" if method == "GET" else "fs.write"
+
+        def attempt() -> dict:
+            # the body read lives INSIDE the retried callable: a response
+            # truncated mid-body (IncompleteRead) must re-attempt the whole
+            # metadata op, not escape the retry envelope
+            with self._open_raw(url, method, None, site) as r:
+                body = r.read()
+            return json.loads(body) if body else {}
+
+        if not retryable:
+            return attempt()
+        return retry.call(attempt, policy=self._policy(),
+                          site=f"webhdfs.{site}")
 
     def _status(self, path: str) -> dict:
         return self._json(path, "GETFILESTATUS")["FileStatus"]
@@ -84,31 +132,47 @@ class WebHdfsFileSystem(FileSystem):
         """Two-step WebHDFS write: PUT (no body) to the namenode, receive a
         307 with the datanode Location, PUT the body there.  urllib does
         not follow redirects for PUT, so the hop is explicit; a server
-        answering 200/201 directly (single-node, fakes) skips the hop."""
+        answering 200/201 directly (single-node, fakes) skips the hop.
+        Both hops retry independently — CREATE with overwrite=true is a
+        whole-file PUT, so a duplicate delivery is idempotent."""
         url = self._url(path, "CREATE", overwrite="true")
-        req = urllib.request.Request(url, method="PUT")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s):
-                location = None  # accepted directly
-        except urllib.error.HTTPError as e:
-            if e.code in (301, 302, 307):
-                location = e.headers.get("Location")
-                if not location:
-                    raise WebHdfsError(
-                        f"webhdfs CREATE {url}: redirect without Location"
-                    ) from e
-            else:
-                raise WebHdfsError(f"webhdfs CREATE {url}: {e}") from e
-        except urllib.error.URLError as e:
-            raise WebHdfsError(f"webhdfs CREATE {url}: {e.reason}") from e
+
+        def step1() -> str | None:
+            faults.check("fs.write")
+            req = urllib.request.Request(url, method="PUT")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s):
+                    return None  # accepted directly
+            except urllib.error.HTTPError as e:
+                if e.code in (301, 302, 307):
+                    location = e.headers.get("Location")
+                    if not location:
+                        raise WebHdfsError(
+                            f"webhdfs CREATE {url}: redirect without Location"
+                        ) from e
+                    return location
+                raise WebHdfsError(f"webhdfs CREATE {url}: {e}",
+                                   code=e.code) from e
+            except urllib.error.URLError as e:
+                raise WebHdfsError(f"webhdfs CREATE {url}: {e.reason}") from e
+
+        location = retry.call(step1, policy=self._policy(),
+                              site="webhdfs.fs.write")
         with self._request(location or url, "PUT", data=data):
             pass
 
     # ---- FileSystem surface ----
     def open_read(self, path: str) -> BinaryIO:
-        # the response object is file-like; ShardStream reads it in blocks,
-        # so a multi-GB shard streams without landing in memory
-        return self._request(self._url(path, "OPEN"))  # type: ignore[return-value]
+        # resumable streaming: ShardStream reads the response in blocks, so
+        # a multi-GB shard never lands in memory; a mid-body disconnect
+        # re-issues OPEN from the last received byte (WebHDFS offset param)
+        def reopen(offset: int):
+            params = {"offset": offset} if offset else {}
+            return self._request(self._url(path, "OPEN", **params))
+
+        return retry.ResumableReader(  # type: ignore[return-value]
+            reopen, policy=self._policy(), site="webhdfs.fs.read"
+        )
 
     def open_write(self, path: str) -> BinaryIO:
         return UploadOnClose(  # type: ignore[return-value]
@@ -172,7 +236,13 @@ class WebHdfsFileSystem(FileSystem):
         if self.exists(dst):
             self.delete(dst)
         _, dst_path = _split(dst)
-        res = self._json(src, "RENAME", method="PUT", destination=dst_path)
+        # retryable=False: RENAME is the one non-idempotent op here.  A
+        # retry whose FIRST delivery applied (response lost) would find the
+        # source gone and fail — or worse, clobber a newer dst.  Callers
+        # that need at-most-once-with-recovery verify the destination
+        # instead (train/checkpoint.py _commit_rename).
+        res = self._json(src, "RENAME", method="PUT", retryable=False,
+                         destination=dst_path)
         if not res.get("boolean", False):
             raise WebHdfsError(f"rename {src} -> {dst} failed")
 

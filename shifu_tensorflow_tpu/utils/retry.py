@@ -1,0 +1,308 @@
+"""Transient-fault retry: classify → backoff → re-attempt, with visibility.
+
+Parity surface: the reference never retried anything itself — it inherited
+retry discipline from the Hadoop stack underneath it (YARN's AMRMClient
+re-registration, ZooKeeper's session reconnect loop, DFSClient's block
+retries).  This framework replaced those planes with stdlib WebHDFS/GCS
+clients, a newline-JSON TCP RPC, and direct remote checkpoint writes — all
+of which previously failed permanently on the FIRST connection reset or
+503.  This module is the missing discipline, applied uniformly at every
+network seam:
+
+- ``RetryPolicy``: exponential backoff with FULL jitter (delay drawn
+  uniformly from [0, min(cap, base * 2^attempt)] — the AWS-documented
+  variant that decorrelates a thundering herd of restarting workers),
+  bounded by both a max-attempt count and a wall-clock deadline;
+- ``retryable()``: the classifier.  Transport-level failures (URLError,
+  ConnectionError, timeouts, DNS blips, truncated bodies) and throttling /
+  server-side errors (HTTP 429 and 5xx) retry; client errors (4xx —
+  including auth 401/403 and not-found 404) NEVER retry, preserving the
+  "ONLY not-found means absent" contracts in both fs backends;
+- ``call()``: the loop, emitting a structured log line per retry and
+  bumping per-site counters so a chaos drill (utils/faults.py) can assert
+  the layer actually absorbed the injected faults.
+
+Every seam takes an explicit policy and falls back to the process default,
+which ``shifu.tpu.retry-*`` conf keys configure (config/keys.py,
+``policy_from_conf``) — the fs backends auto-register with no conf in
+scope, so the CLI installs the resolved policy via ``set_default_policy``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import random
+import socket
+import threading
+import time
+import urllib.error
+from collections import Counter
+from dataclasses import dataclass, replace
+from typing import Any, Callable
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("retry")
+
+#: throttling statuses that retry in addition to the 5xx range
+_RETRYABLE_STATUS_EXTRA = frozenset({429})
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff envelope for one seam.
+
+    ``max_attempts=1`` disables retry entirely (the chaos drill's control
+    arm).  ``deadline_s`` caps the CUMULATIVE BACKOFF SLEEP a call may
+    accumulate — the stall the retry layer itself adds — NOT the caller's
+    own blocking time: a barrier RPC legitimately blocks for minutes
+    waiting on a straggler, and a connection shed at minute three must
+    still get its reconnects (measuring wall clock from call start would
+    silently zero the retry budget for exactly the long-blocking ops that
+    need it most).  ``seed`` pins the jitter stream for deterministic
+    tests; production leaves it None (module RNG).
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    deadline_s: float = 60.0
+    seed: int | None = None
+
+    def with_attempts(self, max_attempts: int) -> "RetryPolicy":
+        return replace(self, max_attempts=max_attempts)
+
+    def to_dict(self) -> dict:
+        """JSON transport (subprocess workers receive the launching
+        process's resolved policy inside their WorkerConfig)."""
+        return {
+            "max_attempts": self.max_attempts,
+            "base_delay_s": self.base_delay_s,
+            "max_delay_s": self.max_delay_s,
+            "deadline_s": self.deadline_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RetryPolicy":
+        return cls(**d)
+
+    def backoff_cap(self, attempt: int) -> float:
+        """Upper bound of the jitter window after ``attempt`` failures
+        (attempt counts from 1)."""
+        return min(self.max_delay_s, self.base_delay_s * (2 ** (attempt - 1)))
+
+
+#: retry disabled — the explicit policy for non-idempotent one-shot ops
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+_default_policy = RetryPolicy()
+_policy_lock = threading.Lock()
+
+
+def set_default_policy(policy: RetryPolicy) -> None:
+    """Install the process-wide default (CLI does this from the conf layer;
+    tests use it to disable or determinize retries)."""
+    global _default_policy
+    with _policy_lock:
+        _default_policy = policy
+
+
+def default_policy() -> RetryPolicy:
+    with _policy_lock:
+        return _default_policy
+
+
+def policy_from_conf(conf: Any) -> RetryPolicy:
+    """Resolve a policy from the layered conf (shifu.tpu.retry-* keys)."""
+    from shifu_tensorflow_tpu.config import keys as K
+
+    return RetryPolicy(
+        max_attempts=conf.get_int(K.RETRY_MAX_ATTEMPTS,
+                                  K.DEFAULT_RETRY_MAX_ATTEMPTS),
+        base_delay_s=conf.get_int(K.RETRY_BASE_DELAY_MS,
+                                  K.DEFAULT_RETRY_BASE_DELAY_MS) / 1000.0,
+        max_delay_s=conf.get_int(K.RETRY_MAX_DELAY_MS,
+                                 K.DEFAULT_RETRY_MAX_DELAY_MS) / 1000.0,
+        deadline_s=conf.get_int(K.RETRY_DEADLINE_MS,
+                                K.DEFAULT_RETRY_DEADLINE_MS) / 1000.0,
+    )
+
+
+# ---- classification ----
+
+def retryable(exc: BaseException) -> bool:
+    """True when re-attempting could plausibly succeed.
+
+    HTTP-coded errors (anything carrying an int ``.code`` — urllib's
+    HTTPError, WebHdfsError, GcsError, injected faults) follow status
+    semantics: 5xx and 429 are the server's problem, retry; 4xx is OURS
+    (bad request, auth, not-found) — retrying can only hide a bug or, for
+    404, break the "ONLY not-found means absent" contract in the fs
+    backends' ``exists()``.  Errors with no code are transport-level:
+    connection resets/refusals, timeouts, DNS blips, and truncated reads
+    all retry.  Wrapped errors (WebHdfsError/GcsError around a URLError)
+    are classified by their cause when the wrapper itself carries no code.
+    """
+    code = getattr(exc, "code", None)
+    if isinstance(code, int):
+        return code in _RETRYABLE_STATUS_EXTRA or 500 <= code < 600
+    if isinstance(exc, (ConnectionError, TimeoutError, socket.timeout,
+                        socket.gaierror)):
+        return True
+    if isinstance(exc, (http.client.IncompleteRead,
+                        http.client.RemoteDisconnected)):
+        return True
+    if isinstance(exc, urllib.error.URLError):
+        # HTTPError subclasses URLError but carries a code (handled above);
+        # a bare URLError is a failed connect/read — retry
+        return True
+    cause = exc.__cause__
+    if cause is not None and cause is not exc:
+        return retryable(cause)
+    return False
+
+
+# ---- visibility ----
+
+_counters: Counter = Counter()
+_counters_lock = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[key] += n
+
+
+def counters() -> dict[str, int]:
+    """Snapshot of per-site retry counters: ``<site>.retries`` (sleeps
+    taken), ``<site>.recovered`` (calls that succeeded after >=1 retry),
+    ``<site>.exhausted`` (calls that failed after exhausting the policy)."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+# ---- the loop ----
+
+def call(
+    fn: Callable[[], Any],
+    *,
+    policy: RetryPolicy | None = None,
+    site: str = "unknown",
+    classify: Callable[[BaseException], bool] = retryable,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run ``fn()`` under the policy; re-raise the last error when the
+    failure is non-retryable or the policy is exhausted.
+
+    ``site`` names the seam ("webhdfs.fs.read", "rpc.epoch", ...) in logs
+    and counters.  ``fn`` must be safe to re-invoke — non-idempotent
+    effects belong OUTSIDE the callable (dedup tokens for RPC delivery,
+    verify-don't-reissue for the checkpoint rename commit)."""
+    pol = policy if policy is not None else default_policy()
+    rng = random.Random(pol.seed) if pol.seed is not None else random
+    slept = 0.0
+    attempt = 0
+    while True:
+        try:
+            result = fn()
+            if attempt:
+                _bump(f"{site}.recovered")
+            return result
+        except Exception as e:
+            attempt += 1
+            if not classify(e):
+                raise
+            if attempt >= pol.max_attempts:
+                _bump(f"{site}.exhausted")
+                raise
+            delay = rng.uniform(0.0, pol.backoff_cap(attempt))
+            # deadline caps the retry layer's OWN added stall (cumulative
+            # sleep), not the attempts' runtime — see RetryPolicy docstring
+            if slept + delay > pol.deadline_s:
+                _bump(f"{site}.exhausted")
+                raise
+            slept += delay
+            _bump(f"{site}.retries")
+            log.warning(
+                "retrying %s (attempt %d/%d) in %.3fs after %s: %s",
+                site, attempt + 1, pol.max_attempts, delay,
+                type(e).__name__, e,
+            )
+            sleep(delay)
+
+
+class ResumableReader(io.RawIOBase):
+    """Read stream that survives mid-body disconnects by re-issuing the
+    request FROM THE LAST RECEIVED BYTE — a multi-GB shard read dropped at
+    byte 10^9 resumes there instead of restarting (WebHDFS via the ``OPEN``
+    offset param; GCS via a ``Range`` header).
+
+    ``reopen(offset)`` returns a fresh raw stream positioned at ``offset``;
+    the backends route it through their retried ``_request``, so connect
+    failures during the re-issue get their own backoff.  Only READ errors
+    are handled here: a failure mid-``read`` drops the dead stream and
+    re-opens under the policy.  The stream is sequential (not seekable), so
+    callers that need random access buffer it — exactly what they already
+    do for plain HTTP responses.
+    """
+
+    def __init__(self, reopen: Callable[[int], Any], *,
+                 policy: RetryPolicy | None = None, site: str = "fs.read",
+                 classify: Callable[[BaseException], bool] = retryable):
+        super().__init__()
+        self._reopen = reopen
+        self._retry_policy = policy
+        self._site = site
+        self._classify = classify
+        self._offset = 0
+        self._raw = reopen(0)
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        def attempt() -> bytes:
+            if self._raw is None:
+                self._raw = self._reopen(self._offset)
+            try:
+                data = self._raw.read(len(b))
+                if not data and len(b):
+                    # http.client's bounded read() returns b"" instead of
+                    # raising on a connection that died before delivering
+                    # Content-Length bytes (readinto's compat behavior) —
+                    # surface the truncation so the retry resumes, or a
+                    # silently short shard would parse as a short dataset
+                    remaining = getattr(self._raw, "length", None)
+                    if remaining:
+                        raise http.client.IncompleteRead(b"", remaining)
+                return data
+            except Exception:
+                # the stream is poisoned either way; drop it so the next
+                # attempt reopens from the high-water mark
+                try:
+                    self._raw.close()
+                except Exception:
+                    pass
+                self._raw = None
+                raise
+
+        data = call(attempt, policy=self._retry_policy,
+                    site=f"{self._site}.resume", classify=self._classify)
+        n = len(data)
+        b[:n] = data
+        self._offset += n
+        return n
+
+    def close(self) -> None:
+        try:
+            if self._raw is not None:
+                self._raw.close()
+        finally:
+            self._raw = None
+            super().close()

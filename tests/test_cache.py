@@ -263,13 +263,17 @@ def test_bf16_gated_off_for_hashed_feature_models():
         _feature_dtype_for,
     )
 
+    # z-scaled schema isolates the HASHING gate (the no-normalization
+    # gate is covered by test_fp32_worker_defaults_to_bf16_transport)
+    zs = SCHEMA.with_zscale([0.0, 0.0, 0.0], [1.0, 1.0, 1.0])
+
     def cfg(params):
         mc = ModelConfig.from_json({"train": {"params": {
             "NumHiddenLayers": 1, "NumHiddenNodes": [4],
             "ActivationFunc": ["relu"], "LearningRate": 0.1, **params}}})
         return WorkerConfig(
             worker_id="w", coordinator_host="h", coordinator_port=1,
-            model_config=mc, schema=SCHEMA, dtype="bfloat16",
+            model_config=mc, schema=zs, dtype="bfloat16",
         )
 
     assert _feature_dtype_for(cfg({})) == "bfloat16"
@@ -311,6 +315,15 @@ def test_stream_feature_dtype_resolver():
         None, uses_feature_hashing=False) == "bfloat16"
     assert resolve_stream_feature_dtype(
         "auto", uses_feature_hashing=True) == "float32"
+    # no ZSCALE stats = raw-magnitude features: auto stays conservative
+    # (bf16's 8-bit mantissa silently truncates un-normalized codes), but
+    # an explicit bfloat16 is the operator's call and still forces it
+    assert resolve_stream_feature_dtype(
+        "auto", uses_feature_hashing=False,
+        has_normalization_stats=False) == "float32"
+    assert resolve_stream_feature_dtype(
+        "bfloat16", uses_feature_hashing=False,
+        has_normalization_stats=False) == "bfloat16"
     assert resolve_stream_feature_dtype(
         "float32", uses_feature_hashing=False) == "float32"
     assert resolve_stream_feature_dtype(
@@ -324,7 +337,9 @@ def test_stream_feature_dtype_resolver():
 def test_fp32_worker_defaults_to_bf16_transport():
     """The compact-transport default engages for PLAIN fp32 models too —
     transport dtype is decoupled from compute dtype (the jitted step
-    widens on device, train/trainer.py _widen_features)."""
+    widens on device, train/trainer.py _widen_features) — but only when
+    the schema carries ZSCALE stats: normalized features are O(1) where
+    bf16 is plenty; raw magnitudes stay float32 (docs/migration.md)."""
     from shifu_tensorflow_tpu.config.model_config import ModelConfig
     from shifu_tensorflow_tpu.coordinator.worker import (
         WorkerConfig,
@@ -334,15 +349,22 @@ def test_fp32_worker_defaults_to_bf16_transport():
     mc = ModelConfig.from_json({"train": {"params": {
         "NumHiddenLayers": 1, "NumHiddenNodes": [4],
         "ActivationFunc": ["relu"], "LearningRate": 0.1}}})
+    zs = SCHEMA.with_zscale([0.0, 0.0, 0.0], [1.0, 1.0, 1.0])
     cfg = WorkerConfig(
         worker_id="w", coordinator_host="h", coordinator_port=1,
-        model_config=mc, schema=SCHEMA,  # dtype defaults to fp32 compute
+        model_config=mc, schema=zs,  # dtype defaults to fp32 compute
     )
     assert _feature_dtype_for(cfg) == "bfloat16"
+    # no normalization stats: auto falls back to f32 transport
+    cfg_raw = WorkerConfig(
+        worker_id="w", coordinator_host="h", coordinator_port=1,
+        model_config=mc, schema=SCHEMA,
+    )
+    assert _feature_dtype_for(cfg_raw) == "float32"
     # explicit opt-out survives the config bridge
     cfg2 = WorkerConfig(
         worker_id="w", coordinator_host="h", coordinator_port=1,
-        model_config=mc, schema=SCHEMA, stream_feature_dtype="float32",
+        model_config=mc, schema=zs, stream_feature_dtype="float32",
     )
     assert _feature_dtype_for(cfg2) == "float32"
 

@@ -1,0 +1,813 @@
+"""Transient-fault resilience: retry/backoff at every network seam, proven
+by injected faults (utils/retry.py, utils/faults.py).
+
+The chaos drills here are the coverage the reference never had — it leaned
+on YARN/ZooKeeper retry machinery it didn't test.  Our stdlib planes carry
+their own discipline, so the drills make it load-bearing: a WebHDFS-backed
+train → checkpoint → kill → resume cycle must complete BIT-IDENTICALLY
+under a >=20% injected fault rate (503s, dropped connections, mid-body
+truncations), and must FAIL with retries disabled; the coordinator RPC
+fleet must converge while connections drop mid-barrier, with dedup tokens
+keeping retried deliveries of non-idempotent ops (register / epoch report /
+complete) from double-applying.
+"""
+
+import dataclasses
+import http.client
+import json
+import os
+import random
+import socket
+import threading
+import urllib.error
+import urllib.parse
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.coordinator.coordinator import (
+    Coordinator,
+    CoordinatorClient,
+    JobSpec,
+    JobState,
+)
+from shifu_tensorflow_tpu.data.splitter import Shard
+from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+from shifu_tensorflow_tpu.train.trainer import EpochStats, Trainer
+from shifu_tensorflow_tpu.utils import faults, fs, retry
+from shifu_tensorflow_tpu.utils.fs_gcs import GcsError
+from shifu_tensorflow_tpu.utils.fs_webhdfs import WebHdfsError
+from shifu_tensorflow_tpu.utils.retry import RetryPolicy
+
+#: fast deterministic policy for drills — real backoff shape, toy delays
+FAST = RetryPolicy(max_attempts=8, base_delay_s=0.001, max_delay_s=0.004,
+                   deadline_s=30.0, seed=1234)
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    retry.reset_counters()
+    retry.set_default_policy(FAST)
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+    retry.set_default_policy(RetryPolicy())
+
+
+# --------------------------------------------------------------------------
+# retryable-error classification (satellite: table-driven, both fs backends
+# and the RPC client's transport errors)
+# --------------------------------------------------------------------------
+
+
+def _wrapped_transport_error():
+    """WebHdfsError as _open_raw raises it for a failed connect: no code,
+    __cause__ = URLError — classified by the cause."""
+    try:
+        try:
+            raise urllib.error.URLError(ConnectionRefusedError("no route"))
+        except urllib.error.URLError as e:
+            raise WebHdfsError("webhdfs GET http://x: no route") from e
+    except WebHdfsError as e:
+        return e
+
+
+CLASSIFICATION_TABLE = [
+    # HTTP-coded: 5xx / 429 retry, 4xx never (auth + not-found included)
+    (WebHdfsError("x", code=500), True),
+    (WebHdfsError("x", code=502), True),
+    (WebHdfsError("x", code=503), True),
+    (WebHdfsError("x", code=504), True),
+    (WebHdfsError("x", code=429), True),
+    (WebHdfsError("x", code=400), False),
+    (WebHdfsError("x", code=401), False),
+    (WebHdfsError("x", code=403), False),
+    (WebHdfsError("x", code=404), False),
+    (WebHdfsError("x", code=409), False),
+    (GcsError("x", code=503), True),
+    (GcsError("x", code=429), True),
+    (GcsError("x", code=404), False),
+    (GcsError("x", code=403), False),
+    (urllib.error.HTTPError("u", 503, "m", {}, None), True),
+    (urllib.error.HTTPError("u", 404, "m", {}, None), False),
+    (faults.InjectedHttpError(503, "s"), True),
+    (faults.InjectedHttpError(404, "s"), False),
+    # transport-level: always retry
+    (ConnectionResetError("peer reset"), True),
+    (ConnectionRefusedError("refused"), True),
+    (ConnectionAbortedError("aborted"), True),
+    (BrokenPipeError("pipe"), True),
+    (TimeoutError("timed out"), True),
+    (socket.timeout("timed out"), True),
+    (socket.gaierror("dns"), True),
+    (http.client.RemoteDisconnected("gone"), True),
+    (http.client.IncompleteRead(b"", 10), True),
+    (urllib.error.URLError(ConnectionRefusedError("refused")), True),
+    # wrapped transport error classifies by cause; a LOGICAL fs error with
+    # neither code nor cause (rename returned boolean:false) never retries
+    (_wrapped_transport_error(), True),
+    (WebHdfsError("rename a -> b failed"), False),
+    # plain bugs never retry
+    (ValueError("bad"), False),
+    (KeyError("missing"), False),
+    (FileNotFoundError("gone"), False),
+]
+
+
+def test_retryable_classification_table():
+    for exc, want in CLASSIFICATION_TABLE:
+        assert retry.retryable(exc) is want, (
+            f"{type(exc).__name__}({exc}, code={getattr(exc, 'code', None)})"
+            f" should be retryable={want}"
+        )
+
+
+# --------------------------------------------------------------------------
+# retry loop mechanics
+# --------------------------------------------------------------------------
+
+
+def test_retry_call_recovers_with_jittered_backoff():
+    calls = Counter()
+    sleeps = []
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("boom")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.01, max_delay_s=0.04,
+                      deadline_s=5.0, seed=9)
+    assert retry.call(fn, policy=pol, site="t.rec", sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3
+    # full jitter: uniform in [0, base * 2^(attempt-1)], capped
+    assert len(sleeps) == 2
+    assert 0.0 <= sleeps[0] <= 0.01
+    assert 0.0 <= sleeps[1] <= 0.02
+    c = retry.counters()
+    assert c["t.rec.retries"] == 2
+    assert c["t.rec.recovered"] == 1
+
+
+def test_retry_call_non_retryable_raises_immediately():
+    calls = Counter()
+
+    def fn():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry.call(fn, policy=FAST, site="t.bug", sleep=lambda d: None)
+    assert calls["n"] == 1
+    assert "t.bug.retries" not in retry.counters()
+
+
+def test_retry_call_exhausts_attempts():
+    calls = Counter()
+
+    def fn():
+        calls["n"] += 1
+        raise ConnectionResetError("always")
+
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0001, seed=1)
+    with pytest.raises(ConnectionResetError):
+        retry.call(fn, policy=pol, site="t.exh", sleep=lambda d: None)
+    assert calls["n"] == 3
+    assert retry.counters()["t.exh.exhausted"] == 1
+
+
+def test_retry_deadline_caps_cumulative_backoff():
+    sleeps = []
+
+    def fn():
+        raise ConnectionResetError("always")
+
+    pol = RetryPolicy(max_attempts=100, base_delay_s=0.01, deadline_s=0.0,
+                      seed=2)
+    with pytest.raises(ConnectionResetError):
+        retry.call(fn, policy=pol, site="t.dead", sleep=sleeps.append)
+    assert sleeps == []  # the first backoff already exceeded the deadline
+
+
+def test_retry_deadline_ignores_attempt_runtime():
+    """The deadline caps the retry layer's OWN stall (sleep), not the
+    attempts' runtime — a barrier RPC that blocks far past the deadline
+    before a transient drop must still get its reconnects."""
+    import time as _time
+
+    calls = Counter()
+
+    def fn():
+        calls["n"] += 1
+        _time.sleep(0.05)  # attempt runtime alone exceeds the deadline
+        if calls["n"] < 3:
+            raise ConnectionResetError("shed mid-barrier")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=1e-6, max_delay_s=1e-6,
+                      deadline_s=0.01, seed=4)
+    assert retry.call(fn, policy=pol, site="t.block",
+                      sleep=lambda d: None) == "ok"
+    assert calls["n"] == 3
+
+
+def test_policy_conf_and_json_bridge():
+    conf = Conf({K.RETRY_MAX_ATTEMPTS: 3, K.RETRY_BASE_DELAY_MS: 10,
+                 K.RETRY_MAX_DELAY_MS: 100, K.RETRY_DEADLINE_MS: 5000})
+    pol = retry.policy_from_conf(conf)
+    assert pol.max_attempts == 3
+    assert pol.base_delay_s == pytest.approx(0.01)
+    assert pol.max_delay_s == pytest.approx(0.1)
+    assert pol.deadline_s == pytest.approx(5.0)
+    assert RetryPolicy.from_dict(pol.to_dict()) == pol
+    # the multi-worker CLI path carries the policy into WorkerConfig JSON
+    from shifu_tensorflow_tpu.train.__main__ import (
+        build_parser,
+        worker_runtime_kwargs,
+    )
+
+    args = build_parser().parse_args(
+        ["--training-data-path", "/tmp/x", "--feature-columns", "1,2"])
+    kw = worker_runtime_kwargs(args, conf)
+    assert kw["retry"]["max_attempts"] == 3
+
+
+# --------------------------------------------------------------------------
+# fault plan
+# --------------------------------------------------------------------------
+
+
+def _fires(plan, site):
+    try:
+        plan.check(site)
+        return None
+    except Exception as e:
+        return type(e).__name__
+
+
+def test_fault_plan_parse_grammar_and_errors():
+    plan = faults.FaultPlan.parse("fs.read:503@0.5, rpc:reset@1.0", seed=1)
+    assert _fires(plan, "rpc.connect") == "ConnectionResetError"
+    with pytest.raises(ValueError, match="site:kind@rate"):
+        faults.FaultPlan.parse("nonsense")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan.parse("fs.read:explode@0.5")
+    with pytest.raises(ValueError, match="rate out of"):
+        faults.FaultPlan.parse("fs.read:503@1.5")
+
+
+def test_fault_plan_is_deterministic_and_scoped():
+    spec = "fs.read:503@0.4,rpc:timeout@0.3"
+    sites = ["fs.read", "rpc.connect", "fs.read", "rpc.recv"] * 12
+    p1 = faults.FaultPlan.parse(spec, seed=11)
+    p2 = faults.FaultPlan.parse(spec, seed=11)
+    seq1 = [_fires(p1, s) for s in sites]
+    seq2 = [_fires(p2, s) for s in sites]
+    # same seed + same check sequence -> identical fire pattern, and the
+    # storm actually contains faults
+    assert seq1 == seq2
+    assert any(seq1)
+    # a different seed reshuffles
+    p3 = faults.FaultPlan.parse(spec, seed=12)
+    assert [_fires(p3, s) for s in sites] != seq1
+    # scoping: the fs.read term never fires at fs.write; the bare "rpc"
+    # prefix term fires at rpc.* sites only
+    p4 = faults.FaultPlan.parse("fs.read:503@1.0", seed=3)
+    assert _fires(p4, "fs.write") is None
+    assert _fires(p4, "fs.read") == "InjectedHttpError"
+    p5 = faults.FaultPlan.parse("rpc:reset@1.0", seed=3)
+    assert _fires(p5, "fs.read") is None
+    assert _fires(p5, "rpc.recv") == "ConnectionResetError"
+    assert p5.fired() == {"rpc:reset": 1}
+
+
+def test_fault_plan_env_activation(monkeypatch):
+    monkeypatch.setenv("STPU_FAULT_PLAN", "ckpt.write:503@1.0")
+    monkeypatch.setenv("STPU_FAULT_SEED", "5")
+    faults.set_plan(None)
+    faults._loaded_env = False  # force env re-read
+    try:
+        with pytest.raises(faults.InjectedHttpError):
+            faults.check("ckpt.write")
+        faults.check("fs.read")  # unlisted site: no-op
+    finally:
+        faults.set_plan(None)
+
+
+# --------------------------------------------------------------------------
+# flaky WebHDFS server: the in-process fake from test_fs_remote plus
+# seeded chaos — 503s, dropped connections, mid-body truncations
+# --------------------------------------------------------------------------
+
+
+class _FlakyWebHdfsHandler(BaseHTTPRequestHandler):
+    root: str
+    chaos: dict  # rng, rate, midbody, fired (Counter), ops (Counter)
+
+    def log_message(self, *a):
+        pass
+
+    def _local(self, urlpath: str) -> str:
+        assert urlpath.startswith("/webhdfs/v1")
+        rel = urllib.parse.unquote(urlpath[len("/webhdfs/v1"):]).lstrip("/")
+        return os.path.join(self.root, rel)
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _status_obj(self, p: str) -> dict:
+        st = os.stat(p)
+        return {
+            "length": st.st_size,
+            "modificationTime": int(st.st_mtime * 1000),
+            "type": "DIRECTORY" if os.path.isdir(p) else "FILE",
+            "pathSuffix": "",
+        }
+
+    def _inject(self, op: str) -> bool:
+        """Pre-dispatch chaos: the op is NOT applied when a fault fires, so
+        even non-idempotent ops (RENAME) stay consistent — the
+        applied-but-response-lost case gets its own dedicated handlers."""
+        c = self.chaos
+        c["ops"][op] += 1
+        if c.get("rate", 0.0) <= 0.0:
+            return False
+        if c["rng"].random() < c["rate"]:
+            c["fired"][op] += 1
+            if c["rng"].random() < 0.5:
+                self._json(503, {"RemoteException": {
+                    "message": "injected 503"}})
+            # else: close without any response -> RemoteDisconnected
+            return True
+        return False
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        p = self._local(u.path)
+        op = q.get("op")
+        if self._inject(op):
+            return
+        if op == "GETFILESTATUS":
+            if not os.path.exists(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "File does not exist"}})
+            return self._json(200, {"FileStatus": self._status_obj(p)})
+        if op == "LISTSTATUS":
+            if not os.path.isdir(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "not a directory"}})
+            entries = []
+            for name in sorted(os.listdir(p)):
+                e = self._status_obj(os.path.join(p, name))
+                e["pathSuffix"] = name
+                entries.append(e)
+            return self._json(200, {"FileStatuses": {"FileStatus": entries}})
+        if op == "OPEN":
+            if not os.path.exists(p):
+                return self._json(404, {"RemoteException": {
+                    "message": "File does not exist"}})
+            with open(p, "rb") as f:
+                data = f.read()
+            offset = int(q.get("offset", "0"))
+            data = data[offset:]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            c = self.chaos
+            if (c.get("midbody", 0.0) > 0.0 and len(data) > 1
+                    and c["rng"].random() < c["midbody"]):
+                # declared full length, deliver half, die — the resumable
+                # reader must re-OPEN from its high-water mark
+                c["fired"]["OPEN-midbody"] += 1
+                self.wfile.write(data[: len(data) // 2])
+                return
+            self.wfile.write(data)
+            return
+        self._json(400, {"RemoteException": {"message": f"bad op {op}"}})
+
+    def do_PUT(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        p = self._local(u.path)
+        op = q.get("op")
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if self._inject(op):
+            return
+        if op == "CREATE":
+            if "step2" not in q:
+                # model the real namenode's 307 hop so chaos hits BOTH hops
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://{self.headers['Host']}{u.path}?"
+                    + urllib.parse.urlencode({**q, "step2": "1"}),
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "wb") as f:
+                f.write(body)
+            return self._json(201, {})
+        if op == "MKDIRS":
+            os.makedirs(p, exist_ok=True)
+            return self._json(200, {"boolean": True})
+        if op == "RENAME":
+            return self._do_rename(p, q)
+        self._json(400, {"RemoteException": {"message": f"bad op {op}"}})
+
+    def _do_rename(self, p, q):
+        dst = os.path.join(self.root, q["destination"].lstrip("/"))
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(p, dst)
+        return self._json(200, {"boolean": True})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        p = self._local(u.path)
+        if self._inject("DELETE"):
+            return
+        ok = os.path.exists(p)
+        if ok:
+            os.remove(p)
+        self._json(200, {"boolean": ok})
+
+
+@pytest.fixture
+def flaky_hdfs(tmp_path):
+    """Factory: spin up a chaos-configured fake WebHDFS server; returns
+    (base_url, chaos_dict, local_root)."""
+    servers = []
+
+    def make(name, rate=0.0, midbody=0.0, seed=7, handler=None):
+        root = tmp_path / name
+        root.mkdir()
+        chaos = {
+            "rng": random.Random(seed), "rate": rate, "midbody": midbody,
+            "fired": Counter(), "ops": Counter(),
+        }
+        cls = type("H", (handler or _FlakyWebHdfsHandler,),
+                   {"root": str(root), "chaos": chaos})
+        server = ThreadingHTTPServer(("127.0.0.1", 0), cls)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        servers.append(server)
+        host, port = server.server_address[:2]
+        return f"hdfs://{host}:{port}", chaos, root
+
+    yield make
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+# --------------------------------------------------------------------------
+# resumable reads
+# --------------------------------------------------------------------------
+
+
+def test_resumable_read_survives_midbody_truncation(flaky_hdfs):
+    base, chaos, root = flaky_hdfs("resume", rate=0.0, midbody=0.7, seed=3)
+    payload = bytes(random.Random(0).getrandbits(8) for _ in range(96_000))
+    (root / "blob.bin").write_bytes(payload)
+    with fs.open_read(f"{base}/blob.bin") as f:
+        got = f.read()
+    assert got == payload
+    assert chaos["fired"]["OPEN-midbody"] > 0, "no truncation injected"
+    # the resume path re-issued OPEN with an offset (not full restarts)
+    assert chaos["ops"]["OPEN"] > 1
+
+
+# --------------------------------------------------------------------------
+# the fs chaos drill: train -> checkpoint -> kill -> resume, bit-identical
+# --------------------------------------------------------------------------
+
+
+def _model_config():
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 4, "params": {
+            "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+            "ActivationFunc": ["relu"], "LearningRate": 0.1}}}
+    )
+
+
+def _batches():
+    rng = np.random.default_rng(42)
+    out = []
+    for _ in range(3):
+        out.append({
+            "x": rng.normal(size=(16, 3)).astype(np.float32),
+            "y": (rng.random((16, 1)) < 0.5).astype(np.float32),
+            "w": np.ones((16, 1), np.float32),
+        })
+    return out
+
+
+def _state_leaves(state):
+    import jax
+
+    return [np.asarray(jax.device_get(leaf)) for leaf in
+            jax.tree_util.tree_leaves(
+                {"params": state.params, "opt": state.opt_state,
+                 "step": state.step})]
+
+
+def _train_ckpt_kill_resume(ckpt_dir: str, epochs=4, kill_after=2):
+    """The drill choreography, identical for the clean and chaos arms:
+    train, checkpoint each epoch, 'kill' (fresh trainer = fresh process),
+    restore from the (possibly remote) checkpoint, finish the budget."""
+    batches = _batches()
+    mc = _model_config()
+    tr = Trainer(mc, 3)
+    with NpzCheckpointer(ckpt_dir, every_epochs=1, max_to_keep=2) as ck:
+        for e in range(kill_after):
+            tr.train_epoch(list(batches))
+            ck.save(e, tr.state)
+    tr2 = Trainer(mc, 3)
+    with NpzCheckpointer(ckpt_dir, every_epochs=1, max_to_keep=2) as ck:
+        state, nxt = ck.restore_latest(tr2.state)
+        assert nxt == kill_after, "resume must pick up the exact epoch"
+        tr2.state = state
+        for e in range(nxt, epochs):
+            tr2.train_epoch(list(batches))
+            ck.save(e, tr2.state)
+    return _state_leaves(tr2.state)
+
+
+def test_chaos_drill_webhdfs_train_ckpt_resume_bit_identical(
+        flaky_hdfs, tmp_path):
+    """Acceptance drill: >=20% injected transient faults on every fs
+    request (503s + dropped connections) plus mid-body truncations on
+    reads, and the full cycle still produces BIT-identical parameters to a
+    fault-free local run."""
+    clean = _train_ckpt_kill_resume(str(tmp_path / "clean-ckpt"))
+
+    base, chaos, _ = flaky_hdfs("chaos", rate=0.25, midbody=0.3, seed=1007)
+    stormy = _train_ckpt_kill_resume(f"{base}/ckpt")
+
+    assert len(clean) == len(stormy)
+    for a, b in zip(clean, stormy):
+        np.testing.assert_array_equal(a, b)
+    fired = sum(chaos["fired"].values())
+    assert fired >= 5, f"drill proved nothing: only {fired} faults fired"
+    # and the retry layer actually absorbed them
+    absorbed = sum(v for k, v in retry.counters().items()
+                   if k.startswith("webhdfs.") and k.endswith(".retries"))
+    assert absorbed > 0
+
+
+def test_chaos_drill_fails_without_retries(flaky_hdfs):
+    """Control arm: same storm, retries disabled — the drill must die,
+    proving the retry layer (not luck) carries the chaos drill."""
+    retry.set_default_policy(RetryPolicy(max_attempts=1))
+    base, chaos, _ = flaky_hdfs("noretry", rate=0.25, midbody=0.3, seed=1007)
+    with pytest.raises((OSError, http.client.HTTPException)):
+        _train_ckpt_kill_resume(f"{base}/ckpt")
+    assert sum(chaos["fired"].values()) > 0
+
+
+# --------------------------------------------------------------------------
+# rename-commit: at-most-once EFFECT (never blindly re-issued)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _MiniState:
+    """Just enough state surface for NpzCheckpointer (params/opt_state/step
+    + .replace) without paying a Trainer build."""
+
+    params: dict
+    opt_state: tuple
+    step: int
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def _mini_state():
+    return _MiniState(
+        params={"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        opt_state=(np.zeros(3, np.float32),),
+        step=7,
+    )
+
+
+class _RenameAppliedButLostHandler(_FlakyWebHdfsHandler):
+    """RENAME applies server-side, then the response is a 500 — the
+    lost-response case for the non-idempotent commit."""
+
+    def _do_rename(self, p, q):
+        dst = os.path.join(self.root, q["destination"].lstrip("/"))
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(p, dst)
+        return self._json(500, {"RemoteException": {
+            "message": "injected post-apply failure"}})
+
+
+class _RenameFailsOnceHandler(_FlakyWebHdfsHandler):
+    """First RENAME 503s WITHOUT applying; later ones apply normally —
+    the verifiably-not-applied case where one re-issue is safe."""
+
+    def _do_rename(self, p, q):
+        if self.chaos["ops"]["RENAME"] == 1:  # _inject counted this call
+            return self._json(503, {"RemoteException": {
+                "message": "injected pre-apply failure"}})
+        return super()._do_rename(p, q)
+
+
+def test_rename_commit_lost_response_verifies_instead_of_reissuing(
+        flaky_hdfs):
+    base, chaos, _ = flaky_hdfs("lost", handler=_RenameAppliedButLostHandler)
+    with NpzCheckpointer(f"{base}/ckpt", every_epochs=1) as ck:
+        ck.save(0, _mini_state())
+        assert ck.latest_epoch() == 0
+    # exactly ONE RENAME on the wire: the commit was verified, not retried
+    assert chaos["ops"]["RENAME"] == 1
+    # and the published checkpoint restores
+    with NpzCheckpointer(f"{base}/ckpt", every_epochs=1) as ck:
+        state, nxt = ck.restore_latest(_mini_state())
+        assert nxt == 1
+        np.testing.assert_array_equal(state.params["w"],
+                                      _mini_state().params["w"])
+
+
+def test_rename_commit_reissues_only_when_verifiably_not_applied(flaky_hdfs):
+    base, chaos, _ = flaky_hdfs("failonce", handler=_RenameFailsOnceHandler)
+    with NpzCheckpointer(f"{base}/ckpt", every_epochs=1) as ck:
+        ck.save(0, _mini_state())
+        assert ck.latest_epoch() == 0
+    # first delivery provably did not apply (tmp present, dst absent), so
+    # ONE re-issue happened — two RENAMEs total, one effect
+    assert chaos["ops"]["RENAME"] == 2
+
+
+def test_webhdfs_rename_is_never_transport_retried(flaky_hdfs, monkeypatch):
+    """The fs layer must issue RENAME exactly once per rename() call even
+    with an aggressive default policy — retry lives at the verify layer."""
+    base, chaos, root = flaky_hdfs("raw", handler=_RenameAppliedButLostHandler)
+    (root / "src.txt").write_bytes(b"x")
+    impl = fs.filesystem_for(base)
+    with pytest.raises(WebHdfsError):
+        impl.rename(f"{base}/src.txt", f"{base}/dst.txt")
+    assert chaos["ops"]["RENAME"] == 1
+
+
+# --------------------------------------------------------------------------
+# RPC: dedup tokens for non-idempotent ops
+# --------------------------------------------------------------------------
+
+
+def _spec(n=2, epochs=3, **kw):
+    shards = [Shard(i, (f"/data/part-{i}",), 1) for i in range(n)]
+    kw.setdefault("registration_timeout_s", 10.0)
+    return JobSpec(n_workers=n, shards=shards, epochs=epochs, **kw)
+
+
+def _stats(worker, epoch, loss=0.5):
+    return EpochStats(
+        worker_index=worker, current_epoch=epoch, training_loss=loss,
+        valid_loss=loss, training_time_s=1.0 + worker, valid_time_s=0.1,
+        global_step=epoch + 1,
+    )
+
+
+def test_register_duplicate_delivery_replays_cached_response():
+    coord = Coordinator(_spec(2))
+    msg = {"op": "register", "worker_id": "a", "worker_index": None,
+           "host": "h1", "jax_port": None, "token": "tok-reg-1"}
+    r1 = coord.dispatch(dict(msg))
+    r2 = coord.dispatch(dict(msg))
+    assert r1 == r2
+    assert r1["worker_index"] == 0
+    assert coord.status()["registered"] == 1
+    assert coord.op_replays == 1
+    # a genuinely NEW registration (new token, new worker) still lands
+    r3 = coord.dispatch({**msg, "worker_id": "b", "token": "tok-reg-2"})
+    assert r3["worker_index"] == 1
+    assert coord.status()["registered"] == 2
+
+
+def test_epoch_report_duplicate_delivery_cannot_double_count():
+    coord = Coordinator(_spec(2))
+    coord.register("a", 0, host="h")
+    coord.register("b", 1, host="h")
+    msg = {"op": "epoch", "stats": _stats(0, 0).__dict__, "token": "tok-e0"}
+    coord.dispatch(dict(msg))
+    coord.dispatch(dict(msg))  # retried delivery
+    assert coord.op_replays == 1
+    coord.dispatch({"op": "epoch", "stats": _stats(1, 0).__dict__,
+                    "token": "tok-e1"})
+    # quorum completed exactly once, with exactly 2 worker records
+    assert [s.epoch for s in coord.aggregator.summaries] == [0]
+    assert coord.aggregator.summaries[0].n_workers == 2
+    coord.liveness.stop()
+
+
+def test_complete_duplicate_delivery_burns_budget_once():
+    # 3 workers, restart budget = floor(0.4 * 3) = 1
+    coord = Coordinator(_spec(3, max_worker_failure_ratio=0.4))
+    for i, wid in enumerate(["a", "b", "c"]):
+        coord.register(wid, i, host="h")
+    assert coord.max_restarts == 1
+    msg = {"op": "complete", "worker_id": "b", "exit_code": 1,
+           "token": "tok-c1"}
+    coord.dispatch(dict(msg))
+    coord.dispatch(dict(msg))  # retried delivery of the same failure
+    st = coord.status()
+    assert st["restarts_used"] == 1, "duplicate complete double-burned budget"
+    assert coord.state == JobState.TRAINING
+    # a DISTINCT second failure exhausts the budget — proving the budget
+    # accounting is live and the duplicate above was truly deduped
+    coord.dispatch({"op": "complete", "worker_id": "c", "exit_code": 1,
+                    "token": "tok-c2"})
+    assert coord.state == JobState.FAILED
+    coord.liveness.stop()
+
+
+# --------------------------------------------------------------------------
+# RPC chaos drill: connections drop mid-barrier, fleet converges
+# --------------------------------------------------------------------------
+
+
+def test_rpc_drill_fleet_converges_under_connection_faults():
+    plan = faults.FaultPlan.parse(
+        "rpc.connect:reset@0.3,rpc.recv:reset@0.3", seed=5)
+    faults.set_plan(plan)
+    coord = Coordinator(_spec(2, epochs=3, sync_epochs=True))
+    host, port = coord.serve()
+    errors = []
+
+    def run(wid, idx):
+        try:
+            c = CoordinatorClient(host, port, retry_policy=FAST)
+            assert c.register(wid, idx, host="127.0.0.1")["ok"]
+            assert c.await_start()["ok"]
+            for e in range(3):
+                assert c.report_epoch(_stats(idx, e))["ok"]
+                assert c.epoch_barrier(wid, e)["ok"]
+            c.complete(wid, 0)
+        except Exception as exc:  # surface in the main thread
+            errors.append((wid, exc))
+
+    threads = [threading.Thread(target=run, args=(f"w{i}", i))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not errors, f"workers failed under chaos: {errors}"
+        assert coord.state == JobState.FINISHED
+        # every epoch published exactly once, with full quorum — retried
+        # deliveries never double-counted a worker or an epoch stat
+        assert sorted(s.epoch for s in coord.aggregator.summaries) == [0, 1, 2]
+        assert all(s.n_workers == 2 for s in coord.aggregator.summaries)
+        assert sum(plan.fired().values()) > 0, "no faults injected"
+    finally:
+        coord.shutdown()
+
+
+def test_rpc_faults_fatal_without_retry():
+    faults.set_plan(faults.FaultPlan.parse("rpc.connect:refused@1.0", seed=1))
+    c = CoordinatorClient("127.0.0.1", 1, retry_policy=retry.NO_RETRY)
+    with pytest.raises(ConnectionRefusedError):
+        c.status()
+    # with retries the attempts are bounded, then the error surfaces
+    c2 = CoordinatorClient(
+        "127.0.0.1", 1,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0001, seed=1))
+    with pytest.raises(ConnectionRefusedError):
+        c2.status()
+    # both arms count as exhausted (NO_RETRY = a 1-attempt policy)
+    assert retry.counters()["rpc.status.exhausted"] == 2
+
+
+# --------------------------------------------------------------------------
+# fault plan drives the checkpoint seam end to end
+# --------------------------------------------------------------------------
+
+
+def test_ckpt_write_fault_site_respects_retry_and_counts(tmp_path):
+    faults.set_plan(faults.FaultPlan.parse("ckpt.write:503@1.0", seed=2))
+    with NpzCheckpointer(str(tmp_path / "ck")) as ck:
+        # ckpt.write faults are raised at the seam and are NOT retried by
+        # the checkpointer itself (they model the fetch/serialize layer);
+        # the async path surfaces them on the next wait()
+        with pytest.raises(faults.InjectedHttpError):
+            ck.save(0, _mini_state())
+    faults.set_plan(None)
+    with NpzCheckpointer(str(tmp_path / "ck")) as ck:
+        ck.save(0, _mini_state())
+        assert ck.latest_epoch() == 0
