@@ -151,13 +151,52 @@ CACHE_DIR = TPU_PREFIX + "cache-dir"
 CACHE_MAX_BYTES = TPU_PREFIX + "cache-max-bytes"
 DEFAULT_CACHE_MAX_BYTES = 0
 
+# flat-file (npz) checkpointing with sidecar-manifest verification for
+# NON-SPMD workers too (SPMD always uses it — orbax's collective
+# barriers deadlock under chief-writes/everyone-reads)
+FLAT_CHECKPOINT = TPU_PREFIX + "flat-checkpoint"
+DEFAULT_FLAT_CHECKPOINT = False
+
+# ---- training-health watchdog (train/trainer.py HealthGuard;
+# coordinator.report_unhealthy for the fleet rollback policy) ----
+# On-device isfinite check on the per-step loss and (per-step path)
+# global gradient norm, cross-referenced against host-side real-row
+# bookkeeping so the NaN-as-padding marker never trips it.
+HEALTH_CHECK_FINITE = TPU_PREFIX + "health-check-finite"
+DEFAULT_HEALTH_CHECK_FINITE = True
+# EMA loss-spike divergence detector: trip when a finite epoch loss
+# exceeds factor x EMA of previous epochs (0 disables).
+HEALTH_SPIKE_FACTOR = TPU_PREFIX + "health-spike-factor"
+DEFAULT_HEALTH_SPIKE_FACTOR = 0.0
+HEALTH_SPIKE_MIN_EPOCHS = TPU_PREFIX + "health-spike-min-epochs"
+DEFAULT_HEALTH_SPIKE_MIN_EPOCHS = 2
+# wall-clock per-step hang watchdog (ms; 0 disables): catches a wedged
+# device call the liveness monitor is blind to (the heartbeat THREAD
+# keeps beating while the training thread hangs).
+HEALTH_HANG_TIMEOUT_MS = TPU_PREFIX + "health-hang-timeout"
+DEFAULT_HEALTH_HANG_TIMEOUT_MS = 0
+# fleet rollback policy: LR multiplier applied per rollback, the hard cap
+# on rollbacks (they ALSO share the crash-restart budget), and the skip
+# window — each reported bad step plus (window - 1) steps BEFORE it is
+# skipped on the replay (the guard's report already covers the trailing
+# side: it lists the first bad step and its non-finite successors).
+HEALTH_LR_BACKOFF = TPU_PREFIX + "health-rollback-lr-backoff"
+DEFAULT_HEALTH_LR_BACKOFF = 0.5
+HEALTH_MAX_ROLLBACKS = TPU_PREFIX + "health-max-rollbacks"
+DEFAULT_HEALTH_MAX_ROLLBACKS = 2
+HEALTH_SKIP_WINDOW = TPU_PREFIX + "health-skip-window"
+DEFAULT_HEALTH_SKIP_WINDOW = 1
+
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib
 # network planes (WebHDFS/GCS clients, coordinator RPC, remote checkpoint
 # writes) carry their own classify-retry-with-backoff discipline, tuned
 # here.  retry-max-attempts=1 disables retries (the chaos drill's control
-# arm); retry-deadline caps the wall clock across all attempts of one call
-# so a seam can never outlast the liveness monitor's patience.
+# arm); retry-deadline caps one call's CUMULATIVE BACKOFF SLEEP — the
+# stall the retry layer itself adds — NOT the attempts' own blocking time
+# (a long-blocking barrier RPC keeps its reconnect budget), so bounding a
+# seam against the liveness monitor's patience also needs per-request
+# socket timeouts.
 RETRY_MAX_ATTEMPTS = TPU_PREFIX + "retry-max-attempts"
 DEFAULT_RETRY_MAX_ATTEMPTS = 5
 RETRY_BASE_DELAY_MS = TPU_PREFIX + "retry-base-delay"  # ms, backoff base

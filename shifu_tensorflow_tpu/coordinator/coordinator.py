@@ -75,11 +75,24 @@ class WorkerRecord:
     # which fleet generation this record last registered into (SPMD
     # recovery restarts the whole fleet; see _fleet_restart)
     generation: int = 0
+    # non-SPMD health rollback state, scoped to THIS worker: independent
+    # models roll back independently, so worker B's LR back-off and skip
+    # window must never leak into worker C's relaunch (SPMD uses the
+    # coordinator-level fleet directive instead — one model, one policy)
+    lr_scale: float = 1.0
+    skip_directive: dict | None = None
 
 
 #: cooperative exit code for a worker leaving because the fleet is
 #: restarting (not a failure; does not consume restart budget)
 RESTART_EXIT_CODE = 44
+
+#: cooperative exit code for a worker leaving after its health guard
+#: tripped and the coordinator granted a rollback: the BUDGET was already
+#: charged by report_unhealthy, so complete() must not charge it again —
+#: but the worker is restartable (it resumes from the last verified
+#: checkpoint with the rollback directive applied)
+UNHEALTHY_EXIT_CODE = 45
 
 
 @dataclass
@@ -119,6 +132,20 @@ class JobSpec:
     # either forces sync_epochs.
     early_stop_ks: float = 0.0
     early_stop_patience: int = 0
+    # training-health rollback policy (shifu.tpu.health-*): a worker whose
+    # health guard trips (NaN/Inf loss or grad, loss spike, hung step)
+    # reports `unhealthy`; the coordinator arbitrates ONE fleet-wide
+    # rollback — restore the last verified checkpoint, scale the learning
+    # rate by health_lr_backoff, and skip the offending batch window.
+    # Rollbacks are charged against the SAME restart budget as crashes
+    # (spare_restarts et al.) AND capped by health_max_rollbacks; either
+    # limit exhausted fails the job fast with a diagnostic bundle.
+    health_lr_backoff: float = 0.5
+    health_max_rollbacks: int = 2
+    # skip window width: each reported bad step plus (window - 1) steps
+    # before it is skipped on the replay (trailing steps are covered by
+    # the report itself — the guard lists every non-finite step)
+    health_skip_window: int = 1
 
 
 class Coordinator:
@@ -180,8 +207,19 @@ class Coordinator:
             interval_ms=spec.heartbeat_interval_ms,
             max_missed=spec.max_missed_heartbeats,
             on_expired=self._on_worker_expired,
+            on_recovered=self._on_worker_recovered,
         )
         self._failed_restarts = 0
+        # health-rollback state: count, the accumulated LR back-off, the
+        # skip directive for the offending batch window, and the last
+        # unhealthy report's diagnostics (bundled into failures)
+        self._rollbacks = 0
+        self._lr_scale = 1.0
+        self._skip_directive: dict | None = None
+        self._last_unhealthy: dict | None = None
+        # non-SPMD hung workers the submitter must SIGKILL (their training
+        # thread is wedged; they cannot exit cooperatively)
+        self._pending_kills: list[str] = []
         self._server: "_Server | None" = None
         # at-most-once delivery for retried non-idempotent ops: the client
         # stamps register/epoch/complete with a per-LOGICAL-call token; a
@@ -303,6 +341,26 @@ class Coordinator:
                 "spmd": self.spec.spmd,
                 "generation": self._generation,
                 "shard_lines": self._shard_lines.get(rec.worker_index),
+                # rollback directive: relaunched workers train at the
+                # backed-off LR and skip the batch window that tripped
+                # the guard.  SPMD: the FLEET directive (identical for
+                # every worker — one model must stay in lockstep);
+                # non-SPMD: this worker's own rollback state, so a
+                # healthy worker relaunched after an unrelated crash
+                # never inherits another worker's back-off
+                "health": (
+                    {
+                        "lr_scale": self._lr_scale,
+                        "skip": self._skip_directive,
+                        "rollbacks": self._rollbacks,
+                    }
+                    if self.spec.spmd
+                    else {
+                        "lr_scale": rec.lr_scale,
+                        "skip": rec.skip_directive,
+                        "rollbacks": self._rollbacks,
+                    }
+                ),
             }
 
     _LOOPBACK = LOOPBACK_HOSTS
@@ -387,6 +445,23 @@ class Coordinator:
                 return {"ok": False, "error": self.failure_reason}
             # caller's own (shorter) timeout expired; job still registering
             return {"ok": False, "error": "await timeout", "retryable": True}
+
+    def check_registration_deadline(self) -> None:
+        """Enforce the registration deadline from the CONTROL side: the
+        deadline used to live only inside await_start(), i.e. it was
+        policed by the very workers whose absence it guards against — a
+        fleet that never launches (bad image, dead hosts) left the job
+        REGISTERING until the job timeout.  The submitter polls this."""
+        with self._lock:
+            if self.state != JobState.REGISTERING:
+                return
+            elapsed = time.monotonic() - self._gen_started_at
+            if elapsed >= self.spec.registration_timeout_s:
+                self._fail(
+                    f"registration timeout: {len(self.workers)}/"
+                    f"{self.spec.n_workers} workers after "
+                    f"{self.spec.registration_timeout_s:.0f}s"
+                )
 
     def sync_plan(
         self, worker_id: str, plan: dict, timeout_s: float | None = None
@@ -594,6 +669,11 @@ class Coordinator:
                 # failure; the submitter relaunches this worker into the
                 # new generation
                 return {"ok": True, "state": self.state.value}
+            if exit_code == UNHEALTHY_EXIT_CODE:
+                # health-rollback exit: report_unhealthy already charged
+                # the budget; the record stays completed-with-nonzero so
+                # restartable_workers() offers it for relaunch (non-SPMD)
+                return {"ok": True, "state": self.state.value}
             if exit_code != 0:
                 # only a failure during an active job consumes budget: after
                 # FINISHED the model is already exported, and after FAILED
@@ -611,12 +691,162 @@ class Coordinator:
                     self._epoch_cond.notify_all()
             return {"ok": True, "state": self.state.value}
 
+    # ---- training-health rollback ----
+    def report_unhealthy(
+        self,
+        worker_id: str,
+        epoch: int,
+        reason: str,
+        bad_steps: list | None = None,
+        diag: dict | None = None,
+        hung: bool = False,
+    ) -> dict[str, Any]:
+        """A worker's health guard tripped (divergence or hung step).
+        Arbitrate ONE fleet-wide rollback: charge the shared restart
+        budget AND the health_max_rollbacks cap, accumulate the LR
+        back-off, record the skip window for the offending steps, and —
+        SPMD — bump the fleet generation so everyone restores the last
+        verified checkpoint together.  Budget exhausted → fail fast with
+        the diagnostic bundle (last losses/grad norms, per-worker
+        heartbeat ages), never hang."""
+        with self._lock:
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return {"ok": False, "error": f"unknown worker {worker_id}"}
+            if self.state in (JobState.FINISHED, JobState.FAILED):
+                return {"ok": False, "abort": True,
+                        "error": self.failure_reason}
+            if self.spec.spmd and rec.generation < self._generation:
+                # a rollback for this root cause is already underway —
+                # peers of the tripping worker report the same NaN (the
+                # all-reduce propagated it); only the first consumes budget
+                return {"ok": True, "fleet": True, "deduped": True}
+            self._rollbacks += 1
+            self._last_unhealthy = {
+                "worker_id": worker_id,
+                "worker_index": rec.worker_index,
+                "epoch": int(epoch),
+                "reason": reason,
+                "bad_steps": list(bad_steps or []),
+                "diag": dict(diag or {}),
+            }
+            # skip window: every reported bad step plus health_skip_window
+            # - 1 steps before it (the guard reports the FIRST bad step
+            # and its non-finite successors, so the trailing side is
+            # already covered by the report itself)
+            skip = None
+            if bad_steps:
+                w = max(0, int(self.spec.health_skip_window) - 1)
+                steps = sorted({
+                    s
+                    for b in bad_steps
+                    for s in range(max(0, int(b) - w), int(b) + 1)
+                })
+                skip = {"epoch": int(epoch), "steps": steps}
+            if self.spec.spmd:
+                # fleet-wide: one model, one directive
+                self._lr_scale *= self.spec.health_lr_backoff
+                if skip is not None:
+                    self._skip_directive = skip
+                applied_scale = self._lr_scale
+            else:
+                # per-worker: independent models roll back independently
+                rec.lr_scale *= self.spec.health_lr_backoff
+                if skip is not None:
+                    rec.skip_directive = skip
+                applied_scale = rec.lr_scale
+            log.warning(
+                "worker %d unhealthy at epoch %d (%s): rollback %d/%d, "
+                "lr_scale -> %g, skip %s",
+                rec.worker_index, epoch, reason, self._rollbacks,
+                self.spec.health_max_rollbacks, applied_scale, skip,
+            )
+            if self._rollbacks > self.spec.health_max_rollbacks:
+                self._fail(
+                    f"health rollback budget exhausted "
+                    f"({self.spec.health_max_rollbacks}) by worker "
+                    f"{rec.worker_index} at epoch {epoch}: {reason}; "
+                    f"diagnostics: {json.dumps(self.diagnostics())}"
+                )
+                return {"ok": False, "abort": True,
+                        "error": self.failure_reason}
+            if self.spec.spmd:
+                # shares the crash-restart budget: _fleet_restart charges
+                # it and fails the job (with the reason) when exhausted
+                self._fleet_restart(
+                    f"worker {rec.worker_index} unhealthy at epoch "
+                    f"{epoch} ({reason}); rollback {self._rollbacks}/"
+                    f"{self.spec.health_max_rollbacks}"
+                )
+                if self.state == JobState.FAILED:
+                    return {"ok": False, "abort": True,
+                            "error": self.failure_reason}
+                return {"ok": True, "fleet": True}
+            # non-SPMD: this worker rolls back alone — charge the shared
+            # budget here; the worker exits UNHEALTHY_EXIT_CODE (which
+            # complete() treats as already-charged) and is relaunched
+            self._failed_restarts += 1
+            if self._failed_restarts > self.max_restarts:
+                self._fail(
+                    f"worker {rec.worker_index} unhealthy at epoch {epoch} "
+                    f"({reason}); restart budget {self.max_restarts} "
+                    f"exhausted; diagnostics: "
+                    f"{json.dumps(self.diagnostics())}"
+                )
+                return {"ok": False, "abort": True,
+                        "error": self.failure_reason}
+            rec.restarts += 1
+            if hung:
+                # the worker's training thread is wedged — it cannot exit
+                # on its own; the submitter must SIGKILL it before any
+                # relaunch.  Deliberately NOT marked restartable here:
+                # restartability waits for mark_worker_killed(), so the
+                # relaunch can never race ahead of the kill and become
+                # its victim (the submitter's poll loop would otherwise
+                # overwrite its process handle and SIGKILL the fresh
+                # worker while the zombie lives on).
+                self.liveness.unregister(worker_id)
+                self._pending_kills.append(worker_id)
+            return {"ok": True, "fleet": False}
+
+    def take_pending_kills(self) -> list[str]:
+        """Drain the workers the submitter must SIGKILL (hung steps).
+        The submitter calls mark_worker_killed() for each once the kill
+        has been delivered."""
+        with self._lock:
+            out, self._pending_kills = self._pending_kills, []
+            return out
+
+    def mark_worker_killed(self, worker_id: str) -> None:
+        """The submitter delivered the SIGKILL for a hung worker: NOW the
+        record becomes restartable (budget was already charged by
+        report_unhealthy)."""
+        with self._lock:
+            rec = self.workers.get(worker_id)
+            if rec is not None and not rec.completed:
+                rec.completed = True
+                rec.exit_code = UNHEALTHY_EXIT_CODE
+
     # ---- failure handling ----
     def _on_worker_expired(self, worker_id: str) -> None:
         with self._lock:
             rec = self.workers.get(worker_id)
             if rec is not None and not rec.completed:
                 self._on_worker_failed(rec, "missed heartbeats")
+
+    def _on_worker_recovered(self, worker_id: str) -> None:
+        """Liveness flap: a worker written off as expired is beating
+        again (long compile / GC pause / healed partition).  If its
+        expiry already consumed restart budget or triggered a relaunch,
+        that cannot be undone — but the fleet no longer treats the worker
+        as permanently gone, and the flap is on the record."""
+        with self._lock:
+            rec = self.workers.get(worker_id)
+            idx = rec.worker_index if rec is not None else -1
+        log.warning(
+            "worker %d (%s) recovered from liveness expiry (flap #%d)",
+            idx, worker_id, self.liveness.flaps,
+        )
 
     def _on_worker_failed(self, rec: WorkerRecord, why: str) -> None:
         if self.spec.spmd:
@@ -749,6 +979,53 @@ class Coordinator:
                 "pending_epochs": self.aggregator.pending_epochs(),
                 "spmd": self.spec.spmd,
                 "generation": self._generation,
+                # rollback visibility: operators (and the drills) can see
+                # that a health rollback happened, not just that epochs
+                # ran twice
+                "rollbacks": self._rollbacks,
+                "lr_scale": self._lr_scale,
+            }
+
+    def diagnostics(self) -> dict[str, Any]:
+        """The failure-time diagnostic bundle: per-worker last-heartbeat
+        ages and liveness state, last reported epochs, restart/rollback
+        accounting, and the most recent unhealthy report (last losses,
+        grad norms).  Attached to JobResult on every failure and inlined
+        into budget-exhaustion failure reasons — a timeout message alone
+        tells an operator nothing about WHICH worker went quiet."""
+        ages = self.liveness.ages()
+        expired = self.liveness.expired()
+        with self._lock:
+            workers = {}
+            for wid, rec in self.workers.items():
+                if wid in expired:
+                    liveness = "expired"
+                elif wid in ages:
+                    liveness = "alive"
+                else:
+                    liveness = "unregistered"
+                workers[wid] = {
+                    "worker_index": rec.worker_index,
+                    "liveness": liveness,
+                    "last_heartbeat_age_s": (
+                        round(ages[wid], 3) if wid in ages else None
+                    ),
+                    "last_epoch": self._last_epoch.get(
+                        rec.worker_index, -1),
+                    "restarts": rec.restarts,
+                    "completed": rec.completed,
+                    "exit_code": rec.exit_code,
+                    "lr_scale": rec.lr_scale,
+                }
+            return {
+                "workers": workers,
+                "restarts_used": self._failed_restarts,
+                "restart_budget": self.max_restarts,
+                "rollbacks": self._rollbacks,
+                "lr_scale": self._lr_scale,
+                "liveness_flaps": self.liveness.flaps,
+                "generation": self._generation,
+                "last_unhealthy": self._last_unhealthy,
             }
 
     # ---- TCP plumbing ----
@@ -826,6 +1103,15 @@ class Coordinator:
         if op == "request_restart":
             return self.request_restart(
                 msg["worker_id"], msg.get("why") or "unspecified"
+            )
+        if op == "unhealthy":
+            return self.report_unhealthy(
+                msg["worker_id"],
+                int(msg.get("epoch", -1)),
+                msg.get("reason") or "unspecified",
+                bad_steps=msg.get("bad_steps"),
+                diag=msg.get("diag"),
+                hung=bool(msg.get("hung", False)),
             )
         if op == "status":
             return self.status()
@@ -949,6 +1235,30 @@ class CoordinatorClient:
     def request_restart(self, worker_id: str, why: str) -> dict[str, Any]:
         return self.call(
             {"op": "request_restart", "worker_id": worker_id, "why": why}
+        )
+
+    def report_unhealthy(
+        self,
+        worker_id: str,
+        epoch: int,
+        reason: str,
+        bad_steps: list | None = None,
+        diag: dict | None = None,
+        hung: bool = False,
+    ) -> dict[str, Any]:
+        # non-idempotent (charges rollback/restart budget): the dedup
+        # token keeps a retried delivery from double-charging
+        return self.call(
+            {
+                "op": "unhealthy",
+                "worker_id": worker_id,
+                "epoch": epoch,
+                "reason": reason,
+                "bad_steps": list(bad_steps or []),
+                "diag": diag or {},
+                "hung": hung,
+                "token": uuid.uuid4().hex,
+            }
         )
 
     def status(self) -> dict[str, Any]:

@@ -7,6 +7,15 @@ with it and its kill action is commented out, so expiry is vestigial
 (SURVEY.md §5.2).  This monitor is real: workers that miss the budget are
 reported to the failure callback, which drives the coordinator's
 checkpoint-restart policy.
+
+Expiry is NOT terminal: a worker that was marked expired and then beats
+again (a long XLA compile, a GC pause, a network partition healing)
+recovers into ``alive()``, fires ``on_recovered``, and the flap is logged
+and counted — without this, every transient pause permanently shrank the
+fleet the coordinator believed in.  Note the recovery races the failure
+policy by design: if ``on_expired`` already consumed restart budget or
+triggered a relaunch, the recovery does not (cannot) undo it — the flap
+log is the diagnostic trail for that case.
 """
 
 from __future__ import annotations
@@ -15,6 +24,10 @@ import threading
 import time
 from typing import Callable
 
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("liveness")
+
 
 class LivenessMonitor:
     def __init__(
@@ -22,17 +35,21 @@ class LivenessMonitor:
         interval_ms: int = 1000,
         max_missed: int = 25,
         on_expired: Callable[[str], None] | None = None,
+        on_recovered: Callable[[str], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.interval_s = interval_ms / 1000.0
         self.max_missed = max_missed
         self.on_expired = on_expired
+        self.on_recovered = on_recovered
         self._clock = clock
         self._last: dict[str, float] = {}
         self._expired: set[str] = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        #: expired→alive transitions observed (diagnostics)
+        self.flaps = 0
 
     # ---- registration / beats ----
     def register(self, worker_id: str) -> None:
@@ -46,9 +63,27 @@ class LivenessMonitor:
             self._expired.discard(worker_id)
 
     def beat(self, worker_id: str) -> None:
+        recovered = False
         with self._lock:
             if worker_id in self._last:
+                last = self._last[worker_id]
                 self._last[worker_id] = self._clock()
+                if worker_id in self._expired:
+                    # the worker was written off but is beating again —
+                    # recover it instead of ignoring it forever
+                    self._expired.discard(worker_id)
+                    self.flaps += 1
+                    recovered = True
+                    silence = self._clock() - last
+        if recovered:
+            # callback outside the lock (same discipline as check())
+            log.warning(
+                "worker %s recovered after %.1fs of silence (deadline "
+                "%.1fs) — liveness flap #%d", worker_id, silence,
+                self.deadline_s, self.flaps,
+            )
+            if self.on_recovered:
+                self.on_recovered(worker_id)
 
     # ---- expiry ----
     @property
@@ -76,6 +111,13 @@ class LivenessMonitor:
     def alive(self) -> set[str]:
         with self._lock:
             return set(self._last) - self._expired
+
+    def ages(self) -> dict[str, float]:
+        """Seconds since each registered worker's last beat — the
+        diagnostics the coordinator bundles into timeout/health failures."""
+        now = self._clock()
+        with self._lock:
+            return {wid: now - last for wid, last in self._last.items()}
 
     # ---- background loop ----
     def start(self) -> None:

@@ -66,6 +66,15 @@ class JobResult:
     wall_time_s: float
     # coordinator's fleet early-stop reason, None if the budget ran out
     stop_reason: str | None = None
+    # health rollbacks performed (visible in metrics: a rollback is an
+    # operational event, not just epochs silently running twice)
+    rollbacks_used: int = 0
+    # failure-time diagnostic bundle (per-worker last-heartbeat ages +
+    # liveness state, last epochs, restart/rollback accounting, last
+    # unhealthy report) — populated on EVERY failure path, including the
+    # registration-timeout and job-timeout ones whose bare messages used
+    # to be the only evidence
+    diagnostics: dict | None = None
 
 
 class JobSubmitter:
@@ -346,6 +355,21 @@ class JobSubmitter:
                 if state in (JobState.FINISHED, JobState.FAILED):
                     break
                 self._maybe_kill_injected()
+                # a fleet that never comes up must fail by the
+                # REGISTRATION deadline (with diagnostics), not idle all
+                # the way to the job timeout
+                self.coordinator.check_registration_deadline()
+                # hung workers granted a health rollback cannot exit on
+                # their own (the training thread is wedged) — SIGKILL
+                # them so the relaunch below isn't racing a zombie
+                for wid in self.coordinator.take_pending_kills():
+                    log.warning("killing hung worker %s (health rollback)",
+                                wid)
+                    self.kill_worker(wid)
+                    # only AFTER the kill does the worker become
+                    # restartable — ordering that keeps the relaunch from
+                    # racing the kill and becoming its victim
+                    self.coordinator.mark_worker_killed(wid)
                 gen = self.coordinator.generation
                 if gen != seen_generation:
                     # SPMD fleet restart: kill survivors (they are wedged in
@@ -372,7 +396,18 @@ class JobSubmitter:
                         self._launch(rec.worker_id, addr)
                 time.sleep(self.poll_interval_s)
             else:
-                self.coordinator._fail(f"job timeout after {timeout_s:.0f}s")
+                # job timeout: the bare message says nothing about WHICH
+                # worker went quiet — inline the heartbeat picture (the
+                # full bundle rides JobResult.diagnostics below)
+                ages = self.coordinator.liveness.ages()
+                hb = {
+                    wid: f"{age:.1f}s"
+                    for wid, age in sorted(ages.items())
+                } or "none registered"
+                self.coordinator._fail(
+                    f"job timeout after {timeout_s:.0f}s; "
+                    f"last-heartbeat ages: {hb}"
+                )
             # Drain: the chief finishing flips the job to FINISHED while
             # non-chief workers may still be mid-epoch; join them so their
             # in-flight epoch reports land before the result is snapshotted
@@ -405,6 +440,15 @@ class JobSubmitter:
                 restarts_used=self.coordinator._failed_restarts,
                 wall_time_s=wall,
                 stop_reason=self.coordinator.stop_reason,
+                rollbacks_used=self.coordinator._rollbacks,
+                # diagnostics snapshot BEFORE the fleet teardown below, so
+                # heartbeat ages / liveness still describe the failure,
+                # not the cleanup
+                diagnostics=(
+                    self.coordinator.diagnostics()
+                    if self.coordinator.state == JobState.FAILED
+                    else None
+                ),
             )
             self._kill_fleet()
             self.coordinator.shutdown()

@@ -35,6 +35,7 @@ from typing import Callable
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
 from shifu_tensorflow_tpu.coordinator.coordinator import (
     RESTART_EXIT_CODE,
+    UNHEALTHY_EXIT_CODE,
     CoordinatorClient,
 )
 from shifu_tensorflow_tpu.data.dataset import (
@@ -45,6 +46,7 @@ from shifu_tensorflow_tpu.data.dataset import (
 from shifu_tensorflow_tpu.data.reader import RecordSchema
 from shifu_tensorflow_tpu.train import make_trainer
 from shifu_tensorflow_tpu.train.checkpoint import Checkpointer, NpzCheckpointer
+from shifu_tensorflow_tpu.train.trainer import HealthConfig, TrainingUnhealthy
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("worker")
@@ -84,6 +86,10 @@ class WorkerConfig:
     keep_best: str = ""
     # background checkpoint writes (conf key shifu.tpu.async-checkpoint)
     async_checkpoint: bool = False
+    # use the flat-file NpzCheckpointer (sidecar-manifest-verified save /
+    # quarantine-and-fall-back restore) even for non-SPMD workers; SPMD
+    # always uses it (orbax's collective barriers deadlock there)
+    flat_checkpoint: bool = False
     # binary shard cache directory (data/cache.py); None = no caching
     cache_dir: str | None = None
     # streaming transport dtype for features (conf key
@@ -94,6 +100,13 @@ class WorkerConfig:
     # RetryPolicy dict; None keeps the process default.  Carried in the
     # JSON transport so subprocess workers inherit the submit-side conf.
     retry: dict | None = None
+    # training-health guard (shifu.tpu.health-* keys): on-device
+    # isfinite checks on loss/grad-norm, EMA loss-spike detection, and
+    # the wall-clock per-step hang watchdog (0 = off)
+    health_check_finite: bool = True
+    health_spike_factor: float = 0.0
+    health_spike_min_epochs: int = 2
+    health_hang_timeout_s: float = 0.0
 
     def to_json(self) -> dict:
         """JSON transport for subprocess workers (worker_main)."""
@@ -108,8 +121,10 @@ class WorkerConfig:
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "prefetch_depth",
                 "scan_steps", "accum_steps", "keep_best",
-                "async_checkpoint", "cache_dir", "stream_feature_dtype",
-                "retry",
+                "async_checkpoint", "flat_checkpoint", "cache_dir",
+                "stream_feature_dtype",
+                "retry", "health_check_finite", "health_spike_factor",
+                "health_spike_min_epochs", "health_hang_timeout_s",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -228,6 +243,36 @@ def run_worker(cfg: WorkerConfig, *,
     sync_epochs = bool(reg.get("sync_epochs", False))
     spmd = bool(reg.get("spmd", cfg.spmd))
     generation = int(reg.get("generation", 0))
+    # coordinator rollback directive: after a health rollback every worker
+    # trains at the backed-off LR and skips the offending batch window —
+    # identical values fleet-wide (they rode the same register reply)
+    directive = reg.get("health") or {}
+    lr_scale = float(directive.get("lr_scale") or 1.0)
+    skip = directive.get("skip") or {}
+    model_config = cfg.model_config
+    if lr_scale != 1.0:
+        import dataclasses as _dc
+
+        p = model_config.params
+        model_config = _dc.replace(
+            model_config,
+            params=_dc.replace(p, learning_rate=p.learning_rate * lr_scale),
+        )
+        log.warning(
+            "health rollback directive: learning rate scaled x%g -> %g "
+            "(rollback %s)", lr_scale,
+            model_config.params.learning_rate, directive.get("rollbacks"),
+        )
+    health = HealthConfig(
+        check_finite=cfg.health_check_finite,
+        spike_factor=cfg.health_spike_factor,
+        spike_min_epochs=cfg.health_spike_min_epochs,
+        hang_timeout_s=cfg.health_hang_timeout_s,
+        lr_scale=lr_scale,
+        skip_epoch=(int(skip["epoch"]) if skip.get("epoch") is not None
+                    else None),
+        skip_steps=tuple(int(s) for s in (skip.get("steps") or ())),
+    )
 
     hb = _HeartbeatThread(
         client, cfg.worker_id, cfg.heartbeat_interval_s, generation
@@ -235,6 +280,7 @@ def run_worker(cfg: WorkerConfig, *,
     hb.start()
     exit_code = 0
     checkpointer = None
+    trainer = None
     try:
         started = client.await_start()
         if not started.get("ok"):
@@ -290,7 +336,7 @@ def run_worker(cfg: WorkerConfig, *,
         # wide/embedding column positions (and so the param tree) diverge
         # between the trained checkpoint and the restored export model
         trainer = make_trainer(
-            cfg.model_config,
+            model_config,
             cfg.schema.num_features,
             feature_columns=cfg.schema.feature_columns,
             mesh=mesh,
@@ -301,13 +347,32 @@ def run_worker(cfg: WorkerConfig, *,
             scan_steps=cfg.scan_steps,
             accum_steps=cfg.accum_steps,
             keep_best=cfg.keep_best,
+            health=health,
             **extra,
         )
+        if trainer.health_guard is not None:
+            # hang watchdog → coordinated recovery: the wedged training
+            # thread cannot raise, so the watchdog thread reports the
+            # hang; the coordinator rolls the fleet back (SPMD: the
+            # submitter SIGKILLs this very process on the generation
+            # bump; non-SPMD: the submitter kills it via pending_kills)
+            def _on_hang(reason: str, diag: dict) -> None:
+                try:
+                    client.report_unhealthy(
+                        cfg.worker_id, diag.get("epoch", -1), reason,
+                        diag=diag, hung=True,
+                    )
+                except Exception:
+                    log.exception("could not report hung step")
+
+            trainer.health_guard.on_hang = _on_hang
 
         if cfg.checkpoint_dir:
             # SPMD uses the flat-file checkpointer: orbax's internal
-            # cross-process barriers deadlock under chief-writes/all-read
-            if spmd:
+            # cross-process barriers deadlock under chief-writes/all-read.
+            # flat_checkpoint opts non-SPMD workers into it too, for the
+            # manifest-verified save/restore chain.
+            if spmd or cfg.flat_checkpoint:
                 checkpointer = NpzCheckpointer(
                     cfg.checkpoint_dir,
                     every_epochs=cfg.checkpoint_every_epochs,
@@ -340,6 +405,28 @@ def run_worker(cfg: WorkerConfig, *,
                 sync_epochs=sync_epochs,
                 fail_at_epoch=fail_at_epoch,
             )
+    except TrainingUnhealthy as e:
+        # divergence detected at epoch end, BEFORE the diverged state was
+        # checkpointed or reported: hand the coordinator the evidence and
+        # let it arbitrate one fleet-wide rollback
+        log.warning(
+            "health guard tripped (worker_index=%s, epoch %d): %s",
+            worker_index, e.epoch, e.reason,
+        )
+        try:
+            resp = client.report_unhealthy(
+                cfg.worker_id, e.epoch, e.reason,
+                bad_steps=list(e.bad_steps), diag=e.diag,
+            )
+        except Exception:
+            log.exception("could not report unhealthy state")
+            resp = {}
+        if resp.get("fleet"):
+            exit_code = RESTART_EXIT_CODE
+        elif resp.get("ok"):
+            exit_code = UNHEALTHY_EXIT_CODE
+        else:
+            exit_code = 42  # budget gone / job failed: cooperative abort
     except _InjectedFault:
         log.warning("injected fault fired (worker_index=%s, "
                     "fail_at_epoch=%s)", worker_index, fail_at_epoch)
@@ -360,6 +447,13 @@ def run_worker(cfg: WorkerConfig, *,
     finally:
         if port_hold is not None:
             port_hold.release()
+        # stop the hang watchdog FIRST: left armed, it could fire a
+        # spurious unhealthy report for a worker that is already exiting
+        if trainer is not None and trainer.health_guard is not None:
+            try:
+                trainer.health_guard.close()
+            except Exception:
+                pass
         # always release the checkpoint manager: leaked orbax async writer
         # threads abort the interpreter at teardown
         if checkpointer is not None:
@@ -544,9 +638,23 @@ def _run_spmd_training(
         train_steps = dataset.steps_per_epoch(local_batch)
         valid_steps = dataset.valid_steps(local_batch)
 
-    latest = (
-        checkpointer.latest_epoch() if checkpointer is not None else None
-    )
+    # report only VERIFIED generations into the fleet agreement: the
+    # coordinator's min-over-workers must land on an epoch every worker
+    # can actually restore — a corrupt-but-present generation reported
+    # here would wedge the whole fleet on an unrestorable point.
+    # Upgrade path: a checkpoint dir written before manifests existed has
+    # restorable-but-unverifiable (legacy) generations; discarding hours
+    # of progress over a missing sidecar would be worse than trusting the
+    # npz-parse guard, so fall back to latest_epoch() (which itself
+    # quarantines cheap-corrupt generations) when nothing is verified.
+    latest = None
+    if checkpointer is not None:
+        latest = getattr(
+            checkpointer, "latest_verified_epoch",
+            lambda: None,
+        )()
+        if latest is None:
+            latest = checkpointer.latest_epoch()
     plan_payload = {
         "train_steps": train_steps,
         "valid_steps": valid_steps,

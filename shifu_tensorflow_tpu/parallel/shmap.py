@@ -1,6 +1,13 @@
-"""``jax.shard_map`` with the replication-check kwarg pinned across jax
-versions (renamed ``check_rep`` → ``check_vma`` in jax 0.9) — the one shim
-every shard_map call site in the framework shares."""
+"""``shard_map`` resolved across jax versions — the one shim every
+shard_map call site in the framework shares.
+
+Three API generations are covered: ``jax.shard_map`` (new), the
+``jax.experimental.shard_map.shard_map`` it graduated from (jax <= 0.4.x,
+where ``jax.shard_map`` raises an accelerated-deprecation AttributeError),
+and the replication-check kwarg rename ``check_rep`` → ``check_vma``
+(jax 0.9).  Resolving here keeps a jax upgrade or downgrade from taking
+out every SAGN/ring call site at import time.
+"""
 
 from __future__ import annotations
 
@@ -8,15 +15,20 @@ import inspect
 
 import jax
 
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # jax <= 0.4.x: still under jax.experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 _CHECK_KW = (
     "check_vma"
-    if "check_vma" in inspect.signature(jax.shard_map).parameters
+    if "check_vma" in inspect.signature(_shard_map).parameters
     else "check_rep"
 )
 
 
 def shard_map(fn, mesh, in_specs, out_specs, *, check_replication=False):
-    return jax.shard_map(
+    return _shard_map(
         fn,
         mesh=mesh,
         in_specs=in_specs,

@@ -202,7 +202,27 @@ def trainer_extras(args, conf: Conf) -> dict:
         "scan_steps": resolve_scan_steps(args, conf),
         "accum_steps": resolve_accum_steps(args, conf),
         "keep_best": resolve_keep_best(args, conf),
+        "health": resolve_health(conf),
     }
+
+
+def resolve_health(conf: Conf):
+    """shifu.tpu.health-* -> HealthConfig for the single-process run
+    paths (run_multi carries the same keys per worker through the
+    WorkerConfig JSON bridge, worker_runtime_kwargs)."""
+    from shifu_tensorflow_tpu.train.trainer import HealthConfig
+
+    return HealthConfig(
+        check_finite=conf.get_bool(K.HEALTH_CHECK_FINITE,
+                                   K.DEFAULT_HEALTH_CHECK_FINITE),
+        spike_factor=conf.get_float(K.HEALTH_SPIKE_FACTOR,
+                                    K.DEFAULT_HEALTH_SPIKE_FACTOR),
+        spike_min_epochs=conf.get_int(K.HEALTH_SPIKE_MIN_EPOCHS,
+                                      K.DEFAULT_HEALTH_SPIKE_MIN_EPOCHS),
+        hang_timeout_s=conf.get_int(
+            K.HEALTH_HANG_TIMEOUT_MS, K.DEFAULT_HEALTH_HANG_TIMEOUT_MS
+        ) / 1000.0,
+    )
 
 
 def resolve_keep_best(args, conf: Conf) -> str:
@@ -233,12 +253,30 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         "keep_best": resolve_keep_best(args, conf),
         "async_checkpoint": conf.get_bool(K.ASYNC_CHECKPOINT,
                                           K.DEFAULT_ASYNC_CHECKPOINT),
+        "flat_checkpoint": conf.get_bool(K.FLAT_CHECKPOINT,
+                                         K.DEFAULT_FLAT_CHECKPOINT),
         "cache_dir": conf.get(K.CACHE_DIR),
         "stream_feature_dtype": conf.get(K.STREAM_FEATURE_DTYPE,
                                          K.DEFAULT_STREAM_FEATURE_DTYPE),
         # subprocess workers inherit the submit-side retry envelope
         # (shifu.tpu.retry-*) through the WorkerConfig JSON bridge
         "retry": _retry_util.policy_from_conf(conf).to_dict(),
+        # training-health guard (shifu.tpu.health-*): each worker detects
+        # its own divergence/hangs; the coordinator arbitrates rollbacks.
+        # ONE resolver (resolve_health) for both run paths, so a worker
+        # fleet can never apply a different health policy than a
+        # single-process run reading the same conf.
+        **_health_worker_kwargs(conf),
+    }
+
+
+def _health_worker_kwargs(conf: Conf) -> dict:
+    hc = resolve_health(conf)
+    return {
+        "health_check_finite": hc.check_finite,
+        "health_spike_factor": hc.spike_factor,
+        "health_spike_min_epochs": hc.spike_min_epochs,
+        "health_hang_timeout_s": hc.hang_timeout_s,
     }
 
 
@@ -329,6 +367,13 @@ def job_spec_kwargs(conf: Conf) -> dict:
             K.TASK_MAX_MISSED_HEARTBEATS, K.DEFAULT_TASK_MAX_MISSED_HEARTBEATS
         ),
         "sync_epochs": conf.get_bool(K.SYNC_EPOCHS, K.DEFAULT_SYNC_EPOCHS),
+        # training-health rollback policy (coordinator side)
+        "health_lr_backoff": conf.get_float(K.HEALTH_LR_BACKOFF,
+                                            K.DEFAULT_HEALTH_LR_BACKOFF),
+        "health_max_rollbacks": conf.get_int(K.HEALTH_MAX_ROLLBACKS,
+                                             K.DEFAULT_HEALTH_MAX_ROLLBACKS),
+        "health_skip_window": conf.get_int(K.HEALTH_SKIP_WINDOW,
+                                           K.DEFAULT_HEALTH_SKIP_WINDOW),
     }
 
 
@@ -385,6 +430,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     from shifu_tensorflow_tpu.parallel.mesh import make_mesh
     from shifu_tensorflow_tpu.train import make_trainer
     from shifu_tensorflow_tpu.train.checkpoint import Checkpointer
+    from shifu_tensorflow_tpu.train.trainer import TrainingUnhealthy
     from shifu_tensorflow_tpu.utils.profiling import trace_if
 
     device_resident = args.device_resident or conf.get_bool(
@@ -511,6 +557,26 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                     start_epoch=start_epoch,
                     early_stop=early_stop,
                 )
+    except TrainingUnhealthy as e:
+        # divergence caught by the health guard BEFORE the diverged epoch
+        # was checkpointed: single-process runs have no coordinator to
+        # arbitrate a rollback, so fail fast with the diagnostics (resume
+        # from the last verified checkpoint restarts below the bad epoch)
+        print(json.dumps({
+            "state": "unhealthy",
+            "reason": e.reason,
+            "epoch": e.epoch,
+            "bad_steps": list(e.bad_steps),
+            "diagnostics": e.diag,
+        }), flush=True)
+        print(
+            f"training unhealthy: {e.reason} — the last verified "
+            f"checkpoint (if any) was NOT overwritten; re-run to resume "
+            f"below the diverged epoch, lower the learning rate, or "
+            f"disable the guard via {K.HEALTH_CHECK_FINITE}=false",
+            file=sys.stderr,
+        )
+        return 3
     finally:
         if checkpointer is not None:
             checkpointer.close()
@@ -674,6 +740,12 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             "restarts_used": result.restarts_used,
             "wall_time_s": round(result.wall_time_s, 2),
         }
+        if result.rollbacks_used:
+            # a health rollback is an operational event the run record
+            # must show — not just epochs silently running twice
+            summary["rollbacks_used"] = result.rollbacks_used
+        if result.diagnostics is not None:
+            summary["diagnostics"] = result.diagnostics
         if result.stop_reason:
             summary["stopped_early"] = result.stop_reason
         print(json.dumps(summary), flush=True)
@@ -710,9 +782,12 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             # snapshot)
             keep_best=extras["keep_best"],
         )
-        # SPMD jobs checkpoint through the flat-file format (see
-        # NpzCheckpointer); restore with the matching reader
-        ckpt_cls = NpzCheckpointer if use_spmd else Checkpointer
+        # SPMD (and flat-checkpoint-opted) jobs checkpoint through the
+        # flat-file format (see NpzCheckpointer); restore with the
+        # matching reader
+        use_flat = use_spmd or conf.get_bool(K.FLAT_CHECKPOINT,
+                                             K.DEFAULT_FLAT_CHECKPOINT)
+        ckpt_cls = NpzCheckpointer if use_flat else Checkpointer
         with ckpt_cls(args.checkpoint_dir) as ckpt:
             trainer.restore(ckpt)
         wrote = export_model(

@@ -10,7 +10,13 @@ elastic row), so this module makes both halves real:
 
 - Orbax-backed sharded save of {params, opt_state, step} every N epochs;
 - restore returns the *next epoch to run*, so a resumed job trains exactly
-  the remaining budget.
+  the remaining budget;
+- (flat-file path) every save publishes a sidecar manifest (size + CRC32 +
+  SHA-256 over the npz payload) and restore is a verify-quarantine-fall-back
+  chain: a truncated or bit-flipped generation is renamed ``*.corrupt``
+  (never deleted) and the newest VERIFIED epoch restores instead — loading
+  garbage or crashing opaquely are both contract violations
+  (docs/resilience.md "Verified checkpoints").
 """
 
 from __future__ import annotations
@@ -22,7 +28,22 @@ import jax
 import orbax.checkpoint as ocp
 from flax.core import meta as flax_meta
 
-from shifu_tensorflow_tpu.utils import faults, fs
+from shifu_tensorflow_tpu.utils import faults, fs, logs
+
+log = logs.get("checkpoint")
+
+
+class _Corrupt(RuntimeError):
+    """Internal: one generation failed verification (manifest mismatch,
+    truncated payload, unparseable npz)."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No verifiable checkpoint generation remains: every on-disk
+    generation failed its manifest check (or failed to parse, for legacy
+    generations without a manifest).  The corrupt generations were
+    quarantined (renamed ``*.corrupt``), never deleted — the message
+    carries the per-generation diagnostics for the post-mortem."""
 
 
 def _host_tag() -> str:
@@ -184,6 +205,13 @@ class NpzCheckpointer:
     def _path(self, epoch: int) -> str:
         return f"{self.directory.rstrip('/')}/{self._PREFIX}{epoch}{self._SUFFIX}"
 
+    #: sidecar manifest (sizes + digests over the npz payload) published
+    #: beside each generation; ``.json`` suffix keeps it out of _epochs()
+    _MANIFEST_SUFFIX = ".manifest.json"
+
+    def _manifest_path(self, epoch: int) -> str:
+        return self._path(epoch) + self._MANIFEST_SUFFIX
+
     def _epochs(self) -> list[int]:
         out = []
         try:
@@ -198,9 +226,87 @@ class NpzCheckpointer:
                     continue
         return sorted(out)
 
-    def latest_epoch(self) -> int | None:
-        eps = self._epochs()
+    # ---- manifest verification ----
+    def _read_manifest(self, epoch: int) -> dict | None:
+        """Parsed manifest, or None when absent (legacy generation)."""
+        path = self._manifest_path(epoch)
+        try:
+            if not fs.exists(path):
+                return None
+        except OSError:
+            return None
+        import json
+
+        try:
+            return json.loads(fs.read_text(path))
+        except (OSError, ValueError) as e:
+            # unreadable manifest: treat the generation as unverifiable
+            return {"__error__": f"{type(e).__name__}: {e}"}
+
+    def _generation_status(self, epoch: int) -> tuple[str, str]:
+        """Cheap (no payload read) classification of one generation:
+        ``("verified", "")`` — manifest present, parses, and the npz size
+        matches; ``("legacy", why)`` — no manifest (written before
+        manifests existed, or a crash landed the npz without its sidecar);
+        ``("corrupt", why)`` — manifest unreadable or the size disagrees
+        (a truncated upload).  Bit-level corruption that preserves size is
+        only caught by the full digest check at restore time."""
+        m = self._read_manifest(epoch)
+        if m is None:
+            return "legacy", "no manifest"
+        if "__error__" in m:
+            return "corrupt", f"unreadable manifest: {m['__error__']}"
+        try:
+            actual = fs.size(self._path(epoch))
+        except OSError as e:
+            return "corrupt", f"cannot stat npz: {e}"
+        want = int(m.get("size", -1))
+        if actual != want:
+            return (
+                "corrupt",
+                f"size mismatch: manifest says {want} bytes, file has "
+                f"{actual}",
+            )
+        return "verified", ""
+
+    def verified_epochs(self) -> list[int]:
+        """Epochs whose manifest passes the cheap check — the set the
+        coordinator's sync_plan min-over-workers may count, so the fleet
+        only ever agrees on a restorable generation."""
+        return [
+            e for e in self._epochs()
+            if self._generation_status(e)[0] == "verified"
+        ]
+
+    def latest_verified_epoch(self) -> int | None:
+        eps = self.verified_epochs()
         return eps[-1] if eps else None
+
+    def _quarantine(self, epoch: int, why: str) -> None:
+        """Move a corrupt generation aside (``*.corrupt``) — NEVER delete:
+        the bytes are the post-mortem evidence, and a quarantined name no
+        longer matches ``_epochs()`` so every listing/restore path skips
+        it from now on."""
+        log.error("quarantining checkpoint epoch %d: %s", epoch, why)
+        for path in (self._path(epoch), self._manifest_path(epoch)):
+            try:
+                if fs.exists(path):
+                    fs.rename(path, path + ".corrupt")
+            except OSError as e:
+                log.warning("could not quarantine %s: %s", path, e)
+
+    def latest_epoch(self) -> int | None:
+        """Newest restorable-looking epoch: walks back from the newest
+        generation, quarantining ones that fail the cheap manifest check.
+        Legacy (manifest-less) generations are still offered — the full
+        check at restore time guards them."""
+        for epoch in reversed(self._epochs()):
+            status, why = self._generation_status(epoch)
+            if status == "corrupt":
+                self._quarantine(epoch, why)
+                continue
+            return epoch
+        return None
 
     def maybe_save(self, epoch: int, state) -> bool:
         if (epoch + 1) % self.every_epochs != 0:
@@ -243,6 +349,11 @@ class NpzCheckpointer:
         self._pending.append(self._executor.submit(self._write, epoch, arrays))
 
     def _write(self, epoch: int, arrays: dict) -> None:
+        import hashlib
+        import io
+        import json
+        import zlib
+
         import numpy as np
 
         # hostname in the suffix: a shared (NFS-mounted) checkpoint dir is
@@ -251,18 +362,80 @@ class NpzCheckpointer:
         # pid-checks temps stamped with its own hostname
         tmp = self._path(epoch) + f".tmp.{_host_tag()}.{os.getpid()}"
         faults.check("ckpt.write")
+        # serialize to memory first so the manifest digests cover exactly
+        # the bytes handed to the filesystem — any later divergence between
+        # manifest and file IS corruption, by construction.  The full
+        # buffer is affordable at this checkpointer's design scale
+        # (replicated tabular state, MBs — see the class docstring; the
+        # remote backends buffered whole payloads before this change too);
+        # incremental hashing is NOT an option while np.savez drives a
+        # seekable ZipFile, which seeks back to patch headers it already
+        # wrote — a streaming digest would hash the pre-patch bytes.
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        manifest = json.dumps({
+            "epoch": epoch,
+            "size": len(payload),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "leaves": len(arrays),
+            "written_by": f"{_host_tag()}.{os.getpid()}",
+        })
+        # at-rest corruption seam (chaos drills): applied AFTER the digest,
+        # so the manifest records what SHOULD be on disk
+        payload = faults.mutate("ckpt.at-rest", payload)
         # the tmp upload is idempotent (whole-file PUT under a name only
         # this process writes) — transient failures retry inside the fs
         # backends (utils/retry.py); only the rename COMMIT below needs
         # at-most-once care
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
-            np.savez(f, **arrays)
+            f.write(payload)
         self._commit_rename(tmp, self._path(epoch))
-        for old in self._epochs()[: -self.max_to_keep]:
-            try:
-                fs.delete(self._path(old))
-            except OSError:
-                pass
+        # npz first, manifest second: a crash between the two commits
+        # leaves a manifest-less ("legacy") generation that the restore
+        # chain still verifies by parse — never a manifest pointing at
+        # nothing
+        mtmp = self._manifest_path(epoch) + f".tmp.{_host_tag()}.{os.getpid()}"
+        with fs.filesystem_for(mtmp).open_write(fs.strip_local(mtmp)) as f:
+            f.write(manifest.encode("utf-8"))
+        self._commit_rename(mtmp, self._manifest_path(epoch))
+        self._sweep_retention()
+
+    def _sweep_retention(self) -> None:
+        """Delete generations beyond ``max_to_keep`` — manifest TOGETHER
+        with its npz (an orphan manifest would read as corruption), and
+        never reducing the set of verified generations below one: when
+        every surviving generation fails the cheap check, the newest
+        verified candidate is retained past the keep budget — it is the
+        only restorable state the job has."""
+        epochs = self._epochs()
+        candidates = epochs[: -self.max_to_keep]
+        if not candidates:
+            return
+        survivors = epochs[-self.max_to_keep:]
+        # one status pass per sweep: each check costs up to three remote
+        # round trips (manifest exists + read, npz stat) on a remote
+        # checkpoint dir, and this runs on every save
+        status = {e: self._generation_status(e)[0] for e in epochs}
+        if not any(status[e] == "verified" for e in survivors):
+            verified_victims = [
+                e for e in candidates if status[e] == "verified"
+            ]
+            if verified_victims:
+                spared = verified_victims[-1]
+                log.warning(
+                    "retention sweep: no verified generation among the "
+                    "newest %d; keeping epoch %d past the keep budget",
+                    self.max_to_keep, spared,
+                )
+                candidates = [e for e in candidates if e != spared]
+        for old in candidates:
+            for path in (self._path(old), self._manifest_path(old)):
+                try:
+                    fs.delete(path)
+                except OSError:
+                    pass
 
     @staticmethod
     def _commit_rename(tmp: str, final: str) -> None:
@@ -298,7 +471,42 @@ class NpzCheckpointer:
             if self._executor is not None:
                 self._executor.shutdown(wait=True)
 
+    def _verify_payload(self, epoch: int) -> bytes:
+        """Read the generation's full payload and verify it against the
+        manifest (size + CRC32 + SHA-256).  Raises :class:`_Corrupt` on
+        any mismatch; legacy generations (no manifest) pass through to the
+        parse-level guard in ``_restore_tree``."""
+        import hashlib
+        import zlib
+
+        data = fs.read_bytes(self._path(epoch))
+        m = self._read_manifest(epoch)
+        if m is None:
+            log.warning(
+                "checkpoint epoch %d has no manifest (legacy generation): "
+                "integrity guarded only by the npz parse", epoch,
+            )
+            return data
+        if "__error__" in m:
+            raise _Corrupt(f"unreadable manifest: {m['__error__']}")
+        if len(data) != int(m.get("size", -1)):
+            raise _Corrupt(
+                f"manifest mismatch: size {len(data)} != recorded "
+                f"{m.get('size')}"
+            )
+        if (zlib.crc32(data) & 0xFFFFFFFF) != int(m.get("crc32", -1)):
+            raise _Corrupt(
+                f"manifest mismatch: CRC32 {zlib.crc32(data) & 0xFFFFFFFF:#x}"
+                f" != recorded {int(m.get('crc32', -1)):#x}"
+            )
+        sha = m.get("sha256")
+        if sha and hashlib.sha256(data).hexdigest() != sha:
+            raise _Corrupt("manifest mismatch: SHA-256 digest differs")
+        return data
+
     def _restore_tree(self, epoch: int, template_state):
+        import io
+
         import numpy as np
 
         tree = _unbox(
@@ -309,15 +517,16 @@ class NpzCheckpointer:
             }
         )
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        import io
-
-        with fs.open_read(self._path(epoch)) as f:
-            # np.load's zip reader needs a seekable file; local files are,
-            # raw HTTP response streams are not — buffer only those
-            src = f if getattr(f, "seekable", lambda: False)() \
-                else io.BytesIO(f.read())
-            with np.load(src) as z:
+        data = self._verify_payload(epoch)
+        try:
+            with np.load(io.BytesIO(data)) as z:
                 loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
+        except Exception as e:
+            # a digest-clean payload that still fails to parse means the
+            # WRITER produced garbage (or a legacy generation rotted) —
+            # same corruption class, same quarantine-and-fall-back handling
+            raise _Corrupt(
+                f"npz parse failed: {type(e).__name__}: {e}") from e
         # scalars (e.g. step) round-trip as 0-d arrays; cast back via the
         # template leaf's dtype to keep the tree structurally identical
         vals = [
@@ -334,16 +543,50 @@ class NpzCheckpointer:
         )
 
     def restore_epoch(self, epoch: int, template_state):
-        """Restore a specific epoch; returns (state, next_epoch_to_run)."""
+        """Restore a specific (fleet-agreed) epoch; returns
+        ``(state, next_epoch_to_run)``.  A generation that fails
+        verification here is quarantined and the error PROPAGATES instead
+        of falling back: the fleet agreed on this epoch through sync_plan,
+        and a unilateral fallback would silently diverge the SPMD
+        participants — the failure restarts the fleet, whose next
+        sync_plan re-agrees without the quarantined generation."""
         self.wait()  # a still-in-flight save of this very epoch must land
-        return self._restore_tree(epoch, template_state), epoch + 1
+        try:
+            return self._restore_tree(epoch, template_state), epoch + 1
+        except _Corrupt as e:
+            self._quarantine(epoch, str(e))
+            raise CheckpointCorruptError(
+                f"agreed checkpoint epoch {epoch} failed verification "
+                f"({e}); generation quarantined — the fleet must re-agree "
+                f"a restore point"
+            ) from e
 
     def restore_latest(self, template_state):
+        """Fallback chain: walk back from the newest generation to the
+        newest VERIFIABLE one, quarantining (never deleting) corrupt or
+        truncated generations along the way.  Raises
+        :class:`CheckpointCorruptError` with per-generation diagnostics
+        when generations exist but none verifies — loading garbage or
+        crashing opaquely are both contract violations."""
         self.wait()
-        latest = self.latest_epoch()
-        if latest is None:
-            return None, 0
-        return self._restore_tree(latest, template_state), latest + 1
+        failures: list[str] = []
+        for epoch in reversed(self._epochs()):
+            status, why = self._generation_status(epoch)
+            if status == "corrupt":
+                self._quarantine(epoch, why)
+                failures.append(f"epoch {epoch}: {why}")
+                continue
+            try:
+                return self._restore_tree(epoch, template_state), epoch + 1
+            except _Corrupt as e:
+                self._quarantine(epoch, str(e))
+                failures.append(f"epoch {epoch}: {e}")
+        if failures:
+            raise CheckpointCorruptError(
+                f"no verifiable checkpoint generation in {self.directory} "
+                f"(all quarantined as *.corrupt): " + "; ".join(failures)
+            )
+        return None, 0
 
     def __enter__(self):
         return self

@@ -192,14 +192,25 @@ class SAGNTrainer(Trainer):
                 "flat LearningRate while only the global apply followed "
                 "the schedule"
             )
+        # SAGN's window step already batches update_window microbatches
+        # per dispatch — the scan_steps chunking would compose confusingly
+        # with it for no additional amortization.  Forced to 1 BEFORE
+        # super().__init__ so the parent never scales the hang-watchdog
+        # timeout for a scan path that will not run.
+        kw["scan_steps"] = 1
         super().__init__(model_config, num_features, **kw)
-        # SAGN's window step already batches update_window microbatches per
-        # dispatch — the scan_steps chunking would compose confusingly with
-        # it for no additional amortization; disable the inherited path
         self.scan_steps = 1
         self._scan_epoch = None
         p = model_config.params
         self.update_window = max(int(p.update_window), 1)
+        if self.health_guard is not None:
+            # one SAGN dispatch spans the whole communication window — the
+            # per-step hang timeout must stretch with it (same contract as
+            # the parent's scan/accum scaling)
+            self.health_guard.scale_watchdog(
+                self.update_window,
+                "SAGN window: one dispatch spans update_window microbatches",
+            )
         local_name = local_optimizer or p.optimizer
         local_tx = make_base_optimizer(local_name, p.learning_rate)
         if self.mesh is not None:
@@ -258,6 +269,11 @@ class SAGNTrainer(Trainer):
         weights: list[int] = []
         n_micro = 0
         tail: list[Batch] = []
+        guard = self.health_guard
+        if guard is not None:
+            # same instrumentation seam as the parent's train_epoch:
+            # real-row bookkeeping, rollback skip-window, nan injection
+            batches = guard.filter_batches(batches)
 
         def windows():
             buf: list[Batch] = []
@@ -276,17 +292,25 @@ class SAGNTrainer(Trainer):
             losses.append(loss)
             weights.append(K)
             n_micro += K
+            if guard is not None:
+                guard.tick()
         # trailing partial window: plain sync steps (window of 1)
         for batch in tail:
             self.state, loss = self._train_step(self.state, self._put(batch))
             losses.append(loss)
             weights.append(1)
             n_micro += 1
+            if guard is not None:
+                guard.tick()
         if not losses:
             return float("nan"), 0
         # microbatch-weighted epoch mean: a K-micro window counts K times;
         # NaN losses mark all-padding windows (skipped by contract)
         vals = np.asarray(jax.device_get(losses), np.float64)
+        if guard is not None:
+            # per-WINDOW losses: a NaN may be an all-padding window, so
+            # only the inf and epoch-mean divergence checks apply
+            guard.note_losses(vals, mode="loose")
         ws = np.asarray(weights, np.float64)
         mask = ~np.isnan(vals)
         return (

@@ -20,6 +20,7 @@ Epoch-level behavior parity:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -119,6 +120,351 @@ class EarlyStopper:
         return None
 
 
+@dataclass
+class HealthConfig:
+    """Training-health guard settings (conf keys ``shifu.tpu.health-*``).
+
+    - ``check_finite``: on-device ``isfinite`` check on the per-step loss
+      and (per-step path) global gradient norm.  DISTINCT from the
+      NaN-as-padding marker: the guard cross-references each loss with a
+      host-side "did this batch have nonzero-weight rows" record, so a
+      padding batch's contractual NaN never trips it while a NaN from a
+      real batch always does.
+    - ``spike_factor``: trip when a finite epoch loss exceeds
+      ``factor × EMA`` of previous epoch losses (divergence that has not
+      yet reached NaN); 0 disables.
+    - ``hang_timeout_s``: wall-clock per-step watchdog — a training step
+      (or evaluation batch) making no progress for this long fires the
+      hang callback from a watchdog thread; 0 disables.
+    - ``lr_scale`` / ``skip_epoch`` / ``skip_steps``: the coordinator's
+      rollback directive — relaunched workers train at a backed-off
+      learning rate and skip the batch window that tripped the guard
+      (see coordinator.report_unhealthy).
+    """
+
+    check_finite: bool = True
+    spike_factor: float = 0.0
+    spike_min_epochs: int = 2
+    hang_timeout_s: float = 0.0
+    ema_decay: float = 0.7
+    lr_scale: float = 1.0
+    skip_epoch: int | None = None
+    skip_steps: tuple[int, ...] = ()
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "HealthConfig | None":
+        if d is None:
+            return None
+        d = dict(d)
+        if d.get("skip_steps") is not None:
+            d["skip_steps"] = tuple(int(s) for s in d["skip_steps"])
+        return cls(**d)
+
+
+class TrainingUnhealthy(RuntimeError):
+    """The health guard tripped: divergence (non-finite loss/grad,
+    loss spike) detected at epoch end, BEFORE the epoch's checkpoint save
+    and metrics report — diverged parameters must never be published as a
+    restore point.  Carries the diagnostics the coordinator bundles into
+    its rollback decision (and into the failure report when the rollback
+    budget is gone)."""
+
+    def __init__(self, reason: str, epoch: int,
+                 bad_steps: tuple[int, ...] = (), diag: dict | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.epoch = epoch
+        self.bad_steps = tuple(bad_steps)
+        self.diag = diag or {}
+
+
+class StepWatchdog:
+    """Wall-clock per-step hang detector.
+
+    The liveness monitor cannot catch a hung step: the worker's heartbeat
+    THREAD keeps beating while the training thread is wedged inside a
+    device call (the reference's monitor had the same blindspot — and its
+    kill action was commented out anyway, SURVEY.md §5.2).  This watchdog
+    lives beside the training loop, is ticked once per consumed batch,
+    and fires ``on_hang(elapsed_s)`` from its own thread when no tick
+    lands within the timeout — once, ever: the hung thread cannot be
+    un-hung, so the single report hands recovery to the coordinator."""
+
+    def __init__(self, timeout_s: float,
+                 on_hang: Callable[[float], None]):
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self._last = time.monotonic()
+        self._armed = False
+        self.fired = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def arm(self) -> None:
+        self._last = time.monotonic()
+        self._armed = True
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="stpu-step-watchdog"
+            )
+            self._thread.start()
+
+    def tick(self) -> None:
+        self._last = time.monotonic()
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def _run(self) -> None:
+        poll = max(0.01, min(self.timeout_s / 4.0, 0.5))
+        while not self._stop.wait(poll):
+            if not self._armed or self.fired:
+                continue
+            elapsed = time.monotonic() - self._last
+            if elapsed > self.timeout_s:
+                self.fired = True
+                try:
+                    self.on_hang(elapsed)
+                except Exception:  # the watchdog must never die silently
+                    from shifu_tensorflow_tpu.utils import logs
+
+                    logs.get("health").exception("hang callback failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class HealthGuard:
+    """Per-trainer health state machine (built from :class:`HealthConfig`).
+
+    The fit loops call ``begin_epoch`` / ``check_epoch`` around each
+    epoch; ``filter_batches`` wraps the batch stream to (a) record which
+    steps carried real (nonzero-weight) rows — the host-side half of the
+    NaN-vs-padding disambiguation, (b) apply the coordinator's rollback
+    skip-window, and (c) host the ``health.nan-loss`` fault-injection
+    seam; the epoch paths feed their fetched loss (and, per-step, grad
+    norm) arrays back through ``note_losses``.
+    """
+
+    def __init__(self, cfg: HealthConfig, worker_index: int = 0):
+        import collections
+
+        self.cfg = cfg
+        self.worker_index = worker_index
+        self._epoch = -1
+        self._epochs_seen = 0
+        self._ema: float | None = None
+        self._steps_real: list[tuple[int, bool]] = []
+        self._n_real = 0
+        self._bad_steps: list[int] = []
+        self._count_bad: str | None = None
+        self._skip_set = set(cfg.skip_steps)
+        self.skipped_steps = 0
+        self.injected_nans = 0
+        self.last_losses: "collections.deque" = collections.deque(maxlen=16)
+        self.last_grad_norms: "collections.deque" = collections.deque(
+            maxlen=16)
+        #: hook for the worker runtime: called as ``on_hang(reason, diag)``
+        #: from the watchdog thread; default just logs
+        self.on_hang: Callable[[str, dict], None] | None = None
+        self.watchdog = (
+            StepWatchdog(cfg.hang_timeout_s, self._hang)
+            if cfg.hang_timeout_s > 0 else None
+        )
+
+    def scale_watchdog(self, dispatch_steps: int, why: str) -> None:
+        """The watchdog is ticked once per DEVICE DISPATCH; when one
+        dispatch covers many optimizer steps (scan/accum chunking), the
+        configured per-step timeout must stretch accordingly or a
+        legitimately long dispatch reads as a hang."""
+        if self.watchdog is not None and dispatch_steps > 1:
+            from shifu_tensorflow_tpu.utils import logs
+
+            self.watchdog.timeout_s *= dispatch_steps
+            logs.get("health").info(
+                "hang watchdog timeout scaled x%d to %.1fs (%s)",
+                dispatch_steps, self.watchdog.timeout_s, why,
+            )
+
+    def disable_watchdog(self, why: str) -> None:
+        """Paths with no per-step tick granularity (device-resident: one
+        dispatch IS the epoch) cannot distinguish a hang from work — stop
+        the watchdog instead of firing spuriously."""
+        if self.watchdog is not None:
+            from shifu_tensorflow_tpu.utils import logs
+
+            logs.get("health").warning(
+                "hang watchdog disabled: %s (shifu.tpu.health-hang-timeout "
+                "has no per-step tick to measure here)", why,
+            )
+            self.watchdog.stop()
+            self.watchdog = None
+
+    # ---- epoch lifecycle ----
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._steps_real = []
+        self._n_real = 0
+        self._bad_steps = []
+        self._count_bad = None
+        if self.watchdog is not None:
+            self.watchdog.arm()
+
+    def tick(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.tick()
+
+    def _hang(self, elapsed: float) -> None:
+        from shifu_tensorflow_tpu.utils import logs
+
+        reason = (
+            f"hung step: no training progress in {elapsed:.1f}s "
+            f"(shifu.tpu.health-hang-timeout={self.cfg.hang_timeout_s:g}s, "
+            f"epoch {self._epoch})"
+        )
+        logs.get("health").error("%s", reason)
+        if self.on_hang is not None:
+            self.on_hang(reason, self.diagnostics())
+
+    # ---- batch stream instrumentation ----
+    def filter_batches(self, batches: Iterable[Batch]) -> Iterable[Batch]:
+        from shifu_tensorflow_tpu.utils import faults, logs
+
+        epoch = self._epoch
+        step = 0
+        plan_active = faults.active() is not None
+        for b in batches:
+            real = bool(np.any(np.asarray(b["w"]) != 0.0))
+            if (real and epoch == self.cfg.skip_epoch
+                    and step in self._skip_set):
+                # coordinator rollback directive: this batch window tripped
+                # the guard last generation — skip it instead of replaying
+                # the divergence deterministically
+                self.skipped_steps += 1
+                logs.get("health").warning(
+                    "skipping epoch %d step %d (coordinated-rollback "
+                    "directive)", epoch, step,
+                )
+                step += 1
+                continue
+            if real and plan_active and faults.poll(
+                f"health.nan-loss.e{epoch}", index=step
+            ):
+                b = dict(b)
+                x = np.array(b["x"], copy=True)
+                x.flat[0] = np.nan
+                b["x"] = x
+                self.injected_nans += 1
+            self._steps_real.append((step, real))
+            if real:
+                self._n_real += 1
+            step += 1
+            yield b
+
+    # ---- loss bookkeeping ----
+    def note_losses(self, vals, grad_norms=None,
+                    mode: str = "aligned") -> None:
+        """Feed one epoch's fetched loss array (+ optional per-step grad
+        norms).  ``mode``: "aligned" — vals[i] pairs with the i-th yielded
+        batch (per-step / host-emb paths; precise bad-step indices);
+        "counted" — order lost but one loss per batch (scan path; finite
+        count must cover every real batch); "loose" — losses are
+        per-group (accum / SAGN windows; only inf and the epoch-mean NaN
+        check apply)."""
+        vals = np.asarray(vals, np.float64).reshape(-1)
+        for v in vals[np.isfinite(vals)][-8:]:
+            self.last_losses.append(float(v))
+        if grad_norms is not None:
+            g = np.asarray(grad_norms, np.float64).reshape(-1)
+            for v in g[np.isfinite(g)][-8:]:
+                self.last_grad_norms.append(float(v))
+        if not self.cfg.check_finite:
+            return
+        if mode == "aligned":
+            g = (np.asarray(grad_norms, np.float64).reshape(-1)
+                 if grad_norms is not None else None)
+            for i, (step, real) in enumerate(self._steps_real):
+                if not real or i >= len(vals):
+                    continue
+                if not np.isfinite(vals[i]) or (
+                    g is not None and i < len(g) and not np.isfinite(g[i])
+                ):
+                    self._bad_steps.append(step)
+        elif mode == "counted":
+            n_finite = int(np.isfinite(vals).sum())
+            if n_finite < self._n_real:
+                self._count_bad = (
+                    f"{self._n_real - n_finite} of {self._n_real} real "
+                    f"batches produced non-finite losses"
+                )
+        if np.isinf(vals).any():
+            self._count_bad = self._count_bad or "infinite loss observed"
+
+    def bad_steps(self) -> tuple[int, ...]:
+        return tuple(self._bad_steps)
+
+    def diagnostics(self) -> dict:
+        return {
+            "worker_index": self.worker_index,
+            "epoch": self._epoch,
+            "last_losses": list(self.last_losses),
+            "last_grad_norms": list(self.last_grad_norms),
+            "bad_steps": list(self._bad_steps),
+            "skipped_steps": self.skipped_steps,
+            "injected_nans": self.injected_nans,
+        }
+
+    def check_epoch(self, stats: EpochStats) -> str | None:
+        """End-of-epoch verdict: a reason string when unhealthy, else
+        None.  Runs BEFORE the epoch's checkpoint/report so diverged
+        state is never published."""
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+        e = stats.current_epoch
+        if self.cfg.check_finite:
+            if self._bad_steps:
+                shown = self._bad_steps[:4]
+                return (
+                    f"non-finite loss/grad-norm at epoch {e} step(s) "
+                    f"{shown}{'...' if len(self._bad_steps) > 4 else ''}"
+                )
+            if self._count_bad:
+                return f"divergence at epoch {e}: {self._count_bad}"
+            if self._n_real > 0 and not np.isfinite(stats.training_loss):
+                return (
+                    f"divergence at epoch {e}: every real batch produced "
+                    f"a non-finite loss (epoch mean NaN)"
+                )
+        if (
+            self.cfg.spike_factor > 0
+            and np.isfinite(stats.training_loss)
+        ):
+            if (
+                self._ema is not None
+                and self._epochs_seen >= self.cfg.spike_min_epochs
+                and stats.training_loss
+                > self.cfg.spike_factor * self._ema + 1e-12
+            ):
+                return (
+                    f"loss spike at epoch {e}: {stats.training_loss:.6g} > "
+                    f"{self.cfg.spike_factor:g} x EMA {self._ema:.6g}"
+                )
+            d = self.cfg.ema_decay
+            self._ema = (
+                stats.training_loss if self._ema is None
+                else d * self._ema + (1 - d) * stats.training_loss
+            )
+            self._epochs_seen += 1
+        return None
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+
 def _unbox_params(tree):
     """Strip flax partitioning boxes so host snapshots are plain arrays."""
     from flax.core import meta as flax_meta
@@ -168,10 +514,18 @@ def _widen_features(params, x):
     return x
 
 
-def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0):
+def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0,
+                         with_grad_norm: bool = False):
     """The un-jitted (state, batch) -> (state, loss) transition — jitted
     per-batch by make_train_step, lax.scan'ed over stacked batches by
-    make_scan_epoch.  One definition, so the two paths cannot drift."""
+    make_scan_epoch.  One definition, so the two paths cannot drift.
+
+    ``with_grad_norm=True`` (health guard, shifu.tpu.health-check-finite)
+    returns ``(state, (loss, global_grad_norm))`` instead — the norm is a
+    cheap on-device reduction over gradients the step already computed,
+    letting the guard catch an exploding/NaN gradient before the loss
+    itself goes non-finite.
+    """
     loss_fn = get_loss(loss_name)
 
     def compute_loss(params, batch):
@@ -198,13 +552,20 @@ def make_train_step_body(apply_fn, loss_name: str = "mse", l2: float = 0.0):
             lambda s: s,
             state,
         )
-        return state, jnp.where(has_rows, loss, jnp.nan)
+        loss = jnp.where(has_rows, loss, jnp.nan)
+        if with_grad_norm:
+            import optax
+
+            gnorm = jnp.where(has_rows, optax.global_norm(grads), 0.0)
+            return state, (loss, gnorm)
+        return state, loss
 
     return train_step
 
 
 def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
-                    donate: bool | None = None):
+                    donate: bool | None = None,
+                    with_grad_norm: bool = False):
     """Build the jitted SPMD train step.
 
     state is donated (buffers reused in place) where safe — see
@@ -215,7 +576,8 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     """
     if donate is None:
         donate = donation_is_safe()
-    body = make_train_step_body(apply_fn, loss_name, l2)
+    body = make_train_step_body(apply_fn, loss_name, l2,
+                                with_grad_norm=with_grad_norm)
     return partial(jax.jit, donate_argnums=(0,) if donate else ())(body)
 
 
@@ -388,6 +750,7 @@ class Trainer:
         scan_steps: int = 1,
         accum_steps: int = 1,
         keep_best: str = "",
+        health: "HealthConfig | None" = None,
     ):
         # validate the cheap invariants FIRST: a bad combination must
         # fail in microseconds, not after model build + param init +
@@ -583,6 +946,35 @@ class Trainer:
         self._train_step = make_train_step(
             self.model.apply, loss, model_config.params.l2_reg
         )
+        # training-health guard (shifu.tpu.health-*): divergence/hang
+        # detection + the coordinator's rollback directives.  The guard
+        # object exists whenever a HealthConfig is given (even with every
+        # check disabled) so the skip-window directive and the nan-loss
+        # injection seam stay active for the chaos drills' control arm.
+        self.health_guard = (
+            HealthGuard(health, worker_index=worker_index)
+            if health is not None else None
+        )
+        if self.health_guard is not None:
+            # chunked paths tick the watchdog once per DISPATCH, which
+            # spans scan_steps (or accum_steps) optimizer steps
+            self.health_guard.scale_watchdog(
+                max(self.scan_steps, self.accum_steps),
+                "scan/accum chunking: one dispatch spans many steps",
+            )
+        # per-step path only: the health step also returns the on-device
+        # global grad norm; scan/accum/host-emb paths fall back to the
+        # guard's loss-count checks
+        self._health_step = (
+            make_train_step(
+                self.model.apply, loss, model_config.params.l2_reg,
+                with_grad_norm=True,
+            )
+            if (self.health_guard is not None and health.check_finite
+                and self.scan_steps == 1 and self.accum_steps == 1
+                and self._host_emb is None)
+            else None
+        )
         self._host_emb_step = (
             make_host_emb_train_step(
                 self.model.apply, num_features, loss,
@@ -693,6 +1085,12 @@ class Trainer:
     # ---- core loops ----
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count)."""
+        guard = self.health_guard
+        if guard is not None:
+            # instrument the stream BEFORE path dispatch: real-row
+            # bookkeeping, the rollback skip-window, and the nan-loss
+            # injection seam apply to every epoch path identically
+            batches = guard.filter_batches(batches)
         if self._host_emb is not None:
             return self._train_epoch_host_emb(batches)
         if self._scan_epoch is not None:
@@ -700,15 +1098,29 @@ class Trainer:
         if self._accum_step is not None:
             return self._train_epoch_accum(batches)
         losses = []
+        gnorms = []
+        step_fn = self._health_step or self._train_step
         for batch in prefetch_to_device(batches, put=self._put,
                                         depth=self.prefetch_depth):
-            self.state, loss = self._train_step(self.state, batch)
+            if self._health_step is not None:
+                self.state, (loss, gnorm) = step_fn(self.state, batch)
+                gnorms.append(gnorm)
+            else:
+                self.state, loss = step_fn(self.state, batch)
             losses.append(loss)
+            if guard is not None:
+                guard.tick()
             if self.step_timer is not None:
                 self.step_timer.step(loss, rows=batch["x"].shape[0])
         if not losses:
             return float("nan"), 0
         vals = np.asarray(jax.device_get(losses))
+        if guard is not None:
+            guard.note_losses(
+                vals,
+                np.asarray(jax.device_get(gnorms)) if gnorms else None,
+                mode="aligned",
+            )
         # all-padding batches report NaN by contract (make_train_step);
         # exclude them from the epoch mean instead of biasing it
         real = vals[~np.isnan(vals)]
@@ -756,6 +1168,8 @@ class Trainer:
                     ids, g.reshape(ids.shape[0], len(self._host_emb_pos),
                                    self._host_emb.dim))
                 losses.append(loss)
+                if self.health_guard is not None:
+                    self.health_guard.tick()
                 if self.step_timer is not None:
                     self.step_timer.step(loss, rows=ids.shape[0])
         finally:
@@ -764,6 +1178,8 @@ class Trainer:
         if not losses:
             return float("nan"), 0
         vals = np.asarray(jax.device_get(losses))
+        if self.health_guard is not None:
+            self.health_guard.note_losses(vals, mode="aligned")
         real = vals[~np.isnan(vals)]
         return (
             float(np.mean(real)) if real.size else float("nan"),
@@ -866,6 +1282,8 @@ class Trainer:
             self.state, chunk_losses = self._scan_epoch(self.state, stacked)
             losses.append(chunk_losses)
             chunk_rows = rows_meta.popleft()
+            if self.health_guard is not None:
+                self.health_guard.tick()
             if self.step_timer is not None:
                 self.step_timer.step(chunk_losses, rows=chunk_rows)
         if not losses:
@@ -873,6 +1291,10 @@ class Trainer:
         vals = np.concatenate(
             [np.atleast_1d(np.asarray(v)) for v in jax.device_get(losses)]
         )
+        if self.health_guard is not None:
+            # per-batch losses, but chunking lost the batch order; the
+            # guard checks that every real batch produced a finite loss
+            self.health_guard.note_losses(vals, mode="counted")
         real = vals[~np.isnan(vals)]
         return (
             float(np.mean(real)) if real.size else float("nan"),
@@ -896,11 +1318,17 @@ class Trainer:
             self.state, loss = self._accum_step(self.state, stacked)
             losses.append(loss)
             chunk_rows = rows_meta.popleft()
+            if self.health_guard is not None:
+                self.health_guard.tick()
             if self.step_timer is not None:
                 self.step_timer.step(loss, rows=chunk_rows)
         if not losses:
             return float("nan"), 0
         vals = np.asarray(jax.device_get(losses))
+        if self.health_guard is not None:
+            # one loss per UPDATE group — a NaN may be a padding group, so
+            # only the inf and epoch-mean checks apply here
+            self.health_guard.note_losses(vals, mode="loose")
         real = vals[~np.isnan(vals)]
         return (
             float(np.mean(real)) if real.size else float("nan"),
@@ -969,6 +1397,28 @@ class Trainer:
                 f"no host-embedding sidecar for epoch {latest_epoch} in "
                 f"{directory}: the table restarts from init while the "
                 "dense net resumes — expect a KS dip until it re-trains"
+            )
+
+    # ---- health-guard hooks (shared by every fit loop) ----
+    def _health_begin_epoch(self, epoch: int) -> None:
+        if self.health_guard is not None:
+            self.health_guard.begin_epoch(epoch)
+
+    def _health_check_epoch(self, stats: EpochStats) -> None:
+        """Raise :class:`TrainingUnhealthy` when the guard trips — called
+        BEFORE keep-best snapshots, epoch reports, and the checkpoint
+        save, so diverged parameters are never published anywhere."""
+        g = self.health_guard
+        if g is None:
+            return
+        reason = g.check_epoch(stats)
+        if reason:
+            self.stop_reason = reason
+            raise TrainingUnhealthy(
+                reason,
+                epoch=stats.current_epoch,
+                bad_steps=g.bad_steps(),
+                diag=g.diagnostics(),
             )
 
     def _warn_if_validation_empty(self, stats: EpochStats,
@@ -1122,6 +1572,8 @@ class Trainer:
             for host_batch in batches:
                 dev = self._put(host_batch)
                 loss, pred = self._eval_step(self.state.params, dev)
+                if self.health_guard is not None:
+                    self.health_guard.tick()
                 losses.append(loss)
                 # drop any locally-padded rows so rows align with the host
                 # batch (padding sits at the tail)
@@ -1132,6 +1584,8 @@ class Trainer:
             for batch in prefetch_to_device(batches, put=self._put,
                                         depth=self.prefetch_depth):
                 loss, pred = self._eval_step(self.state.params, batch)
+                if self.health_guard is not None:
+                    self.health_guard.tick()
                 losses.append(loss)
                 scores.append(np.asarray(pred))
                 labels.append(np.asarray(batch["y"]))
@@ -1169,6 +1623,7 @@ class Trainer:
         history: list[EpochStats] = []
         self.stop_reason = None
         for epoch in range(start_epoch, epochs):
+            self._health_begin_epoch(epoch)
             t0 = time.time()
             train_loss, _ = self.train_epoch(
                 dataset.train_batches(batch_size, epoch=epoch)
@@ -1190,6 +1645,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._health_check_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
@@ -1250,6 +1706,12 @@ class Trainer:
         epochs = epochs or self.model_config.num_train_epochs
         B = self.align_batch_size(batch_size or self.model_config.batch_size)
         self.stop_reason = None
+        if self.health_guard is not None:
+            # one compiled dispatch IS the epoch here: there is no
+            # per-step tick for the watchdog to measure against
+            self.health_guard.disable_watchdog(
+                "device-resident training runs one dispatch per epoch"
+            )
 
         def _padded_device(block):
             n = len(block)
@@ -1284,6 +1746,7 @@ class Trainer:
         history: list[EpochStats] = []
         base_key = jax.random.key(self.seed)
         for epoch in range(start_epoch, epochs):
+            self._health_begin_epoch(epoch)
             t0 = time.time()
             self.state, losses = epoch_fn(
                 self.state, train_dev, jax.random.fold_in(base_key, epoch)
@@ -1325,6 +1788,16 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            # one-dispatch epochs have no per-step stream for the guard to
+            # instrument; the epoch-level checks (mean-NaN, spike) and the
+            # hang watchdog still apply
+            if self.health_guard is not None:
+                self.health_guard.tick()
+                if not np.isfinite(train_loss):
+                    self.health_guard._count_bad = (
+                        "epoch mean loss non-finite"
+                    )
+            self._health_check_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
@@ -1415,6 +1888,7 @@ class Trainer:
         history: list[EpochStats] = []
         self.stop_reason = None
         for epoch in range(start_epoch, epochs):
+            self._health_begin_epoch(epoch)
             t0 = time.time()
             train_loss, n = self.train_epoch(make_train_stream(epoch))
             train_time = time.time() - t0
@@ -1435,6 +1909,7 @@ class Trainer:
                 ks=ev["ks"],
                 auc=ev["auc"],
             )
+            self._health_check_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)

@@ -19,25 +19,47 @@ check-point exactly or as a dot-prefix ("rpc" fires at "rpc.connect" and
 "rpc.recv"; "fs" at "fs.read"/"fs.write").  ``kind`` is an HTTP status
 (``503``, ``429``...) raised as :class:`InjectedHttpError`, or one of
 ``reset`` / ``refused`` / ``timeout`` mapped to the stdlib exception the
-real failure would raise.  ``rate`` is the per-check fire probability.
+real failure would raise — plus the non-exception kinds below.  ``rate``
+is the per-check fire probability, OR, when written as a bare integer
+>= 2 (no decimal point), a deterministic **at-step trigger**: the term
+fires exactly once, at the Nth matching check (or at the check whose
+explicit ``index`` equals N — the trainer passes its step index).
+
+Beyond the exception kinds (consulted via :func:`check`), two more
+families model faults that are not network weather:
+
+- **at-rest corruption** (``bitflip`` | ``truncate``, consulted via
+  :func:`mutate`): the checkpoint writer passes its serialized payload
+  through the plan, which flips one bit / truncates the tail when a term
+  fires — the manifest is computed from the CLEAN bytes first, so this
+  models silent on-disk corruption that the verified-restore chain must
+  catch (docs/resilience.md);
+- **flag faults** (``nan-loss``, consulted via :func:`poll`): the
+  trainer's health guard polls ``health.nan-loss.e<epoch>`` once per
+  training step; a firing term poisons that step's batch with a NaN,
+  driving the divergence-detection / coordinated-rollback drills.
 
 Determinism: each term owns a :class:`random.Random` seeded from
 ``(seed, site, kind)``, so a fixed seed plus a fixed sequence of checks
 fires the SAME faults every run — a failing chaos drill replays exactly.
 
-Instrumented seams (each consults :func:`check` before the real I/O):
+Instrumented seams (each consults the plan before the real work):
 
-==============  ============================================================
-site            where
-==============  ============================================================
-``fs.read``     WebHDFS / GCS GET requests (metadata + data)
-``fs.write``    WebHDFS / GCS mutating requests (PUT/POST/DELETE)
-``rpc.connect`` CoordinatorClient before dialing the coordinator
-``rpc.recv``    CoordinatorClient after the request is written, before the
-                reply is read — models "op applied server-side, response
-                lost", the case the dedup tokens exist for
-``ckpt.write``  NpzCheckpointer, once per checkpoint tmp-file write
-==============  ============================================================
+=================  =========================================================
+site               where
+=================  =========================================================
+``fs.read``        WebHDFS / GCS GET requests (metadata + data)
+``fs.write``       WebHDFS / GCS mutating requests (PUT/POST/DELETE)
+``rpc.connect``    CoordinatorClient before dialing the coordinator
+``rpc.recv``       CoordinatorClient after the request is written, before
+                   the reply is read — models "op applied server-side,
+                   response lost", the case the dedup tokens exist for
+``ckpt.write``     NpzCheckpointer, once per checkpoint tmp-file write
+``ckpt.at-rest``   NpzCheckpointer payload bytes (``mutate``), after the
+                   manifest digest — silent at-rest corruption
+``health.nan-loss.e<N>``  trainer health guard, once per training step
+                   (``poll`` with the step index) — NaN-loss injection
+=================  =========================================================
 """
 
 from __future__ import annotations
@@ -72,27 +94,66 @@ _KINDS = {
         f"injected fault: timeout at {site}"),
 }
 
+#: at-rest corruption kinds, applied to payload bytes via :func:`mutate`
+_MUTATE_KINDS = ("bitflip", "truncate")
+#: boolean flag kinds, consulted via :func:`poll`
+_FLAG_KINDS = ("nan-loss",)
+
 
 class _Term:
-    def __init__(self, site: str, kind: str, rate: float, seed: int):
+    def __init__(self, site: str, kind: str, rate: float, seed: int,
+                 at_step: int | None = None):
         self.site = site
         self.kind = kind
         self.rate = rate
+        #: deterministic trigger: fire exactly once, at the matching check
+        #: whose index (explicit or this term's own counter) equals this
+        self.at_step = at_step
         # per-term RNG: adding/removing one term never reshuffles another's
         # fire pattern, so drills compose
         self._rng = random.Random(f"{seed}:{site}:{kind}")
         self.fired = 0
+        self._checks = 0
 
     def matches(self, site: str) -> bool:
         return site == self.site or site.startswith(self.site + ".")
 
-    def roll(self, site: str) -> BaseException | None:
-        if self._rng.random() >= self.rate:
-            return None
+    def _fires(self, index: int | None) -> bool:
+        self._checks += 1
+        if self.at_step is not None:
+            idx = index if index is not None else self._checks
+            if idx != self.at_step or self.fired:
+                return False
+        elif self._rng.random() >= self.rate:
+            return False
         self.fired += 1
+        return True
+
+    def roll(self, site: str) -> BaseException | None:
+        if not self._fires(None):
+            return None
         if self.kind.isdigit():
             return InjectedHttpError(int(self.kind), site)
         return _KINDS[self.kind](site)
+
+    def mutate(self, data: bytes, site: str) -> bytes:
+        """Apply this term's at-rest corruption to ``data`` if it fires."""
+        if not self._fires(None) or len(data) < 2:
+            return data
+        if self.kind == "truncate":
+            cut = self._rng.randrange(1, len(data))
+            log.warning("injecting truncate at %s: %d -> %d bytes "
+                        "(term %s, fire #%d)", site, len(data), cut,
+                        self.site, self.fired)
+            return data[:cut]
+        pos = self._rng.randrange(len(data))
+        bit = 1 << self._rng.randrange(8)
+        log.warning("injecting bitflip at %s: byte %d ^ 0x%02x "
+                    "(term %s, fire #%d)", site, pos, bit, self.site,
+                    self.fired)
+        out = bytearray(data)
+        out[pos] ^= bit
+        return bytes(out)
 
 
 class FaultPlan:
@@ -106,6 +167,9 @@ class FaultPlan:
     @classmethod
     def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
         terms: list[_Term] = []
+        all_kinds = (
+            tuple(sorted(_KINDS)) + _MUTATE_KINDS + _FLAG_KINDS
+        )
         for raw in spec.split(","):
             raw = raw.strip()
             if not raw:
@@ -117,26 +181,62 @@ class FaultPlan:
             except ValueError as e:
                 raise ValueError(
                     f"bad fault term {raw!r} (want site:kind@rate)") from e
-            if not kind.isdigit() and kind not in _KINDS:
+            if not kind.isdigit() and kind not in all_kinds:
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {raw!r} "
-                    f"(HTTP status | {' | '.join(sorted(_KINDS))})")
-            if not 0.0 <= rate <= 1.0:
+                    f"(HTTP status | {' | '.join(all_kinds)})")
+            at_step = None
+            if "." not in rate_s and rate >= 2.0:
+                # bare integer >= 2: deterministic at-step trigger (fire
+                # once, at the Nth matching check / at explicit index N)
+                at_step = int(rate)
+                rate = 0.0
+            elif not 0.0 <= rate <= 1.0:
                 raise ValueError(f"fault rate out of [0,1] in {raw!r}")
-            terms.append(_Term(site.strip(), kind, rate, seed))
+            terms.append(_Term(site.strip(), kind, rate, seed,
+                               at_step=at_step))
         return cls(terms)
 
     def check(self, site: str) -> None:
-        """Raise the planned fault for ``site`` if a matching term fires."""
+        """Raise the planned fault for ``site`` if a matching term fires.
+        Mutation/flag kinds never raise — they have their own entry points
+        (:meth:`mutate` / :meth:`poll`) and their counters are untouched
+        here, so one term's pattern never depends on unrelated seams."""
         with self._lock:
             for term in self._terms:
-                if term.matches(site):
+                if (term.matches(site)
+                        and term.kind not in _MUTATE_KINDS
+                        and term.kind not in _FLAG_KINDS):
                     exc = term.roll(site)
                     if exc is not None:
                         log.info("injecting %s at %s (term %s:%s@%g, "
                                  "fire #%d)", type(exc).__name__, site,
                                  term.site, term.kind, term.rate, term.fired)
                         raise exc
+
+    def mutate(self, site: str, data: bytes) -> bytes:
+        """Pass payload bytes through matching at-rest corruption terms."""
+        with self._lock:
+            for term in self._terms:
+                if term.kind in _MUTATE_KINDS and term.matches(site):
+                    data = term.mutate(data, site)
+        return data
+
+    def poll(self, site: str, index: int | None = None) -> bool:
+        """True when a matching flag term fires at this check.  ``index``
+        overrides the term's own check counter for at-step triggers, so
+        the trainer can key injection to its step index rather than to
+        how many times the seam happened to be polled."""
+        fired = False
+        with self._lock:
+            for term in self._terms:
+                if term.kind in _FLAG_KINDS and term.matches(site):
+                    if term._fires(index):
+                        log.warning("injecting %s at %s (term %s, fire "
+                                    "#%d)", term.kind, site, term.site,
+                                    term.fired)
+                        fired = True
+        return fired
 
     def fired(self) -> dict[str, int]:
         """``"site:kind" -> fire count`` — drills assert faults actually
@@ -178,3 +278,21 @@ def check(site: str) -> None:
     plan = active()
     if plan is not None:
         plan.check(site)
+
+
+def mutate(site: str, data: bytes) -> bytes:
+    """At-rest corruption seam: returns ``data``, possibly bit-flipped or
+    truncated by a matching ``bitflip``/``truncate`` term.  No-op (and
+    zero-copy) without an active plan."""
+    plan = active()
+    if plan is None:
+        return data
+    return plan.mutate(site, data)
+
+
+def poll(site: str, index: int | None = None) -> bool:
+    """Flag-fault seam (``nan-loss``): True when a matching term fires."""
+    plan = active()
+    if plan is None:
+        return False
+    return plan.poll(site, index)

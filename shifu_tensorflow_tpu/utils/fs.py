@@ -274,6 +274,16 @@ def read_text(path: str) -> str:
         return f.read().decode("utf-8")
 
 
+def read_bytes(path: str) -> bytes:
+    """Whole-file read.  The verified-checkpoint chain reads payloads this
+    way on purpose: digest checks (size/CRC32/SHA-256 against the sidecar
+    manifest) need the exact byte string a streaming reader could silently
+    truncate, and the resumable remote backends already guarantee the full
+    body or an exception."""
+    with open_read(path) as f:
+        return f.read()
+
+
 def write_text(path: str, text: str) -> None:
     with filesystem_for(path).open_write(strip_local(path)) as f:
         f.write(text.encode("utf-8"))

@@ -355,3 +355,40 @@ def test_stream_feature_dtype_survives_worker_json_bridge():
     )
     rt = WorkerConfig.from_json(cfg.to_json())
     assert rt.stream_feature_dtype == "float32"
+
+
+def test_health_keys_drive_worker_and_spec_fields():
+    import pytest
+
+    from shifu_tensorflow_tpu.train.__main__ import (
+        resolve_health,
+        worker_runtime_kwargs,
+    )
+
+    conf = _conf({
+        K.HEALTH_CHECK_FINITE: "false",
+        K.HEALTH_SPIKE_FACTOR: "3.5",
+        K.HEALTH_SPIKE_MIN_EPOCHS: "4",
+        K.HEALTH_HANG_TIMEOUT_MS: "1500",
+        K.HEALTH_LR_BACKOFF: "0.25",
+        K.HEALTH_MAX_ROLLBACKS: "7",
+        K.HEALTH_SKIP_WINDOW: "3",
+    })
+    kw = worker_runtime_kwargs(_args(), conf)
+    assert kw["health_check_finite"] is False
+    assert kw["health_spike_factor"] == pytest.approx(3.5)
+    assert kw["health_spike_min_epochs"] == 4
+    assert kw["health_hang_timeout_s"] == pytest.approx(1.5)
+    spec_kw = job_spec_kwargs(conf)
+    assert spec_kw["health_lr_backoff"] == pytest.approx(0.25)
+    assert spec_kw["health_max_rollbacks"] == 7
+    assert spec_kw["health_skip_window"] == 3
+    # single-process path: same keys feed the Trainer's HealthConfig
+    hc = resolve_health(conf)
+    assert hc.check_finite is False
+    assert hc.spike_factor == pytest.approx(3.5)
+    assert hc.hang_timeout_s == pytest.approx(1.5)
+    # defaults: guard on, spike/hang off
+    d = resolve_health(_conf({}))
+    assert d.check_finite is True and d.spike_factor == 0.0
+    assert d.hang_timeout_s == 0.0

@@ -464,3 +464,224 @@ def test_worker_config_json_roundtrip_new_fields(job_model_config, psv_dataset):
     del legacy["scan_steps"], legacy["async_checkpoint"]
     old = WorkerConfig.from_json(legacy)
     assert old.scan_steps == 1 and old.async_checkpoint is False
+
+
+# ---- liveness flap recovery (expiry is not terminal) ----
+
+def test_liveness_flap_recovery_and_callback():
+    """A worker marked expired that beats again must recover into
+    alive(), fire on_recovered, and count the flap — a long compile/GC
+    pause must not permanently shrink the fleet."""
+    now = [0.0]
+    expired, recovered = [], []
+    mon = LivenessMonitor(
+        interval_ms=1000, max_missed=3,
+        on_expired=expired.append, on_recovered=recovered.append,
+        clock=lambda: now[0],
+    )
+    mon.register("w0")
+    now[0] = 4.0  # deadline 3s -> expired
+    assert mon.check() == ["w0"]
+    assert mon.alive() == set() and mon.expired() == {"w0"}
+    mon.beat("w0")  # the pause ended
+    assert mon.alive() == {"w0"}
+    assert mon.expired() == set()
+    assert recovered == ["w0"]
+    assert mon.flaps == 1
+    # expiry fires again if the silence repeats (not a one-way latch)
+    now[0] = 9.0
+    assert mon.check() == ["w0"]
+    mon.beat("w0")
+    assert mon.flaps == 2
+    # ages() reports seconds since last beat (diagnostics surface)
+    now[0] = 10.5
+    assert mon.ages() == {"w0": pytest.approx(1.5)}
+
+
+def test_liveness_unregister_clears_flap_candidates():
+    mon = LivenessMonitor()
+    mon.register("w")
+    mon.unregister("w")
+    mon.beat("w")  # must not resurrect an unregistered worker
+    assert mon.alive() == set()
+    assert mon.flaps == 0
+
+
+# ---- health rollback arbitration ----
+
+def test_unhealthy_spmd_rollback_directive_rides_registration():
+    spec = _spec(2, spmd=True, spare_restarts=5, health_max_rollbacks=3,
+                 health_lr_backoff=0.5, health_skip_window=2)
+    coord = Coordinator(spec)
+    coord.register("a", 0, host="h", jax_port=1)
+    coord.register("b", 1, host="h")
+    gen0 = coord.generation
+    r = coord.report_unhealthy("a", 2, "nan loss", bad_steps=[5])
+    assert r["ok"] and r["fleet"]
+    assert coord.generation == gen0 + 1  # fleet restart
+    # a peer reporting the same root cause is deduped by generation
+    r2 = coord.report_unhealthy("b", 2, "nan loss", bad_steps=[5])
+    assert r2.get("deduped")
+    # re-registration delivers the directive: backed-off LR + the skip
+    # window around the offending step (width 2 -> steps 4 and 5)
+    reg = coord.register("a", 0, host="h", jax_port=1)
+    assert reg["health"]["lr_scale"] == pytest.approx(0.5)
+    assert reg["health"]["skip"] == {"epoch": 2, "steps": [4, 5]}
+    assert reg["health"]["rollbacks"] == 1
+    st = coord.status()
+    assert st["rollbacks"] == 1 and st["restarts_used"] == 1
+    coord.liveness.stop()
+
+
+def test_unhealthy_non_spmd_charges_budget_once_and_relaunches():
+    from shifu_tensorflow_tpu.coordinator.coordinator import (
+        UNHEALTHY_EXIT_CODE,
+    )
+
+    coord = Coordinator(_spec(3, spare_restarts=2))
+    for i, wid in enumerate(("a", "b", "c")):
+        coord.register(wid, i, host="h")
+    r = coord.report_unhealthy("b", 1, "loss spike", bad_steps=[0])
+    assert r["ok"] and not r["fleet"]
+    assert coord.status()["restarts_used"] == 1
+    # the worker exits UNHEALTHY_EXIT_CODE: no second budget charge, but
+    # it becomes restartable
+    coord.complete("b", UNHEALTHY_EXIT_CODE)
+    assert coord.status()["restarts_used"] == 1
+    assert [w.worker_id for w in coord.restartable_workers()] == ["b"]
+    assert coord.state == JobState.TRAINING
+    coord.liveness.stop()
+
+
+def test_unhealthy_hung_worker_queued_for_kill():
+    coord = Coordinator(_spec(2, spare_restarts=2))
+    coord.register("a", 0, host="h")
+    coord.register("b", 1, host="h")
+    r = coord.report_unhealthy("b", 0, "hung step", hung=True)
+    assert r["ok"]
+    # the wedged worker cannot exit on its own: the submitter must kill
+    # it — and ONLY once the kill is delivered does the record become
+    # restartable, so a relaunch can never race ahead of the kill and
+    # become its victim
+    assert coord.take_pending_kills() == ["b"]
+    assert coord.take_pending_kills() == []  # drained
+    assert coord.restartable_workers() == []
+    coord.mark_worker_killed("b")
+    assert [w.worker_id for w in coord.restartable_workers()] == ["b"]
+    coord.liveness.stop()
+
+
+# ---- failure diagnostics (registration/job timeout paths) ----
+
+def test_registration_timeout_result_carries_heartbeat_diagnostics():
+    """The registration-timeout failure must hand the operator per-worker
+    heartbeat ages + liveness state through JobResult.diagnostics, not
+    just the bare timeout message."""
+    import time as _time
+
+    spec = _spec(2, registration_timeout_s=0.4)
+
+    def never_registers(cfg, fail_at_epoch=None):
+        _time.sleep(30.0)
+        return 0
+
+    sub = JobSubmitter(
+        spec,
+        lambda wid, addr: WorkerConfig(
+            worker_id=wid, coordinator_host=addr[0],
+            coordinator_port=addr[1], model_config=None, schema=None,
+        ),
+        worker_runner=never_registers,
+        poll_interval_s=0.05,
+    )
+    result = sub.run(timeout_s=10.0)
+    assert result.state == JobState.FAILED
+    assert "registration timeout" in result.failure_reason
+    assert result.diagnostics is not None
+    assert "workers" in result.diagnostics
+    assert result.diagnostics["restart_budget"] == spec.spare_restarts
+    # nobody ever registered: the bundle says so instead of hiding it
+    assert result.diagnostics["workers"] == {}
+
+
+def test_job_timeout_failure_reason_includes_heartbeat_ages():
+    import time as _time
+
+    spec = _spec(1, registration_timeout_s=10.0)
+
+    def registers_then_hangs(cfg, fail_at_epoch=None):
+        from shifu_tensorflow_tpu.coordinator.coordinator import (
+            CoordinatorClient,
+        )
+
+        c = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
+        c.register(cfg.worker_id, cfg.worker_index)
+        _time.sleep(30.0)
+        return 0
+
+    sub = JobSubmitter(
+        spec,
+        lambda wid, addr: WorkerConfig(
+            worker_id=wid, coordinator_host=addr[0],
+            coordinator_port=addr[1], model_config=None, schema=None,
+            worker_index=0,
+        ),
+        worker_runner=registers_then_hangs,
+        poll_interval_s=0.05,
+    )
+    result = sub.run(timeout_s=1.0)
+    assert result.state == JobState.FAILED
+    assert "job timeout" in result.failure_reason
+    assert "last-heartbeat ages" in result.failure_reason
+    assert result.diagnostics["workers"]["worker-0"]["liveness"] in (
+        "alive", "expired")
+    assert result.diagnostics["workers"]["worker-0"][
+        "last_heartbeat_age_s"] is not None
+
+
+def test_worker_config_json_roundtrip_health_fields(job_model_config,
+                                                    psv_dataset):
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    cfg = WorkerConfig(
+        worker_id="w0", coordinator_host="127.0.0.1", coordinator_port=1,
+        model_config=job_model_config, schema=schema,
+        flat_checkpoint=True, health_check_finite=False,
+        health_spike_factor=4.0, health_hang_timeout_s=2.5,
+    )
+    back = WorkerConfig.from_json(cfg.to_json())
+    assert back.flat_checkpoint is True
+    assert back.health_check_finite is False
+    assert back.health_spike_factor == pytest.approx(4.0)
+    assert back.health_hang_timeout_s == pytest.approx(2.5)
+    # configs serialized before these fields existed still load
+    legacy = cfg.to_json()
+    for k in ("flat_checkpoint", "health_check_finite",
+              "health_spike_factor", "health_spike_min_epochs",
+              "health_hang_timeout_s"):
+        del legacy[k]
+    old = WorkerConfig.from_json(legacy)
+    assert old.flat_checkpoint is False
+    assert old.health_check_finite is True
+
+
+def test_unhealthy_non_spmd_directive_does_not_leak_to_peers():
+    """Independent models roll back independently: worker B's LR back-off
+    and skip window must ride ONLY B's re-registration — a healthy worker
+    relaunched after an unrelated crash keeps lr_scale 1.0."""
+    coord = Coordinator(_spec(3, spare_restarts=3, health_max_rollbacks=3))
+    for i, wid in enumerate(("a", "b", "c")):
+        coord.register(wid, i, host="h")
+    coord.report_unhealthy("b", 2, "nan", bad_steps=[4])
+    # the tripper's relaunch gets the directive...
+    rb = coord.register("b", 1, host="h")
+    assert rb["health"]["lr_scale"] == pytest.approx(0.5)
+    assert rb["health"]["skip"] == {"epoch": 2, "steps": [4]}
+    # ...a healthy peer relaunched after an unrelated crash does not
+    rc = coord.register("c", 2, host="h")
+    assert rc["health"]["lr_scale"] == pytest.approx(1.0)
+    assert rc["health"]["skip"] is None
+    coord.liveness.stop()
