@@ -706,6 +706,17 @@ def _attempt(env_overrides: dict, timeout_s: float,
 
 
 def main() -> None:
+    if "serve" in sys.argv[1:]:
+        # serving benchmark (python bench.py serve): micro-batched vs
+        # one-row-per-request scoring over HTTP, artifact
+        # BENCH_SERVE.json — implemented in scripts/bench_serve.py.
+        # Runs in-process on the CPU backend (force_cpu_backend inside),
+        # so the parent's no-jax rule does not apply to this mode.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve
+
+        sys.exit(bench_serve.main())
     if "--run" in sys.argv:
         _child_main()
         return

@@ -187,6 +187,40 @@ DEFAULT_HEALTH_MAX_ROLLBACKS = 2
 HEALTH_SKIP_WINDOW = TPU_PREFIX + "health-skip-window"
 DEFAULT_HEALTH_SKIP_WINDOW = 1
 
+# ---- online serving (serve/: micro-batched scoring server) ----
+# The reference's L6 was a batch-only Java scorer; the serve subsystem
+# puts an HTTP front in front of the same exported artifact.  All knobs
+# resolve through serve/__main__.resolve_serve_config with the usual
+# CLI-wins precedence and land in ServeConfig (serve/config.py).
+SERVE_HOST = TPU_PREFIX + "serve-host"
+DEFAULT_SERVE_HOST = "127.0.0.1"
+SERVE_PORT = TPU_PREFIX + "serve-port"  # 0 = ephemeral (tests)
+DEFAULT_SERVE_PORT = 8080
+# scoring backend behind the server: native (jitted flax) | cpp |
+# saved_model — the same EvalModel backends offline eval uses
+SERVE_BACKEND = TPU_PREFIX + "serve-backend"
+DEFAULT_SERVE_BACKEND = "native"
+# micro-batcher: coalesce concurrent requests into one device dispatch of
+# at most max-batch rows, waiting at most max-delay for peers to arrive.
+# Dispatch shapes pad to the export/bucketing.py power-of-two ladder, so
+# the jitted scorer compiles once per bucket, not once per batch length.
+SERVE_MAX_BATCH = TPU_PREFIX + "serve-max-batch"
+DEFAULT_SERVE_MAX_BATCH = 256
+SERVE_MAX_DELAY_MS = TPU_PREFIX + "serve-max-delay"  # ms
+DEFAULT_SERVE_MAX_DELAY_MS = 5.0
+# backpressure: the admission queue is bounded at this many rows; a
+# request that would overflow it is SHED with 429 + Retry-After instead
+# of queued (unbounded queues collapse latency long before they reject)
+SERVE_QUEUE_ROWS = TPU_PREFIX + "serve-queue-rows"
+DEFAULT_SERVE_QUEUE_ROWS = 4096
+SERVE_RETRY_AFTER_S = TPU_PREFIX + "serve-retry-after"  # seconds, int
+DEFAULT_SERVE_RETRY_AFTER_S = 1
+# hot reload: poll the export dir's manifest at this cadence; a changed
+# artifact is admitted only after manifest verification (size + CRC32 +
+# SHA-256) passes, and swaps atomically.  0 disables reload.
+SERVE_RELOAD_POLL_MS = TPU_PREFIX + "serve-reload-poll"
+DEFAULT_SERVE_RELOAD_POLL_MS = 2000
+
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib
 # network planes (WebHDFS/GCS clients, coordinator RPC, remote checkpoint

@@ -1,0 +1,59 @@
+"""Pad-to-bucket batching: one compiled program per bucket, not per shape.
+
+The jitted native scorer (export/eval_model.py) and the serving
+micro-batcher (serve/batcher.py) both dispatch variable-length row
+batches into a compiled XLA program.  XLA compiles per input SHAPE, so a
+workload whose batch lengths vary freely — concurrent serving requests
+coalesced by arrival time, the tail batch of an offline eval stream —
+re-traces and re-compiles for every distinct length it ever sees
+(~19 ms per trace for the flagship DNN, measured in eval_model).  Padding
+every batch up to a fixed ladder of power-of-two bucket sizes bounds the
+compile count at ``log2(max_bucket / min_bucket) + 1`` programs no matter
+what lengths arrive; the padded rows are sliced back off the output.
+
+This is the same lever the TensorFlow serving stack calls "batching with
+allowed_batch_sizes": amortized dispatch needs shape stability, and shape
+stability needs a ladder, not exact sizes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: smallest bucket — single-row requests pad to this, so the per-row
+#: Computable path and a trickle of tiny requests share ONE program
+DEFAULT_MIN_BUCKET = 8
+#: largest power-of-two bucket; beyond it, sizes round up to a multiple
+#: of this (a fixed-batch-size offline eval loop then compiles once)
+DEFAULT_MAX_BUCKET = 4096
+
+
+def bucket_size(
+    n: int,
+    *,
+    min_bucket: int = DEFAULT_MIN_BUCKET,
+    max_bucket: int = DEFAULT_MAX_BUCKET,
+) -> int:
+    """Smallest ladder size >= ``n``: powers of two in
+    [min_bucket, max_bucket], then multiples of max_bucket above it."""
+    if n < 1:
+        raise ValueError(f"batch length must be >= 1, got {n}")
+    if n >= max_bucket:
+        return ((n + max_bucket - 1) // max_bucket) * max_bucket
+    b = min_bucket
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_rows(rows: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad ``rows`` (n, f) up to (bucket, f); no-op when already
+    sized.  The caller slices the first n output rows back off — padded
+    rows produce scores that are never observed."""
+    n = rows.shape[0]
+    if n == bucket:
+        return rows
+    if n > bucket:
+        raise ValueError(f"rows ({n}) exceed bucket ({bucket})")
+    pad = np.zeros((bucket - n,) + rows.shape[1:], dtype=rows.dtype)
+    return np.concatenate([rows, pad], axis=0)

@@ -16,14 +16,33 @@ lifecycle (init → compute/compute_batch → release) with three backends:
   zero-Python-runtime path matching the reference's JNI evaluator; covers
   every exported family except sequence (dnn, wide&deep, multi-task,
   embedding-augmented).
+
+Thread-safety contract: an ``EvalModel`` instance is internally
+synchronized — ``compute``/``compute_batch``/``release`` serialize on a
+per-instance lock, because none of the backends tolerates concurrent
+entry (the cpp backend shares one ctypes handle, the saved_model backend
+one TF session, and ``release`` tears state down under a running call).
+Concurrent callers are therefore CORRECT but not parallel; for
+throughput, coalesce rows into one ``compute_batch`` call (what the
+serving micro-batcher, serve/batcher.py, exists for) or hold one
+instance per thread.
+
+The ``native`` backend pads every batch up to the export/bucketing.py
+ladder before dispatch, so the jitted scorer compiles once per BUCKET
+instead of once per distinct batch length (a free-varying workload would
+otherwise re-trace per length, ~19 ms each on the flagship DNN);
+``native_trace_count`` exposes the compile count for regression tests.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 
 import numpy as np
+
+from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
 from shifu_tensorflow_tpu.export.saved_model import (
@@ -37,12 +56,23 @@ from shifu_tensorflow_tpu.export.saved_model import (
 from shifu_tensorflow_tpu.utils import fs
 
 
+class ModelReleasedError(RuntimeError):
+    """compute after release(): the instance's backend state is gone.
+    Raised as a distinct type so a holder of a stale reference (the
+    serving hot-reload swap window) can re-fetch the live model instead
+    of surfacing an opaque AttributeError."""
+
+
 class EvalModel:
     """init/compute/release lifecycle over an exported model dir."""
 
     def __init__(self, model_dir: str, backend: str = "native"):
         self.model_dir = model_dir
         self.backend = backend
+        # serializes compute/compute_batch/release — see the module
+        # docstring's thread-safety contract.  RLock: compute() calls
+        # compute_batch() on the same thread.
+        self._compute_lock = threading.RLock()
         self.generic_config = json.loads(
             fs.read_text(os.path.join(model_dir, GENERIC_CONFIG))
         )
@@ -83,11 +113,19 @@ class EvalModel:
         # jit the forward: un-jitted flax apply re-TRACES the model every
         # call (~19ms for the flagship DNN — measured 53 rows/s on the
         # per-row Computable path); compiled per input shape it serves
-        # per-row scoring at tens of microseconds
+        # per-row scoring at tens of microseconds.  Batches pad to the
+        # bucketing ladder before dispatch (compute_batch), so the trace
+        # count is bounded by the ladder, not by how many distinct batch
+        # lengths the workload happens to produce.
         model = self._model
-        self._apply = jax.jit(
-            lambda params, x: model.apply({"params": params}, x)
-        )
+        self._trace_count = 0
+
+        def fwd(params, x):
+            # runs at TRACE time only — counts compilations, not calls
+            self._trace_count += 1
+            return model.apply({"params": params}, x)
+
+        self._apply = jax.jit(fwd)
 
     def _init_cpp(self) -> None:
         from shifu_tensorflow_tpu.export.native_scorer import NativeScorer
@@ -129,26 +167,49 @@ class EvalModel:
             raise ValueError(
                 f"expected {self.num_features} features, got {rows.shape[1]}"
             )
-        if self._means is not None:
-            rows = (rows - self._means) / np.where(self._stds == 0, 1, self._stds)
-        if self.backend == "native":
-            out = self._apply(self._params, self._jnp.asarray(rows))
-            return np.asarray(out)
-        if self.backend == "cpp":
-            return self._cpp.score(rows)
-        result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
-        return result[OUTPUT_NAME].numpy()
+        with self._compute_lock:
+            if getattr(self, "_released", False):
+                # a caller that dereferenced this instance just before a
+                # hot-reload swap can land here AFTER release() won the
+                # lock; the typed error lets it re-fetch the live model
+                raise ModelReleasedError(self.model_dir)
+            if self._means is not None:
+                rows = (rows - self._means) / np.where(
+                    self._stds == 0, 1, self._stds
+                )
+            if self.backend == "native":
+                n = rows.shape[0]
+                # pad to the bucket ladder: compile once per bucket, not
+                # once per distinct batch length (padded rows sliced off)
+                padded = pad_rows(rows, bucket_size(n))
+                out = self._apply(self._params, self._jnp.asarray(padded))
+                return np.asarray(out)[:n]
+            if self.backend == "cpp":
+                return self._cpp.score(rows)
+            result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
+            return result[OUTPUT_NAME].numpy()
+
+    @property
+    def native_trace_count(self) -> int:
+        """How many times the jitted native scorer has (re)traced — flat
+        across varying batch lengths within one bucket, by construction."""
+        return getattr(self, "_trace_count", 0)
 
     def release(self) -> None:
         """Explicit resource release (closeTensors parity,
         TensorflowModel.java:97-109) — backends hold no leaked handles, so
-        this just drops references."""
-        if hasattr(self, "_cpp"):
-            self._cpp.close()
-        for attr in ("_model", "_params", "_infer", "_tf", "_jnp", "_cpp",
-                     "_apply"):
-            if hasattr(self, attr):
-                delattr(self, attr)
+        this just drops references.  Takes the compute lock: a release
+        racing an in-flight compute (the serving hot-reload swap drops the
+        OLD model while the batcher may still be scoring on it) waits for
+        the call to finish instead of tearing state down under it."""
+        with self._compute_lock:
+            self._released = True
+            if hasattr(self, "_cpp"):
+                self._cpp.close()
+            for attr in ("_model", "_params", "_infer", "_tf", "_jnp",
+                         "_cpp", "_apply"):
+                if hasattr(self, attr):
+                    delattr(self, attr)
 
     def __enter__(self):
         return self

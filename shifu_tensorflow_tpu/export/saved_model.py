@@ -32,12 +32,26 @@ import numpy as np
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
 from shifu_tensorflow_tpu.utils import fs
 
+# digest + atomic-publish primitives shared with the serving verifier
+# (serve/model_store.py) — writer and checker must never drift
+from shifu_tensorflow_tpu.utils.integrity import (
+    commit_bytes as _commit_bytes,
+    digest_entry as _digest_entry,
+)
+
 INPUT_NAME = "shifu_input_0"
 OUTPUT_NAME = "shifu_output_0"
 SERVE_TAG = "serve"
 GENERIC_CONFIG = "GenericModelConfig.json"
 NATIVE_ARCH = "shifu_tpu_model.json"
 NATIVE_WEIGHTS = "shifu_tpu_weights.npz"
+#: sidecar manifest over the native bundle (size + CRC32 + SHA-256 per
+#: file, the PR-2 verified-checkpoint scheme applied to exports): the
+#: serving hot-reload path admits a new artifact only after the manifest
+#: verifies, so a partially-written or bit-rotted export is never served.
+#: Written LAST (after every file it covers commits), so a manifest's
+#: presence implies a complete bundle.
+NATIVE_MANIFEST = "shifu_tpu_export.manifest.json"
 
 
 def generic_model_config_json() -> str:
@@ -96,7 +110,10 @@ def export_native_bundle(
     zscale_means=None,
     zscale_stds=None,
 ) -> None:
-    """Write the TF-free artifact: architecture JSON + weights npz."""
+    """Write the TF-free artifact: architecture JSON + weights npz, plus
+    the sidecar manifest (size+CRC32+SHA-256 per file) that the serving
+    reload path verifies before admitting the bundle.  Every file commits
+    via tmp+rename; the manifest commits last."""
     fs.mkdirs(export_dir)
     arch = {
         "format_version": 1,
@@ -139,14 +156,42 @@ def export_native_bundle(
             "stds": list(map(float, zscale_stds)) if zscale_stds is not None else None,
         },
     }
-    fs.write_text(os.path.join(export_dir, NATIVE_ARCH), json.dumps(arch, indent=2))
+    import io
+
+    from shifu_tensorflow_tpu.utils import faults
+
+    arch_bytes = json.dumps(arch, indent=2).encode("utf-8")
     flat = _flatten_params(params)
-    # npz via local write (np.savez needs a real file handle)
-    with fs.filesystem_for(export_dir).open_write(
-        fs.strip_local(os.path.join(export_dir, NATIVE_WEIGHTS))
-    ) as f:
-        np.savez(f, **flat)
-    fs.write_text(os.path.join(export_dir, GENERIC_CONFIG), generic_model_config_json())
+    # serialize the npz to memory first so the manifest digests cover
+    # exactly the bytes handed to the filesystem (same rationale as
+    # NpzCheckpointer._write): any later divergence between manifest and
+    # file IS corruption, by construction
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    weights_bytes = buf.getvalue()
+    generic_bytes = generic_model_config_json().encode("utf-8")
+    weights_entry = _digest_entry(weights_bytes)  # hash the payload once
+    manifest = json.dumps({
+        "format_version": 1,
+        "sha256": weights_entry["sha256"],  # bundle identity
+        "files": {
+            NATIVE_ARCH: _digest_entry(arch_bytes),
+            NATIVE_WEIGHTS: weights_entry,
+            GENERIC_CONFIG: _digest_entry(generic_bytes),
+        },
+        "written_by": str(os.getpid()),
+    }, indent=2)
+    # at-rest corruption seam (chaos drills): applied AFTER the digests,
+    # so the manifest records what SHOULD land on disk — the serving
+    # reload verification must catch the divergence
+    weights_bytes = faults.mutate("export.at-rest", weights_bytes)
+    _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes)
+    _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes)
+    _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes)
+    # manifest LAST: its presence implies every covered file committed
+    _commit_bytes(
+        os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8")
+    )
 
 
 def export_saved_model(
@@ -210,7 +255,13 @@ def export_saved_model(
             tf.saved_model.DEFAULT_SERVING_SIGNATURE_DEF_KEY: serving
         },
     )
-    fs.write_text(os.path.join(export_dir, GENERIC_CONFIG), generic_model_config_json())
+    # atomic commit (same bytes the native bundle wrote, so the export
+    # manifest stays valid): an in-place truncate-and-rewrite would hand a
+    # concurrently-verifying hot-reload scorer an empty file
+    _commit_bytes(
+        os.path.join(export_dir, GENERIC_CONFIG),
+        generic_model_config_json().encode("utf-8"),
+    )
     return True
 
 

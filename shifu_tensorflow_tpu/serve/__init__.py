@@ -1,0 +1,12 @@
+"""Online serving subsystem: micro-batched HTTP scoring with hot model
+reload and shed-before-queue backpressure.  See docs/serving.md.
+
+Import surface is intentionally lazy-friendly: ``serve.config`` carries
+no jax dependency (CLI/--help path); constructing a
+:class:`~shifu_tensorflow_tpu.serve.server.ScoringServer` pulls the
+scorer stack.
+"""
+
+from shifu_tensorflow_tpu.serve.config import ServeConfig, resolve_serve_config
+
+__all__ = ["ServeConfig", "resolve_serve_config"]

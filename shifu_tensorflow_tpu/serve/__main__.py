@@ -1,0 +1,130 @@
+"""Serving CLI — run the scoring server against an exported bundle.
+
+    python -m shifu_tensorflow_tpu.serve \
+        --model-dir ./model-export --port 8080
+
+Config precedence matches the training CLI: built-in defaults →
+``--globalconfig`` file(s) (Hadoop XML or JSON, ``shifu.tpu.serve-*``
+keys) → explicit CLI flags.  On startup the server prints one JSON line
+``{"state": "listening", "port": N, ...}`` (machine-readable for smoke
+tests and supervisors), serves until SIGTERM/SIGINT, then drains and
+prints a final JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.serve.config import resolve_serve_config
+from shifu_tensorflow_tpu.utils import retry as _retry_util
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shifu_tensorflow_tpu.serve",
+        description="Serve an exported model over HTTP with micro-batched "
+                    "scoring, hot reload, and shed-before-queue "
+                    "backpressure.",
+    )
+    p.add_argument("--model-dir", required=True,
+                   help="exported bundle dir (export_model output)")
+    p.add_argument("--globalconfig", action="append", default=[],
+                   help="layered config file (XML or JSON); repeatable, "
+                        "later wins")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None,
+                   help=f"0 = ephemeral (default "
+                        f"{K.DEFAULT_SERVE_PORT})")
+    p.add_argument("--backend", default=None,
+                   choices=["native", "cpp", "saved_model"])
+    p.add_argument("--max-batch", type=int, default=None, dest="max_batch",
+                   help="rows per coalesced dispatch")
+    p.add_argument("--max-delay-ms", type=float, default=None,
+                   dest="max_delay_ms",
+                   help="max wait for request coalescing")
+    p.add_argument("--queue-rows", type=int, default=None, dest="queue_rows",
+                   help="admission bound; beyond it requests shed with 429")
+    p.add_argument("--retry-after", type=int, default=None,
+                   dest="retry_after",
+                   help="Retry-After seconds on shed responses")
+    p.add_argument("--reload-poll-ms", type=int, default=None,
+                   dest="reload_poll_ms",
+                   help="export-dir poll cadence for hot reload; "
+                        "0 disables")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    # after parse_args (--help must not pay a jax import), before any
+    # jax-touching work
+    from shifu_tensorflow_tpu.utils.jaxenv import honor_cpu_pin
+
+    honor_cpu_pin()
+    conf = Conf()
+    for path in args.globalconfig:
+        conf.add_resource(path)
+    _retry_util.set_default_policy(_retry_util.policy_from_conf(conf))
+    try:
+        config = resolve_serve_config(args, conf)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    from shifu_tensorflow_tpu.serve.model_store import ArtifactCorrupt
+    from shifu_tensorflow_tpu.serve.server import ScoringServer
+
+    try:
+        server = ScoringServer(config)
+    except ArtifactCorrupt as e:
+        print(f"refusing to serve {config.model_dir}: {e}", file=sys.stderr)
+        return 3
+
+    import threading
+
+    stop = threading.Event()
+    stopping: list[int] = []
+
+    def on_signal(signum, frame):
+        # only flag from the handler: HTTPServer.shutdown() BLOCKS until
+        # the serve loop exits, so calling it here (on the main thread,
+        # which may be the serve loop) would deadlock — the main loop
+        # below does the actual teardown
+        stopping.append(signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    model = server.store.current()
+    server.start()
+    print(json.dumps({
+        "state": "listening",
+        "host": config.host,
+        "port": server.port,
+        "backend": config.backend,
+        "model_epoch": model.epoch,
+        "model_digest": model.digest[:12],
+        "model_verified": model.verified,
+    }), flush=True)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.close()
+        counters = server.metrics.counters()
+        print(json.dumps({
+            "state": "stopped",
+            "signal": stopping[0] if stopping else None,
+            **{k: v for k, v in sorted(counters.items())},
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

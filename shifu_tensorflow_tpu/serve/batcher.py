@@ -1,0 +1,249 @@
+"""Micro-batching with shed-before-queue backpressure.
+
+The throughput lever of online scoring is the same one the training side
+pulls with scan-steps: per-dispatch cost (Python → jit call → XLA
+program launch) is fixed, so N concurrent one-row requests dispatched
+individually pay it N times, while one coalesced dispatch pays it once
+(the difference the TF-system and tf.data papers call per-request vs
+pipeline throughput).  ``MicroBatcher`` coalesces whatever requests are
+queued into one dispatch of at most ``max_batch`` rows, waiting at most
+``max_delay_s`` for peers to arrive, and pads the coalesced batch up to
+the export/bucketing.py power-of-two ladder so the jitted scorer
+compiles once per bucket, not once per batch length.
+
+Backpressure is SHED-BEFORE-QUEUE: the admission queue is bounded at
+``max_queue_rows`` and a request that would overflow it raises
+:class:`ShedLoad` (the server maps it to 429 + Retry-After) instead of
+being queued.  An unbounded queue never rejects anything — it just
+converts overload into unbounded latency for everyone, which is strictly
+worse than telling the slowest fraction of callers to come back later.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("serve.batcher")
+
+
+class ShedLoad(RuntimeError):
+    """Admission refused: the queue is full.  Carries the Retry-After
+    hint the HTTP layer forwards."""
+
+    def __init__(self, retry_after_s: int, queued_rows: int):
+        super().__init__(
+            f"admission queue full ({queued_rows} rows queued); "
+            f"retry after {retry_after_s}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class BatcherClosed(RuntimeError):
+    """Submit after close(): the server is draining."""
+
+
+class RequestTooLarge(ValueError):
+    """A single request bigger than the admission bound — a client
+    error (413), distinct from both shedding (the queue could NEVER
+    hold it, retrying won't help) and from scorer-side ValueErrors
+    (which are server bugs, not the client's payload)."""
+
+
+class _Pending:
+    __slots__ = ("rows", "event", "result", "error", "t_enqueue")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesce concurrent ``submit`` calls into batched ``score_fn``
+    dispatches on a single worker thread.
+
+    ``score_fn(rows) -> scores`` receives a (n, f) float32 array whose n
+    is always a ladder bucket size and must return an array whose axis 0
+    matches; it runs on the batcher thread only, so a scorer that is
+    merely single-thread-safe (EvalModel's documented contract) needs no
+    extra locking here.
+    """
+
+    def __init__(
+        self,
+        score_fn: Callable[[np.ndarray], np.ndarray],
+        *,
+        max_batch: int = 256,
+        max_delay_s: float = 0.005,
+        max_queue_rows: int = 4096,
+        retry_after_s: int = 1,
+        metrics=None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._score = score_fn
+        self.max_batch = max_batch
+        self.max_delay_s = max(0.0, max_delay_s)
+        self.max_queue_rows = max(max_batch, max_queue_rows)
+        self.retry_after_s = retry_after_s
+        self.metrics = metrics
+        self._cond = threading.Condition()
+        self._pending: deque[_Pending] = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ---- client side ----
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def submit(self, rows: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
+        """Score ``rows`` (n, f); blocks until the coalesced dispatch that
+        includes them completes.  Raises :class:`ShedLoad` when admission
+        would overflow the queue, :class:`BatcherClosed` when draining,
+        TimeoutError if the dispatch does not complete in time, or the
+        scorer's own exception."""
+        n = rows.shape[0]
+        if n < 1:
+            raise ValueError("empty batch")
+        if n > self.max_queue_rows:
+            raise RequestTooLarge(
+                f"request of {n} rows exceeds the admission bound "
+                f"({self.max_queue_rows}); split it"
+            )
+        item = _Pending(rows)
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("batcher is draining")
+            # shed BEFORE queue: admitting past the bound converts
+            # overload into latency collapse for every queued caller
+            if self._queued_rows + n > self.max_queue_rows:
+                if self.metrics is not None:
+                    self.metrics.inc("shed_total")
+                raise ShedLoad(self.retry_after_s, self._queued_rows)
+            self._pending.append(item)
+            self._queued_rows += n
+            self._cond.notify_all()
+        if not item.event.wait(timeout_s):
+            # withdraw from the queue if the item was never taken: the
+            # caller is gone, and leaving the rows behind would keep
+            # consuming admission capacity AND device dispatches for
+            # results nobody reads — amplifying exactly the overload the
+            # timeout signals.  An already-taken item can't be recalled;
+            # its result is simply dropped.
+            with self._cond:
+                if item in self._pending:
+                    self._pending.remove(item)
+                    self._queued_rows -= n
+            raise TimeoutError(
+                f"dispatch did not complete within {timeout_s}s"
+            )
+        if item.error is not None:
+            raise item.error
+        if self.metrics is not None:
+            self.metrics.request_latency.record(
+                time.monotonic() - item.t_enqueue
+            )
+        return item.result
+
+    # ---- worker side ----
+    def _take_batch(self) -> list[_Pending] | None:
+        """Block until work (or close), honor the coalescing window, and
+        pop up to max_batch rows' worth of requests — never splitting a
+        request across dispatches (each caller gets exactly one batch's
+        results)."""
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return None  # closed and drained
+            # coalescing window: from the OLDEST queued request's arrival,
+            # wait up to max_delay for peers — unless a full batch is
+            # already here
+            deadline = self._pending[0].t_enqueue + self.max_delay_s
+            while self._queued_rows < self.max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+                if not self._pending:  # spurious wake after drain
+                    return self._take_batch()
+            batch: list[_Pending] = []
+            taken = 0
+            while self._pending:
+                nxt = self._pending[0]
+                n = nxt.rows.shape[0]
+                if batch and taken + n > self.max_batch:
+                    break
+                batch.append(self._pending.popleft())
+                taken += n
+            self._queued_rows -= taken
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        sizes = [p.rows.shape[0] for p in batch]
+        n = sum(sizes)
+        bucket = bucket_size(n)
+        t0 = time.monotonic()
+        try:
+            # the concatenate is INSIDE the guard: coalesced requests can
+            # disagree on row width (each was validated against whichever
+            # model was current at its admission, and a hot reload can
+            # change the width in between) — that must fail THESE callers,
+            # not kill the worker thread and wedge every future submit
+            x = (batch[0].rows if len(batch) == 1
+                 else np.concatenate([p.rows for p in batch], axis=0))
+            scores = np.asarray(self._score(pad_rows(x, bucket)))[:n]
+        except BaseException as e:  # propagate to every waiting caller
+            log.warning("dispatch of %d rows failed: %s: %s",
+                        n, type(e).__name__, e)
+            for p in batch:
+                p.error = e
+                p.event.set()
+            return
+        if self.metrics is not None:
+            self.metrics.inc("batches_total")
+            self.metrics.inc("rows_total", n)
+            self.metrics.inc("padded_rows_total", bucket - n)
+            self.metrics.batch_latency.record(time.monotonic() - t0)
+        off = 0
+        for p, sz in zip(batch, sizes):
+            p.result = scores[off:off + sz]
+            p.error = None
+            off += sz
+            p.event.set()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting; by default finish what is queued (each waiting
+        caller gets its result), then stop the worker thread."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for p in self._pending:
+                    p.error = BatcherClosed("batcher closed before dispatch")
+                    p.event.set()
+                self._pending.clear()
+                self._queued_rows = 0
+            self._cond.notify_all()
+        self._thread.join(timeout=30.0)

@@ -1,0 +1,81 @@
+"""Serving configuration — the ``shifu.tpu.serve-*`` surface as a typed
+dataclass, resolved with the framework's usual precedence (built-in
+defaults → ``--globalconfig`` XML/JSON layers → CLI flags).
+
+Kept import-light on purpose: the CLI parses ``--help`` and resolves
+config without paying the jax import the server itself needs — the same
+discipline as train/__main__.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+from shifu_tensorflow_tpu.config import keys as K
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Everything the scoring server needs to run — the WorkerConfig
+    analogue for the serving plane (JSON-bridgeable via to/from_json so a
+    supervisor can ship it to a subprocess the same way)."""
+
+    model_dir: str
+    host: str = K.DEFAULT_SERVE_HOST
+    port: int = K.DEFAULT_SERVE_PORT
+    backend: str = K.DEFAULT_SERVE_BACKEND
+    max_batch: int = K.DEFAULT_SERVE_MAX_BATCH
+    max_delay_ms: float = K.DEFAULT_SERVE_MAX_DELAY_MS
+    max_queue_rows: int = K.DEFAULT_SERVE_QUEUE_ROWS
+    retry_after_s: int = K.DEFAULT_SERVE_RETRY_AFTER_S
+    reload_poll_ms: int = K.DEFAULT_SERVE_RELOAD_POLL_MS
+
+    def __post_init__(self):
+        if self.backend not in ("native", "cpp", "saved_model"):
+            raise ValueError(
+                f"unknown {K.SERVE_BACKEND} value {self.backend!r} "
+                "(native | cpp | saved_model)"
+            )
+        if self.max_batch < 1:
+            raise ValueError(f"{K.SERVE_MAX_BATCH} must be >= 1")
+        if self.max_queue_rows < self.max_batch:
+            raise ValueError(
+                f"{K.SERVE_QUEUE_ROWS} ({self.max_queue_rows}) must be >= "
+                f"{K.SERVE_MAX_BATCH} ({self.max_batch}): a queue smaller "
+                "than one dispatch could never fill a batch"
+            )
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ServeConfig":
+        return cls(**d)
+
+
+def resolve_serve_config(args, conf) -> ServeConfig:
+    """CLI flag wins, then the conf key, then the built-in default — the
+    same resolution contract trainer_extras/worker_runtime_kwargs use, so
+    a globalconfig file can drive every serve knob without flags."""
+
+    def pick(flag, key, default, get):
+        v = getattr(args, flag, None)
+        return v if v is not None else get(key, default)
+
+    return ServeConfig(
+        model_dir=args.model_dir,
+        host=pick("host", K.SERVE_HOST, K.DEFAULT_SERVE_HOST, conf.get),
+        port=pick("port", K.SERVE_PORT, K.DEFAULT_SERVE_PORT, conf.get_int),
+        backend=pick("backend", K.SERVE_BACKEND, K.DEFAULT_SERVE_BACKEND,
+                     conf.get),
+        max_batch=pick("max_batch", K.SERVE_MAX_BATCH,
+                       K.DEFAULT_SERVE_MAX_BATCH, conf.get_int),
+        max_delay_ms=pick("max_delay_ms", K.SERVE_MAX_DELAY_MS,
+                          K.DEFAULT_SERVE_MAX_DELAY_MS, conf.get_float),
+        max_queue_rows=pick("queue_rows", K.SERVE_QUEUE_ROWS,
+                            K.DEFAULT_SERVE_QUEUE_ROWS, conf.get_int),
+        retry_after_s=pick("retry_after", K.SERVE_RETRY_AFTER_S,
+                           K.DEFAULT_SERVE_RETRY_AFTER_S, conf.get_int),
+        reload_poll_ms=pick("reload_poll_ms", K.SERVE_RELOAD_POLL_MS,
+                            K.DEFAULT_SERVE_RELOAD_POLL_MS, conf.get_int),
+    )

@@ -1,0 +1,89 @@
+"""Serving observability: counters + latency histograms + /metrics text.
+
+The metrics-plane primitives live with the rest of the metrics plumbing
+(coordinator/metrics_board.py — ``LatencyHistogram``, EpochAggregator
+style: one lock, explicit snapshots, no background machinery); this
+module composes them into the serving scrape surface.
+
+Rendered in the Prometheus text exposition format because every scrape
+stack speaks it; nothing here depends on a Prometheus client library.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from shifu_tensorflow_tpu.coordinator.metrics_board import LatencyHistogram
+
+#: counter names, fixed up front so /metrics always exposes the full set
+#: (a counter that appears only after its first event breaks dashboards)
+_COUNTERS = (
+    "requests_total",       # valid /score requests (incl. later shed/error)
+    "rows_total",           # rows scored (excl. bucket padding)
+    "batches_total",        # device dispatches by the micro-batcher
+    "padded_rows_total",    # padding rows added by the bucket ladder
+    "shed_total",           # requests shed with 429 (backpressure)
+    "errors_total",         # requests failed with 4xx/5xx (excl. 429)
+    "reloads_total",        # hot-reload swaps admitted
+    "reload_failures_total",  # reload attempts refused (corrupt artifact)
+)
+
+
+class ServeMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {name: 0 for name in _COUNTERS}
+        self.request_latency = LatencyHistogram()
+        self.batch_latency = LatencyHistogram()
+        self.started_at = time.time()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # ---- rendering ----
+    def render_prometheus(
+        self,
+        *,
+        queue_rows: int,
+        model_epoch: int,
+        model_digest: str,
+        model_verified: bool,
+    ) -> str:
+        """The /metrics body.  Gauges (queue depth, loaded-model identity)
+        come from the caller — they belong to the batcher/store, and
+        pulling them at render time keeps this module dependency-free."""
+        lines: list[str] = []
+
+        def counter(name: str, value: float) -> None:
+            lines.append(f"# TYPE stpu_serve_{name} counter")
+            lines.append(f"stpu_serve_{name} {value}")
+
+        def gauge(name: str, value: float, labels: str = "") -> None:
+            lines.append(f"# TYPE stpu_serve_{name} gauge")
+            lines.append(f"stpu_serve_{name}{labels} {value}")
+
+        for name, value in sorted(self.counters().items()):
+            counter(name, value)
+        gauge("queue_rows", queue_rows)
+        gauge("model_epoch", model_epoch)
+        gauge("model_verified", int(model_verified))
+        gauge("model_info", 1, labels='{digest="%s"}' % model_digest)
+        gauge("uptime_seconds", round(time.time() - self.started_at, 3))
+        for hist, name in ((self.request_latency, "request_latency_seconds"),
+                           (self.batch_latency, "batch_latency_seconds")):
+            snap = hist.snapshot()
+            lines.append(f"# TYPE stpu_serve_{name} summary")
+            for q in (50, 90, 99):
+                lines.append(
+                    'stpu_serve_%s{quantile="0.%02d"} %g'
+                    % (name, q, hist.percentile(q))
+                )
+            lines.append(f"stpu_serve_{name}_count {snap['count']}")
+            lines.append(f"stpu_serve_{name}_sum {snap['sum']:.6f}")
+        return "\n".join(lines) + "\n"

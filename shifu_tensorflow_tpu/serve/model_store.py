@@ -1,0 +1,311 @@
+"""Hot model reload with verify-before-admit.
+
+The serving process outlives any single export: training jobs keep
+publishing new artifacts into the export dir, and the server must pick
+them up without a restart — but NEVER serve a partially-written or
+corrupt one.  The defense is the PR-2 verified-checkpoint scheme applied
+at the serving boundary:
+
+- ``export_native_bundle`` publishes a sidecar manifest
+  (``shifu_tpu_export.manifest.json``: size + CRC32 + SHA-256 per file)
+  LAST, after every covered file has committed via tmp+rename — so a
+  manifest's presence implies a complete bundle;
+- the store polls the manifest; a changed bundle digest triggers a
+  reload attempt that re-reads every covered file and verifies it
+  against the manifest BEFORE constructing the new scorer;
+- verification failure (an ``export.at-rest`` bitflip/truncate under
+  ``$STPU_FAULT_PLAN``, a torn write, a rotted disk) refuses the
+  artifact: the store keeps serving the previous verified model and
+  retries on the next poll — recovery is automatic when a good artifact
+  lands;
+- the swap is atomic (one reference assignment under a lock) and the old
+  model is released only after the swap, through EvalModel's compute
+  lock — an in-flight dispatch on the old model finishes before its
+  state is torn down.
+
+Transient read faults at the reload path (a flaky NFS mount, a remote
+export dir) retry under utils/retry.py — the ``serve.reload`` faults
+seam sits inside the retried callable, so chaos drills exercise exactly
+the production retry envelope.  Corruption is NOT transient: it never
+retries, it waits for a new artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_MANIFEST,
+    NATIVE_WEIGHTS,
+)
+from shifu_tensorflow_tpu.utils import faults, fs, logs
+from shifu_tensorflow_tpu.utils import retry as retry_util
+from shifu_tensorflow_tpu.utils.integrity import check_entry
+
+log = logs.get("serve.store")
+
+
+class ArtifactCorrupt(RuntimeError):
+    """The artifact on disk disagrees with its manifest (or cannot be
+    loaded).  Deliberately carries no ``.code`` and subclasses none of
+    the transport errors, so the retry classifier never retries it —
+    corruption is cured by a new export, not by re-reading."""
+
+
+class ModelNotLoaded(RuntimeError):
+    """No model has been admitted yet."""
+
+
+@dataclass(frozen=True)
+class LoadedModel:
+    model: object          # EvalModel
+    digest: str            # bundle identity (weights SHA-256; "" = legacy)
+    epoch: int             # reload generation: 0 initial, +1 per swap
+    verified: bool         # manifest present and checked
+    loaded_at: float
+    fingerprint: str = ""  # change-detector value captured at load time
+
+
+def _verify_manifest(model_dir: str) -> dict | None:
+    """Read + check the export manifest: every covered file must match
+    its recorded size/CRC32/SHA-256.  Returns the parsed manifest (with
+    its fingerprint attached under ``__fingerprint__``), or None when
+    absent (legacy export, written before manifests existed).  Raises
+    :class:`ArtifactCorrupt` on any mismatch.
+
+    The fingerprint's mtime is captured BEFORE the content read: if a
+    newer export replaces the manifest mid-verify, the recorded
+    fingerprint is the OLDER one and the next poll sees a change — the
+    race fails open to a reload, never to permanent staleness."""
+    mpath = os.path.join(model_dir, NATIVE_MANIFEST)
+    if not fs.exists(mpath):
+        return None
+    try:
+        mtime = fs.mtime_ns(mpath)
+        manifest = json.loads(fs.read_text(mpath))
+    except (OSError, ValueError) as e:
+        raise ArtifactCorrupt(f"unreadable manifest: {e}") from e
+    manifest["__fingerprint__"] = f"{manifest.get('sha256', '')}:{mtime}"
+    for name, want in manifest.get("files", {}).items():
+        path = os.path.join(model_dir, name)
+        try:
+            data = fs.read_bytes(path)
+        except OSError as e:
+            raise ArtifactCorrupt(f"{name}: cannot read: {e}") from e
+        # same digest-check implementation the export WRITER used
+        # (utils/integrity.py) — the two sides cannot drift
+        mismatch = check_entry(data, want)
+        if mismatch is not None:
+            raise ArtifactCorrupt(f"{name}: {mismatch}")
+    return manifest
+
+
+class ModelStore:
+    """Atomic current-model reference + the background reload poller."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        *,
+        backend: str = "native",
+        poll_interval_s: float = 2.0,
+        metrics=None,
+        retry_policy: retry_util.RetryPolicy | None = None,
+    ):
+        self.model_dir = model_dir
+        self.backend = backend
+        self.poll_interval_s = poll_interval_s
+        self.metrics = metrics
+        self._retry_policy = retry_policy
+        self._lock = threading.Lock()
+        self._current: LoadedModel | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # initial load FAILS FAST on a corrupt artifact: starting a server
+        # that can only 503 (or worse, serve garbage) helps nobody — the
+        # operator points it at a good export instead
+        self._current = self._load(epoch=0)
+        log.info(
+            "loaded model from %s (digest %s, verified=%s)",
+            model_dir, self._current.digest[:12] or "<legacy>",
+            self._current.verified,
+        )
+
+    # ---- loading ----
+    def _load(self, epoch: int) -> LoadedModel:
+        """Verify-then-load under the retry envelope; the serve.reload
+        faults seam sits inside the retried callable so every re-attempt
+        re-rolls, like a real flaky mount."""
+        from shifu_tensorflow_tpu.export.eval_model import EvalModel
+
+        def attempt() -> LoadedModel:
+            faults.check("serve.reload")
+            manifest = _verify_manifest(self.model_dir)
+            legacy_fp = ""
+            if manifest is None:
+                # legacy fingerprint BEFORE constructing the model: a new
+                # export landing during the construction must not stamp
+                # ITS fingerprint onto this older model (same fail-open
+                # rule the manifest path enforces at its re-verify)
+                legacy_fp = self._fingerprint() or ""
+                log.warning(
+                    "export at %s has no manifest (legacy bundle): "
+                    "integrity guarded only by the artifact parse",
+                    self.model_dir,
+                )
+            try:
+                model = EvalModel(self.model_dir, backend=self.backend)
+            except Exception as e:
+                # manifest verified but the load failed: the WRITER
+                # produced garbage, or the bundle changed under us —
+                # same refusal class either way
+                raise ArtifactCorrupt(
+                    f"artifact load failed: {type(e).__name__}: {e}"
+                ) from e
+            digest = (manifest or {}).get("sha256", "")
+            fingerprint = (manifest or {}).get("__fingerprint__", "")
+            if manifest is not None:
+                # close the verify→load window: if the bundle changed
+                # while EvalModel was reading it, the re-read manifest
+                # disagrees and the load is discarded (next poll
+                # reconciles); serving a mix of two bundles is exactly
+                # the "partially-loaded model" this store exists to
+                # prevent
+                after = _verify_manifest(self.model_dir)
+                if after is None or after.get("sha256") != digest:
+                    model.release()
+                    raise ArtifactCorrupt(
+                        "bundle changed during load; discarded"
+                    )
+                # the fingerprint comes from the VERIFIED manifest read,
+                # never a fresh disk read: a bundle landing after the
+                # re-verify must not stamp ITS fingerprint onto this
+                # older model, or the poll loop would skip it forever
+                fingerprint = after["__fingerprint__"]
+            else:
+                # legacy: the pre-construction file-identity fingerprint
+                fingerprint = legacy_fp
+            return LoadedModel(
+                model=model,
+                digest=digest,
+                epoch=epoch,
+                verified=manifest is not None,
+                loaded_at=time.time(),
+                fingerprint=fingerprint,
+            )
+
+        return retry_util.call(
+            attempt, policy=self._retry_policy, site="serve.reload"
+        )
+
+    def _fingerprint(self) -> str | None:
+        """Cheap change detector: the manifest's bundle digest PLUS its
+        mtime (so a re-export is always a new fingerprint, even when it
+        re-publishes identical bytes after a refused corrupt generation),
+        or the weights file's (mtime, size) for legacy manifest-less
+        exports.  None when nothing readable is there (mid-publish; try
+        later)."""
+        mpath = os.path.join(self.model_dir, NATIVE_MANIFEST)
+        try:
+            if fs.exists(mpath):
+                # mtime BEFORE content (same ordering as _verify_manifest):
+                # a replace in between yields a stale-mtime chimera that
+                # matches neither stored fingerprint — the poll then
+                # attempts a reload, i.e. the race fails open
+                mtime = fs.mtime_ns(mpath)
+                sha = json.loads(fs.read_text(mpath)).get("sha256", "")
+                return f"{sha}:{mtime}"
+            wpath = os.path.join(self.model_dir, NATIVE_WEIGHTS)
+            if fs.exists(wpath):
+                return f"legacy:{fs.mtime_ns(wpath)}:{fs.size(wpath)}"
+        except (OSError, ValueError):
+            pass
+        return None
+
+    # ---- public surface ----
+    def current(self) -> LoadedModel:
+        with self._lock:
+            if self._current is None:
+                raise ModelNotLoaded(self.model_dir)
+            return self._current
+
+    def start(self) -> None:
+        """Begin polling for new artifacts (no-op when the poll interval
+        is 0: reload disabled)."""
+        if self.poll_interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="serve-reload", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        with self._lock:
+            current, self._current = self._current, None
+        if current is not None:
+            current.model.release()
+
+    def _poll_loop(self) -> None:
+        # the last fingerprint we refused, for LOG de-duplication only —
+        # the reload is still re-attempted every poll.  Caching the
+        # refusal as a skip would be wrong: a transient mount outage that
+        # exhausts the retry budget surfaces as the same exception class
+        # as real corruption, and skipping its fingerprint forever would
+        # pin the server to a stale model after the mount recovers.
+        # Re-verifying a genuinely corrupt artifact each poll costs one
+        # manifest+file read per interval — cheap insurance.
+        refused: str | None = None
+        while not self._stop.wait(self.poll_interval_s):
+            fp = None
+            try:
+                fp = self._fingerprint()
+                cur = self.current()
+                if fp is None or fp == cur.fingerprint:
+                    continue
+                self.reload_now()
+                refused = None
+            except ArtifactCorrupt as e:
+                if self.metrics is not None:
+                    self.metrics.inc("reload_failures_total")
+                log_fn = log.debug if fp == refused else log.error
+                refused = fp
+                log_fn(
+                    "refusing new artifact at %s (still serving epoch %d, "
+                    "digest %s): %s",
+                    self.model_dir, self.current().epoch,
+                    self.current().digest[:12], e,
+                )
+            except Exception as e:  # poller must never die silently
+                log.error("reload poll failed: %s: %s",
+                          type(e).__name__, e)
+
+    def reload_now(self) -> LoadedModel:
+        """Synchronous verify-and-swap (the poll loop's body; exposed for
+        tests and an operator endpoint).  Raises ArtifactCorrupt when the
+        on-disk artifact fails verification — the previous model keeps
+        serving."""
+        with self._lock:
+            next_epoch = (self._current.epoch + 1
+                          if self._current is not None else 0)
+        loaded = self._load(epoch=next_epoch)
+        with self._lock:
+            old, self._current = self._current, loaded
+        if self.metrics is not None:
+            self.metrics.inc("reloads_total")
+        log.info("hot-reloaded model epoch %d (digest %s, verified=%s)",
+                 loaded.epoch, loaded.digest[:12] or "<legacy>",
+                 loaded.verified)
+        if old is not None:
+            # release AFTER the swap; EvalModel.release takes the compute
+            # lock, so an in-flight dispatch on the old model finishes
+            # first
+            old.model.release()
+        return loaded

@@ -57,6 +57,11 @@ site               where
 ``ckpt.write``     NpzCheckpointer, once per checkpoint tmp-file write
 ``ckpt.at-rest``   NpzCheckpointer payload bytes (``mutate``), after the
                    manifest digest — silent at-rest corruption
+``export.at-rest``  export_native_bundle weights bytes (``mutate``), after
+                   the export manifest digest — a corrupt serving artifact
+                   the hot-reload verification must refuse to admit
+``serve.reload``   serving ModelStore, inside the retried verify-and-load
+                   callable — transient read faults at the reload path
 ``health.nan-loss.e<N>``  trainer health guard, once per training step
                    (``poll`` with the step index) — NaN-loss injection
 =================  =========================================================

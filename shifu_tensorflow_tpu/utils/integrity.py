@@ -1,0 +1,57 @@
+"""Shared artifact-integrity primitives: digest triples + atomic publish.
+
+One definition of the size+CRC32+SHA-256 manifest scheme for the
+artifact plane — the export writer (export/saved_model.py) digests with
+:func:`digest_entry` and publishes with :func:`commit_bytes`; the
+serving verifier (serve/model_store.py) checks with :func:`check_entry`.
+A future change to the scheme (new digest, format bump) lands here once
+instead of drifting between writer and verifier.
+
+train/checkpoint.py predates this module and owns its own checkpoint
+manifest format (extra fields, fault-seam interleaving, remote-fs commit
+protocol via fs.commit_rename — which :func:`commit_bytes` also uses);
+its digest TRIPLE is intentionally the same scheme.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import zlib
+
+from shifu_tensorflow_tpu.utils import fs
+
+
+def digest_entry(payload: bytes) -> dict:
+    """The manifest record for one file's bytes."""
+    return {
+        "size": len(payload),
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "sha256": hashlib.sha256(payload).hexdigest(),
+    }
+
+
+def check_entry(data: bytes, want: dict) -> str | None:
+    """Verify ``data`` against a :func:`digest_entry` record.  Returns
+    None when every recorded digest matches, else a human-readable
+    mismatch description (size first — it is the cheap truncation
+    tell)."""
+    if len(data) != int(want.get("size", -1)):
+        return f"size {len(data)} != recorded {want.get('size')}"
+    if (zlib.crc32(data) & 0xFFFFFFFF) != int(want.get("crc32", -1)):
+        return "CRC32 mismatch"
+    sha = want.get("sha256")
+    if sha and hashlib.sha256(data).hexdigest() != sha:
+        return "SHA-256 digest differs"
+    return None
+
+
+def commit_bytes(path: str, payload: bytes) -> None:
+    """Atomic publish: write to a tmp name only this process uses, then
+    rename-commit (fs.commit_rename).  A concurrent reader — the
+    hot-reloading scorer watching an export dir — must never observe a
+    half-written file under the final name."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
+        f.write(payload)
+    fs.commit_rename(tmp, path)

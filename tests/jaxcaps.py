@@ -1,0 +1,34 @@
+"""Environment-capability gates for tests, shared across files.
+
+The cross-process SPMD drills (test_spmd, test_cli multi-worker,
+test_convergence, test_eval_cli fleet, test_netns_spmd) need
+CROSS-PROCESS collectives on the CPU backend: each worker is its own
+jax process and gradients all-reduce over loopback.  jaxlib 0.4.x's CPU
+PJRT client cannot form them — the fleets hang or fail inside
+jax.distributed initialization, not in framework code (known-broken at
+seed, CHANGES.md PR 2).  Skipping with this explicit reason makes
+tier-1 output distinguish "environment can't run this" from a real
+regression, and stops the broken fleets from burning the suite's
+wall-clock budget on doomed subprocess timeouts.
+
+In-process SPMD (the conftest's 8-device virtual CPU mesh) is
+unaffected and runs everywhere.
+"""
+
+from __future__ import annotations
+
+import jaxlib
+import pytest
+
+JAXLIB_VERSION = tuple(
+    int(p) for p in jaxlib.__version__.split(".")[:3]
+)
+
+needs_multiprocess_collectives = pytest.mark.skipif(
+    JAXLIB_VERSION < (0, 5, 0),
+    reason=(
+        "jaxlib %s CPU backend lacks multiprocess collectives "
+        "(known-broken at seed, see CHANGES.md PR 2); needs jaxlib>=0.5"
+        % jaxlib.__version__
+    ),
+)

@@ -14,6 +14,10 @@ from shifu_tensorflow_tpu.train.__main__ import (
     resolve_schema,
 )
 
+# subprocess fleets need cross-process CPU collectives — an environment
+# capability, not framework logic; see tests/jaxcaps.py for the rationale
+from jaxcaps import needs_multiprocess_collectives
+
 
 def _write_model_config(tmp_path, model_config_json, epochs=2):
     mc = dict(model_config_json)
@@ -137,6 +141,7 @@ def test_cli_single_worker_end_to_end(
     assert (export_dir / "GenericModelConfig.json").exists()
 
 
+@needs_multiprocess_collectives
 def test_cli_multi_worker_end_to_end(
     tmp_path, capsys, psv_dataset, model_config_json
 ):
@@ -161,6 +166,7 @@ def test_cli_multi_worker_end_to_end(
     assert (export_dir / "shifu_tpu_weights.npz").exists()
 
 
+@needs_multiprocess_collectives
 def test_cli_multi_worker_keep_best_exports_chief_snapshot(
     tmp_path, capsys, psv_dataset, model_config_json
 ):
@@ -417,6 +423,7 @@ def test_multi_worker_preflight_rejects_bad_accum_configs(tmp_path):
     with pytest.raises(SystemExit, match="sagn"):
         main(base + ["--model-config", str(mc), "--accum-steps", "4"])
 
+@needs_multiprocess_collectives
 def test_cli_multi_worker_fleet_early_stop(
     tmp_path, capsys, psv_dataset, model_config_json
 ):

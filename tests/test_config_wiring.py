@@ -357,6 +357,77 @@ def test_stream_feature_dtype_survives_worker_json_bridge():
     assert rt.stream_feature_dtype == "float32"
 
 
+def test_serve_keys_round_trip_xml_to_dataclass(tmp_path):
+    """Every shifu.tpu.serve-* key must survive the full resolution
+    chain: Hadoop-XML resource → layered Conf merge → CLI override →
+    ServeConfig dataclass (the serving WorkerConfig analogue) → JSON
+    bridge — same contract the PR-2 health keys are held to."""
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+    from shifu_tensorflow_tpu.serve.__main__ import build_parser as serve_parser
+    from shifu_tensorflow_tpu.serve import resolve_serve_config
+
+    xml = tmp_path / "serve.xml"
+    values = {
+        K.SERVE_HOST: "0.0.0.0",
+        K.SERVE_PORT: "9100",
+        K.SERVE_BACKEND: "cpp",
+        K.SERVE_MAX_BATCH: "128",
+        K.SERVE_MAX_DELAY_MS: "7.5",
+        K.SERVE_QUEUE_ROWS: "2048",
+        K.SERVE_RETRY_AFTER_S: "3",
+        K.SERVE_RELOAD_POLL_MS: "500",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    args = serve_parser().parse_args(["--model-dir", "/m"])
+    cfg = resolve_serve_config(args, conf)
+    assert cfg.host == "0.0.0.0" and cfg.port == 9100
+    assert cfg.backend == "cpp"
+    assert cfg.max_batch == 128 and cfg.max_delay_ms == 7.5
+    assert cfg.max_queue_rows == 2048
+    assert cfg.retry_after_s == 3 and cfg.reload_poll_ms == 500
+    # CLI flags win over the XML layer
+    args = serve_parser().parse_args(
+        ["--model-dir", "/m", "--port", "9200", "--backend", "native",
+         "--max-batch", "64", "--max-delay-ms", "2", "--queue-rows",
+         "512", "--retry-after", "9", "--reload-poll-ms", "0"]
+    )
+    cfg = resolve_serve_config(args, conf)
+    assert (cfg.port, cfg.backend, cfg.max_batch, cfg.max_delay_ms,
+            cfg.max_queue_rows, cfg.retry_after_s, cfg.reload_poll_ms) \
+        == (9200, "native", 64, 2.0, 512, 9, 0)
+    # and the WorkerConfig-style JSON bridge round-trips every field
+    assert ServeConfig.from_json(cfg.to_json()) == cfg
+    # defaults with neither layer set
+    d = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), Conf()
+    )
+    assert d.port == K.DEFAULT_SERVE_PORT
+    assert d.max_batch == K.DEFAULT_SERVE_MAX_BATCH
+    assert d.backend == K.DEFAULT_SERVE_BACKEND
+
+
+def test_serve_config_rejects_misconfiguration():
+    """Typos/incoherent values are one clean pre-launch error (the conf
+    path has no argparse choices guard), not a crash inside the server."""
+    import pytest
+
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+
+    with pytest.raises(ValueError, match="serve-backend"):
+        ServeConfig(model_dir="/m", backend="tensorrt")
+    with pytest.raises(ValueError, match="serve-queue-rows"):
+        ServeConfig(model_dir="/m", max_batch=256, max_queue_rows=100)
+    with pytest.raises(ValueError, match="serve-max-batch"):
+        ServeConfig(model_dir="/m", max_batch=0)
+
+
 def test_health_keys_drive_worker_and_spec_fields():
     import pytest
 

@@ -1,0 +1,669 @@
+"""Serving subsystem drills: micro-batching, bucket-ladder compile
+economy, hot reload with verify-before-admit, shed-before-queue
+backpressure — incl. the chaos drill the acceptance criteria pin: under
+``STPU_FAULT_PLAN`` at-rest corruption of a mid-reload artifact the
+server keeps serving the previous verified model and recovers when a
+good artifact lands."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.export.eval_model import EvalModel
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_MANIFEST,
+    NATIVE_WEIGHTS,
+    export_model,
+)
+from shifu_tensorflow_tpu.serve.batcher import (
+    BatcherClosed,
+    MicroBatcher,
+    ShedLoad,
+)
+from shifu_tensorflow_tpu.serve.config import ServeConfig
+from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+from shifu_tensorflow_tpu.serve.model_store import (
+    ArtifactCorrupt,
+    ModelStore,
+    _verify_manifest,
+)
+from shifu_tensorflow_tpu.serve.server import ScoringServer
+from shifu_tensorflow_tpu.train.trainer import Trainer
+from shifu_tensorflow_tpu.utils import faults
+
+N_FEATURES = 6
+
+
+def _model_config():
+    return ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05}}}
+    )
+
+
+def _export(tmp_dir: str, seed: int = 0) -> str:
+    export_model(tmp_dir, Trainer(_model_config(), N_FEATURES, seed=seed))
+    return tmp_dir
+
+
+@pytest.fixture()
+def export_dir(tmp_path):
+    return _export(str(tmp_path / "model"))
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    faults.set_plan(None)
+
+
+def _rows(n: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).random((n, N_FEATURES)).astype(
+        np.float32
+    )
+
+
+# ------------------------------------------------------------- bucketing
+
+
+def test_bucket_ladder_is_powers_of_two_then_multiples():
+    assert bucket_size(1) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(100) == 128
+    assert bucket_size(4096) == 4096
+    assert bucket_size(4097) == 8192
+    assert bucket_size(9000) == 12288  # 3 * 4096
+    with pytest.raises(ValueError):
+        bucket_size(0)
+
+
+def test_pad_rows_shapes_and_content():
+    x = _rows(5)
+    padded = pad_rows(x, 8)
+    assert padded.shape == (8, N_FEATURES)
+    np.testing.assert_array_equal(padded[:5], x)
+    assert float(np.abs(padded[5:]).sum()) == 0.0
+    assert pad_rows(x, 5) is x  # already sized: no copy
+    with pytest.raises(ValueError):
+        pad_rows(x, 4)
+
+
+def test_native_scorer_trace_count_flat_across_batch_lengths(export_dir):
+    """The compile-once win: varying batch lengths within one bucket must
+    not re-trace the jitted scorer (the old behavior traced once per
+    distinct length — ~19 ms each on the flagship DNN)."""
+    with EvalModel(export_dir) as em:
+        for n in (1, 2, 3, 5, 7, 8):  # all pad to the 8-bucket
+            em.compute_batch(_rows(n, seed=n))
+        assert em.native_trace_count == 1
+        for n in (9, 12, 16, 11, 4, 6):  # 16-bucket joins; 8 reused
+            em.compute_batch(_rows(n, seed=n))
+        assert em.native_trace_count == 2
+        # and padding never leaks into results: padded batch == unpadded
+        x = _rows(5, seed=42)
+        np.testing.assert_allclose(
+            em.compute_batch(x), np.concatenate(
+                [em.compute_batch(x[:3]), em.compute_batch(x[3:])]
+            ), rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_released_model_raises_typed_error(export_dir):
+    """A stale reference held across a hot-reload swap must get the
+    typed released error (the server re-fetches on it), never an opaque
+    AttributeError from torn-down backend state."""
+    from shifu_tensorflow_tpu.export.eval_model import ModelReleasedError
+
+    em = EvalModel(export_dir)
+    em.release()
+    with pytest.raises(ModelReleasedError):
+        em.compute_batch(_rows(2))
+
+
+def test_eval_model_concurrent_compute_is_safe(export_dir):
+    """The documented thread-safety contract: concurrent compute_batch
+    calls serialize on the instance lock and every caller gets its own
+    correct scores (no torn state, no cross-request mixing)."""
+    with EvalModel(export_dir) as em:
+        x = _rows(32)
+        want = em.compute_batch(x)
+        errors: list[BaseException] = []
+
+        def worker(seed: int):
+            try:
+                for _ in range(5):
+                    got = em.compute_batch(x)
+                    np.testing.assert_allclose(got, want, rtol=1e-6)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not errors, errors
+
+
+# ---------------------------------------------------------- micro-batcher
+
+
+class _GatedScorer:
+    """score_fn that can hold the batcher thread, so tests control when
+    queued requests coalesce."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.calls: list[int] = []
+
+    def __call__(self, rows: np.ndarray) -> np.ndarray:
+        self.gate.wait(10.0)
+        self.calls.append(rows.shape[0])
+        return rows.sum(axis=1, keepdims=True)
+
+
+def test_batcher_coalesces_concurrent_requests():
+    scorer = _GatedScorer()
+    metrics = ServeMetrics()
+    b = MicroBatcher(scorer, max_batch=64, max_delay_s=0.05,
+                     max_queue_rows=256, metrics=metrics)
+    try:
+        # hold the batcher on a first request, queue 6 more behind it
+        scorer.gate.clear()
+        results: dict[int, np.ndarray] = {}
+
+        def submit(i, n):
+            results[i] = b.submit(np.full((n, 4), float(i), np.float32))
+
+        threads = [threading.Thread(target=submit, args=(0, 2))]
+        threads[0].start()
+        # let the coalescing window (50 ms) lapse so the lone request
+        # enters the (gated) dispatch before the peers arrive
+        time.sleep(0.2)
+        for i in range(1, 7):
+            threads.append(threading.Thread(target=submit, args=(i, 3)))
+            threads[-1].start()
+        time.sleep(0.2)
+        scorer.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        # first dispatch = the lone request; second coalesced the 6 queued
+        assert scorer.calls[0] == bucket_size(2)
+        assert len(scorer.calls) == 2
+        assert scorer.calls[1] == bucket_size(18)
+        # every caller got exactly its own rows' scores back
+        for i in range(7):
+            n = 2 if i == 0 else 3
+            np.testing.assert_allclose(results[i],
+                                       np.full((n, 1), i * 4.0))
+        assert metrics.counters()["batches_total"] == 2
+        assert metrics.counters()["rows_total"] == 20
+    finally:
+        scorer.gate.set()
+        b.close()
+
+
+def test_batcher_respects_max_batch_and_never_splits_requests():
+    scorer = _GatedScorer()
+    b = MicroBatcher(scorer, max_batch=12, max_delay_s=0.05,
+                     max_queue_rows=256)
+    try:
+        scorer.gate.clear()
+        threads = []
+
+        def submit(n):
+            b.submit(np.ones((n, 2), np.float32))
+
+        t0 = threading.Thread(target=submit, args=(1,))
+        t0.start()
+        # past the 50 ms coalescing window: the lone request is in the
+        # (gated) dispatch before the rest queue up
+        time.sleep(0.2)
+        for n in (5, 5, 5):  # 15 rows queued behind the gated dispatch
+            threads.append(threading.Thread(target=submit, args=(n,)))
+            threads[-1].start()
+            time.sleep(0.02)  # deterministic queue order
+        scorer.gate.set()
+        t0.join(timeout=10.0)
+        for t in threads:
+            t.join(timeout=10.0)
+        # after the gated single, dispatches are [5+5 rows] then [5]:
+        # 5+5+5 > max_batch 12, and a request is never split across
+        # dispatches (splitting would tear the third caller's rows apart)
+        assert scorer.calls[0] == bucket_size(1)
+        assert scorer.calls[1:] == [bucket_size(10), bucket_size(5)]
+    finally:
+        scorer.gate.set()
+        b.close()
+
+
+def test_batcher_sheds_before_queueing():
+    scorer = _GatedScorer()
+    metrics = ServeMetrics()
+    b = MicroBatcher(scorer, max_batch=4, max_delay_s=0.01,
+                     max_queue_rows=8, retry_after_s=3, metrics=metrics)
+    try:
+        scorer.gate.clear()
+        threads = []
+        # first submit enters the gated dispatch (leaves the queue); the
+        # next two fill the 8-row admission bound
+        for _ in range(3):
+            t = threading.Thread(
+                target=lambda: b.submit(np.ones((4, 2), np.float32))
+            )
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)
+        assert b.queued_rows() == 8  # bound reached
+        with pytest.raises(ShedLoad) as ei:
+            b.submit(np.ones((1, 2), np.float32))
+        assert ei.value.retry_after_s == 3
+        assert metrics.counters()["shed_total"] == 1
+        # oversized single requests are a client error, not a shed
+        with pytest.raises(ValueError, match="exceeds"):
+            b.submit(np.ones((9, 2), np.float32))
+        scorer.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        # queue drained: admission works again
+        out = b.submit(np.ones((2, 2), np.float32))
+        assert out.shape == (2, 1)
+    finally:
+        scorer.gate.set()
+        b.close()
+
+
+def test_batcher_survives_mixed_width_coalesce():
+    """Requests with disagreeing row widths can share a coalescing
+    window (a hot reload can change the model width between their
+    admissions): the concatenate failure must land on THOSE callers,
+    not kill the worker thread and wedge every future submit."""
+    scorer = _GatedScorer()
+    b = MicroBatcher(scorer, max_batch=16, max_delay_s=0.05)
+    try:
+        scorer.gate.clear()
+        errors: list[BaseException | None] = [None, None]
+
+        def submit(i, width):
+            try:
+                b.submit(np.ones((2, width), np.float32))
+            except BaseException as e:
+                errors[i] = e
+
+        t0 = threading.Thread(target=submit, args=(0, 3))
+        t0.start()
+        time.sleep(0.2)  # lone request into the gated dispatch
+        ts = [threading.Thread(target=submit, args=(i, w))
+              for i, w in ((0, 3), (1, 5))]  # mixed widths queue together
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        scorer.gate.set()
+        t0.join(timeout=10.0)
+        for t in ts:
+            t.join(timeout=10.0)
+        assert any(isinstance(e, ValueError) for e in errors), errors
+        # the worker survived: a well-formed submit still completes
+        out = b.submit(np.ones((2, 3), np.float32), timeout_s=10.0)
+        assert out.shape == (2, 1)
+    finally:
+        scorer.gate.set()
+        b.close()
+
+
+def test_batcher_propagates_scorer_errors_and_close_rejects():
+    def boom(rows):
+        raise RuntimeError("scorer exploded")
+
+    b = MicroBatcher(boom, max_batch=4, max_delay_s=0.0)
+    with pytest.raises(RuntimeError, match="exploded"):
+        b.submit(np.ones((1, 2), np.float32))
+    b.close()
+    with pytest.raises(BatcherClosed):
+        b.submit(np.ones((1, 2), np.float32))
+
+
+# ----------------------------------------------------- manifest + store
+
+
+def test_export_writes_verifiable_manifest(export_dir):
+    m = _verify_manifest(export_dir)  # raises on any mismatch
+    assert m is not None
+    assert set(m["files"]) == {
+        "shifu_tpu_model.json", NATIVE_WEIGHTS, "GenericModelConfig.json"
+    }
+    assert m["sha256"] == m["files"][NATIVE_WEIGHTS]["sha256"]
+    # no tmp debris left behind by the atomic publishes
+    assert not [n for n in os.listdir(export_dir) if ".tmp." in n]
+
+
+def test_store_refuses_truncated_weights(export_dir):
+    wpath = os.path.join(export_dir, NATIVE_WEIGHTS)
+    data = open(wpath, "rb").read()
+    open(wpath, "wb").write(data[: len(data) // 2])
+    with pytest.raises(ArtifactCorrupt, match="size"):
+        ModelStore(export_dir, poll_interval_s=0)
+
+
+def test_store_loads_legacy_manifestless_bundle(export_dir):
+    os.unlink(os.path.join(export_dir, NATIVE_MANIFEST))
+    store = ModelStore(export_dir, poll_interval_s=0)
+    try:
+        cur = store.current()
+        assert cur.verified is False and cur.digest == ""
+        assert cur.model.compute_batch(_rows(3)).shape == (3, 1)
+    finally:
+        store.close()
+
+
+def test_store_transient_read_fault_retries_under_policy(export_dir):
+    """A transient injected 503 at the serve.reload seam is absorbed by
+    the retry envelope (utils/retry.py), not escalated to a refusal —
+    while artifact CORRUPTION never retries (a new export cures it, not a
+    re-read)."""
+    from shifu_tensorflow_tpu.utils.retry import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                         max_delay_s=0.002, seed=0)
+    # at-step trigger: fire at the 2nd serve.reload check — the initial
+    # load is check 1 (clean), the reload below is check 2 (faulted) and
+    # its retry is check 3 (clean again)
+    faults.set_plan(faults.FaultPlan.parse("serve.reload:503@2", seed=1))
+    store = ModelStore(export_dir, poll_interval_s=0, retry_policy=policy)
+    try:
+        loaded = store.reload_now()  # hits the 503, retries, succeeds
+        plan = faults.active()
+        assert plan is not None and plan.fired()["serve.reload:503"] == 1
+        assert loaded.epoch == 1 and loaded.verified
+    finally:
+        store.close()
+    # control arm: ArtifactCorrupt must NOT retry (retryable() says no)
+    from shifu_tensorflow_tpu.utils.retry import retryable
+
+    assert not retryable(ArtifactCorrupt("digest differs"))
+
+
+# ------------------------------------------------------------- HTTP layer
+
+
+@pytest.fixture()
+def server(export_dir):
+    cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=64,
+                      max_delay_ms=2.0, max_queue_rows=256,
+                      reload_poll_ms=50)
+    with ScoringServer(cfg) as srv:
+        srv.start()
+        yield srv
+
+
+def _post(port: int, payload: dict, path="/score"):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30.0)
+    try:
+        c.request("POST", path, json.dumps(payload),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _get(port: int, path: str):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30.0)
+    try:
+        c.request("GET", path)
+        r = c.getresponse()
+        return r.status, r.read().decode()
+    finally:
+        c.close()
+
+
+def test_http_scores_match_direct_eval(server, export_dir):
+    x = _rows(7)
+    status, _, body = _post(server.port, {"rows": x.tolist()})
+    assert status == 200
+    with EvalModel(export_dir) as em:
+        want = em.compute_batch(x)[:, 0]
+    np.testing.assert_allclose(body["scores"], want, rtol=1e-4, atol=1e-6)
+    assert body["model_epoch"] == 0
+    # single-row form
+    status, _, body = _post(server.port, {"row": x[0].tolist()})
+    assert status == 200 and len(body["scores"]) == 1
+
+
+def test_http_rejects_malformed_requests(server):
+    for payload, match in [
+        ({"rows": []}, "non-empty"),
+        ({"rows": [[1.0, 2.0]]}, "features"),
+        ({"nope": 1}, "rows"),
+        ({"rows": [["a"] * N_FEATURES]}, "numeric"),
+        ({"rows": [[float("nan")] * N_FEATURES]}, None),
+    ]:
+        status, _, body = _post(server.port, payload)
+        assert status == 400, body
+        if match:
+            assert match in body["error"]
+    c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10.0)
+    try:
+        c.request("POST", "/score", "{not json", {})
+        assert c.getresponse().status == 400
+    finally:
+        c.close()
+    status, _, _ = _post(server.port, {"rows": [[0.0] * N_FEATURES]},
+                         path="/nowhere")
+    assert status == 404
+
+
+def test_oversized_body_refused_before_read(server):
+    """A Content-Length past the derived cap is 413'd BEFORE the body is
+    read — materializing it (bytes → json → numpy) would blow memory
+    long before the row-level admission checks could fire."""
+    limit = server.max_body_bytes()
+    c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10.0)
+    try:
+        c.putrequest("POST", "/score")
+        c.putheader("Content-Length", str(limit + 1))
+        c.endheaders()
+        r = c.getresponse()
+        assert r.status == 413
+        assert b"exceeds" in r.read()
+    finally:
+        c.close()
+
+
+def test_close_without_start_does_not_hang(export_dir):
+    """Construct-then-close (e.g. a with-body raising before start())
+    must not deadlock in httpd.shutdown(), which blocks on an event only
+    serve_forever sets."""
+    cfg = ServeConfig(model_dir=export_dir, port=0, reload_poll_ms=0)
+    done = threading.Event()
+
+    def run():
+        with ScoringServer(cfg):
+            pass  # never started
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(30.0), "close() hung on a never-started server"
+
+
+def test_healthz_and_metrics_expose_model_identity(server):
+    status, body = _get(server.port, "/healthz")
+    health = json.loads(body)
+    assert status == 200 and health["ok"] and health["model_verified"]
+    _post(server.port, {"rows": _rows(3).tolist()})
+    status, text = _get(server.port, "/metrics")
+    assert status == 200
+    assert "stpu_serve_requests_total 1" in text
+    assert "stpu_serve_rows_total 3" in text
+    assert "stpu_serve_batches_total 1" in text
+    assert "stpu_serve_shed_total 0" in text
+    assert 'stpu_serve_model_info{digest="%s"}' % health["model_digest"] \
+        in text
+    assert 'stpu_serve_request_latency_seconds{quantile="0.99"}' in text
+    assert "stpu_serve_queue_rows 0" in text
+
+
+def test_hot_reload_swaps_to_new_artifact(server, export_dir):
+    x = _rows(4)
+    _, _, v1 = _post(server.port, {"rows": x.tolist()})
+    _export(export_dir, seed=7)  # new params land atomically
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        _, _, now = _post(server.port, {"rows": x.tolist()})
+        if now["model_epoch"] == 1:
+            break
+        time.sleep(0.05)
+    assert now["model_epoch"] == 1
+    assert now["model_digest"] != v1["model_digest"]
+    assert now["scores"] != v1["scores"]
+    assert server.metrics.counters()["reloads_total"] == 1
+
+
+def test_chaos_drill_corrupt_reload_never_served(server, export_dir):
+    """The acceptance-criteria drill: STPU_FAULT_PLAN at-rest corruption
+    of a mid-reload artifact — the server keeps serving the previous
+    verified model bit-for-bit, never scores through the corrupt one, and
+    recovers when a good artifact lands."""
+    x = _rows(16, seed=3)
+    _, _, v1 = _post(server.port, {"rows": x.tolist()})
+
+    for kind in ("bitflip", "truncate"):
+        # baseline BEFORE the corrupt artifact lands: the 50 ms poller
+        # may refuse it before this thread gets another word in
+        fails_before = server.metrics.counters()["reload_failures_total"]
+        # the corrupt export: payload mutated AFTER the manifest digest,
+        # exactly how silent at-rest corruption presents
+        faults.set_plan(
+            faults.FaultPlan.parse(f"export.at-rest:{kind}@1", seed=11)
+        )
+        _export(export_dir, seed=99)
+        faults.set_plan(None)
+        # wait for the poller to see (and refuse) the corrupt artifact
+        deadline = time.time() + 10.0
+        while (server.metrics.counters()["reload_failures_total"]
+               == fails_before and time.time() < deadline):
+            time.sleep(0.05)
+        assert server.metrics.counters()["reload_failures_total"] \
+            > fails_before, f"{kind}: corrupt artifact was never refused"
+        # still serving the ORIGINAL verified model, bit-for-bit
+        status, _, mid = _post(server.port, {"rows": x.tolist()})
+        assert status == 200
+        assert mid["scores"] == v1["scores"], f"{kind}: scores drifted"
+        assert mid["model_epoch"] == v1["model_epoch"]
+
+    # recovery: a good artifact lands and is admitted
+    _export(export_dir, seed=99)
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        _, _, now = _post(server.port, {"rows": x.tolist()})
+        if now["model_epoch"] > v1["model_epoch"]:
+            break
+        time.sleep(0.05)
+    assert now["model_epoch"] > v1["model_epoch"]
+    assert now["scores"] != v1["scores"]
+    # the drill proved something: faults actually fired
+    assert server.metrics.counters()["reload_failures_total"] >= 2
+
+
+def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
+    """Backpressure drill: a tiny queue + slow scorer under a flood must
+    shed with 429 + Retry-After while every SERVED request completes in
+    bounded time (the queue can never grow past the admission bound)."""
+    cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=8,
+                      max_delay_ms=1.0, max_queue_rows=16,
+                      retry_after_s=2, reload_poll_ms=0)
+    with ScoringServer(cfg) as srv:
+        # slow the dispatch down so the flood outruns the drain
+        inner = srv._score_once
+
+        def slow(rows):
+            time.sleep(0.02)
+            return inner(rows)
+
+        srv.batcher._score = slow
+        srv.start()
+        results: list[tuple[int, float, dict]] = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            for _ in range(6):
+                t0 = time.monotonic()
+                status, headers, body = _post(
+                    srv.port, {"rows": _rows(4, seed=i).tolist()}
+                )
+                with lock:
+                    results.append(
+                        (status, time.monotonic() - t0, headers)
+                    )
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        served = [r for r in results if r[0] == 200]
+        shed = [r for r in results if r[0] == 429]
+        assert served, "nothing served under overload"
+        assert shed, "overload never shed — queue must be bounded"
+        for _, _, headers in shed:
+            assert headers.get("Retry-After") == "2"
+        # bounded latency for the served fraction: worst case is the full
+        # queue ahead (16 rows / 8 per dispatch) at the slowed dispatch
+        # cost plus jit/HTTP overhead — far under the seconds an
+        # unbounded queue would accumulate
+        assert max(r[1] for r in served) < 5.0
+        assert srv.metrics.counters()["shed_total"] >= len(shed)
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def test_serve_cli_smoke(export_dir, tmp_path):
+    """python -m shifu_tensorflow_tpu.serve: listening line, scoring over
+    HTTP, clean SIGTERM shutdown with the final summary line."""
+    import signal
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shifu_tensorflow_tpu.serve",
+         "--model-dir", export_dir, "--port", "0",
+         "--reload-poll-ms", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        ready = json.loads(line)
+        assert ready["state"] == "listening" and ready["model_verified"]
+        status, _, body = _post(ready["port"],
+                                {"rows": _rows(2).tolist()})
+        assert status == 200 and len(body["scores"]) == 2
+        status, text = _get(ready["port"], "/metrics")
+        assert status == 200 and "stpu_serve_requests_total 1" in text
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=30.0)
+        assert proc.returncode == 0, err.decode()[-2000:]
+        summary = json.loads(out.decode().strip().splitlines()[-1])
+        assert summary["state"] == "stopped"
+        assert summary["requests_total"] == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
