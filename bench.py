@@ -706,6 +706,18 @@ def _attempt(env_overrides: dict, timeout_s: float,
 
 
 def main() -> None:
+    if "obs" in sys.argv[1:]:
+        # observability-overhead benchmark (python bench.py obs):
+        # obs-enabled vs disabled step time on the per-step epoch path,
+        # artifact BENCH_OBS.json — implemented in scripts/bench_obs.py.
+        # In-process on the CPU backend (the quantity under test is
+        # host-side instrumentation cost), so the parent's no-jax rule
+        # does not apply to this mode either.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_obs
+
+        sys.exit(bench_obs.main())
     if "serve" in sys.argv[1:]:
         # serving benchmark (python bench.py serve): micro-batched vs
         # one-row-per-request scoring over HTTP, artifact

@@ -221,6 +221,38 @@ DEFAULT_SERVE_RETRY_AFTER_S = 1
 SERVE_RELOAD_POLL_MS = TPU_PREFIX + "serve-reload-poll"
 DEFAULT_SERVE_RELOAD_POLL_MS = 2000
 
+# ---- observability plane (obs/: registry + trace + journal) ----
+# Off-by-default-cheap: with every key unset the instrumented seams cost
+# one is-None check.  Enabling turns on step-phase span timing
+# (infeed/host/dispatch/block per epoch) and — with a journal path — the
+# append-only JSONL event journal all three planes (train, coordinator,
+# serve) write lifecycle events into.  All knobs resolve through
+# obs/config.resolve_obs_config with the usual CLI-wins precedence and
+# ride the WorkerConfig JSON bridge into subprocess workers.
+OBS_ENABLED = TPU_PREFIX + "obs-enabled"
+DEFAULT_OBS_ENABLED = False
+# journal base path ("" = no journal).  Fleet workers write
+# <path>.w<index> siblings; the obs CLI merges the set.
+OBS_JOURNAL = TPU_PREFIX + "obs-journal"
+DEFAULT_OBS_JOURNAL = ""
+# per-writer rotation: the active file rotates past this size (memory
+# string: "8m", "512k", plain bytes), keeping obs-journal-max-files
+# files — disk footprint is bounded at max-bytes x max-files per writer
+OBS_JOURNAL_MAX_BYTES = TPU_PREFIX + "obs-journal-max-bytes"
+DEFAULT_OBS_JOURNAL_MAX_BYTES = 8 << 20
+OBS_JOURNAL_MAX_FILES = TPU_PREFIX + "obs-journal-max-files"
+DEFAULT_OBS_JOURNAL_MAX_FILES = 4
+# span sampling: measure every Nth event per span name (1 = all).
+# Ratios in the step budget stay unbiased; the (already sub-2%) cost
+# divides by N
+OBS_TRACE_SAMPLE = TPU_PREFIX + "obs-trace-sample"
+DEFAULT_OBS_TRACE_SAMPLE = 1
+# latency-histogram bucket bounds for the registry-backed scrape
+# surfaces, comma-separated seconds ("" = the built-in ~100µs..60s
+# ladder, obs/registry.DEFAULT_BOUNDS)
+OBS_HIST_BUCKETS = TPU_PREFIX + "obs-hist-buckets"
+DEFAULT_OBS_HIST_BUCKETS = ""
+
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib
 # network planes (WebHDFS/GCS clients, coordinator RPC, remote checkpoint

@@ -39,6 +39,9 @@ from typing import Any
 from shifu_tensorflow_tpu.config import keys as K
 from shifu_tensorflow_tpu.coordinator.heartbeat import LivenessMonitor
 from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import trace as obs_trace
+from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
 from shifu_tensorflow_tpu.train.trainer import EpochStats
 from shifu_tensorflow_tpu.utils import faults, logs
 from shifu_tensorflow_tpu.utils import retry as retry_util
@@ -175,8 +178,20 @@ class Coordinator:
             if n is not None
         }
         self.failure_reason: str | None = None
+        # control-plane metrics (obs/registry.py): the coordinator's
+        # scrape surface — rendered through the SAME registry/renderer as
+        # serve's /metrics (the `metrics` RPC op), so fleet dashboards
+        # read one text format everywhere.  Counters pre-registered so
+        # the full set exposes from the first scrape.
+        self.registry = MetricsRegistry()
+        for name in ("registrations_total", "epochs_published_total",
+                     "fleet_restarts_total", "health_trips_total",
+                     "rollbacks_total", "worker_expiries_total",
+                     "worker_failures_total", "op_replays_total"):
+            self.registry.counter(name)
         self.aggregator = EpochAggregator(
-            spec.n_workers, board_path=spec.board_path
+            spec.n_workers, board_path=spec.board_path,
+            on_epoch_complete=self._on_epoch_published,
         )
         # fleet early stopping: decided HERE on full-quorum epoch
         # aggregates, delivered via the epoch barrier so every worker
@@ -252,6 +267,20 @@ class Coordinator:
             self._start_barrier.set()  # release anyone waiting
             self._epoch_cond.notify_all()
             self._plan_cond.notify_all()
+        obs_journal.emit("job_failed", plane="coordinator", reason=reason)
+
+    def _on_epoch_published(self, summary) -> None:
+        """EpochAggregator quorum hook: the fleet-level epoch record."""
+        self.registry.inc("epochs_published_total")
+        obs_journal.emit(
+            "epoch_summary", plane="coordinator",
+            epoch=summary.epoch, n_workers=summary.n_workers,
+            mean_train_loss=summary.mean_training_loss,
+            mean_valid_loss=summary.mean_valid_loss,
+            ks=summary.ks, auc=summary.auc,
+            slowest_worker=summary.slowest_worker,
+            slowest_time_s=round(summary.slowest_time_s, 4),
+        )
 
     @property
     def generation(self) -> int:
@@ -329,6 +358,14 @@ class Coordinator:
                              self._generation)
                     self.liveness.start()
                 self._start_barrier.set()
+            self.registry.inc("registrations_total")
+            obs_journal.emit(
+                "register", plane="coordinator",
+                worker=rec.worker_index, worker_id=worker_id,
+                generation=self._generation,
+                registered=len(self.workers),
+                n_workers=self.spec.n_workers,
+            )
             return {
                 "ok": True,
                 "worker_index": rec.worker_index,
@@ -689,6 +726,9 @@ class Coordinator:
                     self.state = JobState.FINISHED
                     log.info("chief completed cleanly: FINISHED")
                     self._epoch_cond.notify_all()
+                    obs_journal.emit("job_finished", plane="coordinator",
+                                     epochs_published=len(
+                                         self.aggregator.summaries))
             return {"ok": True, "state": self.state.value}
 
     # ---- training-health rollback ----
@@ -760,6 +800,21 @@ class Coordinator:
                 "lr_scale -> %g, skip %s",
                 rec.worker_index, epoch, reason, self._rollbacks,
                 self.spec.health_max_rollbacks, applied_scale, skip,
+            )
+            self.registry.inc("health_trips_total")
+            self.registry.inc("rollbacks_total")
+            obs_journal.emit(
+                "health_trip", plane="coordinator",
+                worker=rec.worker_index, epoch=int(epoch), reason=reason,
+                hung=hung, bad_steps=list(bad_steps or [])[:8],
+            )
+            obs_journal.emit(
+                "rollback", plane="coordinator",
+                worker=rec.worker_index, epoch=int(epoch),
+                rollbacks=self._rollbacks,
+                max_rollbacks=self.spec.health_max_rollbacks,
+                lr_scale=applied_scale, skip=skip,
+                fleet=self.spec.spmd,
             )
             if self._rollbacks > self.spec.health_max_rollbacks:
                 self._fail(
@@ -849,6 +904,10 @@ class Coordinator:
         )
 
     def _on_worker_failed(self, rec: WorkerRecord, why: str) -> None:
+        self.registry.inc("worker_failures_total")
+        obs_journal.emit("worker_failed", plane="coordinator",
+                         worker=rec.worker_index, why=why,
+                         generation=rec.generation)
         if self.spec.spmd:
             if rec.generation < self._generation:
                 # casualty of a generation that already restarted: one
@@ -920,6 +979,13 @@ class Coordinator:
             log.warning("fleet restart -> generation %d (%s); budget %d/%d "
                         "used", self._generation, why,
                         self._failed_restarts, self.max_restarts)
+            self.registry.inc("fleet_restarts_total")
+            obs_journal.emit(
+                "fleet_restart", plane="coordinator",
+                generation=self._generation, why=why,
+                restarts_used=self._failed_restarts,
+                restart_budget=self.max_restarts,
+            )
             self._gen_started_at = time.monotonic()
             self._start_barrier = threading.Event()
             self._plans.clear()
@@ -1028,6 +1094,23 @@ class Coordinator:
                 "last_unhealthy": self._last_unhealthy,
             }
 
+    def metrics_text(self) -> str:
+        """The control plane's scrape body — same registry types and
+        renderer as serve's ``/metrics`` (obs/registry.py), so one
+        dashboard stack reads both.  Gauges pulled at render time, the
+        same convention ServeMetrics follows."""
+        with self._lock:
+            self.registry.set_gauge("workers_registered", len(self.workers))
+            self.registry.set_gauge("workers_expected", self.spec.n_workers)
+            self.registry.set_gauge("generation", self._generation)
+            self.registry.set_gauge("restarts_used", self._failed_restarts)
+            self.registry.set_gauge("restart_budget", self.max_restarts)
+            self.registry.set_gauge("lr_scale", self._lr_scale)
+            self.registry.set_gauge(
+                "state_info", 1, labels='{state="%s"}' % self.state.value
+            )
+        return self.registry.render_prometheus("stpu_coord_")
+
     # ---- TCP plumbing ----
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         """Start the TCP server; returns (host, bound_port)."""
@@ -1063,6 +1146,7 @@ class Coordinator:
                 cached = self._op_cache.get(token)
                 if cached is not None:
                     self.op_replays += 1  # under the lock: handler threads
+                    self.registry.inc("op_replays_total")
             if cached is not None:
                 log.info("replaying cached response for duplicate %s "
                          "delivery (token %s)", msg.get("op"), token)
@@ -1115,6 +1199,8 @@ class Coordinator:
             )
         if op == "status":
             return self.status()
+        if op == "metrics":
+            return {"ok": True, "text": self.metrics_text()}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def shutdown(self) -> None:
@@ -1176,9 +1262,13 @@ class CoordinatorClient:
 
         policy = (self._retry_policy if self._retry_policy is not None
                   else retry_util.default_policy())
-        return retry_util.call(
-            attempt, policy=policy, site=f"rpc.{msg.get('op', '?')}"
-        )
+        # obs span: the WHOLE logical call including server-side barrier
+        # waits — "how long was this worker blocked on the coordinator"
+        # is exactly the per-replica signal SPMD stall diagnosis needs
+        with obs_trace.span(f"rpc.{msg.get('op', '?')}"):
+            return retry_util.call(
+                attempt, policy=policy, site=f"rpc.{msg.get('op', '?')}"
+            )
 
     def register(
         self,
@@ -1263,3 +1353,8 @@ class CoordinatorClient:
 
     def status(self) -> dict[str, Any]:
         return self.call({"op": "status"})
+
+    def metrics(self) -> str:
+        """The coordinator's Prometheus text (the serve-/metrics analogue
+        for the control plane)."""
+        return self.call({"op": "metrics"}).get("text", "")

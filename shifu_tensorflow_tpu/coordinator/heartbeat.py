@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable
 
+from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("liveness")
@@ -82,6 +83,11 @@ class LivenessMonitor:
                 "%.1fs) — liveness flap #%d", worker_id, silence,
                 self.deadline_s, self.flaps,
             )
+            obs_journal.emit(
+                "worker_recovered", plane="coordinator",
+                worker_id=worker_id, silence_s=round(silence, 3),
+                flap=self.flaps,
+            )
             if self.on_recovered:
                 self.on_recovered(worker_id)
 
@@ -100,6 +106,9 @@ class LivenessMonitor:
                     self._expired.add(wid)
                     newly.append(wid)
         for wid in newly:
+            obs_journal.emit("worker_expired", plane="coordinator",
+                             worker_id=wid,
+                             deadline_s=round(self.deadline_s, 3))
             if self.on_expired:
                 self.on_expired(wid)
         return newly

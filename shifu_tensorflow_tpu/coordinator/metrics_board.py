@@ -16,77 +16,22 @@ bucket and an epoch is published exactly once, when its quorum completes.
 
 from __future__ import annotations
 
-import bisect
 import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
+# DEPRECATION NOTE: LatencyHistogram's implementation moved to
+# obs/registry.py — the single metrics-primitive home shared by the
+# serve scrape surface and the coordinator fleet metrics, so no third
+# copy can appear.  Re-exported here (with its bucket ladder) because
+# this module was its public address through PR 3; import from
+# shifu_tensorflow_tpu.obs.registry in new code.
+from shifu_tensorflow_tpu.obs.registry import (  # noqa: F401  (re-export)
+    DEFAULT_BOUNDS as _DEFAULT_BOUNDS,
+    LatencyHistogram,
+)
 from shifu_tensorflow_tpu.train.trainer import EpochStats
 from shifu_tensorflow_tpu.utils import fs
-
-
-#: default latency ladder: ~100µs .. 60s, roughly ×2 per bucket — wide
-#: enough for a jitted dispatch at the bottom and a shed/overload tail at
-#: the top, coarse enough that record() is one bisect + one increment
-_DEFAULT_BOUNDS = (
-    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
-)
-
-
-class LatencyHistogram:
-    """Fixed-bound latency histogram with thread-safe record and quantile
-    estimation — the metrics-plane primitive the serving subsystem's
-    /metrics endpoint reads (serve/metrics.py), kept here with the rest of
-    the metrics plumbing in the EpochAggregator style: one lock, explicit
-    snapshots, no background machinery.
-
-    Quantiles come from the bucket upper bound containing the requested
-    rank — conservative (never under-reports) and O(buckets), which is
-    what a per-request hot path can afford."""
-
-    def __init__(self, bounds: tuple[float, ...] = _DEFAULT_BOUNDS):
-        self._bounds = tuple(bounds)
-        # +1 overflow bucket for observations past the last bound
-        self._counts = [0] * (len(self._bounds) + 1)
-        self._count = 0
-        self._sum = 0.0
-        self._lock = threading.Lock()
-
-    def record(self, seconds: float) -> None:
-        i = bisect.bisect_left(self._bounds, seconds)
-        with self._lock:
-            self._counts[i] += 1
-            self._count += 1
-            self._sum += seconds
-
-    def percentile(self, p: float) -> float:
-        """Upper bound of the bucket holding the p-th percentile (p in
-        [0, 100]); 0.0 when nothing has been recorded."""
-        with self._lock:
-            if self._count == 0:
-                return 0.0
-            rank = max(1, int(round(self._count * p / 100.0)))
-            seen = 0
-            for i, c in enumerate(self._counts):
-                seen += c
-                if seen >= rank:
-                    return (self._bounds[i] if i < len(self._bounds)
-                            else float("inf"))
-        return float("inf")
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "count": self._count,
-                "sum": self._sum,
-                "buckets": {
-                    (str(b) if i < len(self._bounds) else "+Inf"): c
-                    for i, (b, c) in enumerate(
-                        zip(self._bounds + (float("inf"),), self._counts)
-                    )
-                },
-            }
 
 
 @dataclass

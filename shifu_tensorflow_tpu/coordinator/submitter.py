@@ -52,6 +52,7 @@ from shifu_tensorflow_tpu.coordinator.coordinator import (
 )
 from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig, run_worker
 from shifu_tensorflow_tpu.data.splitter import split_training_data, total_line_count
+from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("submitter")
@@ -194,6 +195,11 @@ class JobSubmitter:
         first_launch = self._launch_counts.get(worker_id, 0) == 0
         fail_at = self.fault_injections.get(worker_id) if first_launch else None
         self._launch_counts[worker_id] = self._launch_counts.get(worker_id, 0) + 1
+        obs_journal.emit(
+            "worker_launch", plane="coordinator", worker_id=worker_id,
+            worker=cfg.worker_index, attempt=self._launch_counts[worker_id],
+            launcher=self.launcher,
+        )
         if self.launcher == "process":
             self._launch_process(worker_id, cfg, fail_at)
         elif self.launcher == "ssh":
@@ -315,6 +321,9 @@ class JobSubmitter:
                     pass
         if was_alive:
             proc.kill()
+        if was_alive or remote_killed:
+            obs_journal.emit("worker_kill", plane="coordinator",
+                             worker_id=worker_id)
         return was_alive or remote_killed
 
     def _kill_fleet(self) -> None:

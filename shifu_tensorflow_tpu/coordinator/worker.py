@@ -107,6 +107,11 @@ class WorkerConfig:
     health_spike_factor: float = 0.0
     health_spike_min_epochs: int = 2
     health_hang_timeout_s: float = 0.0
+    # observability plane (shifu.tpu.obs-* keys) as an ObsConfig dict;
+    # None keeps obs off.  Carried in the JSON transport so subprocess
+    # workers inherit the submit-side conf — each worker journals to
+    # <journal_path>.w<index> (one writer per file, obs/journal.py)
+    obs: dict | None = None
 
     def to_json(self) -> dict:
         """JSON transport for subprocess workers (worker_main)."""
@@ -125,6 +130,7 @@ class WorkerConfig:
                 "stream_feature_dtype",
                 "retry", "health_check_finite", "health_spike_factor",
                 "health_spike_min_epochs", "health_hang_timeout_s",
+                "obs",
             )
         }
         d["model_config"] = dict(self.model_config.raw)
@@ -238,6 +244,36 @@ def run_worker(cfg: WorkerConfig, *,
         log.error("registration rejected: %s", reg.get("error"))
         return 1  # never registered; the coordinator doesn't know us
     worker_index = reg["worker_index"]
+    private_tracer = None
+    if cfg.obs:
+        # installed AFTER registration so the journal file carries the
+        # ASSIGNED index (a pinned cfg.worker_index may be None); the
+        # trainer picks the tracer up at construction below
+        from shifu_tensorflow_tpu.obs import ObsConfig, install_obs
+        from shifu_tensorflow_tpu.obs import journal as _obs_journal
+        from shifu_tensorflow_tpu.obs import trace as _obs_trace
+
+        obs_cfg = ObsConfig.from_json(cfg.obs)
+        if _obs_journal.active() is None and _obs_trace.active() is None:
+            # subprocess worker: this process is ours to instrument
+            install_obs(obs_cfg, worker_index=worker_index, plane="train")
+        elif obs_cfg.enabled:
+            # thread launcher: we SHARE the submitter's process, whose
+            # journal/tracer are already installed — replacing them
+            # would misattribute coordinator events and leak the open
+            # journal.  Events flow into the shared journal (explicit
+            # worker/plane fields keep attribution right); the step
+            # phases get a PRIVATE per-worker tracer below so
+            # take_summary() in one worker thread cannot drain
+            # another's epoch.
+            private_tracer = _obs_trace.Tracer(
+                worker_index=worker_index,
+                sample_every=obs_cfg.trace_sample,
+            )
+        _obs_journal.emit("worker_start", plane="train",
+                          worker=worker_index,
+                          worker_id=cfg.worker_id,
+                          generation=int(reg.get("generation", 0)))
     shard_paths = reg["shard"]
     epochs = reg.get("epochs") or cfg.model_config.num_train_epochs
     sync_epochs = bool(reg.get("sync_epochs", False))
@@ -350,6 +386,8 @@ def run_worker(cfg: WorkerConfig, *,
             health=health,
             **extra,
         )
+        if private_tracer is not None:
+            trainer.tracer = private_tracer
         if trainer.health_guard is not None:
             # hang watchdog → coordinated recovery: the wedged training
             # thread cannot raise, so the watchdog thread reports the
@@ -466,6 +504,11 @@ def run_worker(cfg: WorkerConfig, *,
             client.complete(cfg.worker_id, exit_code)
         except Exception:
             pass
+        from shifu_tensorflow_tpu.obs import journal as _obs_journal
+
+        _obs_journal.emit("worker_exit", plane="train",
+                          worker=worker_index,
+                          worker_id=cfg.worker_id, exit_code=exit_code)
     return exit_code
 
 

@@ -1,0 +1,86 @@
+"""Unified observability plane: metrics registry, span tracing, event journal.
+
+The reference system's only window into a running job was YARN container
+logs and heartbeat exit codes — the AM could say *that* a worker died,
+never *why it was slow* (SURVEY.md §5).  By PR 3 this reproduction had
+grown three private telemetry planes (serve counters, the coordinator
+epoch board, ad-hoc trainer log lines) with no shared vocabulary.  This
+package is the one instrumentation layer all three planes share:
+
+- :mod:`~shifu_tensorflow_tpu.obs.registry` — thread-safe counters,
+  gauges, and latency histograms with one Prometheus text renderer.
+  ``serve/metrics.py`` and ``coordinator/metrics_board.py`` are thin
+  wrappers over these types.
+- :mod:`~shifu_tensorflow_tpu.obs.trace` — lightweight span timing for
+  the per-step loop (infeed / host / dispatch / block), checkpoint
+  save/restore, retry sleeps, and coordinator RPCs.  Spans carry the
+  worker index so SPMD replicas compare.
+- :mod:`~shifu_tensorflow_tpu.obs.journal` — append-only JSONL event
+  journal (rotation + size cap, crash-safe line-at-a-time writes) that
+  records structured lifecycle events from train, coordinator, and
+  serve.  ``python -m shifu_tensorflow_tpu.obs tail|summary`` reads it.
+
+Everything is off-by-default-cheap: with no ``shifu.tpu.obs-*`` key set,
+the module-level hooks are a single ``is None`` check per call site
+(measured <2% step-time overhead even fully enabled — BENCH_OBS.json).
+stdlib-only by design: the observability plane must import in every
+process (CLI ``--help`` included) without paying for jax.
+"""
+
+from __future__ import annotations
+
+from shifu_tensorflow_tpu.obs.config import ObsConfig, resolve_obs_config
+from shifu_tensorflow_tpu.obs.registry import (
+    LatencyHistogram,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "ObsConfig",
+    "resolve_obs_config",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "install_obs",
+]
+
+
+def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
+                plane: str = "train"):
+    """Install the process-wide tracer + journal from a resolved
+    :class:`ObsConfig`.  Returns ``(tracer, journal)`` (either may be
+    None).  Subprocess workers pass their ``worker_index`` so their
+    journal lands beside the base path as ``<path>.w<index>`` — one
+    writer per file keeps the line-at-a-time crash-safety contract
+    honest across a fleet (the CLI reader merges the set by timestamp).
+    """
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs import registry as registry_mod
+    from shifu_tensorflow_tpu.obs import trace as trace_mod
+
+    if not cfg.enabled:
+        return None, None
+    if cfg.hist_buckets:
+        # scrape surfaces construct their registries AFTER the CLI
+        # installs obs, so the configured ladder reaches them here
+        registry_mod.set_default_bounds(cfg.hist_buckets)
+    tracer = trace_mod.Tracer(
+        worker_index=worker_index if worker_index is not None else 0,
+        sample_every=cfg.trace_sample,
+    )
+    trace_mod.install(tracer)
+    jrn = None
+    if cfg.journal_path:
+        path = (
+            cfg.journal_path
+            if worker_index is None
+            else f"{cfg.journal_path}.w{worker_index}"
+        )
+        jrn = journal_mod.Journal(
+            path,
+            max_bytes=cfg.journal_max_bytes,
+            max_files=cfg.journal_max_files,
+            plane=plane,
+            worker=worker_index,
+        )
+        journal_mod.install(jrn)
+    return tracer, jrn

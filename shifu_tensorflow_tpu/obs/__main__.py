@@ -1,0 +1,185 @@
+"""Observability CLI — render a journal as a time budget + fleet timeline.
+
+    python -m shifu_tensorflow_tpu.obs summary --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs tail    --journal /tmp/job.jsonl -n 40
+
+Works on a finished or a RUNNING job: readers never lock writers, and a
+torn final line (writer killed mid-event) is skipped, not fatal.  The
+``--journal`` path is the base the job was configured with
+(``shifu.tpu.obs-journal``); fleet-worker siblings (``.w<k>``) and
+rotations (``.N``) are discovered and merged by timestamp.
+
+stdlib-only and jax-free: this must run on an operator's laptop against
+a journal scp'd out of a dead fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+
+from shifu_tensorflow_tpu.obs.journal import journal_files, read_events
+
+#: events that are high-signal fleet lifecycle (the timeline keeps every
+#: event, but these get rendered even under --compact aggregation)
+_STEP_PHASES = ("infeed", "host", "dispatch", "block")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shifu_tensorflow_tpu.obs",
+        description="Inspect a shifu.tpu.obs-journal event journal.",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tail = sub.add_parser("tail", help="print the last N events")
+    tail.add_argument("--journal", required=True,
+                      help="journal base path (shifu.tpu.obs-journal)")
+    tail.add_argument("-n", type=int, default=20, dest="count",
+                      help="events to show (default 20)")
+    summ = sub.add_parser(
+        "summary",
+        help="per-step time budget + fleet event timeline",
+    )
+    summ.add_argument("--journal", required=True,
+                      help="journal base path (shifu.tpu.obs-journal)")
+    summ.add_argument("--timeline-limit", type=int, default=200,
+                      help="max timeline rows (default 200; 0 = all)")
+    return p
+
+
+def _fmt_event(ev: dict, t0: float) -> str:
+    ts = ev.get("ts", t0)
+    plane = ev.get("plane", "?")
+    worker = ev.get("worker")
+    who = f"{plane} w{worker}" if worker is not None else plane
+    skip = {"ts", "event", "plane", "worker"}
+    detail = " ".join(
+        f"{k}={_short(v)}" for k, v in ev.items() if k not in skip
+    )
+    return f"+{ts - t0:10.3f}s  {who:<14} {ev.get('event', '?'):<22} {detail}"
+
+
+def _short(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    s = str(v)
+    return s if len(s) <= 60 else s[:57] + "..."
+
+
+def cmd_tail(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    for ev in events[-args.count:]:
+        print(_fmt_event(ev, t0))
+    return 0
+
+
+def _step_budget(events: list[dict]) -> list[str]:
+    """Aggregate step_breakdown (+ matching epoch) events into one
+    budget row per worker: where each step's wall clock went."""
+    # (worker) -> accumulated phase seconds / steps / epochs
+    acc: dict = defaultdict(lambda: {
+        "epochs": 0, "steps": 0,
+        **{p: 0.0 for p in _STEP_PHASES}, "spans": defaultdict(
+            lambda: {"count": 0, "total_s": 0.0}),
+    })
+    epoch_wall: dict = defaultdict(float)  # worker -> train wall seconds
+    for ev in events:
+        w = ev.get("worker", 0) or 0
+        if ev.get("event") == "step_breakdown":
+            a = acc[w]
+            a["epochs"] += 1
+            a["steps"] += int(ev.get("steps", 0))
+            for p in _STEP_PHASES:
+                a[p] += float(ev.get(f"{p}_s", 0.0))
+            for name, s in (ev.get("spans") or {}).items():
+                a["spans"][name]["count"] += int(s.get("count", 0))
+                a["spans"][name]["total_s"] += float(s.get("total_s", 0.0))
+        elif ev.get("event") == "epoch":
+            epoch_wall[w] += float(ev.get("train_time_s", 0.0))
+    if not acc:
+        return ["  (no step_breakdown events — was the run traced? "
+                "set shifu.tpu.obs-enabled=true / --obs)"]
+    lines = [
+        "  worker  epochs  steps  step_ms   infeed%   host%  dispatch%"
+        "  block%  other%"
+    ]
+    for w in sorted(acc):
+        a = acc[w]
+        phase_total = sum(a[p] for p in _STEP_PHASES)
+        wall = epoch_wall.get(w, 0.0) or phase_total
+        denom = max(wall, phase_total) or 1.0
+        other = max(0.0, denom - phase_total)
+        step_ms = (denom / a["steps"] * 1000.0) if a["steps"] else 0.0
+        pct = {p: 100.0 * a[p] / denom for p in _STEP_PHASES}
+        lines.append(
+            f"  {w:<7} {a['epochs']:<7} {a['steps']:<6} {step_ms:<9.3f}"
+            f" {pct['infeed']:<9.1f} {pct['host']:<6.1f}"
+            f" {pct['dispatch']:<10.1f} {pct['block']:<7.1f}"
+            f" {100.0 * other / denom:.1f}"
+        )
+        span_bits = [
+            f"{name} {s['count']}x {s['total_s']:.3f}s"
+            for name, s in sorted(a["spans"].items())
+        ]
+        if span_bits:
+            lines.append(f"          spans: {', '.join(span_bits)}")
+    return lines
+
+
+def cmd_summary(args) -> int:
+    files = journal_files(args.journal)
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {files or 'none'})", file=sys.stderr)
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    t1 = events[-1].get("ts", t0)
+    counts = defaultdict(int)
+    for ev in events:
+        counts[ev.get("event", "?")] += 1
+    print(f"journal {args.journal}: {len(events)} events in "
+          f"{len(files)} file(s), spanning {t1 - t0:.1f}s")
+    print("  " + ", ".join(
+        f"{name} x{n}" for name, n in sorted(counts.items())))
+    print()
+    print("per-step time budget")
+    for line in _step_budget(events):
+        print(line)
+    print()
+    print("fleet timeline")
+    timeline = [e for e in events if e.get("event") != "step_breakdown"]
+    limit = args.timeline_limit
+    shown = timeline if not limit else timeline[-limit:]
+    if len(shown) < len(timeline):
+        print(f"  ... {len(timeline) - len(shown)} earlier events elided "
+              f"(--timeline-limit {limit})")
+    for ev in shown:
+        print(" " + _fmt_event(ev, t0))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "tail":
+            return cmd_tail(args)
+        return cmd_summary(args)
+    except BrokenPipeError:
+        # `... | head` closes our stdout mid-timeline; that is the
+        # reader's prerogative, not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Observability configuration — the ``shifu.tpu.obs-*`` surface as a
+typed dataclass, resolved with the framework's usual precedence
+(built-in defaults → ``--globalconfig`` XML/JSON layers → CLI flags),
+the same bridge the serve and health keys ride.
+
+Import-light on purpose (stdlib + config.keys only): every CLI resolves
+this on startup, including ``--help`` paths that must not pay for jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+from shifu_tensorflow_tpu.config import keys as K
+
+
+@dataclass(frozen=True)
+class ObsConfig:
+    """Everything the observability plane needs — JSON-bridgeable so a
+    submitter ships it to subprocess workers inside WorkerConfig, the
+    same way the retry envelope travels."""
+
+    enabled: bool = K.DEFAULT_OBS_ENABLED
+    journal_path: str = K.DEFAULT_OBS_JOURNAL
+    journal_max_bytes: int = K.DEFAULT_OBS_JOURNAL_MAX_BYTES
+    journal_max_files: int = K.DEFAULT_OBS_JOURNAL_MAX_FILES
+    trace_sample: int = K.DEFAULT_OBS_TRACE_SAMPLE
+    hist_buckets: tuple[float, ...] = field(default_factory=tuple)
+
+    def __post_init__(self):
+        if self.journal_max_bytes < 4096:
+            raise ValueError(
+                f"{K.OBS_JOURNAL_MAX_BYTES} must be >= 4096 bytes "
+                f"(got {self.journal_max_bytes}): a cap below one event "
+                "batch would rotate on every line"
+            )
+        if self.journal_max_files < 1:
+            raise ValueError(f"{K.OBS_JOURNAL_MAX_FILES} must be >= 1")
+        if self.trace_sample < 1:
+            raise ValueError(f"{K.OBS_TRACE_SAMPLE} must be >= 1")
+        if list(self.hist_buckets) != sorted(self.hist_buckets) or any(
+            b <= 0 for b in self.hist_buckets
+        ):
+            raise ValueError(
+                f"{K.OBS_HIST_BUCKETS} must be positive and ascending, "
+                f"got {self.hist_buckets}"
+            )
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["hist_buckets"] = list(self.hist_buckets)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ObsConfig":
+        d = dict(d)
+        d["hist_buckets"] = tuple(d.get("hist_buckets") or ())
+        return cls(**d)
+
+
+def parse_buckets(value: str) -> tuple[float, ...]:
+    """Comma-separated seconds -> bounds tuple ("" = built-in ladder)."""
+    if not value or not value.strip():
+        return ()
+    return tuple(float(s) for s in value.split(",") if s.strip())
+
+
+def resolve_obs_config(args, conf) -> ObsConfig:
+    """CLI flag wins, then the conf key, then the built-in default.
+
+    ``--obs-journal`` (or a conf journal path) implies ``enabled``: a
+    requested journal that silently recorded nothing because a second
+    flag was missing would be the worst kind of observability bug.
+    ``args`` may be any namespace — absent attributes read as unset, so
+    the serve CLI and the train CLI share this resolver.
+    """
+
+    def flag(name):
+        return getattr(args, name, None)
+
+    journal = flag("obs_journal")
+    if journal is None:
+        journal = conf.get(K.OBS_JOURNAL, K.DEFAULT_OBS_JOURNAL) or ""
+    enabled = flag("obs")
+    if enabled is None:
+        enabled = conf.get_bool(K.OBS_ENABLED, K.DEFAULT_OBS_ENABLED)
+    enabled = bool(enabled) or bool(journal)
+    max_bytes = conf.get_memory(
+        K.OBS_JOURNAL_MAX_BYTES, str(K.DEFAULT_OBS_JOURNAL_MAX_BYTES)
+    )
+    return ObsConfig(
+        enabled=enabled,
+        journal_path=journal,
+        journal_max_bytes=int(max_bytes),
+        journal_max_files=conf.get_int(K.OBS_JOURNAL_MAX_FILES,
+                                       K.DEFAULT_OBS_JOURNAL_MAX_FILES),
+        trace_sample=conf.get_int(K.OBS_TRACE_SAMPLE,
+                                  K.DEFAULT_OBS_TRACE_SAMPLE),
+        hist_buckets=parse_buckets(
+            conf.get(K.OBS_HIST_BUCKETS, K.DEFAULT_OBS_HIST_BUCKETS) or ""
+        ),
+    )

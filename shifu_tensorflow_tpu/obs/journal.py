@@ -1,0 +1,259 @@
+"""Append-only JSONL event journal: the fleet's flight recorder.
+
+One structured line per lifecycle event (register, epoch, health trip,
+rollback, reload, shed, ...), from every plane (train / coordinator /
+serve / checkpoint), into a size-capped rotating file set.  The CLI
+(``python -m shifu_tensorflow_tpu.obs``) reconstructs a per-step time
+budget and a fleet timeline from it — for a finished job or a running
+one (readers never lock writers).
+
+Crash-safety contract: every event is ONE ``write()`` of one complete
+``\\n``-terminated line, flushed immediately.  A process killed
+mid-write can tear at most the final line of one file; readers
+(:func:`iter_events`) skip unparseable lines instead of failing, so a
+journal with a torn tail (or a corrupted middle) still yields every
+intact event.  One writer per file: fleet workers write
+``<path>.w<index>`` siblings (obs.install_obs) rather than interleaving
+into one file — POSIX O_APPEND atomicity is not portable past pipe-buf
+sizes, and rotation across processes is unresolvable races.
+
+Rotation: when a write would push the file past ``max_bytes``, the file
+shifts ``path → path.1 → path.2 → ...`` keeping ``max_files`` files
+total — the journal's disk footprint is bounded at
+``max_bytes * max_files`` per writer no matter how long the job runs.
+
+Journal failures (disk full, permission lost mid-job) degrade to a
+logged warning, never an exception: observability must not take down
+the job it observes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Iterator
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("obs")
+
+__all__ = [
+    "Journal",
+    "install",
+    "uninstall",
+    "active",
+    "emit",
+    "iter_events",
+    "journal_files",
+    "read_events",
+]
+
+
+class Journal:
+    def __init__(
+        self,
+        path: str,
+        *,
+        max_bytes: int = 8 << 20,
+        max_files: int = 4,
+        plane: str | None = None,
+        worker: int | None = None,
+    ):
+        self.path = os.fspath(path)
+        self.max_bytes = max(4096, int(max_bytes))
+        self.max_files = max(1, int(max_files))
+        self.plane = plane
+        self.worker = worker
+        self._lock = threading.Lock()
+        self._file = None
+        self._size = 0
+        self._warned = False
+        #: events dropped because the filesystem failed (diagnostics)
+        self.dropped = 0
+
+    # ---- writing ----
+    def emit(self, event: str, **fields: Any) -> None:
+        rec: dict[str, Any] = {"ts": round(time.time(), 6), "event": event}
+        if self.plane is not None:
+            rec["plane"] = self.plane
+        if self.worker is not None:
+            rec["worker"] = self.worker
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        except (TypeError, ValueError) as e:
+            # an unserializable field must not kill the event, let alone
+            # the job — record what we can plus the failure
+            fallback = {"ts": rec["ts"], "event": event,
+                        "journal_error": f"{type(e).__name__}: {e}"}
+            if self.plane is not None:
+                fallback["plane"] = self.plane
+            if self.worker is not None:
+                fallback["worker"] = self.worker
+            line = json.dumps(fallback) + "\n"
+        data = line.encode("utf-8")
+        with self._lock:
+            try:
+                self._ensure_open(len(data))
+                os.write(self._file, data)
+                self._size += len(data)
+            except OSError as e:
+                self.dropped += 1
+                if not self._warned:
+                    self._warned = True
+                    log.warning("journal write to %s failed (%s); further "
+                                "events will be dropped silently",
+                                self.path, e)
+
+    def _open(self) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._file = os.open(
+            self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+        )
+        self._size = os.fstat(self._file).st_size
+
+    def _ensure_open(self, incoming: int) -> None:
+        """Open (or rotate-and-reopen) the journal file.  Caller holds
+        the lock.  Uses a raw fd: one ``os.write`` per line is the
+        crash-safety unit — buffered layers can tear lines anywhere.
+
+        Rotation failure (e.g. the directory lost write permission while
+        the already-open file stays writable) is tolerated ONCE per
+        attempt, not retried in a loop: the file keeps growing past the
+        cap — the footprint bound degrades, the job does not."""
+        if self._file is None:
+            self._open()
+        if self._size and self._size + incoming > self.max_bytes:
+            os.close(self._file)
+            self._file = None
+            self._rotate()
+            self._open()
+            if self._size and self._size + incoming > self.max_bytes:
+                if not self._warned:
+                    self._warned = True
+                    log.warning(
+                        "journal rotation of %s failed (file still %d "
+                        "bytes past the %d cap); continuing to append — "
+                        "the size bound is degraded, not the job",
+                        self.path, self._size, self.max_bytes,
+                    )
+
+    def _rotate(self) -> None:
+        # shift path.{N-1} -> path.N, ..., path -> path.1; the oldest
+        # file falls off the end (bounded footprint)
+        for i in range(self.max_files - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            dst = f"{self.path}.{i}"
+            try:
+                if os.path.exists(src):
+                    os.replace(src, dst)
+            except OSError:
+                pass
+        if self.max_files == 1:
+            # no room for history: truncate in place
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    os.close(self._file)
+                except OSError:
+                    pass
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---- process-global hook ----
+
+_active: Journal | None = None
+
+
+def install(journal: Journal) -> Journal:
+    global _active
+    _active = journal
+    return journal
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.close()
+    _active = None
+
+
+def active() -> Journal | None:
+    return _active
+
+
+def emit(event: str, **fields: Any) -> None:
+    """Emit into the installed journal; free no-op when none is."""
+    j = _active
+    if j is not None:
+        j.emit(event, **fields)
+
+
+# ---- reading ----
+
+def journal_files(base: str) -> list[str]:
+    """Every file belonging to the journal at ``base``: the file itself,
+    its rotations (``base.N``), fleet-worker siblings (``base.wK``), and
+    their rotations — oldest-first within each writer so a re-sorted
+    merge is stable for equal timestamps."""
+    base = os.fspath(base)
+    pat = re.compile(
+        re.escape(os.path.basename(base)) + r"(\.w\d+)?(\.\d+)?$"
+    )
+    found = [
+        p for p in glob.glob(glob.escape(base) + "*")
+        if pat.fullmatch(os.path.basename(p))
+    ]
+
+    def order(p: str):
+        m = pat.fullmatch(os.path.basename(p))
+        worker = int(m.group(1)[2:]) if m.group(1) else -1
+        rot = int(m.group(2)[1:]) if m.group(2) else 0
+        return (worker, -rot)  # higher rotation number = older
+
+    return sorted(found, key=order)
+
+
+def iter_events(path: str) -> Iterator[dict]:
+    """Parse one journal file, skipping torn/corrupt lines (at minimum
+    the final line of a file whose writer was killed mid-write)."""
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return
+    with f:
+        for raw in f:
+            try:
+                ev = json.loads(raw)
+            except ValueError:
+                continue  # torn tail / corrupted line: skip, keep reading
+            if isinstance(ev, dict) and "event" in ev:
+                yield ev
+
+
+def read_events(base: str) -> list[dict]:
+    """All intact events of the journal (every writer, every rotation),
+    merged oldest-first by timestamp."""
+    events: list[dict] = []
+    for path in journal_files(base):
+        events.extend(iter_events(path))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events

@@ -1,0 +1,183 @@
+"""Thread-safe metrics primitives + the one Prometheus text renderer.
+
+The single implementation behind every scrape surface in the framework:
+``serve/metrics.py`` (the scoring server's ``/metrics``) and the
+coordinator's fleet metrics (``metrics`` RPC op) both compose these
+types, so counters, gauges, and latency summaries render in the same
+Prometheus text exposition format everywhere — no third copy of a
+histogram can appear (the serve and coordinator copies this replaced
+had already started to drift in docstring only; one more subsystem and
+they would have drifted in math).
+
+Design style is the EpochAggregator discipline the originals followed:
+one lock per primitive, explicit snapshots, no background machinery —
+``record()`` on the hot path is one bisect + one increment.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+#: default latency ladder: ~100µs .. 60s, roughly ×2 per bucket — wide
+#: enough for a jitted dispatch at the bottom and a shed/overload tail at
+#: the top, coarse enough that record() is one bisect + one increment.
+#: Overridable per registry via ``shifu.tpu.obs-hist-buckets``.
+DEFAULT_BOUNDS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: process-wide bucket ladder (shifu.tpu.obs-hist-buckets): installed by
+#: obs.install_obs BEFORE the scrape surfaces construct their registries
+#: (both CLIs resolve obs first), so ServeMetrics and the coordinator
+#: pick the configured ladder up without threading it through every
+#: constructor
+_default_bounds: tuple[float, ...] = DEFAULT_BOUNDS
+
+
+def set_default_bounds(bounds: tuple[float, ...] | None) -> None:
+    global _default_bounds
+    _default_bounds = tuple(bounds) if bounds else DEFAULT_BOUNDS
+
+
+def default_bounds() -> tuple[float, ...]:
+    return _default_bounds
+
+
+class LatencyHistogram:
+    """Fixed-bound latency histogram with thread-safe record and quantile
+    estimation.
+
+    Quantiles come from the bucket upper bound containing the requested
+    rank — conservative (never under-reports) and O(buckets), which is
+    what a per-request hot path can afford."""
+
+    def __init__(self, bounds: tuple[float, ...] | None = None):
+        self._bounds = tuple(bounds) if bounds else _default_bounds
+        # +1 overflow bucket for observations past the last bound
+        self._counts = [0] * (len(self._bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        i = bisect.bisect_left(self._bounds, seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += seconds
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket holding the p-th percentile (p in
+        [0, 100]); 0.0 when nothing has been recorded."""
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            rank = max(1, int(round(self._count * p / 100.0)))
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= rank:
+                    return (self._bounds[i] if i < len(self._bounds)
+                            else float("inf"))
+        return float("inf")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "buckets": {
+                    (str(b) if i < len(self._bounds) else "+Inf"): c
+                    for i, (b, c) in enumerate(
+                        zip(self._bounds + (float("inf"),), self._counts)
+                    )
+                },
+            }
+
+
+class MetricsRegistry:
+    """Named counters + gauges + latency histograms with one renderer.
+
+    Counters are pre-registrable (``counter(name)``) so a scrape surface
+    can expose its full set from the first request — a counter that
+    appears only after its first event breaks dashboards.  Gauges are
+    set-at-render-time by convention (they belong to live objects — a
+    queue, a model store — and pulling them at render keeps the registry
+    dependency-free, the same argument serve/metrics.py already made).
+
+    Rendering order is deterministic: counters sorted by name, then
+    gauges and histogram summaries in registration order — so two
+    registries fed the same way render byte-identical text.
+    """
+
+    def __init__(self, bounds: tuple[float, ...] | None = None):
+        self._lock = threading.Lock()
+        self._bounds = tuple(bounds) if bounds else _default_bounds
+        self._counters: dict[str, int] = {}
+        # name -> (labels, value); one label set per gauge name — a
+        # re-set with fresh labels (e.g. model_info after a hot reload)
+        # REPLACES the old series instead of accumulating stale ones
+        self._gauges: dict[str, tuple[str, float]] = {}
+        self._hists: dict[str, LatencyHistogram] = {}
+
+    # ---- counters ----
+    def counter(self, name: str) -> None:
+        """Pre-register ``name`` at 0 so it renders before any event."""
+        with self._lock:
+            self._counters.setdefault(name, 0)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # ---- gauges ----
+    def set_gauge(self, name: str, value: float, labels: str = "") -> None:
+        """``labels`` is a pre-rendered Prometheus label block, e.g.
+        ``'{digest="abc"}'`` — empty for an unlabeled gauge."""
+        with self._lock:
+            self._gauges[name] = (labels, value)
+
+    # ---- histograms ----
+    def histogram(self, name: str,
+                  bounds: tuple[float, ...] | None = None) -> LatencyHistogram:
+        """Create-or-get the histogram registered under ``name``."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = LatencyHistogram(bounds or self._bounds)
+                self._hists[name] = h
+            return h
+
+    # ---- rendering ----
+    def render_prometheus(self, prefix: str) -> str:
+        """The scrape body: every counter (sorted), gauge, and histogram
+        summary under ``prefix`` (e.g. ``"stpu_serve_"``)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+        lines: list[str] = []
+        for name, value in counters:
+            lines.append(f"# TYPE {prefix}{name} counter")
+            lines.append(f"{prefix}{name} {value}")
+        for name, (labels, value) in gauges:
+            lines.append(f"# TYPE {prefix}{name} gauge")
+            lines.append(f"{prefix}{name}{labels} {value}")
+        for name, hist in hists:
+            snap = hist.snapshot()
+            lines.append(f"# TYPE {prefix}{name} summary")
+            for q in (50, 90, 99):
+                lines.append(
+                    '%s%s{quantile="0.%02d"} %g'
+                    % (prefix, name, q, hist.percentile(q))
+                )
+            lines.append(f"{prefix}{name}_count {snap['count']}")
+            lines.append(f"{prefix}{name}_sum {snap['sum']:.6f}")
+        return "\n".join(lines) + "\n"
+

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="reload_poll_ms",
                    help="export-dir poll cadence for hot reload; "
                         "0 disables")
+    p.add_argument("--obs-journal", default=None, dest="obs_journal",
+                   help="observability journal path (shifu.tpu.obs-journal):"
+                        " reload/shed lifecycle events append here; read "
+                        "with `python -m shifu_tensorflow_tpu.obs`")
     return p
 
 
@@ -72,6 +76,13 @@ def main(argv: list[str] | None = None) -> int:
     _retry_util.set_default_policy(_retry_util.policy_from_conf(conf))
     try:
         config = resolve_serve_config(args, conf)
+        # observability plane (shifu.tpu.obs-* / --obs-journal): the serve
+        # process journals reload/shed lifecycle events beside the
+        # training planes' — one fleet timeline across all three
+        from shifu_tensorflow_tpu.obs import install_obs, resolve_obs_config
+
+        obs_cfg = resolve_obs_config(args, conf)
+        install_obs(obs_cfg, plane="serve")
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -101,8 +112,13 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
 
+    from shifu_tensorflow_tpu.obs import journal as _obs_journal
+
     model = server.store.current()
     server.start()
+    _obs_journal.emit("serve_start", plane="serve", port=server.port,
+                      model_epoch=model.epoch,
+                      model_digest=model.digest[:12])
     print(json.dumps({
         "state": "listening",
         "host": config.host,
@@ -118,6 +134,9 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.close()
         counters = server.metrics.counters()
+        _obs_journal.emit("serve_stop", plane="serve",
+                          requests_total=counters.get("requests_total", 0),
+                          shed_total=counters.get("shed_total", 0))
         print(json.dumps({
             "state": "stopped",
             "signal": stopping[0] if stopping else None,

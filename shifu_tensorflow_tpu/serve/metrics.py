@@ -1,20 +1,23 @@
-"""Serving observability: counters + latency histograms + /metrics text.
+"""Serving observability: the scoring server's ``/metrics`` surface.
 
-The metrics-plane primitives live with the rest of the metrics plumbing
-(coordinator/metrics_board.py — ``LatencyHistogram``, EpochAggregator
-style: one lock, explicit snapshots, no background machinery); this
-module composes them into the serving scrape surface.
-
-Rendered in the Prometheus text exposition format because every scrape
-stack speaks it; nothing here depends on a Prometheus client library.
+DEPRECATION NOTE: the metrics primitives that used to live here (and in
+``coordinator/metrics_board.py``) are now
+:mod:`shifu_tensorflow_tpu.obs.registry` — the single implementation
+behind every scrape surface.  ``LatencyHistogram`` is re-exported for
+compatibility; import it from ``obs.registry`` in new code so no third
+copy can appear.  This module keeps only the serve-specific composition:
+which counters exist, which gauges the batcher/store contribute at
+render time, and the ``stpu_serve_`` prefix.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 
-from shifu_tensorflow_tpu.coordinator.metrics_board import LatencyHistogram
+from shifu_tensorflow_tpu.obs.registry import (  # noqa: F401  (re-export)
+    LatencyHistogram,
+    MetricsRegistry,
+)
 
 #: counter names, fixed up front so /metrics always exposes the full set
 #: (a counter that appears only after its first event breaks dashboards)
@@ -31,20 +34,28 @@ _COUNTERS = (
 
 
 class ServeMetrics:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters = {name: 0 for name in _COUNTERS}
-        self.request_latency = LatencyHistogram()
-        self.batch_latency = LatencyHistogram()
+    """Thin wrapper over :class:`obs.registry.MetricsRegistry` carrying
+    the serving plane's counter set and gauge conventions."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        for name in _COUNTERS:
+            self.registry.counter(name)
+        self.request_latency = self.registry.histogram(
+            "request_latency_seconds")
+        self.batch_latency = self.registry.histogram("batch_latency_seconds")
         self.started_at = time.time()
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] += n
+        if name not in _COUNTERS:
+            # the registry auto-creates counters; the serve surface is a
+            # FIXED set, so a typo'd name must fail loudly (the old dict
+            # raised KeyError) instead of silently forking a new series
+            raise KeyError(f"unknown serve counter {name!r}")
+        self.registry.inc(name, n)
 
     def counters(self) -> dict[str, int]:
-        with self._lock:
-            return dict(self._counters)
+        return self.registry.counters()
 
     # ---- rendering ----
     def render_prometheus(
@@ -58,32 +69,11 @@ class ServeMetrics:
         """The /metrics body.  Gauges (queue depth, loaded-model identity)
         come from the caller — they belong to the batcher/store, and
         pulling them at render time keeps this module dependency-free."""
-        lines: list[str] = []
-
-        def counter(name: str, value: float) -> None:
-            lines.append(f"# TYPE stpu_serve_{name} counter")
-            lines.append(f"stpu_serve_{name} {value}")
-
-        def gauge(name: str, value: float, labels: str = "") -> None:
-            lines.append(f"# TYPE stpu_serve_{name} gauge")
-            lines.append(f"stpu_serve_{name}{labels} {value}")
-
-        for name, value in sorted(self.counters().items()):
-            counter(name, value)
-        gauge("queue_rows", queue_rows)
-        gauge("model_epoch", model_epoch)
-        gauge("model_verified", int(model_verified))
-        gauge("model_info", 1, labels='{digest="%s"}' % model_digest)
-        gauge("uptime_seconds", round(time.time() - self.started_at, 3))
-        for hist, name in ((self.request_latency, "request_latency_seconds"),
-                           (self.batch_latency, "batch_latency_seconds")):
-            snap = hist.snapshot()
-            lines.append(f"# TYPE stpu_serve_{name} summary")
-            for q in (50, 90, 99):
-                lines.append(
-                    'stpu_serve_%s{quantile="0.%02d"} %g'
-                    % (name, q, hist.percentile(q))
-                )
-            lines.append(f"stpu_serve_{name}_count {snap['count']}")
-            lines.append(f"stpu_serve_{name}_sum {snap['sum']:.6f}")
-        return "\n".join(lines) + "\n"
+        self.registry.set_gauge("queue_rows", queue_rows)
+        self.registry.set_gauge("model_epoch", model_epoch)
+        self.registry.set_gauge("model_verified", int(model_verified))
+        self.registry.set_gauge("model_info", 1,
+                                labels='{digest="%s"}' % model_digest)
+        self.registry.set_gauge("uptime_seconds",
+                                round(time.time() - self.started_at, 3))
+        return self.registry.render_prometheus("stpu_serve_")

@@ -42,6 +42,7 @@ from shifu_tensorflow_tpu.export.saved_model import (
     NATIVE_MANIFEST,
     NATIVE_WEIGHTS,
 )
+from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.utils import faults, fs, logs
 from shifu_tensorflow_tpu.utils import retry as retry_util
 from shifu_tensorflow_tpu.utils.integrity import check_entry
@@ -276,6 +277,12 @@ class ModelStore:
                 if self.metrics is not None:
                     self.metrics.inc("reload_failures_total")
                 log_fn = log.debug if fp == refused else log.error
+                if fp != refused:
+                    # journal the refusal once per offending artifact —
+                    # the per-poll re-verification stays, but the event
+                    # stream should record state CHANGES, not poll ticks
+                    obs_journal.emit("reload_refused", plane="serve",
+                                     why=str(e))
                 refused = fp
                 log_fn(
                     "refusing new artifact at %s (still serving epoch %d, "
@@ -303,6 +310,9 @@ class ModelStore:
         log.info("hot-reloaded model epoch %d (digest %s, verified=%s)",
                  loaded.epoch, loaded.digest[:12] or "<legacy>",
                  loaded.verified)
+        obs_journal.emit("reload", plane="serve", epoch=loaded.epoch,
+                         digest=loaded.digest[:12],
+                         verified=loaded.verified)
         if old is not None:
             # release AFTER the swap; EvalModel.release takes the compute
             # lock, so an in-flight dispatch on the old model finishes

@@ -83,6 +83,11 @@ class ScoringServer:
         self._serve_thread: threading.Thread | None = None
         self._serving = False
         self._closed = False
+        # journal shed events at most once per window: the journal
+        # records STATE (we are shedding), not per-request ticks — a
+        # sustained overload at thousands of 429s/s would otherwise
+        # rotate the lifecycle events out of the size-capped journal
+        self._last_shed_emit = 0.0
 
     def max_body_bytes(self) -> int:
         """Reject-before-read bound on a /score body: the admission queue
@@ -313,7 +318,25 @@ def _make_handler(server: ScoringServer):
                 server.metrics.inc("errors_total")
                 self._reply_json(400, {"error": str(e)})
             except ShedLoad as e:
-                # shed counter already bumped by the batcher
+                # shed counter already bumped by the batcher.  The
+                # journal gets at most one event per 5s window carrying
+                # the running shed_total — the per-request volume lives
+                # in the counter, the journal records the CONDITION
+                # (benign race on the timestamp: a duplicate event, not
+                # a flood)
+                now = time.monotonic()
+                if now - server._last_shed_emit > 5.0:
+                    server._last_shed_emit = now
+                    from shifu_tensorflow_tpu.obs import (
+                        journal as obs_journal,
+                    )
+
+                    obs_journal.emit(
+                        "shed", plane="serve",
+                        queue_rows=server.batcher.queued_rows(),
+                        shed_total=server.metrics.counters().get(
+                            "shed_total", 0),
+                    )
                 self._reply_json(
                     429,
                     {"error": "overloaded, retry later",

@@ -115,6 +115,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metrics board file (reference console-board parity)")
     p.add_argument("--profile-dir", default=None,
                    help="write jax.profiler traces for the run here")
+    # observability plane (shifu.tpu.obs-*): step-phase tracing + the
+    # fleet event journal; --obs-journal implies --obs
+    p.add_argument("--obs", action="store_true", default=None,
+                   help="enable the observability plane: per-epoch "
+                        "infeed/host/dispatch/block step breakdown and "
+                        "lifecycle spans (<2%% step overhead, "
+                        "BENCH_OBS.json)")
+    p.add_argument("--obs-journal", default=None, dest="obs_journal",
+                   help="event-journal base path (implies --obs); fleet "
+                        "workers write <path>.w<i>; read with "
+                        "`python -m shifu_tensorflow_tpu.obs summary`")
     return p
 
 
@@ -206,6 +217,16 @@ def trainer_extras(args, conf: Conf) -> dict:
     }
 
 
+def resolve_obs(args, conf: Conf):
+    """shifu.tpu.obs-* -> ObsConfig with the usual CLI-wins precedence —
+    ONE resolver for both run paths (and the wiring tests), so a fleet
+    can never trace under a different policy than a single-process run
+    reading the same conf."""
+    from shifu_tensorflow_tpu.obs import resolve_obs_config
+
+    return resolve_obs_config(args, conf)
+
+
 def resolve_health(conf: Conf):
     """shifu.tpu.health-* -> HealthConfig for the single-process run
     paths (run_multi carries the same keys per worker through the
@@ -267,7 +288,16 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         # fleet can never apply a different health policy than a
         # single-process run reading the same conf.
         **_health_worker_kwargs(conf),
+        # observability plane (shifu.tpu.obs-*): subprocess workers
+        # inherit the submit-side config through the JSON bridge and
+        # journal to <path>.w<index> siblings
+        **_obs_worker_kwargs(args, conf),
     }
+
+
+def _obs_worker_kwargs(args, conf: Conf) -> dict:
+    obs_cfg = resolve_obs(args, conf)
+    return {"obs": obs_cfg.to_json() if obs_cfg.enabled else None}
 
 
 def _health_worker_kwargs(conf: Conf) -> dict:
@@ -467,6 +497,11 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
 
     mesh_spec = conf.get(K.MESH_SHAPE, K.DEFAULT_MESH_SHAPE)
     mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
+    # observability plane: installed BEFORE make_trainer so the trainer
+    # picks the tracer up at construction (obs/trace.active())
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    install_obs(resolve_obs(args, conf), plane="train")
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
     extras = trainer_extras(args, conf)
@@ -722,6 +757,13 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             **worker_runtime_kwargs(args, conf),
         )
 
+    # observability plane for the CONTROL side: the coordinator/submitter
+    # journal lifecycle events (register, restarts, rollbacks) to the
+    # base path; workers (launched with the obs dict in their
+    # WorkerConfig) write <path>.w<index> siblings
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    install_obs(resolve_obs(args, conf), plane="coordinator")
     submitter = JobSubmitter(spec, make_cfg, launcher=args.launcher)
     timeout_ms = conf.get_int(K.APPLICATION_TIMEOUT, K.DEFAULT_APPLICATION_TIMEOUT)
     result = submitter.run(

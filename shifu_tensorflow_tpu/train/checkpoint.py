@@ -28,6 +28,8 @@ import jax
 import orbax.checkpoint as ocp
 from flax.core import meta as flax_meta
 
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import faults, fs, logs
 
 log = logs.get("checkpoint")
@@ -288,6 +290,8 @@ class NpzCheckpointer:
         longer matches ``_epochs()`` so every listing/restore path skips
         it from now on."""
         log.error("quarantining checkpoint epoch %d: %s", epoch, why)
+        obs_journal.emit("checkpoint_quarantined", plane="checkpoint",
+                         epoch=epoch, why=why)
         for path in (self._path(epoch), self._manifest_path(epoch)):
             try:
                 if fs.exists(path):
@@ -349,6 +353,16 @@ class NpzCheckpointer:
         self._pending.append(self._executor.submit(self._write, epoch, arrays))
 
     def _write(self, epoch: int, arrays: dict) -> None:
+        # obs span: on the sync path this is the caller-visible save
+        # stall; on the async path it runs (and records) from the writer
+        # thread — the tracer is thread-safe and the span still shows
+        # what the overlapped write cost
+        with obs_trace.span("checkpoint.save"):
+            self._write_inner(epoch, arrays)
+        obs_journal.emit("checkpoint_saved", plane="checkpoint",
+                         epoch=epoch, directory=self.directory)
+
+    def _write_inner(self, epoch: int, arrays: dict) -> None:
         import hashlib
         import io
         import json
@@ -552,7 +566,11 @@ class NpzCheckpointer:
         sync_plan re-agrees without the quarantined generation."""
         self.wait()  # a still-in-flight save of this very epoch must land
         try:
-            return self._restore_tree(epoch, template_state), epoch + 1
+            with obs_trace.span("checkpoint.restore"):
+                state = self._restore_tree(epoch, template_state)
+            obs_journal.emit("checkpoint_restored", plane="checkpoint",
+                             epoch=epoch)
+            return state, epoch + 1
         except _Corrupt as e:
             self._quarantine(epoch, str(e))
             raise CheckpointCorruptError(
@@ -577,7 +595,11 @@ class NpzCheckpointer:
                 failures.append(f"epoch {epoch}: {why}")
                 continue
             try:
-                return self._restore_tree(epoch, template_state), epoch + 1
+                with obs_trace.span("checkpoint.restore"):
+                    state = self._restore_tree(epoch, template_state)
+                obs_journal.emit("checkpoint_restored", plane="checkpoint",
+                                 epoch=epoch)
+                return state, epoch + 1
             except _Corrupt as e:
                 self._quarantine(epoch, str(e))
                 failures.append(f"epoch {epoch}: {e}")
@@ -634,7 +656,13 @@ class Checkpointer:
         return True
 
     def save(self, epoch: int, state) -> None:
-        self._mgr.save(epoch, args=ocp.args.StandardSave(self._tree(state)))
+        # the orbax manager writes asynchronously; this span covers only
+        # the enqueue stall the epoch loop actually pays
+        with obs_trace.span("checkpoint.save"):
+            self._mgr.save(
+                epoch, args=ocp.args.StandardSave(self._tree(state)))
+        obs_journal.emit("checkpoint_saved", plane="checkpoint",
+                         epoch=epoch, directory=self.directory)
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -647,9 +675,13 @@ class Checkpointer:
         latest = self._mgr.latest_step()
         if latest is None:
             return None, 0
-        restored = self._mgr.restore(
-            latest, args=ocp.args.StandardRestore(self._tree(template_state))
-        )
+        with obs_trace.span("checkpoint.restore"):
+            restored = self._mgr.restore(
+                latest,
+                args=ocp.args.StandardRestore(self._tree(template_state))
+            )
+        obs_journal.emit("checkpoint_restored", plane="checkpoint",
+                         epoch=latest)
         # the template decides boxing: a sharded trainer gets its
         # nn.Partitioned annotations back regardless of who wrote the file
         state = template_state.replace(

@@ -47,6 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
 from shifu_tensorflow_tpu.data.dataset import Batch, prefetch_to_device
+from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
 from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
 from shifu_tensorflow_tpu.train.optimizers import make_base_optimizer
@@ -274,6 +275,12 @@ class SAGNTrainer(Trainer):
             # same instrumentation seam as the parent's train_epoch:
             # real-row bookkeeping, rollback skip-window, nan injection
             batches = guard.filter_batches(batches)
+        tracer = self.tracer
+        if tracer is not None:
+            # same step-phase seams as the parent (obs plane): raw batch
+            # production is "step.host", window placement "step.infeed",
+            # one dispatch per SAGN window
+            batches = tracer.wrap_iter("step.host", batches)
 
         def windows():
             buf: list[Batch] = []
@@ -286,17 +293,26 @@ class SAGNTrainer(Trainer):
 
         # overlap host-side window stacking + transfer with device compute,
         # same double-buffering the plain trainer gets from prefetch_to_device
-        for wb in prefetch_to_device(windows(), put=self._put_window,
+        put_window = (tracer.timed("step.infeed", self._put_window)
+                      if tracer is not None else self._put_window)
+        for wb in prefetch_to_device(windows(), put=put_window,
                                      depth=self.prefetch_depth):
-            self.state, loss = self._sagn_step(self.state, wb)
+            with obs_trace.maybe_span(tracer, "step.dispatch"):
+                self.state, loss = self._sagn_step(self.state, wb)
             losses.append(loss)
             weights.append(K)
             n_micro += K
             if guard is not None:
                 guard.tick()
-        # trailing partial window: plain sync steps (window of 1)
+        # trailing partial window: plain sync steps (window of 1); the
+        # placement is timed as step.infeed like the main path, not
+        # swallowed into the dispatch span
+        put = (tracer.timed("step.infeed", self._put)
+               if tracer is not None else self._put)
         for batch in tail:
-            self.state, loss = self._train_step(self.state, self._put(batch))
+            dev = put(batch)
+            with obs_trace.maybe_span(tracer, "step.dispatch"):
+                self.state, loss = self._train_step(self.state, dev)
             losses.append(loss)
             weights.append(1)
             n_micro += 1
@@ -306,7 +322,8 @@ class SAGNTrainer(Trainer):
             return float("nan"), 0
         # microbatch-weighted epoch mean: a K-micro window counts K times;
         # NaN losses mark all-padding windows (skipped by contract)
-        vals = np.asarray(jax.device_get(losses), np.float64)
+        with obs_trace.maybe_span(tracer, "step.block"):
+            vals = np.asarray(jax.device_get(losses), np.float64)
         if guard is not None:
             # per-WINDOW losses: a NaN may be an all-padding window, so
             # only the inf and epoch-mean divergence checks apply

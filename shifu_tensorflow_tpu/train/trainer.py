@@ -39,6 +39,8 @@ from shifu_tensorflow_tpu.data.dataset import (
     prefetch_to_device,
 )
 from shifu_tensorflow_tpu.models.factory import build_model
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops import metrics as M
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
 from shifu_tensorflow_tpu.train.optimizers import make_optimizer
@@ -1004,6 +1006,13 @@ class Trainer:
         self.prefetch_depth = max(1, int(prefetch_depth))
         # opt-in per-step timing (utils/profiling.StepTimer); None = free
         self.step_timer = None
+        # observability span sink (obs/trace.py): picked up from the
+        # process-wide install (obs.install_obs runs before trainer
+        # construction in every CLI path) so the epoch loops report the
+        # infeed/host/dispatch/block step breakdown without a new
+        # make_trainer parameter; None = every instrumented site is one
+        # is-None check
+        self.tracer = obs_trace.active()
         # set by the fit loops when an EarlyStopper ends training early
         self.stop_reason: str | None = None
         # keep-best (conf key shifu.tpu.keep-best, validated at the top
@@ -1091,6 +1100,15 @@ class Trainer:
             # bookkeeping, the rollback skip-window, and the nan-loss
             # injection seam apply to every epoch path identically
             batches = guard.filter_batches(batches)
+        tracer = self.tracer
+        if tracer is not None:
+            # "step.host": producing the next host batch (parse / stack /
+            # filter) — wrapped before path dispatch so every epoch path
+            # shares the phase definition.  Chunk stacking (scan/accum)
+            # and device placement are NOT in here; placement is
+            # "step.infeed" at each path's put, stacking lands in the
+            # budget's "other" slice.
+            batches = tracer.wrap_iter("step.host", batches)
         if self._host_emb is not None:
             return self._train_epoch_host_emb(batches)
         if self._scan_epoch is not None:
@@ -1100,13 +1118,16 @@ class Trainer:
         losses = []
         gnorms = []
         step_fn = self._health_step or self._train_step
-        for batch in prefetch_to_device(batches, put=self._put,
+        put = (tracer.timed("step.infeed", self._put)
+               if tracer is not None else self._put)
+        for batch in prefetch_to_device(batches, put=put,
                                         depth=self.prefetch_depth):
-            if self._health_step is not None:
-                self.state, (loss, gnorm) = step_fn(self.state, batch)
-                gnorms.append(gnorm)
-            else:
-                self.state, loss = step_fn(self.state, batch)
+            with obs_trace.maybe_span(tracer, "step.dispatch"):
+                if self._health_step is not None:
+                    self.state, (loss, gnorm) = step_fn(self.state, batch)
+                    gnorms.append(gnorm)
+                else:
+                    self.state, loss = step_fn(self.state, batch)
             losses.append(loss)
             if guard is not None:
                 guard.tick()
@@ -1114,13 +1135,12 @@ class Trainer:
                 self.step_timer.step(loss, rows=batch["x"].shape[0])
         if not losses:
             return float("nan"), 0
-        vals = np.asarray(jax.device_get(losses))
+        with obs_trace.maybe_span(tracer, "step.block"):
+            vals = np.asarray(jax.device_get(losses))
+            gvals = (np.asarray(jax.device_get(gnorms))
+                     if gnorms else None)
         if guard is not None:
-            guard.note_losses(
-                vals,
-                np.asarray(jax.device_get(gnorms)) if gnorms else None,
-                mode="aligned",
-            )
+            guard.note_losses(vals, gvals, mode="aligned")
         # all-padding batches report NaN by contract (make_train_step);
         # exclude them from the epoch mean instead of biasing it
         real = vals[~np.isnan(vals)]
@@ -1157,13 +1177,20 @@ class Trainer:
         losses = []
         self._emb_ids.clear()
         self._collect_emb_ids = True
+        tracer = self.tracer
+        put = (tracer.timed("step.infeed", self._put)
+               if tracer is not None else self._put)
         try:
-            for batch in prefetch_to_device(batches, put=self._put,
+            for batch in prefetch_to_device(batches, put=put,
                                             depth=1):
-                self.state, loss, g_emb = self._host_emb_step(
-                    self.state, batch)
+                with obs_trace.maybe_span(tracer, "step.dispatch"):
+                    self.state, loss, g_emb = self._host_emb_step(
+                        self.state, batch)
                 ids = self._emb_ids.popleft()
-                g = np.asarray(jax.device_get(g_emb))[: ids.shape[0]]
+                # the per-step gradient fetch is this path's real
+                # completion wait (the table cannot update without it)
+                with obs_trace.maybe_span(tracer, "step.block"):
+                    g = np.asarray(jax.device_get(g_emb))[: ids.shape[0]]
                 self._host_emb.apply_grads(
                     ids, g.reshape(ids.shape[0], len(self._host_emb_pos),
                                    self._host_emb.dim))
@@ -1177,7 +1204,8 @@ class Trainer:
             self._emb_ids.clear()
         if not losses:
             return float("nan"), 0
-        vals = np.asarray(jax.device_get(losses))
+        with obs_trace.maybe_span(tracer, "step.block"):
+            vals = np.asarray(jax.device_get(losses))
         if self.health_guard is not None:
             self.health_guard.note_losses(vals, mode="aligned")
         real = vals[~np.isnan(vals)]
@@ -1275,11 +1303,16 @@ class Trainer:
         chunks, rows_meta, counts = self._stacked_chunks(
             batches, self.scan_steps
         )
+        tracer = self.tracer
+        put = (tracer.timed("step.infeed", self._put_stacked)
+               if tracer is not None else self._put_stacked)
         losses = []  # (K,) device arrays, chunk-pad entries NaN
         for stacked in prefetch_to_device(
-            chunks, put=self._put_stacked, depth=self.prefetch_depth
+            chunks, put=put, depth=self.prefetch_depth
         ):
-            self.state, chunk_losses = self._scan_epoch(self.state, stacked)
+            with obs_trace.maybe_span(tracer, "step.dispatch"):
+                self.state, chunk_losses = self._scan_epoch(
+                    self.state, stacked)
             losses.append(chunk_losses)
             chunk_rows = rows_meta.popleft()
             if self.health_guard is not None:
@@ -1288,9 +1321,11 @@ class Trainer:
                 self.step_timer.step(chunk_losses, rows=chunk_rows)
         if not losses:
             return float("nan"), 0
-        vals = np.concatenate(
-            [np.atleast_1d(np.asarray(v)) for v in jax.device_get(losses)]
-        )
+        with obs_trace.maybe_span(tracer, "step.block"):
+            vals = np.concatenate(
+                [np.atleast_1d(np.asarray(v))
+                 for v in jax.device_get(losses)]
+            )
         if self.health_guard is not None:
             # per-batch losses, but chunking lost the batch order; the
             # guard checks that every real batch produced a finite loss
@@ -1311,11 +1346,15 @@ class Trainer:
         chunks, rows_meta, counts = self._stacked_chunks(
             batches, self.accum_steps
         )
+        tracer = self.tracer
+        put = (tracer.timed("step.infeed", self._put_stacked)
+               if tracer is not None else self._put_stacked)
         losses = []  # scalars, one per update; all-padding groups NaN
         for stacked in prefetch_to_device(
-            chunks, put=self._put_stacked, depth=self.prefetch_depth
+            chunks, put=put, depth=self.prefetch_depth
         ):
-            self.state, loss = self._accum_step(self.state, stacked)
+            with obs_trace.maybe_span(tracer, "step.dispatch"):
+                self.state, loss = self._accum_step(self.state, stacked)
             losses.append(loss)
             chunk_rows = rows_meta.popleft()
             if self.health_guard is not None:
@@ -1324,7 +1363,8 @@ class Trainer:
                 self.step_timer.step(loss, rows=chunk_rows)
         if not losses:
             return float("nan"), 0
-        vals = np.asarray(jax.device_get(losses))
+        with obs_trace.maybe_span(tracer, "step.block"):
+            vals = np.asarray(jax.device_get(losses))
         if self.health_guard is not None:
             # one loss per UPDATE group — a NaN may be a padding group, so
             # only the inf and epoch-mean checks apply here
@@ -1419,6 +1459,43 @@ class Trainer:
                 epoch=stats.current_epoch,
                 bad_steps=g.bad_steps(),
                 diag=g.diagnostics(),
+            )
+
+    def _obs_epoch(self, stats: EpochStats) -> None:
+        """Journal the epoch and its step-phase time budget (obs plane).
+
+        Runs AFTER the health check, so a diverged epoch surfaces in the
+        journal as the coordinator's health_trip/rollback events rather
+        than a clean epoch record.  The step_breakdown event drains the
+        tracer (take_summary), so spans recorded between epochs —
+        checkpoint saves, barrier RPCs, retry sleeps — attribute to the
+        NEXT epoch's breakdown; the budget math only ever compares a
+        breakdown against its own epoch's phases, so the off-by-one on
+        auxiliary spans is cosmetic and documented here once."""
+        j = obs_journal.active()
+        if j is None:
+            return
+        j.emit(
+            "epoch",
+            plane="train",
+            worker=self.worker_index,
+            epoch=stats.current_epoch,
+            train_loss=stats.training_loss,
+            valid_loss=stats.valid_loss,
+            ks=stats.ks,
+            auc=stats.auc,
+            train_time_s=round(stats.training_time_s, 4),
+            valid_time_s=round(stats.valid_time_s, 4),
+            global_step=stats.global_step,
+        )
+        t = self.tracer
+        if t is not None:
+            j.emit(
+                "step_breakdown",
+                plane="train",
+                worker=self.worker_index,
+                epoch=stats.current_epoch,
+                **obs_trace.budget_fields(t.take_summary()),
             )
 
     def _warn_if_validation_empty(self, stats: EpochStats,
@@ -1646,6 +1723,7 @@ class Trainer:
                 auc=ev["auc"],
             )
             self._health_check_epoch(stats)
+            self._obs_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
@@ -1748,10 +1826,16 @@ class Trainer:
         for epoch in range(start_epoch, epochs):
             self._health_begin_epoch(epoch)
             t0 = time.time()
-            self.state, losses = epoch_fn(
-                self.state, train_dev, jax.random.fold_in(base_key, epoch)
-            )
-            vals = np.asarray(jax.device_get(losses))
+            # one compiled dispatch IS the epoch on this path: the step
+            # budget degenerates to dispatch + block (no per-step
+            # host/infeed phases exist to measure)
+            with obs_trace.maybe_span(self.tracer, "step.dispatch"):
+                self.state, losses = epoch_fn(
+                    self.state, train_dev,
+                    jax.random.fold_in(base_key, epoch)
+                )
+            with obs_trace.maybe_span(self.tracer, "step.block"):
+                vals = np.asarray(jax.device_get(losses))
             real = vals[~np.isnan(vals)]
             train_loss = float(np.mean(real)) if real.size else float("nan")
             train_time = time.time() - t0
@@ -1798,6 +1882,7 @@ class Trainer:
                         "epoch mean loss non-finite"
                     )
             self._health_check_epoch(stats)
+            self._obs_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)
@@ -1910,6 +1995,7 @@ class Trainer:
                 auc=ev["auc"],
             )
             self._health_check_epoch(stats)
+            self._obs_epoch(stats)
             self._warn_if_validation_empty(stats, early_stop)
             self._maybe_snapshot_best(stats, checkpointer)
             history.append(stats)

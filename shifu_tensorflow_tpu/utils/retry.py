@@ -41,6 +41,7 @@ from collections import Counter
 from dataclasses import dataclass, replace
 from typing import Any, Callable
 
+from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("retry")
@@ -234,6 +235,11 @@ def call(
                 site, attempt + 1, pol.max_attempts, delay,
                 type(e).__name__, e,
             )
+            # obs span: the stall the retry layer itself adds.  Recorded
+            # under ONE name so the per-epoch step budget shows "how
+            # long did backoff cost this epoch" at a glance; the
+            # per-site split already lives in counters()
+            obs_trace.record("retry.sleep", delay)
             sleep(delay)
 
 

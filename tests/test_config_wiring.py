@@ -463,3 +463,80 @@ def test_health_keys_drive_worker_and_spec_fields():
     d = resolve_health(_conf({}))
     assert d.check_finite is True and d.spike_factor == 0.0
     assert d.hang_timeout_s == 0.0
+
+
+def test_obs_keys_round_trip_xml_to_dataclass(tmp_path):
+    """Every shifu.tpu.obs-* key must survive the full resolution chain:
+    Hadoop-XML resource → layered Conf merge → CLI override → ObsConfig →
+    JSON bridge (the WorkerConfig transport) — the same contract the
+    serve and health keys are held to."""
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "obs.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.OBS_JOURNAL: "/tmp/job.jsonl",
+        K.OBS_JOURNAL_MAX_BYTES: "2m",
+        K.OBS_JOURNAL_MAX_FILES: "6",
+        K.OBS_TRACE_SAMPLE: "5",
+        K.OBS_HIST_BUCKETS: "0.001,0.01,0.1,1.0",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.enabled is True
+    assert cfg.journal_path == "/tmp/job.jsonl"
+    assert cfg.journal_max_bytes == 2 << 20
+    assert cfg.journal_max_files == 6
+    assert cfg.trace_sample == 5
+    assert cfg.hist_buckets == (0.001, 0.01, 0.1, 1.0)
+    # JSON bridge round-trips (subprocess workers receive this dict)
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # CLI flags win over the XML layer
+    cfg = resolve_obs(
+        _args(["--obs-journal", "/tmp/other.jsonl"]), conf
+    )
+    assert cfg.journal_path == "/tmp/other.jsonl"
+
+
+def test_obs_defaults_are_off_and_cli_flags_imply_enabled():
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    cfg = resolve_obs(_args(), _conf({}))
+    assert cfg.enabled is False and cfg.journal_path == ""
+    # --obs enables tracing without a journal
+    assert resolve_obs(_args(["--obs"]), _conf({})).enabled is True
+    # --obs-journal implies enabled (a requested journal that silently
+    # recorded nothing would be the worst observability bug)
+    cfg = resolve_obs(_args(["--obs-journal", "/tmp/x.jsonl"]), _conf({}))
+    assert cfg.enabled is True and cfg.journal_path == "/tmp/x.jsonl"
+    # a conf journal path alone also enables
+    assert resolve_obs(_args(),
+                       _conf({K.OBS_JOURNAL: "/tmp/y.jsonl"})).enabled
+
+
+def test_obs_keys_reach_worker_config_bridge():
+    """run_multi ships the resolved ObsConfig to subprocess workers via
+    WorkerConfig.obs (JSON bridge) — and omits it entirely when obs is
+    off, so the off path stays a None check."""
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import worker_runtime_kwargs
+
+    kw = worker_runtime_kwargs(
+        _args(), _conf({K.OBS_JOURNAL: "/tmp/fleet.jsonl"})
+    )
+    assert kw["obs"]["journal_path"] == "/tmp/fleet.jsonl"
+    assert ObsConfig.from_json(kw["obs"]).enabled is True
+    assert worker_runtime_kwargs(_args(), _conf({}))["obs"] is None
+    # and the field survives the WorkerConfig JSON transport
+    import dataclasses
+    fields = {f.name for f in dataclasses.fields(WorkerConfig)}
+    assert "obs" in fields

@@ -1,0 +1,516 @@
+"""Observability plane: registry thread-safety, journal rotation and
+corrupt-tail recovery, span tracing, trainer/coordinator integration,
+and the obs CLI.
+
+Every test that installs a process-global tracer/journal uninstalls it
+(the obs hooks are module state the rest of the suite must not see).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.obs import journal as journal_mod
+from shifu_tensorflow_tpu.obs import trace as trace_mod
+from shifu_tensorflow_tpu.obs.config import ObsConfig
+from shifu_tensorflow_tpu.obs.journal import (
+    Journal,
+    journal_files,
+    read_events,
+)
+from shifu_tensorflow_tpu.obs.registry import LatencyHistogram, MetricsRegistry
+from shifu_tensorflow_tpu.obs.trace import Tracer, budget_fields
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_hooks():
+    yield
+    trace_mod.uninstall()
+    journal_mod.uninstall()
+
+
+# ---- registry ----
+
+def test_registry_prereg_counters_render_at_zero():
+    r = MetricsRegistry()
+    r.counter("requests_total")
+    text = r.render_prometheus("t_")
+    assert "# TYPE t_requests_total counter" in text
+    assert "t_requests_total 0" in text
+
+
+def test_registry_thread_safety_under_concurrent_writers():
+    """8 writer threads hammering one registry: counter totals must be
+    exact (no lost increments), histogram count must equal records."""
+    r = MetricsRegistry()
+    hist = r.histogram("lat")
+    N, T = 2000, 8
+
+    def writer(i):
+        for k in range(N):
+            r.inc("ops_total")
+            r.set_gauge("last_writer", i)
+            hist.record(0.001 * (k % 7))
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.counters()["ops_total"] == N * T
+    assert hist.snapshot()["count"] == N * T
+    # render must not crash mid-write either (smoke: it parses as text)
+    assert "ops_total" in r.render_prometheus("x_")
+
+
+def test_serve_metrics_format_unchanged_over_registry():
+    """The /metrics body through the shared registry must keep the exact
+    serve exposition format (the CI smoke greps these lines verbatim)."""
+    from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.inc("requests_total")
+    m.inc("rows_total", 2)
+    m.request_latency.record(0.004)
+    text = m.render_prometheus(
+        queue_rows=3, model_epoch=7, model_digest="abc123", model_verified=True
+    )
+    lines = text.splitlines()
+    assert "stpu_serve_requests_total 1" in lines
+    assert "stpu_serve_rows_total 2" in lines
+    assert "# TYPE stpu_serve_queue_rows gauge" in lines
+    assert "stpu_serve_queue_rows 3" in lines
+    assert 'stpu_serve_model_info{digest="abc123"} 1' in lines
+    assert any(
+        l.startswith('stpu_serve_request_latency_seconds{quantile="0.99"}')
+        for l in lines
+    )
+    assert any(l.startswith("stpu_serve_request_latency_seconds_count 1")
+               for l in lines)
+    # the full counter set renders even before any event (dashboards)
+    assert "stpu_serve_shed_total 0" in lines
+
+
+def test_latency_histogram_reexports_are_the_same_type():
+    """Satellite: serve/metrics and coordinator/metrics_board are
+    re-exports of the obs registry types — no third copy can appear."""
+    from shifu_tensorflow_tpu.coordinator import metrics_board
+    from shifu_tensorflow_tpu.serve import metrics as serve_metrics
+
+    assert serve_metrics.LatencyHistogram is LatencyHistogram
+    assert metrics_board.LatencyHistogram is LatencyHistogram
+
+
+def test_coordinator_metrics_render_through_registry():
+    from types import SimpleNamespace
+
+    from shifu_tensorflow_tpu.coordinator.coordinator import (
+        Coordinator,
+        JobSpec,
+    )
+
+    spec = JobSpec(n_workers=1, shards=[SimpleNamespace(paths=("s0",))])
+    coord = Coordinator(spec)
+    try:
+        assert coord.register("w0", 0)["ok"]
+        text = coord.metrics_text()
+    finally:
+        coord.shutdown()
+    assert "stpu_coord_registrations_total 1" in text
+    assert "stpu_coord_workers_registered 1" in text
+    assert 'stpu_coord_state_info{state="training"} 1' in text
+    # the dispatch surface exposes it too (the serve-/metrics analogue)
+    resp = coord.dispatch({"op": "metrics"})
+    assert resp["ok"] and "stpu_coord_registrations_total" in resp["text"]
+
+
+# ---- journal ----
+
+def test_journal_emit_read_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, plane="train", worker=3) as j:
+        j.emit("epoch", epoch=0, loss=0.5)
+        j.emit("epoch", epoch=1, loss=0.25, worker=9)  # explicit wins
+    events = read_events(path)
+    assert [e["event"] for e in events] == ["epoch", "epoch"]
+    assert events[0]["plane"] == "train" and events[0]["worker"] == 3
+    assert events[1]["worker"] == 9
+    assert events[0]["ts"] <= events[1]["ts"]
+
+
+def test_journal_rotation_bounds_footprint(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, max_bytes=4096, max_files=3) as j:
+        for i in range(2000):
+            j.emit("tick", i=i, pad="x" * 40)
+    files = journal_files(path)
+    assert 1 < len(files) <= 3
+    for f in files:
+        # one event of slack past the cap, never unbounded growth
+        assert os.path.getsize(f) <= 4096 + 200
+    events = read_events(path)
+    assert events, "rotation must not lose the active file"
+    # the newest event always survives rotation
+    assert events[-1]["i"] == 1999
+
+
+def test_journal_corrupt_tail_and_middle_recovery(tmp_path):
+    """A writer killed mid-write tears the final line; at-rest corruption
+    can garble a middle line.  Readers skip both, keep every intact
+    event, and never raise."""
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path) as j:
+        for i in range(5):
+            j.emit("tick", i=i)
+    raw = open(path, "rb").read().splitlines(keepends=True)
+    raw[2] = b"\x00\xff garbage not json \xfe\n"  # corrupted middle
+    raw.append(b'{"ts": 1.0, "event": "torn", "i"')  # torn tail, no \n
+    open(path, "wb").write(b"".join(raw))
+    events = read_events(path)
+    assert [e["i"] for e in events] == [0, 1, 3, 4]
+
+
+def test_journal_merges_worker_siblings_and_rotations(tmp_path):
+    base = str(tmp_path / "job.jsonl")
+    with Journal(base, plane="coordinator") as j:
+        j.emit("register", worker=0)
+    for w in (0, 1):
+        with Journal(f"{base}.w{w}", max_bytes=4096, max_files=2,
+                     plane="train", worker=w) as jw:
+            for i in range(200):
+                jw.emit("epoch", epoch=i, pad="y" * 30)
+    files = journal_files(base)
+    assert any(f.endswith(".w0") for f in files)
+    assert any(".w0.1" in f for f in files), "rotations must be discovered"
+    # an unrelated sibling must NOT be swept in
+    open(str(tmp_path / "job.jsonl.bak"), "w").write('{"event": "no"}\n')
+    assert not any(f.endswith(".bak") for f in journal_files(base))
+    events = read_events(base)
+    assert {e["event"] for e in events} == {"register", "epoch"}
+    assert events == sorted(events, key=lambda e: e["ts"])
+
+
+def test_journal_install_emit_is_noop_without_install():
+    journal_mod.uninstall()
+    journal_mod.emit("nobody-listening", x=1)  # must not raise
+
+
+def test_journal_write_failure_degrades_not_raises(tmp_path):
+    j = Journal(str(tmp_path / "j.jsonl"))
+    j.emit("ok")
+    # simulate the disk going away mid-job: further emits drop, not raise
+    os.close(j._file)
+    j._file = -1
+    j.emit("dropped")
+    assert j.dropped == 1
+    j._file = None  # avoid double-close on cleanup
+    j.close()
+
+
+# ---- tracer ----
+
+def test_tracer_spans_and_budget_fields():
+    t = Tracer(worker_index=2)
+    with t.span("step.dispatch"):
+        pass
+    with t.span("step.dispatch"):
+        pass
+    t.add("step.infeed", 0.25)
+    t.add("checkpoint.save", 1.5)
+    fields = budget_fields(t.take_summary())
+    assert fields["steps"] == 2
+    assert fields["infeed_s"] == 0.25
+    assert fields["host_s"] == 0.0
+    assert fields["spans"]["checkpoint.save"]["count"] == 1
+    # take_summary drained the tracer
+    assert t.summary() == {}
+
+
+def test_tracer_sampling_measures_every_nth():
+    # sampling applies to the hot-path step.* phases only
+    t = Tracer(sample_every=4)
+    f = t.timed("step.host", lambda: None)
+    for _ in range(8):
+        f()
+    s = t.summary()["step.host"]
+    assert s["count"] == 2 and s["sampled_every"] == 4
+
+
+def test_maybe_span_is_noop_without_tracer():
+    with trace_mod.maybe_span(None, "x"):
+        pass
+    trace_mod.record("x", 1.0)  # no tracer installed: no-op
+
+
+def test_retry_sleep_records_span():
+    from shifu_tensorflow_tpu.utils import retry as retry_util
+
+    t = trace_mod.install(Tracer())
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("nope")
+        return "ok"
+
+    pol = retry_util.RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                                 max_delay_s=0.002, seed=7)
+    assert retry_util.call(flaky, policy=pol, site="test.seam") == "ok"
+    spans = t.summary()
+    assert spans["retry.sleep"]["count"] == 2
+
+
+def test_checkpoint_save_restore_spans_and_events(tmp_path):
+    import jax.numpy as jnp
+
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train import make_trainer
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+    t = trace_mod.install(Tracer())
+    j = journal_mod.install(Journal(str(tmp_path / "j.jsonl")))
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(0, 1))
+    with NpzCheckpointer(str(tmp_path / "ckpt")) as ckpt:
+        ckpt.save(0, trainer.state)
+        restored, nxt = ckpt.restore_latest(trainer.state)
+    assert nxt == 1
+    spans = t.summary()
+    assert spans["checkpoint.save"]["count"] == 1
+    assert spans["checkpoint.restore"]["count"] == 1
+    events = [e["event"] for e in read_events(str(tmp_path / "j.jsonl"))]
+    assert "checkpoint_saved" in events and "checkpoint_restored" in events
+
+
+# ---- trainer integration ----
+
+def _tiny_dataset(tmp_path):
+    from shifu_tensorflow_tpu.data.dataset import InMemoryDataset
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "data.psv"
+    with open(path, "w") as f:
+        for _ in range(120):
+            x = rng.normal(size=2)
+            y = int(x[0] + 0.5 * x[1] > 0)
+            f.write(f"{y}|{x[0]:.4f}|{x[1]:.4f}\n")
+    schema = RecordSchema(feature_columns=(1, 2), target_column=0)
+    return InMemoryDataset.load([str(path)], schema, valid_rate=0.2), schema
+
+
+@pytest.mark.parametrize("scan_steps", [1, 4])
+def test_trainer_journals_epoch_and_step_breakdown(tmp_path, scan_steps):
+    """The acceptance loop in miniature: a traced fit emits one epoch +
+    one step_breakdown event per epoch, and the breakdown's phases are
+    populated (dispatch counted per device call)."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    trace_mod.install(Tracer())
+    journal_mod.install(Journal(str(tmp_path / "j.jsonl"), plane="train"))
+    dataset, schema = _tiny_dataset(tmp_path)
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(1, 2),
+                           scan_steps=scan_steps)
+    assert trainer.tracer is trace_mod.active()
+    trainer.fit(dataset, epochs=2, batch_size=32)
+    events = read_events(str(tmp_path / "j.jsonl"))
+    epochs = [e for e in events if e["event"] == "epoch"]
+    breakdowns = [e for e in events if e["event"] == "step_breakdown"]
+    assert len(epochs) == 2 and len(breakdowns) == 2
+    for b in breakdowns:
+        assert b["steps"] > 0
+        assert b["dispatch_s"] > 0.0
+        assert b["infeed_s"] > 0.0
+    assert epochs[0]["global_step"] > 0
+
+
+def test_trainer_untraced_emits_nothing_and_has_no_tracer(tmp_path):
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    dataset, _ = _tiny_dataset(tmp_path)
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    trainer = make_trainer(mc, 2, feature_columns=(1, 2))
+    assert trainer.tracer is None
+    trainer.fit(dataset, epochs=1, batch_size=32)  # must not journal/crash
+
+
+# ---- CLI ----
+
+def _seed_cli_journal(tmp_path) -> str:
+    base = str(tmp_path / "job.jsonl")
+    with Journal(base, plane="coordinator") as j:
+        j.emit("register", worker=0, worker_id="w-0", generation=0)
+        j.emit("rollback", worker=0, epoch=1, rollbacks=1, lr_scale=0.5)
+    with Journal(f"{base}.w0", plane="train", worker=0) as jw:
+        jw.emit("epoch", epoch=0, train_loss=0.4, train_time_s=2.0)
+        jw.emit("step_breakdown", epoch=0, steps=10, infeed_s=0.2,
+                host_s=0.3, dispatch_s=1.2, block_s=0.1,
+                spans={"rpc.epoch": {"count": 1, "total_s": 0.05}})
+    return base
+
+
+def test_obs_cli_summary_renders_budget_and_timeline(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_cli_journal(tmp_path)
+    assert obs_main(["summary", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "per-step time budget" in out
+    assert "fleet timeline" in out
+    assert "register" in out and "rollback" in out
+    # the budget row: 1.2s dispatch of a 2.0s epoch wall = 60%
+    assert "60.0" in out
+    assert "rpc.epoch 1x 0.050s" in out
+
+
+def test_obs_cli_tail_shows_last_events(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_cli_journal(tmp_path)
+    assert obs_main(["tail", "--journal", base, "-n", "2"]) == 0
+    out = capsys.readouterr().out
+    assert len(out.strip().splitlines()) == 2
+
+
+def test_obs_cli_missing_journal_fails_cleanly(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    assert obs_main(["summary", "--journal",
+                     str(tmp_path / "nope.jsonl")]) == 1
+    assert "no journal events" in capsys.readouterr().err
+
+
+# ---- ObsConfig ----
+
+def test_obs_config_json_bridge_roundtrip():
+    cfg = ObsConfig(enabled=True, journal_path="/tmp/j.jsonl",
+                    journal_max_bytes=1 << 20, journal_max_files=2,
+                    trace_sample=3, hist_buckets=(0.001, 0.01, 0.1))
+    assert ObsConfig.from_json(json.loads(json.dumps(cfg.to_json()))) == cfg
+
+
+def test_obs_config_rejects_misconfiguration():
+    with pytest.raises(ValueError, match="obs-trace-sample"):
+        ObsConfig(trace_sample=0)
+    with pytest.raises(ValueError, match="obs-journal-max-files"):
+        ObsConfig(journal_max_files=0)
+    with pytest.raises(ValueError, match="obs-hist-buckets"):
+        ObsConfig(hist_buckets=(0.1, 0.01))
+    with pytest.raises(ValueError, match="obs-journal-max-bytes"):
+        ObsConfig(journal_max_bytes=100)
+
+
+def test_install_obs_wires_worker_sibling_paths(tmp_path):
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    cfg = ObsConfig(enabled=True, journal_path=str(tmp_path / "j.jsonl"))
+    tracer, j = install_obs(cfg, worker_index=2, plane="train")
+    assert tracer is trace_mod.active() and tracer.worker_index == 2
+    assert j.path.endswith(".w2") and j.worker == 2
+    journal_mod.emit("hello")
+    journal_mod.uninstall()
+    assert read_events(str(tmp_path / "j.jsonl"))[0]["worker"] == 2
+    # disabled config installs nothing
+    assert install_obs(ObsConfig()) == (None, None)
+
+
+# ---- review-fix regressions ----
+
+def test_budget_fields_scales_sampled_step_phases():
+    """trace-sample=N measures 1/N of step events; the journal must carry
+    unbiased ABSOLUTE estimates or the CLI budget overstates step_ms by N."""
+    t = Tracer(sample_every=4)
+    f = t.timed("step.infeed", lambda: None)
+    for _ in range(8):
+        f()
+        with t.span("step.dispatch"):
+            pass
+    t.add("retry.sleep", 0.5)  # aux spans are never sampled
+    fields = budget_fields(t.take_summary())
+    assert fields["steps"] == 8  # 2 measured x 4
+    assert fields["trace_sample"] == 4
+    assert fields["spans"]["retry.sleep"]["count"] == 1
+
+
+def test_aux_spans_are_never_sampled():
+    t = Tracer(sample_every=10)
+    for _ in range(3):
+        with t.span("checkpoint.save"):
+            pass
+    assert t.summary()["checkpoint.save"]["count"] == 3
+
+
+def test_journal_survives_persistent_rotation_failure(tmp_path):
+    """Rotation failing forever (dir lost write permission) must degrade
+    to append-past-the-cap, not recurse to a crash."""
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, max_bytes=4096, max_files=3)
+    j._rotate = lambda: None  # every rotation attempt silently fails
+    for i in range(500):
+        j.emit("tick", i=i, pad="x" * 40)
+    j.close()
+    events = read_events(path)
+    assert events[-1]["i"] == 499  # nothing lost, nothing raised
+    assert os.path.getsize(path) > 4096  # bound degraded, job alive
+
+
+def test_hist_buckets_reach_scrape_registries(tmp_path):
+    """shifu.tpu.obs-hist-buckets must actually drive the histograms the
+    scrape surfaces build (it was once resolved-but-dead)."""
+    from shifu_tensorflow_tpu.obs import install_obs
+    from shifu_tensorflow_tpu.obs import registry as registry_mod
+    from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+
+    try:
+        install_obs(ObsConfig(enabled=True, hist_buckets=(0.5, 1.0)))
+        m = ServeMetrics()
+        m.request_latency.record(0.7)
+        assert m.request_latency.percentile(99) == 1.0  # custom ladder
+        snap = m.request_latency.snapshot()
+        assert set(snap["buckets"]) == {"0.5", "1.0", "+Inf"}
+    finally:
+        registry_mod.set_default_bounds(None)
+
+
+def test_run_worker_does_not_clobber_shared_process_obs(tmp_path):
+    """Thread-launcher seam: a worker sharing the submitter's process must
+    NOT replace the installed journal/tracer (coordinator events would be
+    misattributed and the journal fd leaked) — it gets a private tracer
+    and emits into the shared journal with explicit plane/worker."""
+    from shifu_tensorflow_tpu.obs import journal as jm
+    from shifu_tensorflow_tpu.obs import trace as tm
+
+    base = str(tmp_path / "job.jsonl")
+    shared_j = jm.install(Journal(base, plane="coordinator"))
+    shared_t = tm.install(Tracer(worker_index=0))
+    # simulate the run_worker install-guard branch
+    from shifu_tensorflow_tpu.obs.config import ObsConfig as OC
+
+    cfg = OC(enabled=True, journal_path=base)
+    assert jm.active() is shared_j and tm.active() is shared_t
+    # the guard condition run_worker checks:
+    assert not (jm.active() is None and tm.active() is None)
+    jm.emit("epoch", plane="train", worker=1)
+    jm.uninstall()
+    tm.uninstall()
+    ev = read_events(base)[0]
+    assert ev["plane"] == "train" and ev["worker"] == 1
