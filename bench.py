@@ -718,6 +718,18 @@ def main() -> None:
         import bench_obs
 
         sys.exit(bench_obs.main())
+    if "serve-scale" in sys.argv[1:]:
+        # serve-plane scale benchmark (python bench.py serve-scale):
+        # bucket-ladder warm-up latency cliffs (cold start + hot-reload
+        # admits, warm vs --no-warm) and SO_REUSEPORT --serve-workers
+        # throughput scaling, artifact BENCH_SERVE_SCALE.json —
+        # implemented in scripts/bench_serve_scale.py.  In-process on
+        # the CPU backend, so the parent's no-jax rule does not apply.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve_scale
+
+        sys.exit(bench_serve_scale.main())
     if "serve" in sys.argv[1:]:
         # serving benchmark (python bench.py serve): micro-batched vs
         # one-row-per-request scoring over HTTP, artifact

@@ -1,0 +1,318 @@
+"""Serve-plane scale benchmark: warm-up latency cliffs + SO_REUSEPORT
+worker scaling.
+
+Two questions, two phases:
+
+**Warm-up (deterministic + latency):** does the bucket-ladder pre-warm
+(`EvalModel.warm`, wired through the ModelStore admit path) actually
+remove the first-request and first-request-after-reload compile cliffs?
+Measured in-process against a real ScoringServer over real HTTP:
+
+- trace pinning: after start and after every hot-reload admit, scoring
+  across EVERY ladder bucket adds zero traces (`native_trace_count` —
+  the deterministic criterion; it cannot be confounded by host noise);
+- cold-start: fresh server (warm vs --no-warm arm), first `/score`
+  latency vs the server's own steady-state p50;
+- reload: R hot-reload admits, first `/score` after each swap, p50/p99
+  vs steady p50.  The no-warm arm shows the cliff the warm arm deletes.
+
+**Scale-out (throughput):** `--serve-workers 1` vs `2` through the real
+CLI supervisor (separate processes, one SO_REUSEPORT port), driven by
+the same multi-process HTTP load harness `python bench.py serve` uses,
+at fixed concurrency.  On a wide host 2 workers ≈ 2x (two GILs, two
+batcher pipelines); on this repo's 2-core CI host the load generator and
+both workers contend for the same two cores, so the ratio caps well
+below the ideal — the artifact reports the measured number honestly and
+the acceptance gate falls back to the deterministic warm-up criterion
+(`host_capped: true`), exactly as the issue specifies.
+
+Output contract matches bench.py: every stdout line is a JSON object,
+the last the most complete; artifact lands in ``BENCH_SERVE_SCALE.json``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_serve import (  # noqa: E402  (shared load harness)
+    HIDDEN,
+    NUM_FEATURES,
+    _drive_http,
+    _export_model,
+    _percentiles,
+)
+
+ARTIFACT = os.path.join(REPO_ROOT, "BENCH_SERVE_SCALE.json")
+COLD_TRIALS = int(os.environ.get("BENCH_SCALE_COLD_TRIALS", 5))
+RELOAD_TRIALS = int(os.environ.get("BENCH_SCALE_RELOAD_TRIALS", 12))
+STEADY_REQUESTS = int(os.environ.get("BENCH_SCALE_STEADY_REQUESTS", 300))
+SCALE_THREADS = int(os.environ.get("BENCH_SCALE_THREADS", 8))
+SCALE_SECONDS = float(os.environ.get("BENCH_SCALE_SECONDS", 5.0))
+SCALE_ROWS = int(os.environ.get("BENCH_SCALE_ROWS", 8))
+
+
+def _emit(result: dict, partial: bool = True) -> None:
+    out = dict(result)
+    if partial:
+        out["partial"] = True
+    print(json.dumps(out), flush=True)
+
+
+def _score_once(conn: http.client.HTTPConnection, body: str) -> float:
+    t0 = time.monotonic()
+    conn.request("POST", "/score", body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.status == 200, resp.status
+    return time.monotonic() - t0
+
+
+def _connect(port: int) -> http.client.HTTPConnection:
+    import socket as _socket
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+    conn.connect()
+    conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    return conn
+
+
+# ----------------------------------------------------------- warm-up phase
+
+
+def _republish(export_dir: str) -> None:
+    """Make the export look freshly landed to the store (a new manifest
+    fingerprint) without running an in-process training/export — which
+    would thrash the very host whose request latency is being measured.
+    Production re-exports come from a DIFFERENT process; this is the
+    honest stand-in."""
+    from shifu_tensorflow_tpu.export.saved_model import NATIVE_MANIFEST
+
+    os.utime(os.path.join(export_dir, NATIVE_MANIFEST))
+
+
+def _warmup_phase(export_dir: str) -> dict:
+    from shifu_tensorflow_tpu.export.bucketing import bucket_size, ladder
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+    from shifu_tensorflow_tpu.serve.server import ScoringServer
+
+    rng = np.random.default_rng(0)
+    body = json.dumps(
+        {"rows": rng.random((4, NUM_FEATURES)).astype(float).tolist()})
+
+    def cfg() -> ServeConfig:
+        return ServeConfig(model_dir=export_dir, port=0, max_batch=256,
+                           max_delay_ms=0.0, max_queue_rows=1024,
+                           reload_poll_ms=0)
+
+    out: dict = {"ladder": list(ladder(1024))}
+
+    def steady_p50s(port: int, conn) -> tuple[float, float]:
+        """(fresh-connection p50, keep-alive p50).  The first-request
+        samples below each pay a fresh TCP connect + handler-thread
+        spawn, so the apples-to-apples steady baseline must too; the
+        keep-alive number is reported as context."""
+        keep = [_score_once(conn, body) for _ in range(STEADY_REQUESTS)]
+        fresh = []
+        for _ in range(STEADY_REQUESTS // 3):
+            c = _connect(port)
+            fresh.append(_score_once(c, body))
+            c.close()
+        return _percentiles(fresh)[0], _percentiles(keep)[0]
+
+    # ---- cold start, both arms ----
+    for arm, warm in (("warm", True), ("no_warm", False)):
+        firsts = []
+        for _ in range(COLD_TRIALS):
+            with ScoringServer(cfg(), warm=warm) as srv:
+                srv.start()
+                conn = _connect(srv.port)
+                firsts.append(_score_once(conn, body))
+                if len(firsts) == COLD_TRIALS:
+                    p50, keep50 = steady_p50s(srv.port, conn)
+                conn.close()
+        f50, f99 = _percentiles(firsts)
+        out[f"cold_start_{arm}"] = {
+            "first_request_ms_p50": round(f50 * 1000, 2),
+            "first_request_ms_p99": round(f99 * 1000, 2),
+            "steady_p50_ms": round(p50 * 1000, 2),
+            "steady_keepalive_p50_ms": round(keep50 * 1000, 2),
+            "ratio_p50_vs_steady_p50": round(f50 / max(1e-9, p50), 2),
+            "ratio_p99_vs_steady_p50": round(f99 / max(1e-9, p50), 2),
+        }
+
+    # ---- reload admits, both arms + the trace-pinning criterion ----
+    for arm, warm in (("warm", True), ("no_warm", False)):
+        with ScoringServer(cfg(), warm=warm) as srv:
+            srv.start()
+            conn = _connect(srv.port)
+            p50, keep50 = steady_p50s(srv.port, conn)
+            if warm:
+                # deterministic criterion: a /score across EVERY ladder
+                # bucket after start adds zero traces
+                m = srv.store.current().model
+                for b in out["ladder"]:
+                    n = max(1, b - 1)
+                    rows = rng.random((min(n, 1024), NUM_FEATURES))
+                    assert bucket_size(rows.shape[0]) == b
+                    _score_once(conn, json.dumps(
+                        {"rows": rows.astype(float).tolist()}))
+                out["warm_traces_after_start"] = (
+                    m.native_trace_count - len(out["ladder"]))
+            firsts = []
+            for _ in range(RELOAD_TRIALS):
+                _republish(export_dir)
+                srv.store.reload_now()  # verify → load → warm → swap
+                c = _connect(srv.port)
+                firsts.append(_score_once(c, body))
+                c.close()
+            if warm:
+                m = srv.store.current().model
+                before = m.native_trace_count
+                for b in out["ladder"]:
+                    rows = rng.random((min(max(1, b - 1), 1024),
+                                       NUM_FEATURES))
+                    _score_once(conn, json.dumps(
+                        {"rows": rows.astype(float).tolist()}))
+                out["warm_traces_after_reload"] = (
+                    m.native_trace_count - before)
+            conn.close()
+        f50, f99 = _percentiles(firsts)
+        out[f"reload_{arm}"] = {
+            "first_request_ms_p50": round(f50 * 1000, 2),
+            "first_request_ms_p99": round(f99 * 1000, 2),
+            "steady_p50_ms": round(p50 * 1000, 2),
+            "steady_keepalive_p50_ms": round(keep50 * 1000, 2),
+            "ratio_p50_vs_steady_p50": round(f50 / max(1e-9, p50), 2),
+            "ratio_p99_vs_steady_p50": round(f99 / max(1e-9, p50), 2),
+        }
+    return out
+
+
+# --------------------------------------------------------- scale-out phase
+
+
+def _spawn_fleet(export_dir: str, workers: int) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "shifu_tensorflow_tpu.serve",
+         "--model-dir", export_dir, "--port", "0",
+         "--serve-workers", str(workers), "--reload-poll-ms", "0",
+         "--max-delay-ms", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=REPO_ROOT,
+    )
+
+
+def _scale_phase(export_dir: str) -> dict:
+    out: dict = {"concurrency": SCALE_THREADS,
+                 "rows_per_request": SCALE_ROWS,
+                 "duration_s": SCALE_SECONDS}
+    for workers in (1, 2):
+        proc = _spawn_fleet(export_dir, workers)
+        try:
+            ready = json.loads(proc.stdout.readline().decode())
+            port = ready["port"]
+            # warm the HTTP path once per worker before measuring
+            conn = _connect(port)
+            body = json.dumps({"rows": [[0.1] * NUM_FEATURES] * SCALE_ROWS})
+            for _ in range(4 * workers):
+                _score_once(conn, body)
+            conn.close()
+            phase = _drive_http(port, SCALE_THREADS, SCALE_SECONDS,
+                                rows_per_request=SCALE_ROWS)
+            out[f"workers_{workers}"] = phase
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.communicate(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+    r1 = out["workers_1"]["served_rows_per_sec"]
+    r2 = out["workers_2"]["served_rows_per_sec"]
+    out["speedup_2_vs_1"] = round(r2 / max(1e-9, r1), 2)
+    return out
+
+
+def main() -> int:
+    from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+    import jax
+
+    result: dict = {
+        "metric": "serve_scale",
+        "platform": jax.devices()[0].platform,
+        "host_cpus": os.cpu_count(),
+        "model": f"dnn {NUM_FEATURES}x{'x'.join(map(str, HIDDEN))}x1",
+        "cold_trials": COLD_TRIALS,
+        "reload_trials": RELOAD_TRIALS,
+    }
+    with tempfile.TemporaryDirectory(prefix="stpu-bench-scale-") as root:
+        export_dir = os.path.join(root, "model")
+        _export_model(export_dir)
+        result.update(_warmup_phase(export_dir))
+        _emit(result)
+        result.update(_scale_phase(export_dir))
+    host_capped = (os.cpu_count() or 2) < 4
+    result["host_capped"] = host_capped
+    # warm-up acceptance: the deterministic trace criterion plus the
+    # latency shape — warmed first requests near steady state (p50
+    # within ~1.2x, a 2 ms absolute allowance for HTTP jitter on a tiny
+    # loopback p50; the p99-of-few-trials is reported but hostage to
+    # this 2-core host's scheduler spikes, which hit steady requests
+    # equally), unwarmed showing the compile cliff the warm path deletes
+    warm_r = result["reload_warm"]
+    traces_ok = (result.get("warm_traces_after_start") == 0
+                 and result.get("warm_traces_after_reload") == 0)
+    latency_ok = (
+        warm_r["first_request_ms_p50"]
+        <= max(1.2 * warm_r["steady_p50_ms"], warm_r["steady_p50_ms"] + 2.0)
+        and result["cold_start_warm"]["first_request_ms_p50"]
+        <= max(1.2 * result["cold_start_warm"]["steady_p50_ms"],
+               result["cold_start_warm"]["steady_p50_ms"] + 2.0)
+    )
+    cliff_exists = (
+        result["reload_no_warm"]["first_request_ms_p50"]
+        >= 3.0 * result["reload_no_warm"]["steady_p50_ms"]
+    )
+    scale_ok = result["speedup_2_vs_1"] >= 1.5
+    result["acceptance"] = {
+        "warm_traces_pinned": traces_ok,
+        "warm_latency_within_1p2x": latency_ok,
+        "no_warm_cliff_exists": cliff_exists,
+        "scale_speedup_ok": scale_ok,
+    }
+    # on a <4-core host the scale ratio measures core contention, not
+    # the server design; gate on the deterministic warm-up criterion
+    result["acceptance_ok"] = bool(
+        traces_ok and cliff_exists
+        and (latency_ok or host_capped)
+        and (scale_ok or host_capped)
+    )
+    _emit(result, partial=False)
+    with open(ARTIFACT, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"artifact": ARTIFACT,
+                      "acceptance_ok": result["acceptance_ok"]}),
+          flush=True)
+    return 0 if result["acceptance_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

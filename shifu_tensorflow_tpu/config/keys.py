@@ -220,6 +220,13 @@ DEFAULT_SERVE_RETRY_AFTER_S = 1
 # SHA-256) passes, and swaps atomically.  0 disables reload.
 SERVE_RELOAD_POLL_MS = TPU_PREFIX + "serve-reload-poll"
 DEFAULT_SERVE_RELOAD_POLL_MS = 2000
+# multi-process scale-out: N scoring processes share ONE port via
+# SO_REUSEPORT (the kernel load-balances connections), each with its own
+# ModelStore/batcher/GIL and an obs journal sibling (<base>.s<i>).  A
+# parent supervisor propagates SIGTERM drain and restarts crashed
+# workers.  1 = the single-process server (no supervisor).
+SERVE_WORKERS = TPU_PREFIX + "serve-workers"
+DEFAULT_SERVE_WORKERS = 1
 
 # ---- observability plane (obs/: registry + trace + journal) ----
 # Off-by-default-cheap: with every key unset the instrumented seams cost

@@ -46,6 +46,38 @@ def bucket_size(
     return b
 
 
+def ladder(
+    max_rows: int,
+    *,
+    min_bucket: int = DEFAULT_MIN_BUCKET,
+    max_bucket: int = DEFAULT_MAX_BUCKET,
+) -> tuple[int, ...]:
+    """Every bucket a workload bounded at ``max_rows`` rows can reach:
+    the powers of two below it plus :func:`bucket_size`'s rounding of
+    ``max_rows`` itself.  This is the pre-warm set — compiling exactly
+    these shapes up front (``EvalModel.warm``) means no request the
+    admission bound can admit ever waits on a compile."""
+    if max_rows < 1:
+        raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+    sizes = []
+    b = min_bucket
+    while b <= max_bucket and b < max_rows:
+        sizes.append(b)
+        b <<= 1
+    top = bucket_size(max_rows, min_bucket=min_bucket,
+                      max_bucket=max_bucket)
+    if max_rows > max_bucket:
+        # above the power-of-two range the ladder is EVERY multiple of
+        # max_bucket up to the top — a request between two multiples
+        # buckets to the intermediate one, which must be warm too
+        m = 2 * max_bucket
+        while m < top:
+            sizes.append(m)
+            m += max_bucket
+    sizes.append(top)
+    return tuple(sizes)
+
+
 def pad_rows(rows: np.ndarray, bucket: int) -> np.ndarray:
     """Zero-pad ``rows`` (n, f) up to (bucket, f); no-op when already
     sized.  The caller slices the first n output rows back off — padded

@@ -189,6 +189,37 @@ class EvalModel:
             result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
             return result[OUTPUT_NAME].numpy()
 
+    def warm(self, buckets) -> int:
+        """Pre-compile the jitted native scorer for every ladder bucket
+        in ``buckets`` (row counts), so no future ``compute_batch`` ever
+        pays a trace+compile on the request path.  Returns the number of
+        NEW traces this call caused (0 when everything was already
+        compiled — the pinned-``native_trace_count`` serving invariant).
+
+        The cpp and saved_model backends compile nothing per shape, so
+        warming them is a free no-op.  Thread-safe under the same
+        per-instance lock as compute; raises
+        :class:`ModelReleasedError` after release()."""
+        if self.backend != "native":
+            return 0
+        with self._compute_lock:
+            if getattr(self, "_released", False):
+                raise ModelReleasedError(self.model_dir)
+            before = self._trace_count
+            for b in sorted({int(b) for b in buckets}):
+                if b < 1:
+                    raise ValueError(f"bucket must be >= 1, got {b}")
+                # zeros are fine: compilation keys on SHAPE, and the
+                # scores of a warm-up batch are never observed.  The
+                # value FETCH matters: dispatch alone returns futures,
+                # and a warm() that only enqueued would let the model be
+                # swapped in while its warm-up programs still occupy the
+                # device — the first real request would queue behind
+                # them, re-creating (a smaller) latency cliff.
+                x = self._jnp.zeros((b, self.num_features), self._jnp.float32)
+                np.asarray(self._apply(self._params, x))
+            return self._trace_count - before
+
     @property
     def native_trace_count(self) -> int:
         """How many times the jitted native scorer has (re)traced — flat

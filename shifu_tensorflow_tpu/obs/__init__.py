@@ -49,9 +49,11 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     """Install the process-wide tracer + journal from a resolved
     :class:`ObsConfig`.  Returns ``(tracer, journal)`` (either may be
     None).  Subprocess workers pass their ``worker_index`` so their
-    journal lands beside the base path as ``<path>.w<index>`` — one
-    writer per file keeps the line-at-a-time crash-safety contract
-    honest across a fleet (the CLI reader merges the set by timestamp).
+    journal lands beside the base path as ``<path>.w<index>`` (train
+    fleets) or ``<path>.s<index>`` (``--serve-workers`` scoring
+    processes) — one writer per file keeps the line-at-a-time
+    crash-safety contract honest across a fleet (the CLI reader merges
+    the set by timestamp).
     """
     from shifu_tensorflow_tpu.obs import journal as journal_mod
     from shifu_tensorflow_tpu.obs import registry as registry_mod
@@ -70,10 +72,11 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     trace_mod.install(tracer)
     jrn = None
     if cfg.journal_path:
+        suffix = "s" if plane == "serve" else "w"
         path = (
             cfg.journal_path
             if worker_index is None
-            else f"{cfg.journal_path}.w{worker_index}"
+            else f"{cfg.journal_path}.{suffix}{worker_index}"
         )
         jrn = journal_mod.Journal(
             path,

@@ -133,6 +133,79 @@ def _step_budget(events: list[dict]) -> list[str]:
     return lines
 
 
+def _serve_plane(events: list[dict]) -> list[str]:
+    """Aggregate the serve plane's lifecycle events into one row per
+    scoring process: request volume and rate (from serve_start/stop),
+    shed pressure, and reload outcomes — the per-worker split the
+    SO_REUSEPORT fleet's per-process /metrics cannot show in one
+    place."""
+    serve = [e for e in events if e.get("plane") == "serve"]
+    if not serve:
+        return []
+    per: dict = defaultdict(lambda: {
+        "start_ts": None, "stop_ts": None, "requests": None,
+        "reloads": 0, "refused": 0, "shed_events": 0, "shed_total": 0,
+        "restarts": 0,
+    })
+    fleet = {"workers": None, "restarts": 0}
+    for ev in serve:
+        kind = ev.get("event")
+        w = ev.get("worker")
+        a = per[w]
+        if kind == "serve_start":
+            a["start_ts"] = ev.get("ts")
+        elif kind == "serve_stop":
+            a["stop_ts"] = ev.get("ts")
+            a["requests"] = ev.get("requests_total")
+            a["shed_total"] = max(a["shed_total"],
+                                  int(ev.get("shed_total", 0) or 0))
+        elif kind == "reload":
+            a["reloads"] += 1
+        elif kind == "reload_refused":
+            a["refused"] += 1
+        elif kind == "shed":
+            a["shed_events"] += 1
+            a["shed_total"] = max(a["shed_total"],
+                                  int(ev.get("shed_total", 0) or 0))
+        elif kind == "serve_fleet_start":
+            fleet["workers"] = ev.get("workers")
+        elif kind in ("serve_worker_restart",):
+            fleet["restarts"] += 1
+    rows = {w: a for w, a in per.items()
+            if a["start_ts"] is not None or a["requests"] is not None
+            or a["reloads"] or a["refused"] or a["shed_events"]}
+    lines = []
+    if fleet["workers"]:
+        lines.append(f"  fleet: {fleet['workers']} workers"
+                     + (f", {fleet['restarts']} restart(s)"
+                        if fleet["restarts"] else ""))
+    if not rows:
+        # a fleet whose workers all died before serve_start (crash
+        # loop: bad artifact, stolen port) has no per-worker rows, but
+        # the fleet line above — workers + restart count — is exactly
+        # what the operator diagnosing it needs; never hide it
+        if fleet["workers"]:
+            lines.append("  (no worker reached serve_start)")
+        return lines
+    lines.append(
+        "  worker  requests  req/s    shed   reloads  refused")
+    for w in sorted(rows, key=lambda k: (k is None, k)):
+        a = rows[w]
+        who = "-" if w is None else str(w)
+        reqs = a["requests"]
+        rate = ""
+        if (reqs is not None and a["start_ts"] is not None
+                and a["stop_ts"] is not None
+                and a["stop_ts"] > a["start_ts"]):
+            rate = f"{reqs / (a['stop_ts'] - a['start_ts']):.1f}"
+        lines.append(
+            f"  {who:<7} {('?' if reqs is None else reqs):<9} "
+            f"{rate or '?':<8} {a['shed_total']:<6} {a['reloads']:<8} "
+            f"{a['refused']}"
+        )
+    return lines
+
+
 def cmd_summary(args) -> int:
     files = journal_files(args.journal)
     events = read_events(args.journal)
@@ -154,6 +227,12 @@ def cmd_summary(args) -> int:
     for line in _step_budget(events):
         print(line)
     print()
+    serve_lines = _serve_plane(events)
+    if serve_lines:
+        print("serve plane")
+        for line in serve_lines:
+            print(line)
+        print()
     print("fleet timeline")
     timeline = [e for e in events if e.get("event") != "step_breakdown"]
     limit = args.timeline_limit

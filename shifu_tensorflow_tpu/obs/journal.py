@@ -211,12 +211,13 @@ def emit(event: str, **fields: Any) -> None:
 
 def journal_files(base: str) -> list[str]:
     """Every file belonging to the journal at ``base``: the file itself,
-    its rotations (``base.N``), fleet-worker siblings (``base.wK``), and
-    their rotations — oldest-first within each writer so a re-sorted
+    its rotations (``base.N``), fleet-worker siblings (``base.wK`` for
+    train workers, ``base.sK`` for --serve-workers scoring processes),
+    and their rotations — oldest-first within each writer so a re-sorted
     merge is stable for equal timestamps."""
     base = os.fspath(base)
     pat = re.compile(
-        re.escape(os.path.basename(base)) + r"(\.w\d+)?(\.\d+)?$"
+        re.escape(os.path.basename(base)) + r"(\.[ws]\d+)?(\.\d+)?$"
     )
     found = [
         p for p in glob.glob(glob.escape(base) + "*")
@@ -225,9 +226,13 @@ def journal_files(base: str) -> list[str]:
 
     def order(p: str):
         m = pat.fullmatch(os.path.basename(p))
+        # siblings sort base-first, then .w<k>, then .s<k> (train fleet
+        # before serve fleet; within equal timestamps the merge is
+        # stable in this order)
+        kind = {"": -1, "w": 0, "s": 1}[m.group(1)[1] if m.group(1) else ""]
         worker = int(m.group(1)[2:]) if m.group(1) else -1
         rot = int(m.group(2)[1:]) if m.group(2) else 0
-        return (worker, -rot)  # higher rotation number = older
+        return (kind, worker, -rot)  # higher rotation number = older
 
     return sorted(found, key=order)
 

@@ -56,14 +56,30 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="reload_poll_ms",
                    help="export-dir poll cadence for hot reload; "
                         "0 disables")
+    p.add_argument("--serve-workers", type=int, default=None,
+                   dest="serve_workers",
+                   help="scoring processes sharing the port via "
+                        "SO_REUSEPORT (shifu.tpu.serve-workers); a parent "
+                        "supervisor drains them on SIGTERM and restarts "
+                        "crashes.  1 = single process (default)")
+    p.add_argument("--no-warm", action="store_true", dest="no_warm",
+                   help="skip the bucket-ladder pre-warm at startup and "
+                        "on reload admits (diagnostic/benchmark arm: "
+                        "exposes the first-request compile cliff)")
+    p.add_argument("--worker-index", type=int, default=None,
+                   dest="serve_worker_index",
+                   help="(internal) index of this scoring process under "
+                        "--serve-workers; set by the supervisor")
     p.add_argument("--obs-journal", default=None, dest="obs_journal",
                    help="observability journal path (shifu.tpu.obs-journal):"
-                        " reload/shed lifecycle events append here; read "
+                        " reload/shed lifecycle events append here; serve "
+                        "workers write <path>.s<i> siblings; read "
                         "with `python -m shifu_tensorflow_tpu.obs`")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
     args = build_parser().parse_args(argv)
     # after parse_args (--help must not pay a jax import), before any
     # jax-touching work
@@ -82,7 +98,14 @@ def main(argv: list[str] | None = None) -> int:
         from shifu_tensorflow_tpu.obs import install_obs, resolve_obs_config
 
         obs_cfg = resolve_obs_config(args, conf)
-        install_obs(obs_cfg, plane="serve")
+        if config.workers > 1 and args.serve_worker_index is None:
+            # multi-process scale-out: this invocation becomes the
+            # supervisor, each scoring process is a re-exec of this CLI
+            # with --worker-index set (and the SAME argv otherwise, so
+            # every knob — conf layers included — reaches the workers)
+            return _supervise(argv, config, obs_cfg)
+        install_obs(obs_cfg, plane="serve",
+                    worker_index=args.serve_worker_index)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -91,7 +114,8 @@ def main(argv: list[str] | None = None) -> int:
     from shifu_tensorflow_tpu.serve.server import ScoringServer
 
     try:
-        server = ScoringServer(config)
+        server = ScoringServer(config, warm=not args.no_warm,
+                               worker_index=args.serve_worker_index)
     except ArtifactCorrupt as e:
         print(f"refusing to serve {config.model_dir}: {e}", file=sys.stderr)
         return 3
@@ -119,7 +143,7 @@ def main(argv: list[str] | None = None) -> int:
     _obs_journal.emit("serve_start", plane="serve", port=server.port,
                       model_epoch=model.epoch,
                       model_digest=model.digest[:12])
-    print(json.dumps({
+    ready = {
         "state": "listening",
         "host": config.host,
         "port": server.port,
@@ -127,7 +151,10 @@ def main(argv: list[str] | None = None) -> int:
         "model_epoch": model.epoch,
         "model_digest": model.digest[:12],
         "model_verified": model.verified,
-    }), flush=True)
+    }
+    if args.serve_worker_index is not None:
+        ready["worker_index"] = args.serve_worker_index
+    print(json.dumps(ready), flush=True)
     try:
         while not stop.wait(0.5):
             pass
@@ -143,6 +170,228 @@ def main(argv: list[str] | None = None) -> int:
             **{k: v for k, v in sorted(counters.items())},
         }), flush=True)
     return 0
+
+
+class _Worker:
+    """One supervised scoring process: the subprocess handle plus the
+    reader thread that captures its stdout JSON lines (forwarded to the
+    supervisor's stderr so the supervisor's OWN stdout keeps the
+    one-listening-line / one-stopped-line machine-readable contract)."""
+
+    def __init__(self, index: int, argv: list[str], port: int):
+        import subprocess
+        import threading
+
+        self.index = index
+        self.listening = threading.Event()
+        self.last_json: dict = {}
+        # re-exec this CLI: original argv first, the supervisor's
+        # overrides LAST (argparse last-wins) — the resolved port must
+        # replace a possible "--port 0", and the index marks the child
+        # as a worker so it does not recurse into supervision
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "shifu_tensorflow_tpu.serve", *argv,
+             "--port", str(port), "--worker-index", str(index)],
+            stdout=subprocess.PIPE,
+        )
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self) -> None:
+        for raw in self.proc.stdout:
+            line = raw.decode(errors="replace").rstrip()
+            print(f"[serve.s{self.index}] {line}", file=sys.stderr,
+                  flush=True)
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                self.last_json = obj
+                if obj.get("state") == "listening":
+                    self.listening.set()
+
+
+def _probe_port(host: str):
+    """Resolve ``--port 0`` for the fleet: every worker must bind the
+    SAME concrete port, so the supervisor picks an ephemeral one.  The
+    probe socket is returned STILL BOUND (SO_REUSEPORT, not listening):
+    closing it before the workers bind would open a window for any
+    other process to take the port — held bound, the kernel reserves it,
+    workers' SO_REUSEPORT binds coexist with it, and a bound
+    non-listening socket receives no connections.  The caller closes it
+    once every worker is listening."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((host, 0))
+    except BaseException:
+        s.close()
+        raise
+    return s, int(s.getsockname()[1])
+
+
+def _supervise(argv: list[str], config, obs_cfg) -> int:
+    """Parent of ``--serve-workers N``: spawn N scoring processes
+    sharing one SO_REUSEPORT port, restart crashes (bounded), propagate
+    SIGTERM as a fleet-wide drain, and aggregate the final summary."""
+    import signal
+    import threading
+    import time as _time
+
+    from shifu_tensorflow_tpu.obs import install_obs
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    # the supervisor journals fleet lifecycle at the BASE path; workers
+    # write <base>.s<i> siblings (install_obs plane="serve")
+    install_obs(obs_cfg, plane="serve")
+    n = config.workers
+    probe = None
+    if config.port:
+        port = config.port
+    else:
+        probe, port = _probe_port(config.host)
+    # a crash loop (bad artifact, port stolen, OOM) must fail the fleet,
+    # not respawn forever — but the budget is over a sliding WINDOW, not
+    # the fleet's lifetime: sporadic single-worker deaths spaced hours
+    # apart are transients a long-lived fleet must absorb, while a
+    # crashing artifact burns through the window's budget in seconds
+    restart_budget = max(5, 2 * n)
+    restart_window_s = 600.0
+    recent_restarts: list[float] = []  # monotonic ts, pruned to window
+    restarts = 0  # lifetime total, for the journal + summary only
+
+    stop = threading.Event()
+    stopping: list[int] = []
+
+    def on_signal(signum, frame):
+        stopping.append(signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    # every exit path — spawn failure, barrier failure, SIGTERM
+    # mid-startup, budget exhaustion, normal drain — goes through the
+    # finally below: the fleet is always reaped and the one
+    # machine-readable "stopped" line always prints (a consumer tailing
+    # stdout must never see a run end without it).  Spawning INSIDE the
+    # try matters: if worker k's fork fails, workers 0..k-1 are already
+    # listening on the shared port and must not be orphaned.
+    workers: list[_Worker] = []
+    rc: int | None = None
+    drain_rc = 0
+    try:
+        for i in range(n):
+            workers.append(_Worker(i, argv, port))
+        obs_journal.emit("serve_fleet_start", plane="serve", port=port,
+                         workers=n)
+        # listening barrier: every worker up (or one dead = fail fast —
+        # a fleet that can only half-listen mis-advertises its capacity)
+        deadline = _time.monotonic() + 180.0
+        ready = True
+        for w in workers:
+            while ready and not w.listening.wait(0.2):
+                if stop.is_set():
+                    ready = False  # drained below; signal rc wins
+                elif w.proc.poll() is not None:
+                    print(f"serve worker {w.index} exited rc="
+                          f"{w.proc.returncode} before listening",
+                          file=sys.stderr)
+                    rc = 3
+                    ready = False
+                elif _time.monotonic() > deadline:
+                    print(f"serve workers not listening after 180s",
+                          file=sys.stderr)
+                    rc = 3
+                    ready = False
+            if not ready:
+                break
+        if probe is not None:
+            # the workers hold the port now (or the fleet is failing);
+            # release the reservation either way
+            probe.close()
+            probe = None
+        if ready:
+            print(json.dumps({
+                "state": "listening", "host": config.host, "port": port,
+                "workers": n,
+            }), flush=True)
+            while not stop.wait(0.2):
+                for i, w in enumerate(workers):
+                    if w.proc.poll() is None:
+                        continue
+                    # unprompted exit = crash (clean or not, a scoring
+                    # process has no business leaving on its own)
+                    obs_journal.emit("serve_worker_exit", plane="serve",
+                                     index=w.index, rc=w.proc.returncode)
+                    now = _time.monotonic()
+                    recent_restarts = [t for t in recent_restarts
+                                       if now - t < restart_window_s]
+                    if len(recent_restarts) >= restart_budget:
+                        print(f"serve worker {w.index} died (rc="
+                              f"{w.proc.returncode}) with the restart "
+                              f"budget ({restart_budget} per "
+                              f"{restart_window_s:.0f}s) exhausted; "
+                              "stopping the fleet", file=sys.stderr)
+                        rc = 4
+                        stop.set()
+                        break
+                    restarts += 1
+                    recent_restarts.append(now)
+                    _time.sleep(0.5)  # a crashing artifact busy-loops
+                    workers[i] = _Worker(w.index, argv, port)
+                    obs_journal.emit("serve_worker_restart", plane="serve",
+                                     index=w.index, restarts=restarts)
+                    print(f"restarted serve worker {w.index} "
+                          f"({restarts}/{restart_budget})", file=sys.stderr)
+    finally:
+        if probe is not None:
+            probe.close()
+        # fleet-wide drain: SIGTERM each live worker (it stops
+        # admitting, finishes queued dispatches, prints its summary)
+        for w in workers:
+            if w.proc.poll() is None:
+                w.proc.terminate()
+        for w in workers:
+            try:
+                wrc = w.proc.wait(timeout=60.0)
+            except Exception:
+                w.proc.kill()
+                wrc = w.proc.wait()
+            # wrc == -SIGTERM is OUR drain signal landing before the
+            # worker installed its graceful handler (e.g. a just-
+            # restarted worker still importing jax) — an expected drain
+            # outcome, not a failure
+            if wrc not in (0, -signal.SIGTERM):
+                drain_rc = drain_rc or wrc
+            # the worker's final "stopped" JSON line may still be in
+            # the pipe when wait() returns — let the reader drain it
+            # before the aggregate summary reads last_json
+            w._reader.join(timeout=10.0)
+        obs_journal.emit("serve_fleet_stop", plane="serve",
+                         restarts=restarts)
+        totals: dict[str, int] = {}
+        per_worker = []
+        for w in workers:
+            summary = (w.last_json
+                       if w.last_json.get("state") == "stopped" else {})
+            per_worker.append({"index": w.index, **{
+                k: v for k, v in summary.items() if k != "state"}})
+            for k, v in summary.items():
+                if isinstance(v, (int, float)) and k != "signal":
+                    totals[k] = totals.get(k, 0) + v
+        print(json.dumps({
+            "state": "stopped",
+            "signal": stopping[0] if stopping else None,
+            "workers": n,
+            "restarts": restarts,
+            **{k: v for k, v in sorted(totals.items())},
+            "per_worker": per_worker,
+        }), flush=True)
+    return rc if rc is not None else (drain_rc or 0)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,5 @@
-"""Micro-batching with shed-before-queue backpressure.
+"""Micro-batching with shed-before-queue backpressure and a pipelined
+pack → dispatch → scatter dispatch path.
 
 The throughput lever of online scoring is the same one the training side
 pulls with scan-steps: per-dispatch cost (Python → jit call → XLA
@@ -11,16 +12,43 @@ queued into one dispatch of at most ``max_batch`` rows, waiting at most
 the export/bucketing.py power-of-two ladder so the jitted scorer
 compiles once per bucket, not once per batch length.
 
+The dispatch path is a three-stage pipeline, the serving analogue of
+tf.data's overlap-host-work-with-device-work discipline (arxiv
+2101.12127): the device must never wait on host bookkeeping.
+
+- **pack** (its own thread): pop a coalesced batch from the admission
+  queue, concatenate and pad it to the bucket ladder;
+- **dispatch** (its own thread): the only thread that touches
+  ``score_fn`` — it does nothing but feed the device;
+- **scatter** (its own thread): slice the batch's scores back into
+  per-request results and wake the waiting callers.
+
+The stages hand off through depth-bounded queues (double-buffering), so
+while the device scores batch N the pack stage is already preparing
+batch N+1 and the scatter stage is still distributing batch N-1 — under
+load, per-request scatter and pad/pack cost disappears from the dispatch
+critical path entirely.  The ``serve.pack`` / ``serve.dispatch`` /
+``serve.scatter`` obs trace spans (obs/trace.py) measure each stage;
+their totals summing past the batcher's wall clock is the overlap,
+observable in any traced run.
+
 Backpressure is SHED-BEFORE-QUEUE: the admission queue is bounded at
 ``max_queue_rows`` and a request that would overflow it raises
 :class:`ShedLoad` (the server maps it to 429 + Retry-After) instead of
 being queued.  An unbounded queue never rejects anything — it just
 converts overload into unbounded latency for everyone, which is strictly
 worse than telling the slowest fraction of callers to come back later.
+The ``Retry-After`` hint is JITTERED uniformly over [0.5x, 1.5x] of the
+configured value (the configured value is the mean): a shed wave echoed
+back verbatim synchronizes every rejected client into one retry
+thundering herd exactly one Retry-After later — on a server that just
+proved it cannot absorb the first wave.
 """
 
 from __future__ import annotations
 
+import queue
+import random
 import threading
 import time
 from collections import deque
@@ -29,21 +57,28 @@ from typing import Callable
 import numpy as np
 
 from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("serve.batcher")
 
 
 class ShedLoad(RuntimeError):
-    """Admission refused: the queue is full.  Carries the Retry-After
-    hint the HTTP layer forwards."""
+    """Admission refused: the queue is full.  Carries the (jittered)
+    Retry-After hint the HTTP layer forwards, plus the configured mean
+    it was drawn around."""
 
-    def __init__(self, retry_after_s: int, queued_rows: int):
+    def __init__(self, retry_after_s: int, queued_rows: int,
+                 retry_after_mean_s: int | None = None):
         super().__init__(
             f"admission queue full ({queued_rows} rows queued); "
             f"retry after {retry_after_s}s"
         )
         self.retry_after_s = retry_after_s
+        self.retry_after_mean_s = (
+            retry_after_mean_s if retry_after_mean_s is not None
+            else retry_after_s
+        )
 
 
 class BatcherClosed(RuntimeError):
@@ -68,13 +103,30 @@ class _Pending:
         self.t_enqueue = time.monotonic()
 
 
+class _Work:
+    """One coalesced batch moving through the pipeline stages."""
+
+    __slots__ = ("batch", "sizes", "n", "bucket", "padded", "scores",
+                 "error", "dispatch_s")
+
+    def __init__(self, batch: list[_Pending]):
+        self.batch = batch
+        self.sizes = [p.rows.shape[0] for p in batch]
+        self.n = sum(self.sizes)
+        self.bucket = bucket_size(self.n)
+        self.padded: np.ndarray | None = None
+        self.scores: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.dispatch_s = 0.0
+
+
 class MicroBatcher:
     """Coalesce concurrent ``submit`` calls into batched ``score_fn``
-    dispatches on a single worker thread.
+    dispatches through the pack → dispatch → scatter pipeline.
 
     ``score_fn(rows) -> scores`` receives a (n, f) float32 array whose n
     is always a ladder bucket size and must return an array whose axis 0
-    matches; it runs on the batcher thread only, so a scorer that is
+    matches; it runs on the dispatch thread only, so a scorer that is
     merely single-thread-safe (EvalModel's documented contract) needs no
     extra locking here.
     """
@@ -97,19 +149,54 @@ class MicroBatcher:
         self.max_queue_rows = max(max_batch, max_queue_rows)
         self.retry_after_s = retry_after_s
         self.metrics = metrics
+        self._rng = random.Random()
         self._cond = threading.Condition()
         self._pending: deque[_Pending] = deque()
         self._queued_rows = 0
+        # rows taken off the admission queue but not yet scattered —
+        # the (up to three) coalesced batches inside the pipeline.
+        # Admission sheds on _queued_rows alone (the documented bound);
+        # the observability surfaces report queued + in-flight so the
+        # gauge cannot read ~0 while a thousand rows are mid-pipeline.
+        self._inflight_rows = 0
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._loop, name="serve-batcher", daemon=True
-        )
-        self._thread.start()
+        # stage handoffs: depth 1 into dispatch (one packed batch staged
+        # while one scores — deeper would just add queueing latency),
+        # depth 2 out of it (the device may finish two batches before a
+        # slow scatter catches up without ever stalling the dispatch)
+        self._dispatch_q: queue.Queue[_Work | None] = queue.Queue(maxsize=1)
+        self._scatter_q: queue.Queue[_Work | None] = queue.Queue(maxsize=2)
+        self._threads = [
+            threading.Thread(target=self._pack_loop,
+                             name="serve-pack", daemon=True),
+            threading.Thread(target=self._dispatch_loop,
+                             name="serve-dispatch", daemon=True),
+            threading.Thread(target=self._scatter_loop,
+                             name="serve-scatter", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
 
     # ---- client side ----
     def queued_rows(self) -> int:
+        """Rows admitted but not yet scattered: the admission queue PLUS
+        the batches moving through the pack/dispatch/scatter pipeline."""
         with self._cond:
-            return self._queued_rows
+            return self._queued_rows + self._inflight_rows
+
+    def _jittered_retry_after(self) -> int:
+        """Uniform over [0.5x, 1.5x] of the configured value (which is
+        therefore the mean), made integral by STOCHASTIC rounding — the
+        HTTP Retry-After header is whole seconds, and deterministic
+        round() would collapse the whole range to a constant at the
+        default 1 s mean (every shed client told "1" retries in the same
+        herd the jitter exists to break).  Floored at 1 s, which skews
+        the effective mean slightly above a configured value of 1."""
+        x = self.retry_after_s * self._rng.uniform(0.5, 1.5)
+        n = int(x)
+        if self._rng.random() < x - n:
+            n += 1
+        return max(1, n)
 
     def submit(self, rows: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
         """Score ``rows`` (n, f); blocks until the coalesced dispatch that
@@ -134,7 +221,9 @@ class MicroBatcher:
             if self._queued_rows + n > self.max_queue_rows:
                 if self.metrics is not None:
                     self.metrics.inc("shed_total")
-                raise ShedLoad(self.retry_after_s, self._queued_rows)
+                raise ShedLoad(self._jittered_retry_after(),
+                               self._queued_rows,
+                               retry_after_mean_s=self.retry_after_s)
             self._pending.append(item)
             self._queued_rows += n
             self._cond.notify_all()
@@ -160,7 +249,7 @@ class MicroBatcher:
             )
         return item.result
 
-    # ---- worker side ----
+    # ---- pack stage ----
     def _take_batch(self) -> list[_Pending] | None:
         """Block until work (or close), honor the coalescing window, and
         pop up to max_batch rows' worth of requests — never splitting a
@@ -192,43 +281,79 @@ class MicroBatcher:
                 batch.append(self._pending.popleft())
                 taken += n
             self._queued_rows -= taken
+            self._inflight_rows += taken
             return batch
 
-    def _loop(self) -> None:
+    def _pack_loop(self) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
+                self._dispatch_q.put(None)  # cascade the drain sentinel
                 return
-            self._dispatch(batch)
+            work = _Work(batch)
+            with obs_trace.span("serve.pack"):
+                try:
+                    # the concatenate is INSIDE the guard: coalesced
+                    # requests can disagree on row width (each was
+                    # validated against whichever model was current at
+                    # its admission, and a hot reload can change the
+                    # width in between) — that must fail THESE callers,
+                    # not kill a pipeline thread and wedge every future
+                    # submit
+                    x = (batch[0].rows if len(batch) == 1
+                         else np.concatenate([p.rows for p in batch],
+                                             axis=0))
+                    work.padded = pad_rows(x, work.bucket)
+                except BaseException as e:
+                    work.error = e
+            self._dispatch_q.put(work)
 
-    def _dispatch(self, batch: list[_Pending]) -> None:
-        sizes = [p.rows.shape[0] for p in batch]
-        n = sum(sizes)
-        bucket = bucket_size(n)
-        t0 = time.monotonic()
-        try:
-            # the concatenate is INSIDE the guard: coalesced requests can
-            # disagree on row width (each was validated against whichever
-            # model was current at its admission, and a hot reload can
-            # change the width in between) — that must fail THESE callers,
-            # not kill the worker thread and wedge every future submit
-            x = (batch[0].rows if len(batch) == 1
-                 else np.concatenate([p.rows for p in batch], axis=0))
-            scores = np.asarray(self._score(pad_rows(x, bucket)))[:n]
-        except BaseException as e:  # propagate to every waiting caller
+    # ---- dispatch stage ----
+    def _dispatch_loop(self) -> None:
+        while True:
+            work = self._dispatch_q.get()
+            if work is None:
+                self._scatter_q.put(None)
+                return
+            if work.error is None:
+                t0 = time.monotonic()
+                with obs_trace.span("serve.dispatch"):
+                    try:
+                        work.scores = np.asarray(self._score(work.padded))
+                    except BaseException as e:
+                        work.error = e
+                work.dispatch_s = time.monotonic() - t0
+                work.padded = None  # the pad copy is dead weight now
+            self._scatter_q.put(work)
+
+    # ---- scatter stage ----
+    def _scatter_loop(self) -> None:
+        while True:
+            work = self._scatter_q.get()
+            if work is None:
+                return
+            with obs_trace.span("serve.scatter"):
+                self._scatter(work)
+
+    def _scatter(self, work: _Work) -> None:
+        with self._cond:
+            self._inflight_rows -= work.n
+        if work.error is not None:
+            # propagate to every waiting caller of THIS batch
             log.warning("dispatch of %d rows failed: %s: %s",
-                        n, type(e).__name__, e)
-            for p in batch:
-                p.error = e
+                        work.n, type(work.error).__name__, work.error)
+            for p in work.batch:
+                p.error = work.error
                 p.event.set()
             return
         if self.metrics is not None:
             self.metrics.inc("batches_total")
-            self.metrics.inc("rows_total", n)
-            self.metrics.inc("padded_rows_total", bucket - n)
-            self.metrics.batch_latency.record(time.monotonic() - t0)
+            self.metrics.inc("rows_total", work.n)
+            self.metrics.inc("padded_rows_total", work.bucket - work.n)
+            self.metrics.batch_latency.record(work.dispatch_s)
+        scores = work.scores[:work.n]
         off = 0
-        for p, sz in zip(batch, sizes):
+        for p, sz in zip(work.batch, work.sizes):
             p.result = scores[off:off + sz]
             p.error = None
             off += sz
@@ -236,7 +361,9 @@ class MicroBatcher:
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; by default finish what is queued (each waiting
-        caller gets its result), then stop the worker thread."""
+        caller gets its result), then stop the pipeline threads — the
+        drain sentinel cascades pack → dispatch → scatter so every
+        in-flight batch scatters before the threads exit."""
         with self._cond:
             self._closed = True
             if not drain:
@@ -246,4 +373,5 @@ class MicroBatcher:
                 self._pending.clear()
                 self._queued_rows = 0
             self._cond.notify_all()
-        self._thread.join(timeout=30.0)
+        for t in self._threads:
+            t.join(timeout=30.0)

@@ -29,8 +29,11 @@ class ServeConfig:
     max_queue_rows: int = K.DEFAULT_SERVE_QUEUE_ROWS
     retry_after_s: int = K.DEFAULT_SERVE_RETRY_AFTER_S
     reload_poll_ms: int = K.DEFAULT_SERVE_RELOAD_POLL_MS
+    workers: int = K.DEFAULT_SERVE_WORKERS
 
     def __post_init__(self):
+        if self.workers < 1:
+            raise ValueError(f"{K.SERVE_WORKERS} must be >= 1")
         if self.backend not in ("native", "cpp", "saved_model"):
             raise ValueError(
                 f"unknown {K.SERVE_BACKEND} value {self.backend!r} "
@@ -78,4 +81,6 @@ def resolve_serve_config(args, conf) -> ServeConfig:
                            K.DEFAULT_SERVE_RETRY_AFTER_S, conf.get_int),
         reload_poll_ms=pick("reload_poll_ms", K.SERVE_RELOAD_POLL_MS,
                             K.DEFAULT_SERVE_RELOAD_POLL_MS, conf.get_int),
+        workers=pick("serve_workers", K.SERVE_WORKERS,
+                     K.DEFAULT_SERVE_WORKERS, conf.get_int),
     )

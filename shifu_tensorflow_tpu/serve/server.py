@@ -24,6 +24,7 @@ stop admitting, finish queued dispatches, release the model.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -36,6 +37,7 @@ from shifu_tensorflow_tpu.serve.batcher import (
     RequestTooLarge,
     ShedLoad,
 )
+from shifu_tensorflow_tpu.export.bucketing import ladder
 from shifu_tensorflow_tpu.serve.config import ServeConfig
 from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
 from shifu_tensorflow_tpu.serve.model_store import ModelNotLoaded, ModelStore
@@ -48,15 +50,47 @@ class _BadRequest(ValueError):
     """Client-side error → 400 with the message."""
 
 
+class _ReuseportHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that binds with SO_REUSEPORT, so N scoring
+    processes can listen on ONE port and the kernel load-balances
+    incoming connections across them — the scale-out past one process's
+    GIL (``--serve-workers``).  SO_REUSEADDR alone is not enough: it
+    permits rebinding a TIME_WAIT port, not concurrent listeners."""
+
+    def server_bind(self):
+        if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover
+            raise OSError(
+                "SO_REUSEPORT is not available on this platform; "
+                "run with --serve-workers 1"
+            )
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 class ScoringServer:
-    def __init__(self, config: ServeConfig, *, metrics: ServeMetrics | None = None):
+    def __init__(
+        self,
+        config: ServeConfig,
+        *,
+        metrics: ServeMetrics | None = None,
+        warm: bool = True,
+        worker_index: int | None = None,
+    ):
         self.config = config
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.worker_index = worker_index
+        # pre-warm set: every bucket the admission bound can admit (a
+        # single request may carry up to max_queue_rows rows and is
+        # never split) — compiled at startup and on every hot-reload
+        # admit so no /score ever waits on a trace.  warm=False is the
+        # diagnostic/benchmark arm that shows the compile cliff.
+        warm_buckets = ladder(config.max_queue_rows) if warm else ()
         self.store = ModelStore(
             config.model_dir,
             backend=config.backend,
             poll_interval_s=config.reload_poll_ms / 1000.0,
             metrics=self.metrics,
+            warm_buckets=warm_buckets,
         )
         self.batcher = MicroBatcher(
             self._score_once,
@@ -67,8 +101,12 @@ class ScoringServer:
             metrics=self.metrics,
         )
         handler = _make_handler(self)
+        # workers > 1 means this process is ONE of several sharing the
+        # port — every one of them must bind with SO_REUSEPORT
+        server_cls = (_ReuseportHTTPServer if config.workers > 1
+                      else ThreadingHTTPServer)
         try:
-            self.httpd = ThreadingHTTPServer(
+            self.httpd = server_cls(
                 (config.host, config.port), handler
             )
         except BaseException:
@@ -211,7 +249,7 @@ class ScoringServer:
             m = self.store.current()
         except ModelNotLoaded:
             return 503, {"ok": False, "error": "no model loaded"}
-        return 200, {
+        out = {
             "ok": True,
             "model_epoch": m.epoch,
             "model_digest": m.digest[:12],
@@ -220,6 +258,9 @@ class ScoringServer:
             "queue_rows": self.batcher.queued_rows(),
             "uptime_s": round(time.time() - self.metrics.started_at, 1),
         }
+        if self.worker_index is not None:
+            out["worker_index"] = self.worker_index
+        return 200, out
 
     def metrics_text(self) -> str:
         try:
@@ -227,6 +268,12 @@ class ScoringServer:
             epoch, digest, verified = m.epoch, m.digest[:12], m.verified
         except ModelNotLoaded:
             epoch, digest, verified = -1, "", False
+        if self.worker_index is not None:
+            # /metrics is per-process by design; under --serve-workers
+            # the kernel routes a scrape to an ARBITRARY worker, so each
+            # response carries which one answered
+            self.metrics.registry.set_gauge("worker_index",
+                                            self.worker_index)
         return self.metrics.render_prometheus(
             queue_rows=self.batcher.queued_rows(),
             model_epoch=epoch,

@@ -27,6 +27,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 sweep (ROADMAP.md runs -m 'not "
+        "slow' under a hard wall-clock budget); run with -m slow on a "
+        "host that can afford it",
+    )
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(20260729)

@@ -17,6 +17,8 @@ unaffected and runs everywhere.
 
 from __future__ import annotations
 
+import os
+
 import jaxlib
 import pytest
 
@@ -29,6 +31,30 @@ needs_multiprocess_collectives = pytest.mark.skipif(
     reason=(
         "jaxlib %s CPU backend lacks multiprocess collectives "
         "(known-broken at seed, see CHANGES.md PR 2); needs jaxlib>=0.5"
+        % jaxlib.__version__
+    ),
+)
+
+# The ssh-launcher drills additionally bind the jax coordination service
+# to this machine's non-loopback interface — on top of the cross-process
+# collective requirement, the containerized CI network cannot route
+# worker<->chief traffic over it (verified failing identically on a
+# pristine seed checkout, PR 4 notes).  That network limitation is
+# INDEPENDENT of the jaxlib version, so a jaxlib bump alone must not
+# lift the skip into a guaranteed environment failure: these tests run
+# only when jaxlib has the collectives AND the operator asserts the
+# network can route the non-loopback plane by setting
+# STPU_NONLOOPBACK_SPMD_TESTS=1.  Tier-1 then reads
+# green-or-real-regression instead of known-red.
+needs_nonloopback_spmd = pytest.mark.skipif(
+    JAXLIB_VERSION < (0, 5, 0)
+    or not os.environ.get("STPU_NONLOOPBACK_SPMD_TESTS"),
+    reason=(
+        "non-loopback cross-process SPMD: needs jaxlib>=0.5 "
+        "multiprocess collectives (have %s) AND a network that routes "
+        "the non-loopback coordination plane — opt in with "
+        "STPU_NONLOOPBACK_SPMD_TESTS=1 (container failure pre-existing "
+        "at seed, see CHANGES.md PR 4)"
         % jaxlib.__version__
     ),
 )

@@ -376,6 +376,7 @@ def test_serve_keys_round_trip_xml_to_dataclass(tmp_path):
         K.SERVE_QUEUE_ROWS: "2048",
         K.SERVE_RETRY_AFTER_S: "3",
         K.SERVE_RELOAD_POLL_MS: "500",
+        K.SERVE_WORKERS: "4",
     }
     xml.write_text(
         "<configuration>" + "".join(
@@ -392,16 +393,19 @@ def test_serve_keys_round_trip_xml_to_dataclass(tmp_path):
     assert cfg.max_batch == 128 and cfg.max_delay_ms == 7.5
     assert cfg.max_queue_rows == 2048
     assert cfg.retry_after_s == 3 and cfg.reload_poll_ms == 500
+    assert cfg.workers == 4
     # CLI flags win over the XML layer
     args = serve_parser().parse_args(
         ["--model-dir", "/m", "--port", "9200", "--backend", "native",
          "--max-batch", "64", "--max-delay-ms", "2", "--queue-rows",
-         "512", "--retry-after", "9", "--reload-poll-ms", "0"]
+         "512", "--retry-after", "9", "--reload-poll-ms", "0",
+         "--serve-workers", "2"]
     )
     cfg = resolve_serve_config(args, conf)
     assert (cfg.port, cfg.backend, cfg.max_batch, cfg.max_delay_ms,
-            cfg.max_queue_rows, cfg.retry_after_s, cfg.reload_poll_ms) \
-        == (9200, "native", 64, 2.0, 512, 9, 0)
+            cfg.max_queue_rows, cfg.retry_after_s, cfg.reload_poll_ms,
+            cfg.workers) \
+        == (9200, "native", 64, 2.0, 512, 9, 0, 2)
     # and the WorkerConfig-style JSON bridge round-trips every field
     assert ServeConfig.from_json(cfg.to_json()) == cfg
     # defaults with neither layer set
@@ -411,6 +415,7 @@ def test_serve_keys_round_trip_xml_to_dataclass(tmp_path):
     assert d.port == K.DEFAULT_SERVE_PORT
     assert d.max_batch == K.DEFAULT_SERVE_MAX_BATCH
     assert d.backend == K.DEFAULT_SERVE_BACKEND
+    assert d.workers == K.DEFAULT_SERVE_WORKERS
 
 
 def test_serve_config_rejects_misconfiguration():
@@ -426,6 +431,8 @@ def test_serve_config_rejects_misconfiguration():
         ServeConfig(model_dir="/m", max_batch=256, max_queue_rows=100)
     with pytest.raises(ValueError, match="serve-max-batch"):
         ServeConfig(model_dir="/m", max_batch=0)
+    with pytest.raises(ValueError, match="serve-workers"):
+        ServeConfig(model_dir="/m", workers=0)
 
 
 def test_health_keys_drive_worker_and_spec_fields():

@@ -193,6 +193,33 @@ def test_journal_merges_worker_siblings_and_rotations(tmp_path):
     assert events == sorted(events, key=lambda e: e["ts"])
 
 
+def test_journal_discovers_serve_worker_siblings(tmp_path):
+    """--serve-workers scoring processes write <base>.s<i> siblings; the
+    reader merges them beside train (.w<i>) siblings and rotations, and
+    install_obs routes a serve-plane worker to the .s path."""
+    base = str(tmp_path / "job.jsonl")
+    with Journal(base, plane="serve") as j:
+        j.emit("serve_fleet_start", workers=2)
+    for s in (0, 1):
+        with Journal(f"{base}.s{s}", plane="serve", worker=s) as js:
+            js.emit("serve_start", port=1234)
+    files = journal_files(base)
+    assert any(f.endswith(".s0") for f in files)
+    assert any(f.endswith(".s1") for f in files)
+    events = read_events(base)
+    assert [e["event"] for e in events] == [
+        "serve_fleet_start", "serve_start", "serve_start"]
+    assert {e.get("worker") for e in events
+            if e["event"] == "serve_start"} == {0, 1}
+
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    cfg = ObsConfig(enabled=True, journal_path=base)
+    _, j = install_obs(cfg, worker_index=3, plane="serve")
+    assert j.path.endswith(".s3")
+    journal_mod.uninstall()
+
+
 def test_journal_install_emit_is_noop_without_install():
     journal_mod.uninstall()
     journal_mod.emit("nobody-listening", x=1)  # must not raise
@@ -380,6 +407,40 @@ def test_obs_cli_summary_renders_budget_and_timeline(tmp_path, capsys):
     # the budget row: 1.2s dispatch of a 2.0s epoch wall = 60%
     assert "60.0" in out
     assert "rpc.epoch 1x 0.050s" in out
+
+
+def test_obs_cli_summary_renders_serve_plane(tmp_path, capsys):
+    """The serve plane renders per-worker from journal events alongside
+    the train/fleet views: request volume + rate, shed pressure, reload
+    outcomes, and the --serve-workers split."""
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_cli_journal(tmp_path)  # train events: plane must coexist
+    with Journal(base + ".sup", plane="serve") as sup:
+        pass  # (unmatched name: must NOT be swept in)
+    with Journal(base, plane="serve") as j:
+        j.emit("serve_fleet_start", port=9100, workers=2)
+        j.emit("serve_worker_restart", index=1, restarts=1)
+    for s, reqs in ((0, 120), (1, 80)):
+        with Journal(f"{base}.s{s}", plane="serve", worker=s) as js:
+            js.emit("serve_start", port=9100)
+            js.emit("reload", epoch=1, digest="abc", verified=True)
+            if s == 1:
+                js.emit("reload_refused", why="weights.npz: sha256 differs")
+                js.emit("shed", queue_rows=64, shed_total=17)
+            js.emit("serve_stop", requests_total=reqs, shed_total=17 * s)
+    assert obs_main(["summary", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "serve plane" in out
+    assert "fleet: 2 workers, 1 restart(s)" in out
+    lines = [ln for ln in out.splitlines() if ln.strip().startswith(("0 ", "1 "))]
+    serve_rows = {ln.split()[0]: ln.split() for ln in lines}
+    assert serve_rows["0"][1] == "120"
+    assert serve_rows["1"][1] == "80"
+    assert serve_rows["1"][3] == "17"   # shed column
+    assert serve_rows["1"][5] == "1"    # refused column
+    # the train budget and timeline still render beside it
+    assert "per-step time budget" in out and "fleet timeline" in out
 
 
 def test_obs_cli_tail_shows_last_events(tmp_path, capsys):

@@ -17,7 +17,7 @@ import numpy as np
 import pytest
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
-from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.export.bucketing import bucket_size, ladder, pad_rows
 from shifu_tensorflow_tpu.export.eval_model import EvalModel
 from shifu_tensorflow_tpu.export.saved_model import (
     NATIVE_MANIFEST,
@@ -97,6 +97,43 @@ def test_pad_rows_shapes_and_content():
     assert pad_rows(x, 5) is x  # already sized: no copy
     with pytest.raises(ValueError):
         pad_rows(x, 4)
+
+
+def test_ladder_enumerates_reachable_buckets():
+    assert ladder(1) == (8,)
+    assert ladder(8) == (8,)
+    assert ladder(9) == (8, 16)
+    assert ladder(256) == (8, 16, 32, 64, 128, 256)
+    assert ladder(4096)[-1] == 4096
+    assert ladder(5000) == (8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                            4096, 8192)
+    # past max_bucket, EVERY multiple up to the top is reachable (a
+    # 9000-row request buckets to 12288) and must be in the warm set
+    assert ladder(13000)[-4:] == (4096, 8192, 12288, 16384)
+    assert all(bucket_size(n) in ladder(13000)
+               for n in (1, 5000, 8300, 12289, 13000))
+    with pytest.raises(ValueError):
+        ladder(0)
+
+
+def test_eval_model_warm_precompiles_ladder(export_dir):
+    """warm() compiles every ladder bucket up front, so no later
+    compute_batch — whatever its length — adds a trace."""
+    with EvalModel(export_dir) as em:
+        buckets = ladder(256)
+        assert em.warm(buckets) == len(buckets)
+        assert em.native_trace_count == len(buckets)
+        assert em.warm(buckets) == 0  # idempotent: nothing re-traces
+        for n in (1, 7, 9, 31, 100, 256):
+            em.compute_batch(_rows(n, seed=n))
+        assert em.native_trace_count == len(buckets)
+    # released instance refuses to warm (typed, like compute)
+    from shifu_tensorflow_tpu.export.eval_model import ModelReleasedError
+
+    em = EvalModel(export_dir)
+    em.release()
+    with pytest.raises(ModelReleasedError):
+        em.warm((8,))
 
 
 def test_native_scorer_trace_count_flat_across_batch_lengths(export_dir):
@@ -258,19 +295,26 @@ def test_batcher_sheds_before_queueing():
     try:
         scorer.gate.clear()
         threads = []
-        # first submit enters the gated dispatch (leaves the queue); the
-        # next two fill the 8-row admission bound
-        for _ in range(3):
+        # the pipeline absorbs three coalesced batches beyond the queue
+        # (one gated in dispatch, one staged in the dispatch handoff, one
+        # packed and blocked on it); the next two fill the 8-row
+        # admission bound
+        for _ in range(5):
             t = threading.Thread(
                 target=lambda: b.submit(np.ones((4, 2), np.float32))
             )
             t.start()
             threads.append(t)
             time.sleep(0.05)
-        assert b.queued_rows() == 8  # bound reached
+        # 8 queued + 12 in-pipeline: the gauge reports ALL outstanding
+        # rows, while admission sheds on the queued 8 alone
+        assert b.queued_rows() == 20
         with pytest.raises(ShedLoad) as ei:
             b.submit(np.ones((1, 2), np.float32))
-        assert ei.value.retry_after_s == 3
+        # Retry-After is jittered around the configured mean (3 s):
+        # uniform over [0.5x, 1.5x], integral, floored at 1
+        assert ei.value.retry_after_mean_s == 3
+        assert 1 <= ei.value.retry_after_s <= 5
         assert metrics.counters()["shed_total"] == 1
         # oversized single requests are a client error, not a shed
         with pytest.raises(ValueError, match="exceeds"):
@@ -322,6 +366,47 @@ def test_batcher_survives_mixed_width_coalesce():
     finally:
         scorer.gate.set()
         b.close()
+
+
+def test_pipeline_spans_prove_pack_runs_ahead_of_dispatch():
+    """The pack → dispatch → scatter pipeline: while a batch is held on
+    the device, later batches are already packed (serve.pack spans land
+    before the gated serve.dispatch span can), and every stage's span
+    count matches the dispatch count once drained."""
+    from shifu_tensorflow_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.install(obs_trace.Tracer())
+    scorer = _GatedScorer()
+    b = MicroBatcher(scorer, max_batch=8, max_delay_s=0.01)
+    try:
+        scorer.gate.clear()
+        threads = []
+        for s in range(3):
+            t = threading.Thread(
+                target=lambda: b.submit(np.ones((2, 3), np.float32))
+            )
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)  # three separate coalescing windows
+        # batch 1 is gated INSIDE the dispatch stage; batches 2 and 3
+        # still get packed — host work running ahead of the device
+        deadline = time.time() + 5.0
+        while (tracer.summary().get("serve.pack", {}).get("count", 0) < 3
+               and time.time() < deadline):
+            time.sleep(0.01)
+        s = tracer.summary()
+        assert s["serve.pack"]["count"] == 3
+        assert "serve.scatter" not in s  # nothing completed yet
+        scorer.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        s = tracer.summary()
+        assert s["serve.dispatch"]["count"] == 3
+        assert s["serve.scatter"]["count"] == 3
+    finally:
+        scorer.gate.set()
+        b.close()
+        obs_trace.uninstall()
 
 
 def test_batcher_propagates_scorer_errors_and_close_rejects():
@@ -533,6 +618,60 @@ def test_hot_reload_swaps_to_new_artifact(server, export_dir):
     assert server.metrics.counters()["reloads_total"] == 1
 
 
+def test_warm_up_pins_trace_count_across_start_and_reload(server,
+                                                          export_dir):
+    """The pre-warm contract: after server start AND after a hot-reload
+    admit, scoring across EVERY ladder bucket triggers zero new traces —
+    the compile cliffs are paid off-request, before the model serves."""
+    buckets = ladder(server.config.max_queue_rows)
+    m0 = server.store.current().model
+    assert m0.native_trace_count == len(buckets)  # warmed at start
+    for n in (1, 9, 17, 33, 65, 129):  # one request per ladder bucket
+        status, _, _ = _post(server.port, {"rows": _rows(n, seed=n).tolist()})
+        assert status == 200
+    assert m0.native_trace_count == len(buckets), \
+        "a /score paid a compile the warm-up should have pre-paid"
+
+    # hot reload: the NEW model must be warmed BEFORE the swap
+    _export(export_dir, seed=5)
+    deadline = time.time() + 10.0
+    while server.store.current().epoch == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    m1 = server.store.current()
+    assert m1.epoch == 1
+    assert m1.model.native_trace_count == len(buckets)
+    for n in (1, 9, 17, 33, 65, 129):
+        status, _, _ = _post(server.port, {"rows": _rows(n, seed=n).tolist()})
+        assert status == 200
+    assert m1.model.native_trace_count == len(buckets)
+
+
+def test_corrupt_reload_keeps_warmed_model_without_recompile(server,
+                                                             export_dir):
+    """A refused (corrupt) reload must leave the OLD pre-warmed model
+    serving bit-identically with zero re-compiles — the refusal path
+    never touches the live model's compiled programs."""
+    x = _rows(8, seed=2)
+    _, _, v1 = _post(server.port, {"rows": x.tolist()})
+    m0 = server.store.current().model
+    traces_before = m0.native_trace_count
+    fails_before = server.metrics.counters()["reload_failures_total"]
+    faults.set_plan(
+        faults.FaultPlan.parse("export.at-rest:bitflip@1", seed=7)
+    )
+    _export(export_dir, seed=123)
+    faults.set_plan(None)
+    deadline = time.time() + 10.0
+    while (server.metrics.counters()["reload_failures_total"] == fails_before
+           and time.time() < deadline):
+        time.sleep(0.05)
+    assert server.metrics.counters()["reload_failures_total"] > fails_before
+    assert server.store.current().model is m0  # same warmed instance
+    status, _, mid = _post(server.port, {"rows": x.tolist()})
+    assert status == 200 and mid["scores"] == v1["scores"]
+    assert m0.native_trace_count == traces_before
+
+
 def test_chaos_drill_corrupt_reload_never_served(server, export_dir):
     """The acceptance-criteria drill: STPU_FAULT_PLAN at-rest corruption
     of a mid-reload artifact — the server keeps serving the previous
@@ -610,8 +749,10 @@ def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
                         (status, time.monotonic() - t0, headers)
                     )
 
+        # in-flight demand must exceed queue bound PLUS the three-batch
+        # pipeline depth (16 + 3x8 = 40 rows) or nothing ever sheds
         threads = [threading.Thread(target=client, args=(i,))
-                   for i in range(12)]
+                   for i in range(16)]
         for t in threads:
             t.start()
         for t in threads:
@@ -621,7 +762,8 @@ def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
         assert served, "nothing served under overload"
         assert shed, "overload never shed — queue must be bounded"
         for _, _, headers in shed:
-            assert headers.get("Retry-After") == "2"
+            # jittered around the configured mean of 2 s: [1, 3]
+            assert 1 <= int(headers.get("Retry-After")) <= 3
         # bounded latency for the served fraction: worst case is the full
         # queue ahead (16 rows / 8 per dispatch) at the slowed dispatch
         # cost plus jit/HTTP overhead — far under the seconds an
@@ -663,6 +805,120 @@ def test_serve_cli_smoke(export_dir, tmp_path):
         summary = json.loads(out.decode().strip().splitlines()[-1])
         assert summary["state"] == "stopped"
         assert summary["requests_total"] == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+def test_multiworker_chaos_drill_serves_warmed_model_bit_identically(
+    export_dir, tmp_path
+):
+    """The acceptance drill at scale-out: --serve-workers 2 share one
+    SO_REUSEPORT port; a hot reload under STPU_FAULT_PLAN at-rest
+    corruption is refused by BOTH scoring processes, which keep serving
+    the previous verified, pre-warmed model bit-identically; a good
+    artifact recovers both; SIGTERM drains the whole process group
+    cleanly with per-worker journals."""
+    import signal
+    import subprocess
+    import sys
+
+    journal = str(tmp_path / "serve.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    x = _rows(16, seed=3)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shifu_tensorflow_tpu.serve",
+         "--model-dir", export_dir, "--port", "0", "--serve-workers", "2",
+         "--reload-poll-ms", "200", "--obs-journal", journal],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        ready = json.loads(proc.stdout.readline().decode())
+        assert ready["state"] == "listening" and ready["workers"] == 2
+        port = ready["port"]
+
+        def metrics_by_worker() -> dict[int, dict]:
+            """Scrape until every worker index has answered (the kernel
+            routes each connection to an arbitrary listener)."""
+            seen: dict[int, dict] = {}
+            deadline = time.time() + 30.0
+            while len(seen) < 2 and time.time() < deadline:
+                _, text = _get(port, "/metrics")
+                fields = dict(
+                    line.rsplit(" ", 1)
+                    for line in text.splitlines()
+                    if line and not line.startswith("#")
+                    and " " in line
+                )
+                idx = int(float(fields.get("stpu_serve_worker_index", -1)))
+                if idx >= 0:
+                    seen[idx] = fields
+            return seen
+
+        assert set(metrics_by_worker()) == {0, 1}
+        _, _, v1 = _post(port, {"rows": x.tolist()})
+        assert v1["model_epoch"] == 0
+
+        # corrupt artifact lands (payload mutated AFTER the manifest
+        # digest, the at-rest signature) — both workers must refuse it
+        faults.set_plan(
+            faults.FaultPlan.parse("export.at-rest:bitflip@1", seed=11)
+        )
+        _export(export_dir, seed=99)
+        faults.set_plan(None)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            by_worker = metrics_by_worker()
+            if len(by_worker) == 2 and all(
+                float(m.get("stpu_serve_reload_failures_total", 0)) >= 1
+                for m in by_worker.values()
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "both workers never refused the corrupt artifact"
+            )
+        # every score — whichever worker the kernel picks — is the OLD
+        # verified model, bit-for-bit
+        for _ in range(8):
+            status, _, mid = _post(port, {"rows": x.tolist()})
+            assert status == 200
+            assert mid["model_epoch"] == 0
+            assert mid["scores"] == v1["scores"]
+
+        # recovery: a good artifact admits on both workers
+        _export(export_dir, seed=99)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            _, _, now = _post(port, {"rows": x.tolist()})
+            if now["model_epoch"] == 1:
+                break
+            time.sleep(0.1)
+        assert now["model_epoch"] == 1 and now["scores"] != v1["scores"]
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60.0)
+        assert proc.returncode == 0, err.decode()[-2000:]
+        summary = json.loads(out.decode().strip().splitlines()[-1])
+        assert summary["state"] == "stopped" and summary["workers"] == 2
+        assert summary["requests_total"] >= 9
+        # per-worker journal siblings carry the refusal + lifecycle
+        from shifu_tensorflow_tpu.obs.journal import (
+            journal_files,
+            read_events,
+        )
+
+        names = {os.path.basename(p) for p in journal_files(journal)}
+        assert {"serve.jsonl", "serve.jsonl.s0", "serve.jsonl.s1"} <= names
+        events = read_events(journal)
+        refused_by = {e.get("worker") for e in events
+                      if e["event"] == "reload_refused"}
+        assert refused_by == {0, 1}
+        assert {e.get("worker") for e in events
+                if e["event"] == "serve_start"} == {0, 1}
     finally:
         if proc.poll() is None:
             proc.kill()

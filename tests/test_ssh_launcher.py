@@ -26,6 +26,7 @@ from shifu_tensorflow_tpu.coordinator.submitter import JobSubmitter
 from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
 from shifu_tensorflow_tpu.data.reader import RecordSchema
 from shifu_tensorflow_tpu.data.splitter import split_training_data
+from jaxcaps import needs_nonloopback_spmd
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER_ENV = {
@@ -64,6 +65,7 @@ def fake_ssh(tmp_path):
     return str(path)
 
 
+@needs_nonloopback_spmd
 def test_ssh_launcher_spmd_on_nonloopback_interface(
     psv_dataset, tmp_path, fake_ssh
 ):
@@ -121,6 +123,7 @@ def test_ssh_launcher_spmd_on_nonloopback_interface(
     assert len(result.epoch_summaries) == 2
 
 
+@needs_nonloopback_spmd
 def test_ssh_launcher_remote_kill_uses_run_tag(
     psv_dataset, tmp_path, fake_ssh
 ):
