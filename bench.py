@@ -706,6 +706,18 @@ def _attempt(env_overrides: dict, timeout_s: float,
 
 
 def main() -> None:
+    if "ingest" in sys.argv[1:]:
+        # staged-ingest pipeline benchmark (python bench.py ingest):
+        # cold parallel-reader scaling, traced dispatch occupancy, and
+        # autotune-vs-grid, artifact BENCH_INGEST_PIPELINE.json —
+        # implemented in scripts/bench_ingest_pipeline.py.  In-process
+        # on the CPU backend (host ingest is the quantity under test),
+        # so the parent's no-jax rule does not apply to this mode.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_ingest_pipeline
+
+        sys.exit(bench_ingest_pipeline.main())
     if "obs" in sys.argv[1:]:
         # observability-overhead benchmark (python bench.py obs):
         # obs-enabled vs disabled step time on the per-step epoch path,

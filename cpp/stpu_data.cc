@@ -17,13 +17,29 @@
 #include <algorithm>
 #include <cctype>
 #include <charconv>
+#include <cerrno>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
 #include <zlib.h>
+
+// Floating-point std::from_chars needs libstdc++ >= 11 / libc++ >= 14;
+// __cpp_lib_to_chars is only defined where the FP overloads exist.  On
+// older toolchains (this includes GCC 10, still common on LTS images)
+// parse_cell falls back to strtod pinned to the C locale — without the
+// pin, a comma-decimal locale would stop strtod at '.' and silently drop
+// every fractional row that the Python fallback keeps.
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+#define STPU_HAVE_FP_FROM_CHARS 1
+#else
+#define STPU_HAVE_FP_FROM_CHARS 0
+#include <locale.h>
+#endif
 
 namespace {
 
@@ -44,7 +60,7 @@ inline bool ieq(const char* p, const char* end, const char* lower) {
 // out-of-range: returns true when the value's magnitude is huge.  Computes
 // the decimal exponent of the first significant digit; out-of-range doubles
 // sit at |exp| ≥ ~300, so the sign is unambiguous.
-inline bool decimal_is_huge(const char* p, const char* end) {
+[[maybe_unused]] inline bool decimal_is_huge(const char* p, const char* end) {
   constexpr long kCap = 1000000000;
   long exp = 0;
   const char* mant_end = end;
@@ -126,12 +142,13 @@ inline bool parse_cell(const char* p, const char* end, float* out) {
         return true;
       }
     }
-    // digits-only path: from_chars never sees a sign or inf/nan spellings.
-    // Parse as double then narrow — the Python path is float() (a double)
-    // followed by a float32 cast, so parsing straight to float would both
-    // double-round differently and reject float32-range overflows
-    // ('4e38') the Python path keeps as ±inf.
+    // digits-only path: the slow parser never sees a sign or inf/nan
+    // spellings.  Parse as double then narrow — the Python path is
+    // float() (a double) followed by a float32 cast, so parsing straight
+    // to float would both double-round differently and reject
+    // float32-range overflows ('4e38') the Python path keeps as ±inf.
     double d;
+#if STPU_HAVE_FP_FROM_CHARS
     auto res = std::from_chars(p, end, d);
     if (res.ptr != end) return false;
     if (res.ec == std::errc::result_out_of_range) {
@@ -140,6 +157,52 @@ inline bool parse_cell(const char* p, const char* end, float* out) {
     } else if (res.ec != std::errc()) {
       return false;
     }
+#else
+    // strtod fallback.  It accepts spellings from_chars rejects (hex
+    // floats, leading "inf"), so the exact cell grammar is enforced by
+    // hand first: (\d+\.?\d*|\.\d+)(e[+-]?\d+)? over the full range.
+    {
+      const char* q = p;
+      bool seen_digit = false, seen_point = false;
+      for (; q < end; ++q) {
+        if (*q >= '0' && *q <= '9') {
+          seen_digit = true;
+        } else if (*q == '.' && !seen_point) {
+          seen_point = true;
+        } else {
+          break;
+        }
+      }
+      if (!seen_digit) return false;
+      if (q < end && (*q | 0x20) == 'e') {
+        ++q;
+        if (q < end && (*q == '+' || *q == '-')) ++q;
+        if (q >= end) return false;  // bare exponent marker
+        for (; q < end; ++q)
+          if (*q < '0' || *q > '9') break;
+      }
+      if (q != end) return false;
+      char stack_buf[64];
+      std::string heap_buf;
+      const size_t len = static_cast<size_t>(end - p);
+      const char* cstr;
+      if (len < sizeof(stack_buf)) {
+        std::memcpy(stack_buf, p, len);
+        stack_buf[len] = '\0';
+        cstr = stack_buf;
+      } else {
+        heap_buf.assign(p, end);
+        cstr = heap_buf.c_str();
+      }
+      static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+      char* endp = nullptr;
+      errno = 0;
+      d = c_loc ? strtod_l(cstr, &endp, c_loc) : strtod(cstr, &endp);
+      if (endp != cstr + len) return false;
+      // ERANGE parity falls out of strtod itself: overflow returns
+      // ±HUGE_VAL (→ float ±inf), underflow returns a denormal or 0
+    }
+#endif
     *out = static_cast<float>(d);
     if (neg) *out = -*out;
     return true;

@@ -151,6 +151,31 @@ CACHE_DIR = TPU_PREFIX + "cache-dir"
 CACHE_MAX_BYTES = TPU_PREFIX + "cache-max-bytes"
 DEFAULT_CACHE_MAX_BYTES = 0
 
+# ---- streaming-ingest pipeline (data/pipeline.py + data/autotune.py) ----
+# Stage widths for the staged pull pipeline behind --stream.  0 = auto:
+# the autotuner (on by default) sizes the dimension from live stage span
+# ratios between epochs (tf.data-style; docs/ingest.md).  An EXPLICIT
+# value both sets the dimension and PINS it — the operator's number wins
+# and the tuner stops adjusting that dimension (the others keep adapting).
+# Batch order is reproducible at ANY width (ordered sequencer), so these
+# are pure throughput knobs.
+DATA_READERS = TPU_PREFIX + "data-readers"  # parallel shard readers
+DEFAULT_DATA_READERS = 0
+DATA_DECODE_WORKERS = TPU_PREFIX + "data-decode-workers"  # parse/cast pool
+DEFAULT_DATA_DECODE_WORKERS = 0
+# device-put pipeline depth (batches placed ahead of dispatch); 0 = auto
+# (starts from shifu.tpu.prefetch-depth, then autotuned)
+DATA_PREFETCH = TPU_PREFIX + "data-prefetch"
+DEFAULT_DATA_PREFETCH = 0
+DATA_AUTOTUNE = TPU_PREFIX + "data-autotune"
+DEFAULT_DATA_AUTOTUNE = True
+# seeded shuffle-buffer stage: window of rows permuted per seeded RNG
+# before batching (0 = off).  Deterministic for a fixed seed regardless
+# of reader/decode width — the streaming analogue of the in-memory
+# loader's per-epoch shuffle.
+DATA_SHUFFLE_ROWS = TPU_PREFIX + "data-shuffle-rows"
+DEFAULT_DATA_SHUFFLE_ROWS = 0
+
 # flat-file (npz) checkpointing with sidecar-manifest verification for
 # NON-SPMD workers too (SPMD always uses it — orbax's collective
 # barriers deadlock under chief-writes/everyone-reads)

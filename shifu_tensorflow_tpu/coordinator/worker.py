@@ -74,7 +74,16 @@ class WorkerConfig:
     host: str = "127.0.0.1"  # this worker's address for peers
     # streaming input (1B-row path): stream the shard instead of loading it
     stream: bool = False
+    # staged-ingest knobs (shifu.tpu.data-* keys; data/pipeline.py):
+    # None/0 = auto — the per-worker autotuner sizes the dimension
+    # between epochs; an explicit value pins it (data/autotune.py)
     n_readers: int | None = None
+    decode_workers: int | None = None
+    data_prefetch: int | None = None
+    data_autotune: bool = True
+    # seeded shuffle-buffer window in rows (0 = off); deterministic per
+    # (seed, epoch) at any reader/decode width
+    data_shuffle_rows: int = 0
     # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
     prefetch_depth: int = 2
     # batches per lax.scan dispatch (conf key shifu.tpu.scan-steps)
@@ -124,7 +133,9 @@ class WorkerConfig:
                 "worker_index", "batch_size", "checkpoint_dir",
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
-                "spmd", "host", "stream", "n_readers", "prefetch_depth",
+                "spmd", "host", "stream", "n_readers", "decode_workers",
+                "data_prefetch", "data_autotune", "data_shuffle_rows",
+                "prefetch_depth",
                 "scan_steps", "accum_steps", "keep_best",
                 "async_checkpoint", "flat_checkpoint", "cache_dir",
                 "stream_feature_dtype",
@@ -579,18 +590,23 @@ def _run_local_training(
 
     if cfg.stream:
         batch_size = trainer.align_batch_size(cfg.batch_size)
+        widths, stats_sink = _ingest_setup(cfg, trainer)
         trainer.fit_stream(
             lambda epoch: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="train", salt=cfg.seed,
-                n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
                 feature_dtype=_feature_dtype_for(cfg),
+                shuffle_rows=cfg.data_shuffle_rows,
+                shuffle_seed=cfg.seed + epoch,
+                stats_sink=stats_sink, **widths(),
             ),
             (lambda: ShardStream(
                 shard_paths, cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="valid", salt=cfg.seed,
-                n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                cache_dir=cfg.cache_dir,
                 feature_dtype=_feature_dtype_for(cfg),
+                **widths(),
             )) if valid_rate > 0 else None,
             epochs=epochs,
             on_epoch=on_epoch,
@@ -618,6 +634,19 @@ def _run_local_training(
         # the checkpoint missing
         save_ckpt.wait()
     return 0
+
+
+def _ingest_setup(cfg, trainer):
+    """Resolve this worker's staged-ingest knobs (shifu.tpu.data-*) and
+    install the per-worker autotuner on its trainer — the shared wiring
+    helper (data/autotune.install_ingest_autotuner) run_single uses too,
+    so fleet and single-process paths cannot drift."""
+    from shifu_tensorflow_tpu.data.autotune import install_ingest_autotuner
+
+    return install_ingest_autotuner(
+        trainer, cfg.n_readers, cfg.decode_workers, cfg.data_prefetch,
+        autotune=cfg.data_autotune, fallback_prefetch=cfg.prefetch_depth,
+    )
 
 
 def _np_feature_dtype(cfg):
@@ -740,14 +769,18 @@ def _run_spmd_training(
 
     if cfg.stream:
         x_dtype = _np_feature_dtype(cfg)
+        widths, stats_sink = _ingest_setup(cfg, trainer)
 
         def make_train(epoch: int):
             return fixed_step_batches(
                 ShardStream(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="train", salt=cfg.seed,
-                    n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                    cache_dir=cfg.cache_dir,
                     feature_dtype=_feature_dtype_for(cfg),
+                    shuffle_rows=cfg.data_shuffle_rows,
+                    shuffle_seed=cfg.seed + epoch,
+                    stats_sink=stats_sink, **widths(),
                 ),
                 local_batch, train_steps, num_features,
                 on_dropped=_warn_dropped, x_dtype=x_dtype,
@@ -758,8 +791,9 @@ def _run_spmd_training(
                 ShardStream(
                     shard_paths, cfg.schema, local_batch,
                     valid_rate=valid_rate, emit="valid", salt=cfg.seed,
-                    n_readers=cfg.n_readers, cache_dir=cfg.cache_dir,
+                    cache_dir=cfg.cache_dir,
                     feature_dtype=_feature_dtype_for(cfg),
+                    **widths(),
                 ),
                 local_batch, valid_steps, num_features, x_dtype=x_dtype,
             )

@@ -1,0 +1,298 @@
+"""Obs-driven ingest autotuner — sizes the pipeline from live span ratios.
+
+The tf.data paper's core result (PAPERS.md) is that hand-set
+parallelism/prefetch knobs lose to a feedback loop reading the pipeline's
+own timing.  This is that loop for the staged ingest pipeline
+(data/pipeline.py): after every epoch it reads the stage accounting the
+stream collected (``StageStats`` — reader busy, decode busy, consumer
+starvation) plus, when the obs plane is tracing, the step-phase summary
+from the installed tracer (``step.infeed.wait`` / ``step.dispatch``), and
+adjusts ONE knob for the next epoch:
+
+- **starved** (consumer waited on ingest for more than ``starve_hi`` of
+  the epoch): widen the binding stage — the decode pool when its busy
+  fraction dominates (host-bound: parse/finalize is the constraint), else
+  the readers (infeed-bound: IO/inflate is the constraint).  When both
+  stages look idle yet the consumer still stalls, the gap is placement
+  burstiness — deepen prefetch.
+- **balanced** (starvation under ``starve_lo``): converged; stop.  No
+  oscillation by construction: one dimension moves per epoch, growth is
+  +1 step bounded by per-dimension caps.
+- **regret rollback**: a widening must pay for itself in measured epoch
+  throughput (rows/s from the stream's own accounting).  If the next
+  epoch is not faster than the pre-widening epoch by ``IMPROVE_EPS``,
+  the knob reverts and the dimension retires — on a host whose cores
+  are already saturated, blindly widening walks PAST the optimum into
+  oversubscription (more threads than deliverable cores = scheduler
+  thrash, measured slower), which is exactly the hand-tuning failure
+  the tf.data feedback loop exists to avoid.  A retired dimension is
+  re-eligible only if starvation later rises above ``starve_hi`` again
+  with every other dimension also blocked (host conditions changed).
+  The check is SKIPPED (knob kept, no strike) when the cache-served
+  fraction shifted by more than 25 points between the two epochs — a
+  cold→warm (or eviction) transition moves rows/s severalfold on its
+  own, and the verdict would measure cache state, not the knob.
+
+Explicit knobs pin their dimension: a CLI/conf-set value is an operator
+statement the tuner must not override (``shifu.tpu.data-*`` keys,
+docs/ingest.md).  The decision log (``history``) rides into the obs
+journal via the trainer's epoch events so a tuned run is auditable.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+from typing import Iterable
+
+from shifu_tensorflow_tpu.data.pipeline import IngestKnobs, StageStats
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("ingest.autotune")
+
+#: consumer-starvation fraction above which the tuner acts
+STARVE_HI = 0.10
+#: ... and below which the pipeline counts as balanced (converged)
+STARVE_LO = 0.05
+#: a stage whose busy fraction exceeds this is the binding constraint
+BUSY_HI = 0.60
+#: minimum epoch-rate improvement for a widening to stick; below it the
+#: knob reverts (rate noise on a shared host argues for a SMALL positive
+#: margin: a false revert keeps a config measured no worse, a false keep
+#: leaves one extra thread — both cheap)
+IMPROVE_EPS = 0.02
+
+
+class IngestAutotuner:
+    """Per-trainer feedback controller over (readers, decode_workers,
+    prefetch).  Thread-compatible with the trainer's single-threaded epoch
+    loop — ``settings()`` at stream build, ``note_stats()`` from the
+    stream's close, ``observe_epoch()`` between epochs."""
+
+    def __init__(
+        self,
+        initial: IngestKnobs,
+        *,
+        pinned: Iterable[str] = (),
+        max_readers: int | None = None,
+        max_decode: int | None = None,
+        max_prefetch: int = 8,
+        cpu_count: int | None = None,
+    ):
+        cpus = cpu_count if cpu_count is not None else (os.cpu_count() or 1)
+        self.knobs = initial
+        self.pinned = frozenset(pinned)
+        # readers beyond ~2x cores only help when reads block on remote
+        # IO; decode is pure CPU so its cap is the core count
+        self.max_readers = max_readers or max(4, 2 * cpus)
+        self.max_decode = max_decode or max(1, cpus)
+        self.max_prefetch = max_prefetch
+        self.converged = False
+        self.history: list[dict] = []
+        self._last_stats: StageStats | None = None
+        #: (dimension, knobs-before-widen, rate-before-widen,
+        #: cache-fraction-before-widen) awaiting the regret check
+        #: against the NEXT epoch's measured rate
+        self._pending: "tuple[str, IngestKnobs, float, float] | None" = None
+        #: dimensions retired by a failed widening (regret rollback) and
+        #: the per-dimension failure count.  A once-failed dimension may
+        #: be re-probed a single time (host conditions can change
+        #: mid-job); a second failure retires it for good — the
+        #: widen/revert cycle is bounded, never a thrash loop.
+        self._retired: set[str] = set()
+        self._reverts: dict[str, int] = {}
+
+    # ---- inputs ----
+    def settings(self) -> IngestKnobs:
+        return self.knobs
+
+    def note_stats(self, stats: StageStats) -> None:
+        """Stats sink for the TRAIN stream (ShardStream ``stats_sink``)."""
+        self._last_stats = stats
+
+    # ---- the policy ----
+    def observe_epoch(self, step_summary: dict | None = None) -> IngestKnobs:
+        """Digest the finished epoch; returns the knobs for the next one.
+
+        ``step_summary`` is the installed tracer's span summary (may be
+        None when obs is off — the pipeline's own StageStats carry the
+        primary signal either way)."""
+        stats = self._last_stats
+        self._last_stats = None
+        if stats is None or stats.wall_s <= 0.0:
+            return self.knobs
+        frac = stats.busy_fractions()
+        starve = frac["wait_frac"]
+        # prefer the tracer's consumer-side infeed wait when present: it
+        # measures the stall where it hurts (the training loop), while
+        # the pipeline's wait_s is measured at the sequencer — upstream
+        # of the put stage
+        if step_summary:
+            w = step_summary.get("step.infeed.wait")
+            epoch_wall = stats.wall_s
+            if w and epoch_wall > 0:
+                # step.* spans measure 1/sampled_every of the real events
+                # (obs-trace-sample) — scale back to an absolute total
+                # before dividing by the (unsampled) wall clock, exactly
+                # as budget_fields does, or sampling would understate
+                # starvation by the sample factor
+                wait_total = w["total_s"] * w.get("sampled_every", 1)
+                starve = max(starve, min(1.0, wait_total / epoch_wall))
+
+        rate = stats.rows / stats.wall_s
+        cache_frac = stats.cache_chunks / max(1, stats.chunks)
+        decision = {"starve": round(starve, 4),
+                    "read_busy": round(frac["read_busy"], 4),
+                    "decode_busy": round(frac["decode_busy"], 4),
+                    "rows_per_s": round(rate, 0),
+                    "cache_frac": round(cache_frac, 2),
+                    "knobs": (self.knobs.readers, self.knobs.decode_workers,
+                              self.knobs.prefetch)}
+        # regret check first: the previous epoch's widening must have paid
+        # for itself in measured throughput, or the knob reverts and the
+        # dimension retires (oversubscription measures SLOWER, not just
+        # flat — walking past the optimum is the failure mode here)
+        if self._pending is not None:
+            dim, prev_knobs, prev_rate, prev_cache = self._pending
+            self._pending = None
+            if abs(cache_frac - prev_cache) > 0.25:
+                # the source changed under the comparison: a cold->warm
+                # transition (first epoch parses text, second streams
+                # memmap'd cache blocks severalfold faster) or a mid-job
+                # eviction in the other direction moves rows/s far more
+                # than any one-step widening — the verdict would reflect
+                # cache state, not the knob.  Keep the knob provisionally
+                # and spend no revert strike; the normal policy below
+                # re-evaluates from this epoch's (same-source) baseline.
+                decision["action"] = f"regret-skip-{dim}"
+                self.history.append(decision)
+                return self.knobs
+            if prev_rate > 0 and rate < prev_rate * (1.0 + IMPROVE_EPS):
+                self.knobs = prev_knobs
+                self._retired.add(dim)
+                self._reverts[dim] = self._reverts.get(dim, 0) + 1
+                decision["action"] = f"revert-{dim}"
+                self.history.append(decision)
+                log.info("ingest autotune: revert %s (%.0f -> %.0f "
+                         "rows/s, below +%.0f%%)", dim, prev_rate, rate,
+                         100 * IMPROVE_EPS)
+                return self.knobs
+        if starve < STARVE_LO:
+            self.converged = True
+            decision["action"] = "balanced"
+        elif starve < STARVE_HI:
+            # the dead band holds UNCONDITIONALLY, converged or not:
+            # widening on noise-level starvation can't earn its 2% regret
+            # margin, and the failed attempt would burn one of the
+            # dimension's two revert strikes — permanently retiring it
+            # before the job ever becomes genuinely starved
+            decision["action"] = "hold"
+        else:
+            self.converged = False
+            decision["action"] = self._widen(frac, rate, cache_frac)
+        self.history.append(decision)
+        if decision["action"] not in ("balanced", "hold", "pinned"):
+            log.info("ingest autotune: %s -> readers=%d decode=%d "
+                     "prefetch=%d (starve=%.0f%%)", decision["action"],
+                     self.knobs.readers, self.knobs.decode_workers,
+                     self.knobs.prefetch, 100 * starve)
+        return self.knobs
+
+    def _widen(self, frac: dict[str, float], rate: float,
+               cache_frac: float) -> str:
+        k = self.knobs
+        blocked = self.pinned | self._retired
+        decode_bound = (frac["decode_busy"] >= frac["read_busy"]
+                        and frac["decode_busy"] > BUSY_HI)
+        read_bound = frac["read_busy"] > BUSY_HI
+        if decode_bound and "decode_workers" not in blocked \
+                and k.decode_workers < self.max_decode:
+            self.knobs = replace(k, decode_workers=k.decode_workers + 1)
+            self._pending = ("decode_workers", k, rate, cache_frac)
+            return "widen-decode"
+        if read_bound and "readers" not in blocked \
+                and k.readers < self.max_readers:
+            self.knobs = replace(k, readers=k.readers + 1)
+            self._pending = ("readers", k, rate, cache_frac)
+            return "widen-readers"
+        # neither stage saturated (or both pinned/capped) yet the consumer
+        # starves: the batches exist but arrive bursty — deepen the device
+        # put pipeline
+        if "prefetch" not in blocked and k.prefetch < self.max_prefetch:
+            self.knobs = replace(k, prefetch=k.prefetch + 1)
+            self._pending = ("prefetch", k, rate, cache_frac)
+            return "deepen-prefetch"
+        # starved with every dimension pinned, retired, or at cap.  A
+        # once-failed dimension gets one re-probe (host conditions change
+        # mid-job); a twice-failed dimension stays retired — the cycle is
+        # bounded.  The actual widen happens next starved epoch with the
+        # retirement lifted; this epoch just lifts it.
+        retryable = {d for d in self._retired
+                     if self._reverts.get(d, 0) < 2 and d not in self.pinned}
+        if retryable:
+            self._retired -= retryable
+            return "reprobe"
+        return "pinned"
+
+
+def resolve_ingest_knobs(
+    readers: int | None,
+    decode_workers: int | None,
+    prefetch: int | None,
+    *,
+    autotune: bool = True,
+    fallback_prefetch: int = 2,
+    cpu_count: int | None = None,
+) -> tuple[IngestKnobs, "IngestAutotuner | None"]:
+    """Turn resolved knob values (None/0 = auto) into (initial knobs,
+    autotuner-or-None).  An explicitly set knob both seeds its dimension
+    and PINS it — the operator's value wins over the tuner for that
+    dimension while the others keep adapting; with autotune off the
+    initial knobs are simply final."""
+    from shifu_tensorflow_tpu.data.pipeline import default_knobs
+
+    auto = default_knobs(cpu_count)
+    pinned = set()
+    r = auto.readers
+    if readers:
+        r = int(readers)
+        pinned.add("readers")
+    d = auto.decode_workers
+    if decode_workers:
+        d = int(decode_workers)
+        pinned.add("decode_workers")
+    p = fallback_prefetch
+    if prefetch:
+        p = int(prefetch)
+        pinned.add("prefetch")
+    knobs = IngestKnobs(readers=max(1, r), decode_workers=max(1, d),
+                        prefetch=max(1, p))
+    if not autotune:
+        return knobs, None
+    return knobs, IngestAutotuner(knobs, pinned=pinned,
+                                  cpu_count=cpu_count)
+
+
+def install_ingest_autotuner(trainer, readers, decode_workers, prefetch,
+                             *, autotune: bool, fallback_prefetch: int):
+    """Resolve the staged-ingest knobs, install the tuner (or None, with
+    autotune off) on ``trainer``, seed its device-put depth, and return
+    ``(widths, stats_sink)``: the per-epoch stream factories call
+    ``widths()`` for the CURRENT reader/decode widths (the tuner may have
+    resized them since last epoch), and the TRAIN stream feeds its
+    ``StageStats`` into ``stats_sink`` (None when there is no tuner).
+    The ONE wiring helper both the single-process CLI and the fleet
+    worker use, so the two paths resolve ``shifu.tpu.data-*`` the same
+    way by construction."""
+    knobs, tuner = resolve_ingest_knobs(
+        readers, decode_workers, prefetch,
+        autotune=autotune, fallback_prefetch=fallback_prefetch,
+    )
+    trainer.ingest_autotuner = tuner
+    trainer.prefetch_depth = max(1, knobs.prefetch)
+
+    def widths() -> dict:
+        k = tuner.settings() if tuner is not None else knobs
+        return {"n_readers": k.readers, "decode_workers": k.decode_workers}
+
+    return widths, (tuner.note_stats if tuner is not None else None)

@@ -9,17 +9,23 @@ TPU from the start:
   final partial batch is zero-padded with ``weight=0`` rows so the padded
   rows contribute nothing to the weighted loss and XLA sees one static
   shape (no recompilation, MXU-friendly);
-- **streaming**: ``ShardStream`` reads+parses blocks on a background thread
-  into a bounded queue, overlapping host IO/decompression with device step
-  time;
+- **streaming**: ``ShardStream`` fronts the staged pull pipeline
+  (data/pipeline.py): parallel shard readers + decode pool + ordered
+  sequencer + seeded shuffle buffer, overlapping host IO/decompression/
+  parse with device step time while keeping the batch order a pure
+  function of (paths, schema, salt) — reproducible at any parallelism;
 - **prefetch to device**: ``prefetch_to_device`` keeps ``depth`` batches
-  resident ahead of the consumer via ``jax.device_put``.
+  resident ahead of the consumer via ``jax.device_put``; ``pipelined=True``
+  moves production+placement onto a put thread so batch k+1's transfer
+  overlaps batch k's dispatch (``step.infeed.wait`` vs ``step.infeed.put``
+  spans).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator, Sequence
 
@@ -29,7 +35,6 @@ from shifu_tensorflow_tpu.data.reader import (
     ParsedBlock,
     RecordSchema,
     parse_buffer_split,
-    wanted_columns,
 )
 from shifu_tensorflow_tpu.utils import fs
 
@@ -81,10 +86,6 @@ def resolve_stream_feature_dtype(setting: str | None, *,
             "(auto | float32 | bfloat16)"
         )
     return s
-
-# reader-thread end marker: (_TAIL, leftover ParsedBlock)
-_TAIL = object()
-
 
 def make_batch(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> Batch:
     return {"x": x, "y": y, "w": w}
@@ -213,39 +214,115 @@ def fixed_step_batches(
     more than ``steps`` batches has the surplus dropped (``on_dropped``
     receives the dropped row count — callers log it; silent truncation reads
     as full coverage when it isn't).
+
+    Returns a closeable iterator that remembers ``batches`` as its ROOT:
+    ``close()`` closes the root stream FIRST (object-level, thread-safe —
+    it can unwedge a pipelined-infeed put thread blocked inside this
+    adapter's generator, whose own close() is refused while its frame is
+    live on that thread) and then the generator.
     """
+    return _RootedBatches(
+        _fixed_step_gen(batches, batch_size, steps, num_features,
+                        on_dropped=on_dropped, x_dtype=x_dtype),
+        batches,
+    )
+
+
+class _RootedBatches:
+    """A generator chain paired with the root stream object under it.
+
+    Iterating delegates to the generator.  ``close()`` goes root-first:
+    the root's object-level close is safe from any thread and releases
+    the producer machinery (ShardStream contract), after which closing
+    the generator itself (running its ``finally``) succeeds once no
+    thread is executing its frame."""
+
+    __slots__ = ("_gen", "root")
+
+    def __init__(self, gen, root):
+        self._gen = gen
+        self.root = root
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        close_stream(self.root)
+        close_stream(self._gen)
+
+
+def _fixed_step_gen(
+    batches: Iterable[Batch],
+    batch_size: int,
+    steps: int,
+    num_features: int,
+    *,
+    on_dropped: Callable[[int], None] | None = None,
+    x_dtype=np.float32,
+) -> Iterator[Batch]:
     it = iter(batches)
-    emitted = 0
-    for batch in it:
-        if emitted >= steps:
-            dropped = int(batch["x"].shape[0])
-            for extra in it:
-                dropped += int(extra["x"].shape[0])
-            if on_dropped is not None and dropped:
-                on_dropped(dropped)
-            return
-        n = batch["x"].shape[0]
-        if n != batch_size:  # pad a short (final) batch to the fixed shape
-            pad = batch_size - n
-            batch = {
-                k: np.concatenate(
-                    [np.asarray(v), np.zeros((pad,) + v.shape[1:], v.dtype)]
-                )
-                for k, v in batch.items()
-            }
-        yield batch
-        emitted += 1
-    while emitted < steps:
-        yield _zero_batch(batch_size, num_features, x_dtype)
-        emitted += 1
+    try:
+        emitted = 0
+        for batch in it:
+            if emitted >= steps:
+                dropped = int(batch["x"].shape[0])
+                for extra in it:
+                    dropped += int(extra["x"].shape[0])
+                if on_dropped is not None and dropped:
+                    on_dropped(dropped)
+                return
+            n = batch["x"].shape[0]
+            if n != batch_size:  # pad a short (final) batch to the fixed shape
+                pad = batch_size - n
+                batch = {
+                    k: np.concatenate(
+                        [np.asarray(v), np.zeros((pad,) + v.shape[1:], v.dtype)]
+                    )
+                    for k, v in batch.items()
+                }
+            yield batch
+            emitted += 1
+        while emitted < steps:
+            yield _zero_batch(batch_size, num_features, x_dtype)
+            emitted += 1
+    finally:
+        # close-through: abandoning this adapter (step cap reached, caller
+        # exception, rollback) must release the wrapped stream's producer
+        # threads — the ShardStream close() contract
+        close_stream(batches)
+
+
+def close_stream(obj) -> None:
+    """Close a batch source if it supports it (ShardStream, a generator,
+    a pipelined prefetcher); quietly ignore sources that don't.  The one
+    teardown helper every epoch path's ``finally`` uses.
+
+    A generator whose frame is LIVE on another thread (a pipelined-infeed
+    put thread blocked mid-``next()``) refuses ``close()`` with
+    ValueError("generator already executing") — swallowed here: the
+    abandonment paths close the ROOT stream too, whose stop signal is
+    what actually releases that thread, and letting the ValueError fly
+    out of an epoch ``finally`` would mask the original exception."""
+    close = getattr(obj, "close", None)
+    if callable(close):
+        try:
+            close()
+        except ValueError as e:
+            if "already executing" not in str(e):
+                raise
 
 
 class ShardStream:
-    """Background streaming reader: files → parsed blocks → fixed batches.
+    """Streaming reader: files → staged pull pipeline → fixed batches.
 
-    ``n_readers`` threads split the file list and fill one bounded queue of
-    fixed-size batches; the consumer (training loop) drains it.  Each file
-    is served from the fastest available source, in order:
+    A thin facade over ``data/pipeline.ShardPipeline`` — parallel shard
+    readers (static round-robin shard→reader assignment), a decode/cast
+    pool, an order-preserving pull sequencer, an optional seeded shuffle
+    buffer, and fixed-shape batch formation.  Each file is served from the
+    fastest available source, in order:
 
     1. **binary cache hit** (``cache_dir`` set, entry valid): finalized
        tensors are memory-mapped and batches are zero-copy views — ingest
@@ -256,14 +333,21 @@ class ShardStream:
        GIL released; a cache entry is written as a side effect when
        ``cache_dir`` is set;
     3. **byte-chunk fallback** (remote schemes / no native lib): fs-layer
-       reads + block parse, the original path.
+       reads, parsed in the decode pool.
 
-    Determinism: row→train/valid membership is per-row content hashing and
-    independent of reader count and of which source served the file; with
-    ``n_readers > 1`` the *order* in which batches arrive (and batch
-    composition at file boundaries) depends on thread interleaving, so the
-    default stays at 1 reader — fully reproducible — and parallel ingest
-    is an explicit opt-in for hosts with cores to spare.
+    Determinism: the emitted batch sequence is a pure function of
+    (path order, schema, salt, batch size, shuffle knobs) — INDEPENDENT of
+    ``n_readers``, ``decode_workers``, queue depths, and thread
+    interleaving (the sequencer merges per-reader queues in global shard
+    order).  Parallel ingest is therefore safe to enable — and to
+    autotune — without losing reproducibility; a fixed seed plus a fixed
+    shard list replays the identical epoch (tests/test_ingest.py pins
+    this across 1/2/4 readers and across chaos-drill resumes).
+
+    Lifecycle: iterating to completion releases every pipeline thread; an
+    abandoned iterator is released by ``close()`` (also available as a
+    context manager), which the trainer's epoch paths call from their
+    ``finally`` blocks.
     """
 
     def __init__(
@@ -275,12 +359,19 @@ class ShardStream:
         valid_rate: float = 0.0,
         emit: str = "train",  # which side of the split to emit
         block_bytes: int = 4 << 20,
-        queue_depth: int = 8,
+        block_rows: int = 1 << 16,
+        queue_depth: int = 4,
         drop_remainder: bool = False,
         salt: int = 0,
         n_readers: int | None = None,
         cache_dir: str | None = None,
         feature_dtype: str = "float32",
+        decode_workers: int | None = None,
+        shuffle_rows: int = 0,
+        shuffle_seed: int | None = None,
+        retry_policy=None,
+        stats_sink: "Callable | None" = None,
+        traced: bool | None = None,
     ):
         self.paths = list(paths)
         self.schema = schema
@@ -288,6 +379,9 @@ class ShardStream:
         self.valid_rate = valid_rate
         self.emit = emit
         self.block_bytes = block_bytes
+        self.block_rows = block_rows  # native fused-stream rows per chunk
+        # per-reader chunk-queue capacity: bounds read-ahead AND in-flight
+        # decodes (futures live in the queue)
         self.queue_depth = queue_depth
         self.drop_remainder = drop_remainder
         self.salt = salt
@@ -298,268 +392,138 @@ class ShardStream:
         if n_readers is None:
             n_readers = 1
         self.n_readers = max(1, min(n_readers, max(1, len(self.paths))))
+        self.decode_workers = max(1, decode_workers or 1)
+        self.shuffle_rows = max(0, int(shuffle_rows))
+        self.shuffle_seed = salt if shuffle_seed is None else int(shuffle_seed)
+        self.retry_policy = retry_policy
+        # called with the epoch's StageStats after each full iteration /
+        # close — the autotuner's feedback channel (data/autotune.py)
+        self.stats_sink = stats_sink
+        # record ingest.* spans to the installed tracer?  None = auto:
+        # train-side streams trace, valid-side streams don't — the eval
+        # pass runs untraced by discipline (trainer.evaluate), and its
+        # ingest work polluting the train epoch's journaled span budget
+        # would point the hand-tuning decision table (docs/ingest.md) at
+        # the wrong stage
+        self.traced = (emit != "valid") if traced is None else bool(traced)
+        self._live: list = []  # pipelines with threads possibly running
 
-    @staticmethod
-    def _put_or_stop(q: "queue.Queue", stop: threading.Event, item) -> bool:
-        """Bounded put that gives up when the consumer abandoned the
-        iterator; a plain q.put could block a daemon thread forever."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+    def close(self) -> None:
+        """Release every live pipeline (producer threads, decode pool,
+        uncommitted cache writers).  Idempotent; the contract every
+        consumer that may abandon the iterator mid-epoch must honor."""
+        for pipe in list(self._live):
+            pipe.close()
 
-    def _produce(
-        self,
-        files: Sequence[str],
-        q: "queue.Queue",
-        stop: threading.Event,
-    ) -> None:
-        """One reader thread: emit full batches from its file subset, then a
-        ``(_TAIL, leftover ParsedBlock)`` marker the consumer merges."""
-        carry = ParsedBlock.empty(self.schema.num_features)
-        try:
-            for path in files:
-                for block, hashes in self._file_blocks(path):
-                    carry = self._emit_blocks(
-                        q, stop, carry, self._route(block, hashes)
-                    )
-                    if stop.is_set():
-                        return
-            self._put_or_stop(q, stop, (_TAIL, carry))
-        except Exception as e:  # surface reader errors to the consumer
-            self._put_or_stop(q, stop, e)
+    def __enter__(self) -> "ShardStream":
+        return self
 
-    # ---- sources ----------------------------------------------------------
-
-    def _file_blocks(self, path: str):
-        """Yield (finalized full ParsedBlock, routing hashes|None) for one
-        shard, from cache / native stream / byte-chunk fallback."""
-        from shifu_tensorflow_tpu.data import cache as shard_cache
-        from shifu_tensorflow_tpu.data import native
-        from shifu_tensorflow_tpu.data.reader import _finalize
-
-        need_hashes = self.valid_rate > 0.0
-        if self.cache_dir is not None:
-            reader = shard_cache.lookup(self.cache_dir, path, self.schema,
-                                        self.salt, self.feature_dtype)
-            if reader is not None and (not need_hashes or reader.has_hashes):
-                yield from reader.blocks()
-                return
-
-        writer = None
-        if self.cache_dir is not None:
-            writer = shard_cache.ShardCacheWriter(
-                self.cache_dir, path, self.schema, self.salt,
-                self.feature_dtype,
-            )
-        want_hashes = need_hashes or writer is not None
-
-        gen = None
-        if "://" not in path or path.startswith("file://"):
-            gen = native.stream_blocks(
-                fs.strip_local(path), wanted_columns(self.schema),
-                self.schema.delimiter, salt=self.salt,
-                want_hashes=want_hashes,
-            )
-        try:
-            blocks = (
-                gen if gen is not None
-                else self._byte_chunk_blocks(path, want_hashes)
-            )
-            cast = self._cast_features
-            for arr, hashes in blocks:
-                block = cast(_finalize(arr, self.schema))
-                if writer is not None:
-                    writer.append(block, hashes)
-                yield block, hashes
-            if writer is not None:
-                writer.commit()
-        except BaseException:
-            if writer is not None:
-                writer.abort()
-            raise
-
-    def _byte_chunk_blocks(self, path: str, want_hashes: bool):
-        """fs-layer fallback: decompressed byte chunks cut at line
-        boundaries, parsed per chunk (native block parser when present,
-        pure Python otherwise).  Yields (wanted-matrix, hashes|None)."""
-        from shifu_tensorflow_tpu.data import native
-        from shifu_tensorflow_tpu.data.reader import parse_lines_full
-
-        wanted = wanted_columns(self.schema)
-
-        def _parse(buf: bytes):
-            parsed = native.parse_buffer(
-                buf, wanted, self.schema.delimiter,
-                salt=self.salt, want_hashes=want_hashes,
-            )
-            if parsed is None:
-                parsed = parse_lines_full(buf, self.schema, self.salt,
-                                          want_hashes)
-            return parsed
-
-        tail = b""
-        with fs.open_maybe_gzip(path) as f:
-            while True:
-                chunk = f.read(self.block_bytes)
-                if not chunk:
-                    break
-                data = tail + chunk
-                cut = data.rfind(b"\n")
-                if cut < 0:
-                    tail = data
-                    continue
-                tail = data[cut + 1 :]
-                yield _parse(data[: cut + 1])
-        if tail:
-            yield _parse(tail)
-
-    def _cast_features(self, block: ParsedBlock) -> ParsedBlock:
-        """Cast parsed float32 features to the emission dtype (no-op for
-        float32); cold parse and warm cache then serve identical values."""
-        if self.feature_dtype == "float32":
-            return block
-        from shifu_tensorflow_tpu.data.cache import _feature_dtype
-
-        return ParsedBlock(
-            block.features.astype(_feature_dtype(self.feature_dtype)),
-            block.targets, block.weights,
-        )
-
-    # ---- routing + batch emission -----------------------------------------
-
-    def _route(self, block: ParsedBlock, hashes) -> ParsedBlock:
-        """Select this stream's side of the train/valid split."""
-        if self.valid_rate <= 0.0:
-            if self.emit == "train":
-                return block
-            return ParsedBlock.empty(self.schema.num_features)
-        if hashes is None:
-            raise ValueError("valid_rate > 0 requires routing hashes")
-        from shifu_tensorflow_tpu.data.reader import route_is_valid
-
-        is_valid = route_is_valid(hashes, self.valid_rate)
-        keep = is_valid if self.emit == "valid" else ~is_valid
-        if keep.all():
-            return block
-        return ParsedBlock(
-            block.features[keep], block.targets[keep], block.weights[keep]
-        )
-
-    def _emit_blocks(self, q, stop, carry: ParsedBlock,
-                     block: ParsedBlock) -> ParsedBlock:
-        """Emit fixed-size batches; full batches inside ``block`` are pure
-        slices (views — zero copy on the memmap'd cache path); only the
-        carry top-up at block boundaries copies rows."""
-        B = self.batch_size
-        i = 0
-        if len(carry):
-            take = min(B - len(carry), len(block))
-            if take:
-                carry = ParsedBlock.concat([
-                    carry,
-                    ParsedBlock(block.features[:take], block.targets[:take],
-                                block.weights[:take]),
-                ])
-                i = take
-            if len(carry) < B:
-                return carry
-            if not self._put_or_stop(
-                q, stop,
-                make_batch(carry.features, carry.targets, carry.weights),
-            ):
-                return ParsedBlock.empty(self.schema.num_features)
-            carry = ParsedBlock.empty(self.schema.num_features)
-        n_full = i + ((len(block) - i) // B) * B
-        for j in range(i, n_full, B):
-            sl = slice(j, j + B)
-            if not self._put_or_stop(
-                q, stop,
-                make_batch(block.features[sl], block.targets[sl],
-                           block.weights[sl]),
-            ):
-                return carry
-        return ParsedBlock(
-            block.features[n_full:], block.targets[n_full:],
-            block.weights[n_full:],
-        )
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __iter__(self) -> Iterator[Batch]:
-        q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
-        stop = threading.Event()
-        if self.n_readers == 1:
-            buckets = [self.paths]
-        else:
-            # size-aware assignment (greedy LPT): one huge file must not
-            # leave the other readers idle for most of the epoch
-            from shifu_tensorflow_tpu.data.splitter import split_size_aware
+        from shifu_tensorflow_tpu.data.pipeline import (
+            ShardPipeline,
+            StageStats,
+            blocks_to_batches,
+            route_blocks,
+            shuffled_blocks,
+        )
 
-            buckets = [
-                list(s.paths)
-                for s in split_size_aware(self.paths, self.n_readers)
-            ]
-        threads = [
-            threading.Thread(
-                target=self._produce, args=(files, q, stop), daemon=True
-            )
-            for files in buckets
-            if files
-        ]
-        for t in threads:
-            t.start()
-        tails: list[ParsedBlock] = []
-        done = 0
+        from shifu_tensorflow_tpu.obs import trace as obs_trace
+
+        stats = StageStats()
+        tracer = obs_trace.active() if self.traced else None
+        pipe = ShardPipeline(
+            self.paths, self.schema,
+            salt=self.salt,
+            n_readers=self.n_readers,
+            decode_workers=self.decode_workers,
+            queue_depth=self.queue_depth,
+            block_bytes=self.block_bytes,
+            block_rows=self.block_rows,
+            cache_dir=self.cache_dir,
+            feature_dtype=self.feature_dtype,
+            need_hashes=self.valid_rate > 0.0,
+            retry_policy=self.retry_policy,
+            stats=stats,
+            tracer=tracer,
+        )
+        self._live.append(pipe)
         try:
-            while done < len(threads):
-                item = q.get()
-                if isinstance(item, Exception):
-                    raise item
-                if isinstance(item, tuple) and item[0] is _TAIL:
-                    tails.append(item[1])
-                    done += 1
-                    continue
-                yield item
-            # merge per-reader leftovers: full batches always stream; only
-            # the final sub-batch remainder is dropped under drop_remainder
-            # (at most batch_size-1 rows, independent of reader count)
-            tails = [t for t in tails if len(t)]
-            if tails:
-                merged = ParsedBlock.concat(tails) if len(tails) > 1 else tails[0]
-                if not self.drop_remainder:
-                    merged = pad_to_batch(merged, self.batch_size)
-                n_full = (len(merged) // self.batch_size) * self.batch_size
-                for i in range(0, n_full, self.batch_size):
-                    sl = slice(i, i + self.batch_size)
-                    yield make_batch(
-                        merged.features[sl], merged.targets[sl],
-                        merged.weights[sl],
-                    )
+            routed = route_blocks(
+                pipe.blocks(), emit=self.emit, valid_rate=self.valid_rate,
+            )
+            blocks = shuffled_blocks(routed, self.shuffle_rows,
+                                     self.shuffle_seed, stats,
+                                     tracer=tracer)
+            yield from blocks_to_batches(
+                blocks, self.batch_size, self.schema.num_features,
+                drop_remainder=self.drop_remainder,
+            )
         finally:
-            stop.set()
-            # drain so producers can observe stop and exit
-            for t in threads:
-                while t.is_alive():
-                    try:
-                        q.get_nowait()
-                    except queue.Empty:
-                        break
+            pipe.close()
+            if pipe in self._live:
+                self._live.remove(pipe)
+            if self.stats_sink is not None:
+                try:
+                    self.stats_sink(stats)
+                except Exception:  # a broken sink must not kill training
+                    pass
 
 
 def prefetch_to_device(
     batches: Iterable[Batch],
     put: Callable[[Batch], Batch] | None = None,
     depth: int = 2,
-) -> Iterator[Batch]:
+    *,
+    pipelined: bool = False,
+    tracer=None,
+    root=None,
+):
     """Keep ``depth`` batches already transferred ahead of the consumer.
 
     ``put`` maps a host batch to device (default ``jax.device_put``); with a
-    ``NamedSharding`` it lands shards directly on the mesh.  This is the
-    double-buffered infeed the reference lacked (its feed_dict marshalled
-    every batch synchronously — SURVEY.md §3.4 hot-loop finding).
+    ``NamedSharding`` it lands shards directly on the mesh.
+
+    Two modes:
+
+    - **unthreaded** (default): a plain generator — ``put`` runs inline in
+      the consumer thread while filling the deque, so placement time is
+      consumer-visible.  The host-embedding path DEPENDS on this (its
+      zero-staleness contract needs gather→update ordering in one thread —
+      trainer._train_epoch_host_emb).
+    - **pipelined** (``pipelined=True``): a producer thread runs
+      ``next(batches)`` + ``put`` and feeds a bounded queue, so host batch
+      production AND device placement of batch k+1 overlap the dispatch of
+      batch k — the double-buffered infeed stage of the ingest pipeline
+      (docs/ingest.md).  The consumer's only stall is the queue wait.
+      Span split: ``step.infeed.put`` (thread-side placement work) vs
+      ``step.infeed.wait`` (consumer-side starvation) — ``obs summary``
+      uses it to distinguish "starved" from "placement-slow".
+
+    The returned object supports ``close()`` (no-op for the unthreaded
+    generator beyond normal generator close) — epoch paths close it in
+    ``finally`` so an abandoned epoch never leaks the put thread.
+
+    ``root`` (pipelined mode only) is the epoch's ROOT stream object
+    (e.g. the ShardStream) when ``batches`` is a generator chain over
+    it.  ``close()`` closes the root FIRST: object-level closes are
+    thread-safe, and signalling the underlying pipeline's stop event is
+    the only thing that can unwedge a put thread blocked inside
+    ``next()`` on a stalled stream — a generator whose frame is live on
+    the put thread refuses ``close()`` outright (ValueError).
     """
+    if pipelined:
+        return _PipelinedPrefetch(batches, put, depth, tracer, root=root)
+    return _sync_prefetch(batches, put, depth)
+
+
+def _sync_prefetch(
+    batches: Iterable[Batch],
+    put: Callable[[Batch], Batch] | None,
+    depth: int,
+) -> Iterator[Batch]:
     import collections
 
     import jax
@@ -577,3 +541,131 @@ def prefetch_to_device(
     except StopIteration:
         while buf:
             yield buf.popleft()
+
+
+class _PipelinedPrefetch:
+    """Threaded device-put stage: one producer thread pulls host batches,
+    places them, and fills a bounded queue the consumer drains.
+
+    Order-preserving (single thread, FIFO queue).  Errors from the source
+    iterator or from ``put`` re-raise in the consumer.  ``close()`` stops
+    the thread, drains the queue, joins, then closes the source — safe to
+    call from the consumer's ``finally`` at any point mid-epoch.
+    """
+
+    _END = object()
+
+    #: close() abandons the put thread past this deadline instead of
+    #: hanging the caller; with a root stream attached the thread always
+    #: unwedges well inside it (the root's stop signal propagates in
+    #: ≤ one queue-poll interval), so this is a backstop, not a budget
+    _JOIN_TIMEOUT_S = 10.0
+
+    def __init__(self, batches, put, depth, tracer=None, root=None):
+        import jax
+
+        self._src = batches
+        self._root = root
+        put_fn = put if put is not None else jax.device_put
+        # only the EXPLICIT tracer records (no fallback to the process
+        # install): the eval pass runs untraced on purpose — its waits
+        # must not inflate the train epoch's step budget.  Recording goes
+        # through the tracer's SAMPLED seams because budget_fields scales
+        # step.* spans back up by sample_every — an unsampled side
+        # channel would overcount under obs-trace-sample > 1.
+        self._tracer = tracer
+        self._put_fn = (
+            tracer.timed("step.infeed.put", put_fn)
+            if tracer is not None else put_fn
+        )
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="stpu-infeed-put", daemon=True
+        )
+        self._closed = False
+        self._thread.start()
+
+    # ---- producer ----
+    def _run(self) -> None:
+        try:
+            it = iter(self._src)
+            while not self._stop.is_set():
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                d = self._put_fn(b)
+                if not self._enqueue(d):
+                    return
+            self._enqueue(self._END)
+        except BaseException as e:
+            self._enqueue(_PrefetchError(e))
+
+    def _enqueue(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ---- consumer ----
+    def __iter__(self) -> Iterator[Batch]:
+        from shifu_tensorflow_tpu.obs import trace as obs_trace
+
+        while True:
+            with obs_trace.maybe_span(self._tracer, "step.infeed.wait"):
+                item = self._dequeue()
+            if item is self._END:
+                return
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            yield item
+
+    def _dequeue(self):
+        while True:
+            try:
+                return self._q.get(timeout=0.5)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # thread died without a terminal marker (should be
+                    # unreachable — _run always posts one) — fail loudly
+                    # rather than hang the epoch
+                    raise RuntimeError("infeed put thread died silently")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # unwedge the put thread FIRST: if it is blocked inside next() on
+        # a stream whose producers stalled, only the source's own stop
+        # signal releases it — this prefetcher's stop event is checked
+        # only between batches.  The root's close() is object-level and
+        # thread-safe (close_stream itself tolerates a generator root
+        # whose frame is live on the put thread).
+        close_stream(self._root)
+        deadline = time.monotonic() + self._JOIN_TIMEOUT_S
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if self._thread.is_alive() and time.monotonic() > deadline:
+                break  # daemon thread; exits once its blocked call returns
+        # the source is no longer being consumed; release ITS threads and
+        # run the generator chain's finallys (stats sink, pipeline close).
+        # Safe now that the thread is joined (frames suspended); in the
+        # abandoned-thread case a live frame refuses close and
+        # close_stream swallows it.
+        close_stream(self._src)
+
+
+class _PrefetchError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc

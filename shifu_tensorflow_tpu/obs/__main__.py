@@ -86,6 +86,7 @@ def _step_budget(events: list[dict]) -> list[str]:
     # (worker) -> accumulated phase seconds / steps / epochs
     acc: dict = defaultdict(lambda: {
         "epochs": 0, "steps": 0,
+        "infeed_wait": 0.0, "infeed_put": 0.0, "host_produce": 0.0,
         **{p: 0.0 for p in _STEP_PHASES}, "spans": defaultdict(
             lambda: {"count": 0, "total_s": 0.0}),
     })
@@ -98,6 +99,9 @@ def _step_budget(events: list[dict]) -> list[str]:
             a["steps"] += int(ev.get("steps", 0))
             for p in _STEP_PHASES:
                 a[p] += float(ev.get(f"{p}_s", 0.0))
+            a["infeed_wait"] += float(ev.get("infeed_wait_s", 0.0))
+            a["infeed_put"] += float(ev.get("infeed_put_s", 0.0))
+            a["host_produce"] += float(ev.get("host_produce_s", 0.0))
             for name, s in (ev.get("spans") or {}).items():
                 a["spans"][name]["count"] += int(s.get("count", 0))
                 a["spans"][name]["total_s"] += float(s.get("total_s", 0.0))
@@ -124,6 +128,22 @@ def _step_budget(events: list[dict]) -> list[str]:
             f" {pct['dispatch']:<10.1f} {pct['block']:<7.1f}"
             f" {100.0 * other / denom:.1f}"
         )
+        if a["infeed_wait"] or a["infeed_put"] or a["host_produce"]:
+            # pipelined infeed: wait is the consumer's stall (part of the
+            # infeed%% above); put and host-produce are work on the put
+            # thread, overlapped with dispatch — wait-heavy means STARVED
+            # (widen the ingest pipeline), put-heavy means PLACEMENT-SLOW
+            # (transfer/pad cost; see docs/ingest.md)
+            line = (
+                f"          infeed split: wait "
+                f"{100.0 * a['infeed_wait'] / denom:.1f}% of wall, put "
+                f"{100.0 * a['infeed_put'] / denom:.1f}% (overlapped)"
+            )
+            if a["host_produce"]:
+                line += (f", host produce "
+                         f"{100.0 * a['host_produce'] / denom:.1f}%"
+                         f" (overlapped)")
+            lines.append(line)
         span_bits = [
             f"{name} {s['count']}x {s['total_s']:.3f}s"
             for name, s in sorted(a["spans"].items())

@@ -240,6 +240,35 @@ def budget_fields(summary: dict[str, dict[str, float]]) -> dict[str, Any]:
         out[field_name] = (
             round(s["total_s"] * s.get("sampled_every", 1), 6) if s else 0.0
         )
+    # pipelined infeed splits the phase: "step.infeed.wait" is the
+    # consumer-visible stall (counts toward the budget's infeed slice,
+    # additive with any unthreaded step.infeed from other paths) while
+    # "step.infeed.put" is put-thread placement work OVERLAPPING dispatch
+    # (reported separately, never added to the phase total — the budget
+    # divides by wall clock, and overlapped work would double-count).
+    # `obs summary` renders the split so "starved" (wait-heavy) and
+    # "placement-slow" (put-heavy) are distinguishable.
+    w = summary.get("step.infeed.wait")
+    if w:
+        wait = round(w["total_s"] * w.get("sampled_every", 1), 6)
+        out["infeed_wait_s"] = wait
+        out["infeed_s"] = round(out["infeed_s"] + wait, 6)
+        scale = max(scale, int(w.get("sampled_every", 1)))
+    p = summary.get("step.infeed.put")
+    if p:
+        out["infeed_put_s"] = round(
+            p["total_s"] * p.get("sampled_every", 1), 6)
+        scale = max(scale, int(p.get("sampled_every", 1)))
+    # "step.host.produce" is host-batch production that ran ON the put
+    # thread (pipelined infeed) — overlapped with dispatch, so, exactly
+    # like infeed_put_s, it reports separately and never joins the
+    # disjoint wall-clock phases (host_s stays the consumer-visible
+    # stall, which is 0 on that path by construction)
+    hp = summary.get("step.host.produce")
+    if hp:
+        out["host_produce_s"] = round(
+            hp["total_s"] * hp.get("sampled_every", 1), 6)
+        scale = max(scale, int(hp.get("sampled_every", 1)))
     d = summary.get("step.dispatch")
     out["steps"] = int(d["count"] * d.get("sampled_every", 1)) if d else 0
     if scale > 1:

@@ -69,9 +69,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stream", action="store_true",
                    help="stream shards (1B-row path) instead of loading to RAM")
     p.add_argument("--readers", type=int, default=None,
-                   help="parallel reader threads for --stream (default 1 = "
-                        "reproducible batch order; >1 trades determinism "
-                        "for ingest throughput)")
+                   help="parallel shard-reader threads for --stream "
+                        "(shifu.tpu.data-readers; default auto: the "
+                        "ingest autotuner sizes it between epochs; an "
+                        "explicit value pins the dimension.  Batch order "
+                        "is reproducible at any reader count)")
+    p.add_argument("--decode-workers", type=int, default=None,
+                   help="parse/finalize/cast pool width for --stream "
+                        "(shifu.tpu.data-decode-workers; default auto/"
+                        "autotuned; explicit value pins it)")
+    p.add_argument("--data-prefetch", type=int, default=None,
+                   help="device-put pipeline depth for --stream "
+                        "(shifu.tpu.data-prefetch; default auto: starts "
+                        "at shifu.tpu.prefetch-depth, then autotuned; "
+                        "explicit value pins it)")
+    tune = p.add_mutually_exclusive_group()
+    tune.add_argument("--data-autotune", dest="data_autotune",
+                      action="store_true", default=None,
+                      help="size readers/decode/prefetch from live stage "
+                           "span ratios between epochs (the default; "
+                           "shifu.tpu.data-autotune)")
+    tune.add_argument("--no-data-autotune", dest="data_autotune",
+                      action="store_false",
+                      help="freeze the ingest knobs at their resolved "
+                           "values")
+    p.add_argument("--shuffle-rows", type=int, default=None,
+                   help="seeded shuffle-buffer window for --stream, in "
+                        "rows (shifu.tpu.data-shuffle-rows; default 0 = "
+                        "off).  Deterministic per seed at any "
+                        "parallelism")
     p.add_argument("--cache-dir", default=None,
                    help="binary shard cache dir: text shards parse once, "
                         "later epochs stream memory-mapped tensors")
@@ -217,6 +243,35 @@ def trainer_extras(args, conf: Conf) -> dict:
     }
 
 
+def resolve_ingest(args, conf: Conf) -> dict:
+    """shifu.tpu.data-* -> staged-ingest knob values with the usual
+    CLI-wins precedence.  0/None = auto (the autotuner sizes the
+    dimension between epochs); an explicit value pins its dimension
+    (data/autotune.resolve_ingest_knobs).  ONE resolver for both run
+    paths and the wiring tests."""
+    def pick(cli, key, default):
+        if cli is not None:
+            return cli
+        return conf.get_int(key, default)
+
+    autotune = (args.data_autotune if getattr(args, "data_autotune", None)
+                is not None
+                else conf.get_bool(K.DATA_AUTOTUNE, K.DEFAULT_DATA_AUTOTUNE))
+    return {
+        "readers": pick(getattr(args, "readers", None),
+                        K.DATA_READERS, K.DEFAULT_DATA_READERS),
+        "decode_workers": pick(getattr(args, "decode_workers", None),
+                               K.DATA_DECODE_WORKERS,
+                               K.DEFAULT_DATA_DECODE_WORKERS),
+        "prefetch": pick(getattr(args, "data_prefetch", None),
+                         K.DATA_PREFETCH, K.DEFAULT_DATA_PREFETCH),
+        "autotune": bool(autotune),
+        "shuffle_rows": pick(getattr(args, "shuffle_rows", None),
+                             K.DATA_SHUFFLE_ROWS,
+                             K.DEFAULT_DATA_SHUFFLE_ROWS),
+    }
+
+
 def resolve_obs(args, conf: Conf):
     """shifu.tpu.obs-* -> ObsConfig with the usual CLI-wins precedence —
     ONE resolver for both run paths (and the wiring tests), so a fleet
@@ -266,6 +321,7 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
     """WorkerConfig runtime fields resolved through the conf layer — the
     run_multi analogue of trainer_extras, extracted so the wiring tests can
     pin each key to the field it drives (no dead keys)."""
+    ing = resolve_ingest(args, conf)
     return {
         "prefetch_depth": conf.get_int(K.PREFETCH_DEPTH,
                                        K.DEFAULT_PREFETCH_DEPTH),
@@ -277,6 +333,15 @@ def worker_runtime_kwargs(args, conf: Conf) -> dict:
         "flat_checkpoint": conf.get_bool(K.FLAT_CHECKPOINT,
                                          K.DEFAULT_FLAT_CHECKPOINT),
         "cache_dir": conf.get(K.CACHE_DIR),
+        # staged-ingest knobs (shifu.tpu.data-*): 0 = auto/autotuned, an
+        # explicit value pins its dimension (data/autotune.py); carried
+        # per worker through the WorkerConfig JSON bridge.  n_readers
+        # keeps its legacy None-means-auto WorkerConfig encoding
+        "n_readers": ing["readers"] or None,
+        "decode_workers": ing["decode_workers"],
+        "data_prefetch": ing["prefetch"],
+        "data_autotune": ing["autotune"],
+        "data_shuffle_rows": ing["shuffle_rows"],
         "stream_feature_dtype": conf.get(K.STREAM_FEATURE_DTYPE,
                                          K.DEFAULT_STREAM_FEATURE_DTYPE),
         # subprocess workers inherit the submit-side retry envelope
@@ -550,18 +615,39 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
                         model_config.params.uses_feature_hashing),
                     has_normalization_stats=bool(schema.means),
                 )
+                # staged-ingest knobs (shifu.tpu.data-*): explicit values
+                # pin their dimension; the rest start at defaults and the
+                # autotuner (on by default) resizes them between epochs
+                # from the live stage span ratios — one shared wiring
+                # helper with the fleet worker path (data/autotune.py)
+                from shifu_tensorflow_tpu.data.autotune import (
+                    install_ingest_autotuner,
+                )
+
+                ing = resolve_ingest(args, conf)
+                _widths, _stats_sink = install_ingest_autotuner(
+                    trainer, ing["readers"], ing["decode_workers"],
+                    ing["prefetch"], autotune=ing["autotune"],
+                    fallback_prefetch=trainer.prefetch_depth,
+                )
+
                 history = trainer.fit_stream(
                     lambda epoch: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="train", salt=args.seed,
-                        n_readers=args.readers, cache_dir=cache_dir,
+                        cache_dir=cache_dir,
                         feature_dtype=feature_dtype,
+                        shuffle_rows=ing["shuffle_rows"],
+                        shuffle_seed=args.seed + epoch,
+                        stats_sink=_stats_sink,
+                        **_widths(),
                     ),
                     (lambda: ShardStream(
                         paths, schema, batch_size,
                         valid_rate=valid_rate, emit="valid", salt=args.seed,
-                        n_readers=args.readers, cache_dir=cache_dir,
+                        cache_dir=cache_dir,
                         feature_dtype=feature_dtype,
+                        **_widths(),
                     )) if valid_rate > 0 else None,
                     epochs=epochs,
                     on_epoch=_print_epoch,
@@ -753,7 +839,6 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             dtype=args.dtype or conf.get(K.DTYPE, K.DEFAULT_DTYPE),
             mesh_spec=conf.get(K.MESH_SHAPE),
             stream=bool(args.stream),
-            n_readers=args.readers,
             **worker_runtime_kwargs(args, conf),
         )
 

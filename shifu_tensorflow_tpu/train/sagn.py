@@ -46,7 +46,11 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from shifu_tensorflow_tpu.config.model_config import ModelConfig
-from shifu_tensorflow_tpu.data.dataset import Batch, prefetch_to_device
+from shifu_tensorflow_tpu.data.dataset import (
+    Batch,
+    close_stream,
+    prefetch_to_device,
+)
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
 from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
@@ -265,6 +269,15 @@ class SAGNTrainer(Trainer):
         )
 
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        """SAGN window epoch; the source is closed on every exit (same
+        stream-teardown contract as the parent's train_epoch)."""
+        source = batches
+        try:
+            return self._train_epoch_sagn(batches)
+        finally:
+            close_stream(source)
+
+    def _train_epoch_sagn(self, batches: Iterable[Batch]) -> tuple[float, int]:
         K = self.update_window
         losses: list = []
         weights: list[int] = []

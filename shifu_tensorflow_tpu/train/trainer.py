@@ -36,6 +36,7 @@ from shifu_tensorflow_tpu.data.dataset import (
     Batch,
     InMemoryDataset,
     _zero_batch,
+    close_stream,
     prefetch_to_device,
 )
 from shifu_tensorflow_tpu.models.factory import build_model
@@ -1002,8 +1003,24 @@ class Trainer:
             if self.accum_steps > 1
             else None
         )
-        # device-infeed lookahead (conf key shifu.tpu.prefetch-depth)
+        # device-infeed lookahead (conf key shifu.tpu.prefetch-depth;
+        # shifu.tpu.data-prefetch / the ingest autotuner may retarget it
+        # between streaming epochs)
         self.prefetch_depth = max(1, int(prefetch_depth))
+        # pipelined infeed: production + device placement of batch k+1 on
+        # a put thread, overlapping batch k's dispatch (data/dataset.py
+        # _PipelinedPrefetch).  Default on for the per-step/scan/accum/
+        # eval paths; the host-embedding path ignores it (zero-staleness
+        # contract pins an unthreaded depth-1 lookahead).
+        self.infeed_pipelined = True
+        # the epoch's ROOT stream (the ShardStream under the generator
+        # chain), stashed by train_epoch/evaluate so _PipelinedPrefetch
+        # can unwedge its put thread on close (data/dataset.py)
+        self._infeed_root = None
+        # optional ingest feedback loop (data/autotune.IngestAutotuner):
+        # installed by the streaming CLI/worker paths; fit_stream feeds it
+        # per-epoch stage stats and applies its prefetch decision
+        self.ingest_autotuner = None
         # opt-in per-step timing (utils/profiling.StepTimer); None = free
         self.step_timer = None
         # observability span sink (obs/trace.py): picked up from the
@@ -1093,7 +1110,35 @@ class Trainer:
 
     # ---- core loops ----
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
-        """Run one epoch; returns (mean loss over batches, batch count)."""
+        """Run one epoch; returns (mean loss over batches, batch count).
+
+        The source is CLOSED on every exit — normal exhaustion, a
+        health-guard trip, any exception — so a streaming source's
+        producer threads (ShardStream close() contract) never outlive the
+        epoch that abandoned them."""
+        source = batches
+        self._infeed_root = source
+        try:
+            return self._train_epoch_dispatch(batches)
+        finally:
+            self._infeed_root = None
+            close_stream(source)
+
+    def _infeed(self, batches: Iterable[Batch], put, tracer):
+        """The device-placement stage for an epoch path: pipelined (put
+        thread overlaps dispatch; step.infeed.wait/put split) by default,
+        the inline generator otherwise.  Callers close() the result."""
+        if self.infeed_pipelined:
+            return prefetch_to_device(batches, put=put,
+                                      depth=self.prefetch_depth,
+                                      pipelined=True, tracer=tracer,
+                                      root=self._infeed_root)
+        timed = (tracer.timed("step.infeed", put)
+                 if tracer is not None else put)
+        return prefetch_to_device(batches, put=timed,
+                                  depth=self.prefetch_depth)
+
+    def _train_epoch_dispatch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         guard = self.health_guard
         if guard is not None:
             # instrument the stream BEFORE path dispatch: real-row
@@ -1102,13 +1147,24 @@ class Trainer:
             batches = guard.filter_batches(batches)
         tracer = self.tracer
         if tracer is not None:
-            # "step.host": producing the next host batch (parse / stack /
-            # filter) — wrapped before path dispatch so every epoch path
-            # shares the phase definition.  Chunk stacking (scan/accum)
-            # and device placement are NOT in here; placement is
-            # "step.infeed" at each path's put, stacking lands in the
-            # budget's "other" slice.
-            batches = tracer.wrap_iter("step.host", batches)
+            # host-batch production (parse / stack / filter) — wrapped
+            # before path dispatch so every epoch path shares the phase
+            # definition.  Chunk stacking (scan/accum) and device
+            # placement are NOT in here; placement is "step.infeed" at
+            # each path's put, stacking lands in the budget's "other"
+            # slice.  SPAN NAME depends on WHERE production runs: on the
+            # unthreaded paths (host-emb, infeed_pipelined off) it stalls
+            # the consumer and is the disjoint "step.host" phase; under
+            # pipelined infeed it runs on the put thread and OVERLAPS
+            # dispatch, so it records as "step.host.produce" — reported
+            # separately (host_produce_s, like infeed_put_s) and excluded
+            # from the wall-clock budget, where counting it would
+            # double-book the overlapped seconds (the consumer-visible
+            # stall is step.infeed.wait alone).
+            overlapped = self.infeed_pipelined and self._host_emb is None
+            batches = tracer.wrap_iter(
+                "step.host.produce" if overlapped else "step.host",
+                batches)
         if self._host_emb is not None:
             return self._train_epoch_host_emb(batches)
         if self._scan_epoch is not None:
@@ -1118,21 +1174,22 @@ class Trainer:
         losses = []
         gnorms = []
         step_fn = self._health_step or self._train_step
-        put = (tracer.timed("step.infeed", self._put)
-               if tracer is not None else self._put)
-        for batch in prefetch_to_device(batches, put=put,
-                                        depth=self.prefetch_depth):
-            with obs_trace.maybe_span(tracer, "step.dispatch"):
-                if self._health_step is not None:
-                    self.state, (loss, gnorm) = step_fn(self.state, batch)
-                    gnorms.append(gnorm)
-                else:
-                    self.state, loss = step_fn(self.state, batch)
-            losses.append(loss)
-            if guard is not None:
-                guard.tick()
-            if self.step_timer is not None:
-                self.step_timer.step(loss, rows=batch["x"].shape[0])
+        feed = self._infeed(batches, self._put, tracer)
+        try:
+            for batch in feed:
+                with obs_trace.maybe_span(tracer, "step.dispatch"):
+                    if self._health_step is not None:
+                        self.state, (loss, gnorm) = step_fn(self.state, batch)
+                        gnorms.append(gnorm)
+                    else:
+                        self.state, loss = step_fn(self.state, batch)
+                losses.append(loss)
+                if guard is not None:
+                    guard.tick()
+                if self.step_timer is not None:
+                    self.step_timer.step(loss, rows=batch["x"].shape[0])
+        finally:
+            close_stream(feed)
         if not losses:
             return float("nan"), 0
         with obs_trace.maybe_span(tracer, "step.block"):
@@ -1304,21 +1361,21 @@ class Trainer:
             batches, self.scan_steps
         )
         tracer = self.tracer
-        put = (tracer.timed("step.infeed", self._put_stacked)
-               if tracer is not None else self._put_stacked)
         losses = []  # (K,) device arrays, chunk-pad entries NaN
-        for stacked in prefetch_to_device(
-            chunks, put=put, depth=self.prefetch_depth
-        ):
-            with obs_trace.maybe_span(tracer, "step.dispatch"):
-                self.state, chunk_losses = self._scan_epoch(
-                    self.state, stacked)
-            losses.append(chunk_losses)
-            chunk_rows = rows_meta.popleft()
-            if self.health_guard is not None:
-                self.health_guard.tick()
-            if self.step_timer is not None:
-                self.step_timer.step(chunk_losses, rows=chunk_rows)
+        feed = self._infeed(chunks, self._put_stacked, tracer)
+        try:
+            for stacked in feed:
+                with obs_trace.maybe_span(tracer, "step.dispatch"):
+                    self.state, chunk_losses = self._scan_epoch(
+                        self.state, stacked)
+                losses.append(chunk_losses)
+                chunk_rows = rows_meta.popleft()
+                if self.health_guard is not None:
+                    self.health_guard.tick()
+                if self.step_timer is not None:
+                    self.step_timer.step(chunk_losses, rows=chunk_rows)
+        finally:
+            close_stream(feed)
         if not losses:
             return float("nan"), 0
         with obs_trace.maybe_span(tracer, "step.block"):
@@ -1347,20 +1404,20 @@ class Trainer:
             batches, self.accum_steps
         )
         tracer = self.tracer
-        put = (tracer.timed("step.infeed", self._put_stacked)
-               if tracer is not None else self._put_stacked)
         losses = []  # scalars, one per update; all-padding groups NaN
-        for stacked in prefetch_to_device(
-            chunks, put=put, depth=self.prefetch_depth
-        ):
-            with obs_trace.maybe_span(tracer, "step.dispatch"):
-                self.state, loss = self._accum_step(self.state, stacked)
-            losses.append(loss)
-            chunk_rows = rows_meta.popleft()
-            if self.health_guard is not None:
-                self.health_guard.tick()
-            if self.step_timer is not None:
-                self.step_timer.step(loss, rows=chunk_rows)
+        feed = self._infeed(chunks, self._put_stacked, tracer)
+        try:
+            for stacked in feed:
+                with obs_trace.maybe_span(tracer, "step.dispatch"):
+                    self.state, loss = self._accum_step(self.state, stacked)
+                losses.append(loss)
+                chunk_rows = rows_meta.popleft()
+                if self.health_guard is not None:
+                    self.health_guard.tick()
+                if self.step_timer is not None:
+                    self.step_timer.step(loss, rows=chunk_rows)
+        finally:
+            close_stream(feed)
         if not losses:
             return float("nan"), 0
         with obs_trace.maybe_span(tracer, "step.block"):
@@ -1636,6 +1693,17 @@ class Trainer:
             self.best_host_table = best_host_table
 
     def evaluate(self, batches: Iterable[Batch]) -> dict[str, float]:
+        """Validation pass; closes the source on every exit (same stream
+        teardown contract as train_epoch)."""
+        source = batches
+        self._infeed_root = source
+        try:
+            return self._evaluate_inner(batches)
+        finally:
+            self._infeed_root = None
+            close_stream(source)
+
+    def _evaluate_inner(self, batches: Iterable[Batch]) -> dict[str, float]:
         losses, scores, labels, weights = [], [], [], []
         if self._cross_process:
             # labels/weights stay host-side (the device copies are global
@@ -1658,15 +1726,18 @@ class Trainer:
                 labels.append(np.asarray(host_batch["y"]))
                 weights.append(np.asarray(host_batch["w"]))
         else:
-            for batch in prefetch_to_device(batches, put=self._put,
-                                        depth=self.prefetch_depth):
-                loss, pred = self._eval_step(self.state.params, batch)
-                if self.health_guard is not None:
-                    self.health_guard.tick()
-                losses.append(loss)
-                scores.append(np.asarray(pred))
-                labels.append(np.asarray(batch["y"]))
-                weights.append(np.asarray(batch["w"]))
+            feed = self._infeed(batches, self._put, None)
+            try:
+                for batch in feed:
+                    loss, pred = self._eval_step(self.state.params, batch)
+                    if self.health_guard is not None:
+                        self.health_guard.tick()
+                    losses.append(loss)
+                    scores.append(np.asarray(pred))
+                    labels.append(np.asarray(batch["y"]))
+                    weights.append(np.asarray(batch["w"]))
+            finally:
+                close_stream(feed)
         if not losses:
             return {"loss": float("nan"), "ks": 0.0, "auc": 0.5}
         s = np.concatenate(scores)[:, 0]
@@ -1972,11 +2043,36 @@ class Trainer:
         epochs = epochs or self.model_config.num_train_epochs
         history: list[EpochStats] = []
         self.stop_reason = None
+        autotuner = self.ingest_autotuner
         for epoch in range(start_epoch, epochs):
+            if autotuner is not None:
+                # apply the tuner's device-put depth for this epoch; the
+                # reader/decode widths land via the stream factory, which
+                # reads autotuner.settings() at build time
+                self.prefetch_depth = max(
+                    1, autotuner.settings().prefetch)
             self._health_begin_epoch(epoch)
             t0 = time.time()
             train_loss, n = self.train_epoch(make_train_stream(epoch))
             train_time = time.time() - t0
+            if autotuner is not None:
+                # digest the epoch's stage stats (delivered through the
+                # stream's stats_sink when train_epoch closed it) plus
+                # THIS epoch's step spans.  With the obs journal active,
+                # _obs_epoch's take_summary() drained the tracer at the
+                # end of the previous epoch, so the non-destructive
+                # summary() covers exactly this epoch (and the journal
+                # still gets it).  Without a journal nothing ever drains,
+                # so drain here — a cumulative wait total divided by one
+                # epoch's wall would ratchet the starvation signal toward
+                # 1.0 and the tuner would widen forever on a healthy
+                # pipeline.
+                summ = None
+                if self.tracer is not None:
+                    summ = (self.tracer.summary()
+                            if obs_journal.active() is not None
+                            else self.tracer.take_summary())
+                autotuner.observe_epoch(summ)
             ev = {"loss": float("nan"), "ks": 0.0, "auc": 0.5}
             valid_time = 0.0
             if make_valid_stream is not None:

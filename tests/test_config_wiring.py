@@ -547,3 +547,90 @@ def test_obs_keys_reach_worker_config_bridge():
     import dataclasses
     fields = {f.name for f in dataclasses.fields(WorkerConfig)}
     assert "obs" in fields
+
+
+def test_data_keys_round_trip_xml_to_worker_config(tmp_path):
+    """shifu.tpu.data-* keys: Hadoop-XML resource → layered Conf → CLI
+    override → resolve_ingest → WorkerConfig JSON round-trip — the same
+    contract the obs/serve/health keys are held to."""
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.train.__main__ import (
+        resolve_ingest,
+        worker_runtime_kwargs,
+    )
+
+    xml = tmp_path / "data.xml"
+    values = {
+        K.DATA_READERS: "3",
+        K.DATA_DECODE_WORKERS: "2",
+        K.DATA_PREFETCH: "5",
+        K.DATA_AUTOTUNE: "false",
+        K.DATA_SHUFFLE_ROWS: "4096",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    ing = resolve_ingest(_args(), conf)
+    assert ing == {"readers": 3, "decode_workers": 2, "prefetch": 5,
+                   "autotune": False, "shuffle_rows": 4096}
+    # CLI flags win over the XML layer
+    ing = resolve_ingest(
+        _args(["--readers", "7", "--data-autotune"]), conf)
+    assert ing["readers"] == 7 and ing["autotune"] is True
+    # worker bridge carries every field, and the WorkerConfig JSON
+    # transport round-trips them to subprocess workers
+    kw = worker_runtime_kwargs(_args(), conf)
+    assert kw["n_readers"] == 3  # one resolver feeds run_multi's bridge
+    assert kw["decode_workers"] == 2
+    assert kw["data_prefetch"] == 5
+    assert kw["data_autotune"] is False
+    assert kw["data_shuffle_rows"] == 4096
+    mc = ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.1}}}
+    )
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    cfg = WorkerConfig(
+        worker_id="w0", coordinator_host="127.0.0.1", coordinator_port=1,
+        model_config=mc,
+        schema=RecordSchema(feature_columns=(1,), target_column=0),
+        n_readers=3, decode_workers=2, data_prefetch=5,
+        data_autotune=False, data_shuffle_rows=4096,
+    )
+    back = WorkerConfig.from_json(cfg.to_json())
+    assert (back.n_readers, back.decode_workers, back.data_prefetch,
+            back.data_autotune, back.data_shuffle_rows) == (3, 2, 5,
+                                                            False, 4096)
+
+
+def test_data_keys_defaults_autotune_on_and_auto_widths():
+    """Defaults: every width 0 (= auto), autotune ON, shuffle off —
+    and resolve_ingest_knobs turns explicit values into PINNED
+    dimensions the tuner must not touch."""
+    from shifu_tensorflow_tpu.data.autotune import resolve_ingest_knobs
+    from shifu_tensorflow_tpu.train.__main__ import resolve_ingest
+
+    ing = resolve_ingest(_args(), _conf({}))
+    assert ing == {"readers": 0, "decode_workers": 0, "prefetch": 0,
+                   "autotune": True, "shuffle_rows": 0}
+    knobs, tuner = resolve_ingest_knobs(
+        ing["readers"], ing["decode_workers"], ing["prefetch"],
+        autotune=ing["autotune"], fallback_prefetch=2, cpu_count=4)
+    assert tuner is not None and tuner.pinned == frozenset()
+    assert knobs.readers >= 1 and knobs.prefetch == 2
+    # an explicit knob wins AND disables autotuning for that dimension
+    ing = resolve_ingest(_args(["--decode-workers", "3"]), _conf({}))
+    knobs, tuner = resolve_ingest_knobs(
+        ing["readers"], ing["decode_workers"], ing["prefetch"],
+        autotune=ing["autotune"], fallback_prefetch=2, cpu_count=4)
+    assert knobs.decode_workers == 3
+    assert "decode_workers" in tuner.pinned
+    # --no-data-autotune freezes everything (no tuner object at all)
+    ing = resolve_ingest(_args(["--no-data-autotune"]), _conf({}))
+    assert ing["autotune"] is False

@@ -362,6 +362,11 @@ def test_trainer_journals_epoch_and_step_breakdown(tmp_path, scan_steps):
         assert b["steps"] > 0
         assert b["dispatch_s"] > 0.0
         assert b["infeed_s"] > 0.0
+        # pipelined infeed (default): host production ran on the put
+        # thread — reported as overlapped host_produce_s, with the
+        # disjoint host_s phase ~0 by construction
+        assert b.get("host_produce_s", 0.0) > 0.0
+        assert b["host_s"] == 0.0
     assert epochs[0]["global_step"] > 0
 
 
