@@ -123,13 +123,20 @@ def _measure(trainer, dataset, journal_dir: str) -> tuple[dict, list]:
     return rates, ratios
 
 
-def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> float:
+def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
     """The obs plane's ADDED WORK per step, measured in isolation: one
     wrap_iter hop + one timed call + one dispatch span (what every step
-    pays), plus the per-epoch journal step_breakdown write amortized
-    over the epoch's steps.  Deterministic to within timer resolution —
-    no XLA, no scheduler contention in the loop."""
+    pays), plus — PR 7 — one SLO digest update (the windowed P² quantile
+    add every tracked hot-path signal costs) and one rid stamp (the
+    serve ingress mint; the train plane's per-event ``seq`` stamp is an
+    ``itertools.count`` next, strictly cheaper), plus the per-epoch
+    journal step_breakdown write + watchdog evaluation amortized over
+    the epoch's steps.  Deterministic to within timer resolution — no
+    XLA, no scheduler contention in the loop."""
+    import uuid
+
     from shifu_tensorflow_tpu.obs.journal import Journal
+    from shifu_tensorflow_tpu.obs.slo import SloWatchdog
     from shifu_tensorflow_tpu.obs.trace import Tracer, budget_fields
 
     t = Tracer()
@@ -140,6 +147,8 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> float:
             yield 1
 
     wrapped = t.wrap_iter("step.host", forever())
+    wd = SloWatchdog(window_s=60.0, plane="train")
+    wd.track("train_step_ms", stat="p99", target=0.0)
     n = 50_000
     t0 = time.perf_counter()
     for _ in range(n):
@@ -148,6 +157,17 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> float:
         with t.span("step.dispatch"):
             pass
     per_step_us = (time.perf_counter() - t0) / n * 1e6
+    # digest update: what every observed hot-path signal adds per event
+    t0 = time.perf_counter()
+    for i in range(n):
+        wd.observe("train_step_ms", 4.0 + (i & 7) * 0.01)
+    digest_us = (time.perf_counter() - t0) / n * 1e6
+    # rid stamp: the serve ingress mint (uuid4 hex slice), the most
+    # expensive id the correlation layer ever creates per request
+    t0 = time.perf_counter()
+    for _ in range(n):
+        uuid.uuid4().hex[:16]
+    rid_us = (time.perf_counter() - t0) / n * 1e6
     t.take_summary()  # drain before the journal-emit measurement
     j = Journal(os.path.join(journal_dir, "micro.jsonl"), plane="train")
     m = 500
@@ -157,9 +177,17 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> float:
             pass
         j.emit("step_breakdown", worker=0, epoch=i,
                **budget_fields(t.take_summary()))
+        wd.evaluate()
     per_epoch_us = (time.perf_counter() - t0) / m * 1e6
     j.close()
-    return per_step_us + per_epoch_us / max(1, steps_per_epoch)
+    return {
+        "span_us": per_step_us,
+        "digest_us": digest_us,
+        "rid_us": rid_us,
+        "epoch_us": per_epoch_us,
+        "total_us": (per_step_us + digest_us + rid_us
+                     + per_epoch_us / max(1, steps_per_epoch)),
+    }
 
 
 def main() -> int:
@@ -199,7 +227,8 @@ def main() -> int:
     # noise floor and fail the sanity bound.
     steps_per_epoch = -(-ROWS // BATCH)
     with tempfile.TemporaryDirectory(prefix="bench-obs-micro-") as mdir:
-        micro_us = _micro_cost_us(steps_per_epoch, mdir)
+        micro = _micro_cost_us(steps_per_epoch, mdir)
+    micro_us = micro["total_us"]
     micro_pct = 100.0 * (micro_us * 1e-6) * off_m
     overhead_pct = micro_pct
     import jax
@@ -224,6 +253,17 @@ def main() -> int:
         "on_steps_per_sec_median": round(on_m, 1),
         "pairs": len(ratios),
         "micro_instrumentation_us_per_step": round(micro_us, 2),
+        "micro_breakdown_us": {
+            # spans = wrap_iter + timed + span (the PR-4 tracer seams);
+            # digest = one windowed P² add (PR-7 SLO hot-path signal);
+            # rid = one serve-ingress uuid4 mint (PR-7 correlation id);
+            # epoch = journal step_breakdown write + watchdog evaluate,
+            # amortized over steps_per_epoch in the headline
+            "spans": round(micro["span_us"], 3),
+            "digest_update": round(micro["digest_us"], 3),
+            "rid_stamp": round(micro["rid_us"], 3),
+            "per_epoch": round(micro["epoch_us"], 2),
+        },
         "micro_pct_of_median_step": round(micro_pct, 3),
         "pair_ratio_p10_p50_p90": [
             round(np.percentile(ratios, 10), 4),

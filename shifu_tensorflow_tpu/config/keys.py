@@ -285,6 +285,33 @@ DEFAULT_OBS_TRACE_SAMPLE = 1
 OBS_HIST_BUCKETS = TPU_PREFIX + "obs-hist-buckets"
 DEFAULT_OBS_HIST_BUCKETS = ""
 
+# ---- SLO watchdog (obs/slo.py: windowed quantile digests + breach
+# events) ----
+# Evaluated over a sliding window of this many seconds; targets of 0
+# leave a signal untargeted (gauges + EWMA-z anomaly detection still
+# run).  Breach/recover transitions are hysteretic — a signal must hold
+# its state for slo-hysteresis consecutive evaluations before the
+# journal records slo_breach / slo_recover — and every /metrics surface
+# appends the stpu_slo_* gauges, so an autoscaling supervisor can read
+# the same signal the journal records.
+SLO_WINDOW_S = TPU_PREFIX + "slo-window"  # seconds
+DEFAULT_SLO_WINDOW_S = 60.0
+SLO_SERVE_P99_MS = TPU_PREFIX + "slo-serve-p99"  # ms; 0 = no target
+DEFAULT_SLO_SERVE_P99_MS = 0.0
+# shed fraction of scoring attempts over the window (0..1; 0 = no target)
+SLO_SERVE_SHED_RATE = TPU_PREFIX + "slo-serve-shed-rate"
+DEFAULT_SLO_SERVE_SHED_RATE = 0.0
+SLO_STEP_TIME_MS = TPU_PREFIX + "slo-step-time"  # ms; 0 = no target
+DEFAULT_SLO_STEP_TIME_MS = 0.0
+# infeed-wait fraction of the step budget (0..1; 0 = no target)
+SLO_INFEED_FRAC = TPU_PREFIX + "slo-infeed-frac"
+DEFAULT_SLO_INFEED_FRAC = 0.0
+SLO_HYSTERESIS = TPU_PREFIX + "slo-hysteresis"  # consecutive evaluations
+DEFAULT_SLO_HYSTERESIS = 2
+# EWMA-z anomaly threshold in sigmas (0 disables anomaly detection)
+SLO_ANOMALY_SIGMA = TPU_PREFIX + "slo-anomaly-sigma"
+DEFAULT_SLO_ANOMALY_SIGMA = 6.0
+
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib
 # network planes (WebHDFS/GCS clients, coordinator RPC, remote checkpoint

@@ -104,6 +104,11 @@ class JobSpec:
     shards: list  # list[Shard] from data.splitter (index-aligned to workers)
     total_rows: int = 0
     epochs: int = 1
+    # fleet-wide correlation id stamped on every journal event and
+    # handed to workers in the register reply ("" = the coordinator
+    # mints one): one merged journal can then tell two jobs apart and
+    # `obs trace` can scope a query to one job's causal story
+    job_id: str = ""
     registration_timeout_s: float = K.REGISTRATION_HARD_TIMEOUT_S
     max_worker_failure_ratio: float = K.WORKER_FAULT_TOLERANCE_THRESHOLD
     spare_restarts: int = 0  # analogue of backup instances
@@ -156,6 +161,9 @@ class Coordinator:
 
     def __init__(self, spec: JobSpec):
         self.spec = spec
+        # the job correlation id workers learn at registration; direct
+        # API users who never set spec.job_id still get a unique one
+        self.job_id = spec.job_id or uuid.uuid4().hex[:8]
         self.state = JobState.REGISTERING
         self.workers: dict[str, WorkerRecord] = {}
         self._by_index: dict[int, str] = {}
@@ -377,6 +385,7 @@ class Coordinator:
                 "sync_epochs": self.spec.sync_epochs,
                 "spmd": self.spec.spmd,
                 "generation": self._generation,
+                "job": self.job_id,
                 "shard_lines": self._shard_lines.get(rec.worker_index),
                 # rollback directive: relaunched workers train at the
                 # backed-off LR and skip the batch window that tripped
@@ -1109,7 +1118,16 @@ class Coordinator:
             self.registry.set_gauge(
                 "state_info", 1, labels='{state="%s"}' % self.state.value
             )
-        return self.registry.render_prometheus("stpu_coord_")
+        text = self.registry.render_prometheus("stpu_coord_")
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+
+        watchdog = obs_slo.active()
+        if watchdog is not None:
+            # the stpu_slo_* gauges append to every scrape surface; on
+            # the thread launcher the coordinator shares the process
+            # with its workers, so the train watchdog renders here too
+            text += watchdog.render_prometheus()
+        return text
 
     # ---- TCP plumbing ----
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:

@@ -266,8 +266,12 @@ def run_worker(cfg: WorkerConfig, *,
 
         obs_cfg = ObsConfig.from_json(cfg.obs)
         if _obs_journal.active() is None and _obs_trace.active() is None:
-            # subprocess worker: this process is ours to instrument
-            install_obs(obs_cfg, worker_index=worker_index, plane="train")
+            # subprocess worker: this process is ours to instrument.
+            # The job correlation id rode the register reply, so every
+            # worker journals the id the coordinator minted — one merged
+            # journal, one job key across all planes.
+            install_obs(obs_cfg, worker_index=worker_index, plane="train",
+                        job=reg.get("job"))
         elif obs_cfg.enabled:
             # thread launcher: we SHARE the submitter's process, whose
             # journal/tracer are already installed — replacing them

@@ -45,21 +45,27 @@ __all__ = [
 
 
 def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
-                plane: str = "train"):
-    """Install the process-wide tracer + journal from a resolved
-    :class:`ObsConfig`.  Returns ``(tracer, journal)`` (either may be
-    None).  Subprocess workers pass their ``worker_index`` so their
-    journal lands beside the base path as ``<path>.w<index>`` (train
-    fleets) or ``<path>.s<index>`` (``--serve-workers`` scoring
-    processes) — one writer per file keeps the line-at-a-time
-    crash-safety contract honest across a fleet (the CLI reader merges
-    the set by timestamp).
+                plane: str = "train", job: str | None = None):
+    """Install the process-wide tracer + journal + SLO watchdog from a
+    resolved :class:`ObsConfig`.  Returns ``(tracer, journal)`` (either
+    may be None; the watchdog is reachable via ``obs.slo.active()``).
+    Subprocess workers pass their ``worker_index`` so their journal
+    lands beside the base path as ``<path>.w<index>`` (train fleets) or
+    ``<path>.s<index>`` (``--serve-workers`` scoring processes) — one
+    writer per file keeps the line-at-a-time crash-safety contract
+    honest across a fleet (the CLI reader merges the set by ``(ts,
+    writer, seq)``).  ``job`` is the fleet-wide correlation id every
+    event from this writer carries — mint one per job at the submitting
+    CLI (workers receive it via the register reply / ``--obs-job``), so
+    one merged journal can tell two jobs' events apart.
     """
     from shifu_tensorflow_tpu.obs import journal as journal_mod
     from shifu_tensorflow_tpu.obs import registry as registry_mod
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
     from shifu_tensorflow_tpu.obs import trace as trace_mod
 
     if not cfg.enabled:
+        slo_mod.uninstall()
         return None, None
     if cfg.hist_buckets:
         # scrape surfaces construct their registries AFTER the CLI
@@ -84,6 +90,13 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
             max_files=cfg.journal_max_files,
             plane=plane,
             worker=worker_index,
+            job=job,
         )
         journal_mod.install(jrn)
+    # the SLO watchdog installs whenever obs is on: with no slo-* target
+    # configured it still feeds the stpu_slo_* gauges and the anomaly
+    # detector — consumers (ScoringServer, Trainer) pick it up via
+    # slo.active() the same way the trainer picks up the tracer
+    slo_mod.install(slo_mod.from_config(cfg, plane=plane,
+                                        worker=worker_index))
     return tracer, jrn

@@ -1,13 +1,26 @@
-"""Observability CLI — render a journal as a time budget + fleet timeline.
+"""Observability CLI — render a journal as budgets, timelines, causal
+traces, and a live dashboard.
 
     python -m shifu_tensorflow_tpu.obs summary --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs tail    --journal /tmp/job.jsonl -n 40
+    python -m shifu_tensorflow_tpu.obs trace 4f2a91b0c3d4e5f6 --journal ...
+    python -m shifu_tensorflow_tpu.obs trace 0:3 --journal ...
+    python -m shifu_tensorflow_tpu.obs top     --journal /tmp/job.jsonl
 
 Works on a finished or a RUNNING job: readers never lock writers, and a
 torn final line (writer killed mid-event) is skipped, not fatal.  The
 ``--journal`` path is the base the job was configured with
-(``shifu.tpu.obs-journal``); fleet-worker siblings (``.w<k>``) and
-rotations (``.N``) are discovered and merged by timestamp.
+(``shifu.tpu.obs-journal``); fleet-worker siblings (``.w<k>`` train,
+``.s<k>`` serve) and rotations (``.N``) are discovered and merged by
+``(ts, writer, seq)``.
+
+``trace`` reconstructs ONE causal story: a request id (as minted at
+serve ingress / supplied via ``X-Request-Id``) or one worker's epoch
+(``worker:epoch``) across every plane that touched it.  ``top`` is a
+live terminal dashboard (``--once`` for CI) that tails the journals and
+optionally scrapes ``/metrics`` URLs.  ``summary`` and ``tail`` take
+``--json`` for machine-readable output — scripts and the autoscaling
+supervisor must not screen-scrape the human renderer.
 
 stdlib-only and jax-free: this must run on an operator's laptop against
 a journal scp'd out of a dead fleet.
@@ -16,7 +29,10 @@ a journal scp'd out of a dead fleet.
 from __future__ import annotations
 
 import argparse
+import json
+import re
 import sys
+import time
 from collections import defaultdict
 
 from shifu_tensorflow_tpu.obs.journal import journal_files, read_events
@@ -25,6 +41,10 @@ from shifu_tensorflow_tpu.obs.journal import journal_files, read_events
 #: event, but these get rendered even under --compact aggregation)
 _STEP_PHASES = ("infeed", "host", "dispatch", "block")
 
+#: per-dispatch request records — high-volume, elided from the fleet
+#: timeline (trace/top still read them)
+_BULK_EVENTS = ("step_breakdown", "serve_batch")
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -32,28 +52,66 @@ def build_parser() -> argparse.ArgumentParser:
         description="Inspect a shifu.tpu.obs-journal event journal.",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
+
     tail = sub.add_parser("tail", help="print the last N events")
     tail.add_argument("--journal", required=True,
                       help="journal base path (shifu.tpu.obs-journal)")
     tail.add_argument("-n", type=int, default=20, dest="count",
                       help="events to show (default 20)")
+    tail.add_argument("--json", action="store_true", dest="as_json",
+                      help="raw events, one JSON object per line")
+
     summ = sub.add_parser(
         "summary",
-        help="per-step time budget + fleet event timeline",
+        help="per-step time budget + serve plane + fleet event timeline",
     )
     summ.add_argument("--journal", required=True,
                       help="journal base path (shifu.tpu.obs-journal)")
     summ.add_argument("--timeline-limit", type=int, default=200,
                       help="max timeline rows (default 200; 0 = all)")
+    summ.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable summary document")
+
+    trace = sub.add_parser(
+        "trace",
+        help="causal timeline of one request (rid) or one step "
+             "(worker:epoch) across every plane",
+    )
+    trace.add_argument("id",
+                       help="a request correlation id (X-Request-Id / "
+                            "minted rid), or worker:epoch (e.g. 0:3)")
+    trace.add_argument("--journal", required=True,
+                       help="journal base path (shifu.tpu.obs-journal)")
+    trace.add_argument("--json", action="store_true", dest="as_json",
+                       help="matching events, one JSON object per line")
+
+    top = sub.add_parser(
+        "top",
+        help="live dashboard: tail the journals (+ optionally scrape "
+             "/metrics) and render fleet state",
+    )
+    top.add_argument("--journal", required=True,
+                     help="journal base path (shifu.tpu.obs-journal)")
+    top.add_argument("--metrics-url", action="append", default=[],
+                     dest="metrics_urls",
+                     help="a /metrics URL to scrape each refresh "
+                          "(repeatable); failures are tolerated — the "
+                          "journal alone still renders")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh seconds (default 2)")
+    top.add_argument("--once", action="store_true",
+                     help="render one frame and exit (CI / dead fleets)")
     return p
 
+
+# ---- shared rendering ----
 
 def _fmt_event(ev: dict, t0: float) -> str:
     ts = ev.get("ts", t0)
     plane = ev.get("plane", "?")
     worker = ev.get("worker")
     who = f"{plane} w{worker}" if worker is not None else plane
-    skip = {"ts", "event", "plane", "worker"}
+    skip = {"ts", "event", "plane", "worker", "seq", "job"}
     detail = " ".join(
         f"{k}={_short(v)}" for k, v in ev.items() if k not in skip
     )
@@ -74,16 +132,22 @@ def cmd_tail(args) -> int:
               f"(files: {journal_files(args.journal) or 'none'})",
               file=sys.stderr)
         return 1
+    shown = events[-args.count:]
+    if args.as_json:
+        for ev in shown:
+            print(json.dumps(ev, separators=(",", ":"), default=str))
+        return 0
     t0 = events[0].get("ts", 0.0)
-    for ev in events[-args.count:]:
+    for ev in shown:
         print(_fmt_event(ev, t0))
     return 0
 
 
-def _step_budget(events: list[dict]) -> list[str]:
+# ---- step budget (data + renderer) ----
+
+def _budget_data(events: list[dict]) -> dict:
     """Aggregate step_breakdown (+ matching epoch) events into one
-    budget row per worker: where each step's wall clock went."""
-    # (worker) -> accumulated phase seconds / steps / epochs
+    budget record per worker: where each step's wall clock went."""
     acc: dict = defaultdict(lambda: {
         "epochs": 0, "steps": 0,
         "infeed_wait": 0.0, "infeed_put": 0.0, "host_produce": 0.0,
@@ -107,28 +171,49 @@ def _step_budget(events: list[dict]) -> list[str]:
                 a["spans"][name]["total_s"] += float(s.get("total_s", 0.0))
         elif ev.get("event") == "epoch":
             epoch_wall[w] += float(ev.get("train_time_s", 0.0))
-    if not acc:
-        return ["  (no step_breakdown events — was the run traced? "
-                "set shifu.tpu.obs-enabled=true / --obs)"]
-    lines = [
-        "  worker  epochs  steps  step_ms   infeed%   host%  dispatch%"
-        "  block%  other%"
-    ]
+    workers = {}
     for w in sorted(acc):
         a = acc[w]
         phase_total = sum(a[p] for p in _STEP_PHASES)
         wall = epoch_wall.get(w, 0.0) or phase_total
         denom = max(wall, phase_total) or 1.0
         other = max(0.0, denom - phase_total)
-        step_ms = (denom / a["steps"] * 1000.0) if a["steps"] else 0.0
-        pct = {p: 100.0 * a[p] / denom for p in _STEP_PHASES}
+        workers[w] = {
+            "epochs": a["epochs"], "steps": a["steps"],
+            "wall_s": round(denom, 6),
+            "step_ms": round(denom / a["steps"] * 1000.0, 3)
+            if a["steps"] else 0.0,
+            "pct": {
+                **{p: round(100.0 * a[p] / denom, 1)
+                   for p in _STEP_PHASES},
+                "other": round(100.0 * other / denom, 1),
+            },
+            "infeed_wait_pct": round(100.0 * a["infeed_wait"] / denom, 1),
+            "infeed_put_pct": round(100.0 * a["infeed_put"] / denom, 1),
+            "host_produce_pct": round(100.0 * a["host_produce"] / denom, 1),
+            "spans": {k: dict(v) for k, v in sorted(a["spans"].items())},
+        }
+    return workers
+
+
+def _render_budget(workers: dict) -> list[str]:
+    if not workers:
+        return ["  (no step_breakdown events — was the run traced? "
+                "set shifu.tpu.obs-enabled=true / --obs)"]
+    lines = [
+        "  worker  epochs  steps  step_ms   infeed%   host%  dispatch%"
+        "  block%  other%"
+    ]
+    for w, a in workers.items():
+        pct = a["pct"]
         lines.append(
-            f"  {w:<7} {a['epochs']:<7} {a['steps']:<6} {step_ms:<9.3f}"
+            f"  {w:<7} {a['epochs']:<7} {a['steps']:<6} {a['step_ms']:<9.3f}"
             f" {pct['infeed']:<9.1f} {pct['host']:<6.1f}"
             f" {pct['dispatch']:<10.1f} {pct['block']:<7.1f}"
-            f" {100.0 * other / denom:.1f}"
+            f" {pct['other']:.1f}"
         )
-        if a["infeed_wait"] or a["infeed_put"] or a["host_produce"]:
+        if a["infeed_wait_pct"] or a["infeed_put_pct"] \
+                or a["host_produce_pct"]:
             # pipelined infeed: wait is the consumer's stall (part of the
             # infeed%% above); put and host-produce are work on the put
             # thread, overlapped with dispatch — wait-heavy means STARVED
@@ -136,36 +221,36 @@ def _step_budget(events: list[dict]) -> list[str]:
             # (transfer/pad cost; see docs/ingest.md)
             line = (
                 f"          infeed split: wait "
-                f"{100.0 * a['infeed_wait'] / denom:.1f}% of wall, put "
-                f"{100.0 * a['infeed_put'] / denom:.1f}% (overlapped)"
+                f"{a['infeed_wait_pct']:.1f}% of wall, put "
+                f"{a['infeed_put_pct']:.1f}% (overlapped)"
             )
-            if a["host_produce"]:
+            if a["host_produce_pct"]:
                 line += (f", host produce "
-                         f"{100.0 * a['host_produce'] / denom:.1f}%"
+                         f"{a['host_produce_pct']:.1f}%"
                          f" (overlapped)")
             lines.append(line)
         span_bits = [
             f"{name} {s['count']}x {s['total_s']:.3f}s"
-            for name, s in sorted(a["spans"].items())
+            for name, s in a["spans"].items()
         ]
         if span_bits:
             lines.append(f"          spans: {', '.join(span_bits)}")
     return lines
 
 
-def _serve_plane(events: list[dict]) -> list[str]:
-    """Aggregate the serve plane's lifecycle events into one row per
-    scoring process: request volume and rate (from serve_start/stop),
-    shed pressure, and reload outcomes — the per-worker split the
-    SO_REUSEPORT fleet's per-process /metrics cannot show in one
-    place."""
+# ---- serve plane (data + renderer) ----
+
+def _serve_data(events: list[dict]) -> dict:
+    """Aggregate the serve plane's lifecycle events: request volume and
+    rate per scoring process, shed pressure, reload outcomes, and the
+    fleet split — what the SO_REUSEPORT fleet's per-process /metrics
+    cannot show in one place."""
     serve = [e for e in events if e.get("plane") == "serve"]
     if not serve:
-        return []
+        return {}
     per: dict = defaultdict(lambda: {
         "start_ts": None, "stop_ts": None, "requests": None,
         "reloads": 0, "refused": 0, "shed_events": 0, "shed_total": 0,
-        "restarts": 0,
     })
     fleet = {"workers": None, "restarts": 0}
     for ev in serve:
@@ -191,9 +276,27 @@ def _serve_plane(events: list[dict]) -> list[str]:
             fleet["workers"] = ev.get("workers")
         elif kind in ("serve_worker_restart",):
             fleet["restarts"] += 1
-    rows = {w: a for w, a in per.items()
-            if a["start_ts"] is not None or a["requests"] is not None
-            or a["reloads"] or a["refused"] or a["shed_events"]}
+    rows = {}
+    for w, a in per.items():
+        if (a["start_ts"] is None and a["requests"] is None
+                and not a["reloads"] and not a["refused"]
+                and not a["shed_events"]):
+            continue
+        rate = None
+        if (a["requests"] is not None and a["start_ts"] is not None
+                and a["stop_ts"] is not None
+                and a["stop_ts"] > a["start_ts"]):
+            rate = round(a["requests"] / (a["stop_ts"] - a["start_ts"]), 1)
+        rows[w] = {**{k: v for k, v in a.items()
+                      if k not in ("start_ts", "stop_ts")},
+                   "req_per_s": rate}
+    return {"fleet": fleet, "workers": rows}
+
+
+def _render_serve(data: dict) -> list[str]:
+    if not data:
+        return []
+    fleet, rows = data["fleet"], data["workers"]
     lines = []
     if fleet["workers"]:
         lines.append(f"  fleet: {fleet['workers']} workers"
@@ -213,11 +316,7 @@ def _serve_plane(events: list[dict]) -> list[str]:
         a = rows[w]
         who = "-" if w is None else str(w)
         reqs = a["requests"]
-        rate = ""
-        if (reqs is not None and a["start_ts"] is not None
-                and a["stop_ts"] is not None
-                and a["stop_ts"] > a["start_ts"]):
-            rate = f"{reqs / (a['stop_ts'] - a['start_ts']):.1f}"
+        rate = "" if a["req_per_s"] is None else f"{a['req_per_s']}"
         lines.append(
             f"  {who:<7} {('?' if reqs is None else reqs):<9} "
             f"{rate or '?':<8} {a['shed_total']:<6} {a['reloads']:<8} "
@@ -226,35 +325,121 @@ def _serve_plane(events: list[dict]) -> list[str]:
     return lines
 
 
-def cmd_summary(args) -> int:
-    files = journal_files(args.journal)
-    events = read_events(args.journal)
+# ---- slo plane (data + renderer) ----
+
+def _slo_data(events: list[dict]) -> dict:
+    """Last-known SLO state per signal from the journaled breach /
+    recover / anomaly transitions (obs/slo.py)."""
+    signals: dict = {}
+    for ev in events:
+        kind = ev.get("event")
+        if kind not in ("slo_breach", "slo_recover", "slo_anomaly"):
+            continue
+        name = ev.get("signal", "?")
+        s = signals.setdefault(name, {
+            "breaches": 0, "recovers": 0, "anomalies": 0,
+            "breached": False, "last_value": None, "target": None,
+            "last_ts": None, "worker": ev.get("worker"),
+        })
+        s["last_ts"] = ev.get("ts")
+        s["last_value"] = ev.get("value")
+        if kind == "slo_breach":
+            s["breaches"] += 1
+            s["breached"] = True
+            s["target"] = ev.get("target")
+            s["window"] = ev.get("window")
+        elif kind == "slo_recover":
+            s["recovers"] += 1
+            s["breached"] = False
+            s["target"] = ev.get("target")
+            s["breach_s"] = ev.get("breach_s")
+        else:
+            s["anomalies"] += 1
+            s["last_z"] = ev.get("z")
+    return signals
+
+
+def _render_slo(signals: dict, t0: float) -> list[str]:
+    if not signals:
+        return []
+    lines = ["  signal            state      value      target   "
+             "breaches  anomalies"]
+    for name in sorted(signals):
+        s = signals[name]
+        state = "BREACHED" if s["breached"] else "ok"
+        val = "?" if s["last_value"] is None else f"{s['last_value']:.4g}"
+        tgt = "-" if not s.get("target") else f"{s['target']:.4g}"
+        lines.append(
+            f"  {name:<17} {state:<10} {val:<10} {tgt:<8} "
+            f"{s['breaches']:<9} {s['anomalies']}"
+        )
+    return lines
+
+
+# ---- summary ----
+
+def _build_summary(base: str, cache: dict | None = None) -> dict | None:
+    files = journal_files(base)
+    events = read_events(base, cache=cache)
     if not events:
-        print(f"no journal events under {args.journal!r} "
-              f"(files: {files or 'none'})", file=sys.stderr)
-        return 1
+        return None
     t0 = events[0].get("ts", 0.0)
     t1 = events[-1].get("ts", t0)
-    counts = defaultdict(int)
+    counts: dict = defaultdict(int)
     for ev in events:
         counts[ev.get("event", "?")] += 1
-    print(f"journal {args.journal}: {len(events)} events in "
-          f"{len(files)} file(s), spanning {t1 - t0:.1f}s")
+    return {
+        "journal": base,
+        "files": files,
+        "events": len(events),
+        "t0": t0,
+        "t1": t1,
+        "span_s": round(t1 - t0, 3),
+        "jobs": sorted({e["job"] for e in events if "job" in e}),
+        "counts": dict(sorted(counts.items())),
+        "budget": _budget_data(events),
+        "serve": _serve_data(events),
+        "slo": _slo_data(events),
+        "_events": events,  # stripped before --json output
+    }
+
+
+def cmd_summary(args) -> int:
+    data = _build_summary(args.journal)
+    if data is None:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    events = data.pop("_events")
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    t0 = data["t0"]
+    print(f"journal {args.journal}: {data['events']} events in "
+          f"{len(data['files'])} file(s), spanning {data['span_s']:.1f}s"
+          + (f"  [job {', '.join(data['jobs'])}]" if data["jobs"] else ""))
     print("  " + ", ".join(
-        f"{name} x{n}" for name, n in sorted(counts.items())))
+        f"{name} x{n}" for name, n in data["counts"].items()))
     print()
     print("per-step time budget")
-    for line in _step_budget(events):
+    for line in _render_budget(data["budget"]):
         print(line)
     print()
-    serve_lines = _serve_plane(events)
+    serve_lines = _render_serve(data["serve"])
     if serve_lines:
         print("serve plane")
         for line in serve_lines:
             print(line)
         print()
+    slo_lines = _render_slo(data["slo"], t0)
+    if slo_lines:
+        print("slo")
+        for line in slo_lines:
+            print(line)
+        print()
     print("fleet timeline")
-    timeline = [e for e in events if e.get("event") != "step_breakdown"]
+    timeline = [e for e in events if e.get("event") not in _BULK_EVENTS]
     limit = args.timeline_limit
     shown = timeline if not limit else timeline[-limit:]
     if len(shown) < len(timeline):
@@ -265,12 +450,219 @@ def cmd_summary(args) -> int:
     return 0
 
 
+# ---- trace ----
+
+_COORD_RE = re.compile(r"^(\d+):(\d+)$")
+
+
+def _match_rid(ev: dict, rid: str) -> bool:
+    if ev.get("rid") == rid:
+        return True
+    rids = ev.get("rids")
+    return isinstance(rids, list) and rid in rids
+
+
+def _match_step(ev: dict, worker: int, epoch: int) -> bool:
+    if ev.get("epoch") != epoch:
+        return False
+    w = ev.get("worker")
+    # coordinator-plane records of the same epoch (epoch_summary,
+    # rollback directives) carry no worker, or the arbitrating one —
+    # they belong to every worker's story for that epoch
+    return w is None or w == worker or ev.get("plane") == "coordinator"
+
+
+def cmd_trace(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r}", file=sys.stderr)
+        return 1
+    m = _COORD_RE.match(args.id)
+    if m:
+        worker, epoch = int(m.group(1)), int(m.group(2))
+        sel = [e for e in events if _match_step(e, worker, epoch)]
+        what = f"worker {worker} epoch {epoch}"
+        if not sel:
+            # the serve sanitizer strips ':' from rids, but journals
+            # written by older builds (or by hand) may carry one — a
+            # missed worker:epoch query falls back to a rid match
+            # rather than refusing an id that is demonstrably present
+            sel = [e for e in events if _match_rid(e, args.id)]
+            if sel:
+                what = f"rid {args.id}"
+    else:
+        sel = [e for e in events if _match_rid(e, args.id)]
+        what = f"rid {args.id}"
+    if not sel:
+        print(f"no events for {what} under {args.journal!r} "
+              f"(is the journal's rotation window past it?)",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        for ev in sel:
+            print(json.dumps(ev, separators=(",", ":"), default=str))
+        return 0
+    t0 = sel[0].get("ts", 0.0)
+    planes = sorted({e.get("plane", "?") for e in sel})
+    jobs = sorted({e["job"] for e in sel if "job" in e})
+    print(f"trace {what}: {len(sel)} event(s) across "
+          f"plane(s) {', '.join(planes)}"
+          + (f"  [job {', '.join(jobs)}]" if jobs else ""))
+    for ev in sel:
+        print(" " + _fmt_event(ev, t0))
+    # the request's phase split, when a serve_batch dispatch carried it
+    for ev in sel:
+        if ev.get("event") == "serve_batch":
+            print(f"  -> coalesced into a {ev.get('rows', '?')}-row "
+                  f"dispatch (bucket {ev.get('bucket', '?')}, "
+                  f"{ev.get('requests', '?')} request(s)): waited "
+                  f"{ev.get('queue_delay_s', 0.0):.4f}s, device "
+                  f"{ev.get('dispatch_s', 0.0):.4f}s")
+    return 0
+
+
+# ---- top ----
+
+def _scrape(url: str, timeout: float = 2.0) -> dict[str, float]:
+    """One /metrics scrape → {metric_name: value} (labels stripped; the
+    last sample of a name wins).  Any failure returns {} — top renders
+    from the journal alone."""
+    import urllib.request
+
+    try:
+        text = urllib.request.urlopen(url, timeout=timeout).read().decode()
+    except Exception:
+        return {}
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            name = key.split("{", 1)[0]
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _render_top(base: str, urls: list[str],
+                cache: dict | None = None) -> list[str] | None:
+    data = _build_summary(base, cache=cache)
+    if data is None:
+        return None
+    events = data.pop("_events")
+    now = time.time()
+    scraped: dict[str, float] = {}
+    reachable = 0
+    for url in urls:
+        got = _scrape(url)
+        if got:
+            reachable += 1
+            scraped.update(got)
+    lines = []
+    age = now - data["t1"]
+    lines.append(
+        f"obs top — {base}  |  {data['events']} events, last {age:.1f}s ago"
+        + (f"  |  job {', '.join(data['jobs'])}" if data["jobs"] else "")
+        + (f"  |  scraped {reachable}/{len(urls)} targets" if urls else "")
+    )
+    lines.append("")
+    # slo: journaled transitions + live gauges when a scrape answered
+    slo = data["slo"]
+    gauge_signals = sorted({
+        m[len("stpu_slo_"):].removesuffix("_breached").removesuffix(
+            "_target").removesuffix("_z")
+        for m in scraped if m.startswith("stpu_slo_")
+    })
+    if slo or gauge_signals:
+        lines.append("slo")
+        names = sorted(set(slo) | set(gauge_signals))
+        lines.append("  signal            state      value      target")
+        for name in names:
+            s = slo.get(name, {})
+            value = scraped.get(f"stpu_slo_{name}", s.get("last_value"))
+            target = scraped.get(f"stpu_slo_{name}_target", s.get("target"))
+            live = scraped.get(f"stpu_slo_{name}_breached")
+            breached = bool(live) if live is not None \
+                else s.get("breached", False)
+            lines.append(
+                f"  {name:<17} {'BREACHED' if breached else 'ok':<10} "
+                f"{'?' if value is None else f'{value:.4g}':<10} "
+                f"{'-' if not target else f'{target:.4g}'}"
+            )
+        lines.append("")
+    # train budget
+    budget = data["budget"]
+    if budget:
+        lines.append("train")
+        lines.append("  worker  epochs  steps  step_ms   infeed%  other%")
+        for w, a in budget.items():
+            lines.append(
+                f"  {w:<7} {a['epochs']:<7} {a['steps']:<6} "
+                f"{a['step_ms']:<9.3f} {a['pct']['infeed']:<8.1f} "
+                f"{a['pct']['other']:.1f}"
+            )
+        lines.append("")
+    # serve plane: journal rows, live counters when scraped
+    serve = data["serve"]
+    if serve and (serve["workers"] or serve["fleet"]["workers"]):
+        lines.append("serve")
+        for line in _render_serve(serve):
+            lines.append(line)
+        live_reqs = scraped.get("stpu_serve_requests_total")
+        if live_reqs is not None:
+            lines.append(
+                f"  live: requests {int(live_reqs)}, shed "
+                f"{int(scraped.get('stpu_serve_shed_total', 0))}, queue "
+                f"{int(scraped.get('stpu_serve_queue_rows', 0))} rows "
+                f"(one scraped worker's view)"
+            )
+        lines.append("")
+    lines.append("recent events")
+    t0 = data["t0"]
+    timeline = [e for e in events if e.get("event") not in _BULK_EVENTS]
+    for ev in timeline[-8:]:
+        lines.append(" " + _fmt_event(ev, t0))
+    return lines
+
+
+def cmd_top(args) -> int:
+    # per-file parse cache: rotated journal files are immutable, so each
+    # refresh re-reads only the growing active files, not the whole
+    # rotation set ("tail", not "re-read everything, every 2 seconds")
+    cache: dict = {}
+    while True:
+        frame = _render_top(args.journal, args.metrics_urls, cache)
+        if frame is None:
+            print(f"no journal events under {args.journal!r} "
+                  f"(files: {journal_files(args.journal) or 'none'})",
+                  file=sys.stderr)
+            return 1
+        if args.once:
+            print("\n".join(frame))
+            return 0
+        # ANSI clear + home: a plain terminal dashboard, no curses dep
+        sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(frame) + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         if args.cmd == "tail":
             return cmd_tail(args)
+        if args.cmd == "trace":
+            return cmd_trace(args)
+        if args.cmd == "top":
+            return cmd_top(args)
         return cmd_summary(args)
+    except KeyboardInterrupt:
+        return 0
     except BrokenPipeError:
         # `... | head` closes our stdout mid-timeline; that is the
         # reader's prerogative, not an error

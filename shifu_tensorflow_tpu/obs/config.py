@@ -26,6 +26,16 @@ class ObsConfig:
     journal_max_files: int = K.DEFAULT_OBS_JOURNAL_MAX_FILES
     trace_sample: int = K.DEFAULT_OBS_TRACE_SAMPLE
     hist_buckets: tuple[float, ...] = field(default_factory=tuple)
+    # SLO watchdog (shifu.tpu.slo-* — obs/slo.py): window + hysteresis +
+    # per-plane targets.  Flat fields (not a nested dataclass) so the
+    # existing WorkerConfig JSON bridge carries them unchanged.
+    slo_window_s: float = K.DEFAULT_SLO_WINDOW_S
+    slo_serve_p99_ms: float = K.DEFAULT_SLO_SERVE_P99_MS
+    slo_serve_shed_rate: float = K.DEFAULT_SLO_SERVE_SHED_RATE
+    slo_step_time_ms: float = K.DEFAULT_SLO_STEP_TIME_MS
+    slo_infeed_frac: float = K.DEFAULT_SLO_INFEED_FRAC
+    slo_hysteresis: int = K.DEFAULT_SLO_HYSTERESIS
+    slo_anomaly_sigma: float = K.DEFAULT_SLO_ANOMALY_SIGMA
 
     def __post_init__(self):
         if self.journal_max_bytes < 4096:
@@ -45,6 +55,22 @@ class ObsConfig:
                 f"{K.OBS_HIST_BUCKETS} must be positive and ascending, "
                 f"got {self.hist_buckets}"
             )
+        if self.slo_window_s <= 0:
+            raise ValueError(f"{K.SLO_WINDOW_S} must be > 0")
+        if self.slo_hysteresis < 1:
+            raise ValueError(f"{K.SLO_HYSTERESIS} must be >= 1")
+        for key, val in ((K.SLO_SERVE_P99_MS, self.slo_serve_p99_ms),
+                         (K.SLO_SERVE_SHED_RATE, self.slo_serve_shed_rate),
+                         (K.SLO_STEP_TIME_MS, self.slo_step_time_ms),
+                         (K.SLO_INFEED_FRAC, self.slo_infeed_frac),
+                         (K.SLO_ANOMALY_SIGMA, self.slo_anomaly_sigma)):
+            if val < 0:
+                raise ValueError(f"{key} must be >= 0 (0 = disabled), "
+                                 f"got {val}")
+        for key, val in ((K.SLO_SERVE_SHED_RATE, self.slo_serve_shed_rate),
+                         (K.SLO_INFEED_FRAC, self.slo_infeed_frac)):
+            if val > 1:
+                raise ValueError(f"{key} is a fraction in [0, 1], got {val}")
 
     def to_json(self) -> dict:
         d = asdict(self)
@@ -99,4 +125,17 @@ def resolve_obs_config(args, conf) -> ObsConfig:
         hist_buckets=parse_buckets(
             conf.get(K.OBS_HIST_BUCKETS, K.DEFAULT_OBS_HIST_BUCKETS) or ""
         ),
+        slo_window_s=conf.get_float(K.SLO_WINDOW_S, K.DEFAULT_SLO_WINDOW_S),
+        slo_serve_p99_ms=conf.get_float(K.SLO_SERVE_P99_MS,
+                                        K.DEFAULT_SLO_SERVE_P99_MS),
+        slo_serve_shed_rate=conf.get_float(K.SLO_SERVE_SHED_RATE,
+                                           K.DEFAULT_SLO_SERVE_SHED_RATE),
+        slo_step_time_ms=conf.get_float(K.SLO_STEP_TIME_MS,
+                                        K.DEFAULT_SLO_STEP_TIME_MS),
+        slo_infeed_frac=conf.get_float(K.SLO_INFEED_FRAC,
+                                       K.DEFAULT_SLO_INFEED_FRAC),
+        slo_hysteresis=conf.get_int(K.SLO_HYSTERESIS,
+                                    K.DEFAULT_SLO_HYSTERESIS),
+        slo_anomaly_sigma=conf.get_float(K.SLO_ANOMALY_SIGMA,
+                                         K.DEFAULT_SLO_ANOMALY_SIGMA),
     )

@@ -30,6 +30,7 @@ the job it observes.
 from __future__ import annotations
 
 import glob
+import itertools
 import json
 import os
 import re
@@ -62,12 +63,21 @@ class Journal:
         max_files: int = 4,
         plane: str | None = None,
         worker: int | None = None,
+        job: str | None = None,
     ):
         self.path = os.fspath(path)
         self.max_bytes = max(4096, int(max_bytes))
         self.max_files = max(1, int(max_files))
         self.plane = plane
         self.worker = worker
+        self.job = job
+        # per-writer monotonic sequence: same-microsecond events from one
+        # writer (and across its rotations) keep their emission order in
+        # the merged read — `obs trace`'s causal ordering depends on it.
+        # itertools.count: atomic under the GIL, never resets (a process
+        # restart writing the same path starts a new Journal, but its
+        # first event's ts is always past the old tail's).
+        self._seq = itertools.count()
         self._lock = threading.Lock()
         self._file = None
         self._size = 0
@@ -77,18 +87,21 @@ class Journal:
 
     # ---- writing ----
     def emit(self, event: str, **fields: Any) -> None:
-        rec: dict[str, Any] = {"ts": round(time.time(), 6), "event": event}
+        rec: dict[str, Any] = {"ts": round(time.time(), 6),
+                               "seq": next(self._seq), "event": event}
         if self.plane is not None:
             rec["plane"] = self.plane
         if self.worker is not None:
             rec["worker"] = self.worker
+        if self.job is not None:
+            rec["job"] = self.job
         rec.update(fields)
         try:
             line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         except (TypeError, ValueError) as e:
             # an unserializable field must not kill the event, let alone
             # the job — record what we can plus the failure
-            fallback = {"ts": rec["ts"], "event": event,
+            fallback = {"ts": rec["ts"], "seq": rec["seq"], "event": event,
                         "journal_error": f"{type(e).__name__}: {e}"}
             if self.plane is not None:
                 fallback["plane"] = self.plane
@@ -254,11 +267,56 @@ def iter_events(path: str) -> Iterator[dict]:
                 yield ev
 
 
-def read_events(base: str) -> list[dict]:
+def read_events(base: str, cache: dict | None = None) -> list[dict]:
     """All intact events of the journal (every writer, every rotation),
-    merged oldest-first by timestamp."""
-    events: list[dict] = []
+    merged oldest-first by ``(ts, writer, seq)``.
+
+    The ``seq`` tiebreak matters for causal reads: two events emitted in
+    the same microsecond by one writer (or straddling a rotation) would
+    otherwise merge in whatever order the sort left them, and ``obs
+    trace`` renders the merged order as causality.  Events predating the
+    ``seq`` field fall back to their position within the writer's file
+    set (journal_files returns each writer's rotations oldest-first, so
+    position IS emission order).
+
+    ``cache`` (an initially-empty dict the caller keeps between calls)
+    makes repeated reads incremental: a file whose ``(size, mtime)``
+    is unchanged reuses its parsed events instead of re-reading JSONL —
+    rotated files are immutable, so a poller like ``obs top`` pays only
+    for the growing active file per refresh, not the whole rotation
+    set."""
+    base = os.fspath(base)
+    pat = re.compile(
+        re.escape(os.path.basename(base)) + r"(\.([ws])(\d+))?(\.\d+)?$"
+    )
+    keyed: list[tuple[float, tuple, int, dict]] = []
+    positions: dict[tuple, int] = {}
     for path in journal_files(base):
-        events.extend(iter_events(path))
-    events.sort(key=lambda e: e.get("ts", 0.0))
-    return events
+        m = pat.fullmatch(os.path.basename(path))
+        writer = ((-1, -1) if not m or not m.group(2)
+                  else ({"w": 0, "s": 1}[m.group(2)], int(m.group(3))))
+        if cache is not None:
+            try:
+                st = os.stat(path)
+                sig = (st.st_size, st.st_mtime_ns)
+            except OSError:
+                continue
+            hit = cache.get(path)
+            if hit is not None and hit[0] == sig:
+                parsed = hit[1]
+            else:
+                parsed = list(iter_events(path))
+                cache[path] = (sig, parsed)
+        else:
+            parsed = iter_events(path)
+        pos = positions.get(writer, 0)
+        for ev in parsed:
+            seq = ev.get("seq")
+            keyed.append((
+                ev.get("ts", 0.0), writer,
+                seq if isinstance(seq, int) else pos, ev,
+            ))
+            pos += 1
+        positions[writer] = pos
+    keyed.sort(key=lambda t: t[:3])
+    return [t[3] for t in keyed]

@@ -177,6 +177,18 @@ class MetricsRegistry:
                     '%s%s{quantile="0.%02d"} %g'
                     % (prefix, name, q, hist.percentile(q))
                 )
+            # real CUMULATIVE buckets beside the quantile gauges: the
+            # quantiles above are bucket upper bounds (convenient but
+            # ladder-quantized), while the _bucket series lets an
+            # external Prometheus run histogram_quantile() itself —
+            # cumulative counts, +Inf == _count, per the exposition
+            # format's histogram convention
+            acc = 0
+            for bound, c in snap["buckets"].items():
+                acc += c
+                lines.append(
+                    '%s%s_bucket{le="%s"} %d' % (prefix, name, bound, acc)
+                )
             lines.append(f"{prefix}{name}_count {snap['count']}")
             lines.append(f"{prefix}{name}_sum {snap['sum']:.6f}")
         return "\n".join(lines) + "\n"

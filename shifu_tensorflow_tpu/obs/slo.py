@@ -1,0 +1,557 @@
+"""SLO watchdog: windowed quantile digests, breach/recover events, and
+an EWMA-z anomaly detector.
+
+The PR-4 obs plane answers *aggregate* questions ("what fraction of the
+step went to infeed?"); this module answers *temporal* ones ("is p99
+degrading RIGHT NOW?") and turns the answer into journaled state
+transitions (``slo_breach`` / ``slo_recover``) a supervisor policy can
+act on — the tf.data lesson (arxiv 2101.12127) applied to the control
+plane: close loops from live measured signals, not hand-set thresholds
+read once at startup.
+
+Three pieces, all stdlib, all bounded-memory:
+
+- :class:`P2Quantile` — the P² streaming quantile estimator (Jain &
+  Chlamtác 1985): five markers, O(1) update, no samples retained.
+  Unlike :class:`obs.registry.LatencyHistogram` — whose ``percentile``
+  returns the UPPER BOUND of the bucket holding the rank (conservative,
+  ladder-quantized) — P² interpolates a point estimate, so a p99 moving
+  *within* one histogram bucket is still visible to the watchdog.
+- :class:`WindowedDigest` — a sliding window as a ring of time-bucket
+  cells, each holding one P² estimator per tracked quantile plus
+  count/sum/max.  Old cells expire by falling out of the ring; the
+  window statistic merges live cells (count-weighted for quantiles — an
+  estimate, exact when the cells are load-homogeneous).  Memory is
+  O(buckets × quantiles), independent of request rate.
+- :class:`EwmaZ` — EWMA mean/variance tracker producing a z-score per
+  observation, for the "no target configured but this just jumped 6σ"
+  case (``slo_anomaly`` events).
+
+:class:`SloWatchdog` composes them: ``observe``/``count`` on the hot
+path (one lock + a handful of float ops), ``evaluate`` on a slow tick
+(serve: a background thread; train: per epoch).  Breach detection is
+hysteretic — ``slo-hysteresis`` consecutive breaching evaluations flip
+to BREACHED (one ``slo_breach`` event carrying the offending window's
+digest snapshot), the same count of clean evaluations flips back (one
+``slo_recover`` with the breach duration).  A signal with no target
+still feeds the anomaly detector and the ``stpu_slo_*`` gauges, which
+every ``/metrics`` surface appends — the sensor the ROADMAP item-4
+autoscaler consumes for free.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Any
+
+from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+
+__all__ = [
+    "P2Quantile",
+    "WindowedDigest",
+    "WindowedCounter",
+    "EwmaZ",
+    "SloWatchdog",
+    "from_config",
+    "install",
+    "uninstall",
+    "active",
+]
+
+_mono = time.monotonic
+
+
+class P2Quantile:
+    """Streaming single-quantile estimator (the P² algorithm): five
+    markers track the running quantile without storing observations.
+    ``value()`` is a point estimate that converges to the true quantile;
+    with fewer than five observations it falls back to the nearest-rank
+    quantile of what it has."""
+
+    __slots__ = ("p", "_q", "_n", "_np", "_dn", "count")
+
+    def __init__(self, p: float):
+        if not 0.0 < p < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {p}")
+        self.p = p
+        self.count = 0
+        self._q: list[float] = []  # marker heights (first 5: raw sorted)
+        self._n: list[int] = []    # marker positions
+        self._np: list[float] = []  # desired positions
+        self._dn = (0.0, p / 2.0, p, (1.0 + p) / 2.0, 1.0)
+
+    def add(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        if self.count <= 5:
+            bisect.insort(self._q, x)
+            if self.count == 5:
+                self._n = [0, 1, 2, 3, 4]
+                self._np = [0.0, 2.0 * self.p, 4.0 * self.p,
+                            2.0 + 2.0 * self.p, 4.0]
+            return
+        q, n = self._q, self._n
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 3
+            for i in range(1, 5):
+                if x < q[i]:
+                    k = i - 1
+                    break
+        for i in range(k + 1, 5):
+            n[i] += 1
+        for i in range(5):
+            self._np[i] += self._dn[i]
+        # adjust the three interior markers toward their desired positions
+        for i in (1, 2, 3):
+            d = self._np[i] - n[i]
+            if (d >= 1.0 and n[i + 1] - n[i] > 1) or (
+                d <= -1.0 and n[i - 1] - n[i] < -1
+            ):
+                step = 1 if d >= 0 else -1
+                cand = self._parabolic(i, step)
+                if not q[i - 1] < cand < q[i + 1]:
+                    cand = self._linear(i, step)
+                q[i] = cand
+                n[i] += step
+
+    def _parabolic(self, i: int, d: int) -> float:
+        q, n = self._q, self._n
+        return q[i] + d / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + d) * (q[i + 1] - q[i]) / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1]) / (n[i] - n[i - 1])
+        )
+
+    def _linear(self, i: int, d: int) -> float:
+        q, n = self._q, self._n
+        return q[i] + d * (q[i + d] - q[i]) / (n[i + d] - n[i])
+
+    def value(self) -> float | None:
+        if self.count == 0:
+            return None
+        if self.count < 5:
+            # nearest-rank on the raw (sorted) observations so far
+            rank = max(0, min(len(self._q) - 1,
+                              int(math.ceil(self.p * len(self._q))) - 1))
+            return self._q[rank]
+        return self._q[2]
+
+
+class _Cell:
+    """One time bucket of a sliding window."""
+
+    __slots__ = ("start", "count", "sum", "max", "p2")
+
+    def __init__(self, start: float, quantiles: tuple[float, ...]):
+        self.start = start
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self.p2 = {q: P2Quantile(q) for q in quantiles}
+
+
+class WindowedDigest:
+    """Sliding-window streaming digest: the window splits into
+    ``buckets`` time cells, each a P²-per-quantile digest; a cell whose
+    ring slot comes around again is reset, so observations older than
+    the window can never contribute.  ``snapshot`` merges live cells —
+    quantiles combine count-weighted across cells (a bounded-memory
+    estimate; exact when the cells saw similar distributions)."""
+
+    def __init__(self, window_s: float = 60.0, buckets: int = 6,
+                 quantiles: tuple[float, ...] = (0.5, 0.9, 0.99)):
+        self.window_s = float(window_s)
+        self.buckets = max(2, int(buckets))
+        self.bucket_s = self.window_s / self.buckets
+        self.quantiles = tuple(quantiles)
+        self._cells: list[_Cell | None] = [None] * self.buckets
+        self._lock = threading.Lock()
+
+    def _cell(self, now: float) -> _Cell:
+        start = (now // self.bucket_s) * self.bucket_s
+        idx = int(now // self.bucket_s) % self.buckets
+        cell = self._cells[idx]
+        if cell is None or cell.start != start:
+            cell = _Cell(start, self.quantiles)
+            self._cells[idx] = cell
+        return cell
+
+    def add(self, x: float, now: float | None = None) -> None:
+        now = _mono() if now is None else now
+        with self._lock:
+            cell = self._cell(now)
+            cell.count += 1
+            cell.sum += x
+            if x > cell.max:
+                cell.max = x
+            for p2 in cell.p2.values():
+                p2.add(x)
+
+    def snapshot(self, now: float | None = None) -> dict | None:
+        """Merged window statistics, or None when the window holds no
+        observations (the signal is then "absent", not zero)."""
+        now = _mono() if now is None else now
+        with self._lock:
+            live = [c for c in self._cells
+                    if c is not None and now - c.start < self.window_s
+                    and c.count > 0]
+            total = sum(c.count for c in live)
+            if not total:
+                return None
+            out: dict[str, Any] = {
+                "count": total,
+                "sum": sum(c.sum for c in live),
+                "max": max(c.max for c in live),
+            }
+            out["mean"] = out["sum"] / total
+            for q in self.quantiles:
+                est = [(c.count, c.p2[q].value()) for c in live]
+                out[f"p{int(q * 100)}"] = (
+                    sum(n * v for n, v in est if v is not None) / total
+                )
+            return out
+
+
+class WindowedCounter:
+    """Sliding-window event counter (same ring-of-cells discipline as
+    :class:`WindowedDigest`, counts only) — rate signals like shed
+    fraction divide two of these over the same window."""
+
+    def __init__(self, window_s: float = 60.0, buckets: int = 6):
+        self.window_s = float(window_s)
+        self.buckets = max(2, int(buckets))
+        self.bucket_s = self.window_s / self.buckets
+        self._cells: list[list[float] | None] = [None] * self.buckets
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1, now: float | None = None) -> None:
+        now = _mono() if now is None else now
+        start = (now // self.bucket_s) * self.bucket_s
+        idx = int(now // self.bucket_s) % self.buckets
+        with self._lock:
+            cell = self._cells[idx]
+            if cell is None or cell[0] != start:
+                cell = [start, 0]
+                self._cells[idx] = cell
+            cell[1] += n
+
+    def total(self, now: float | None = None) -> int:
+        now = _mono() if now is None else now
+        with self._lock:
+            return sum(
+                c[1] for c in self._cells
+                if c is not None and now - c[0] < self.window_s
+            )
+
+
+class EwmaZ:
+    """EWMA mean/variance tracker: ``update(x)`` returns the z-score of
+    ``x`` against the PRE-update statistics (so the excursion itself
+    does not dilute its own detection), then folds ``x`` in.  Returns
+    None during warm-up.  The std floor is relative — 2% of the larger
+    of |mean| and |x| — so a near-constant signal doesn't alarm on
+    float jitter (at the default 6σ an excursion must move ≥12% of the
+    running mean to fire) and a signal sitting at exactly 0 (e.g. a
+    shed rate before the first shed) yields a bounded z (≤50) instead
+    of dividing by nothing."""
+
+    def __init__(self, alpha: float = 0.2, warmup: int = 8):
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self._mean: float | None = None
+        self._var = 0.0
+        self._n = 0
+
+    def update(self, x: float) -> float | None:
+        self._n += 1
+        if self._mean is None:
+            self._mean = float(x)
+            return None
+        std = math.sqrt(max(0.0, self._var))
+        floor = 1e-12 + 0.02 * max(abs(self._mean), abs(x))
+        z = (x - self._mean) / max(std, floor)
+        d = x - self._mean
+        self._mean += self.alpha * d
+        self._var = (1.0 - self.alpha) * (self._var + self.alpha * d * d)
+        return z if self._n > self.warmup else None
+
+
+class _TrackedSignal:
+    __slots__ = ("name", "stat", "target", "unit", "num", "den",
+                 "breached", "bad", "good", "since", "ewma", "anomalous")
+
+    def __init__(self, name: str, stat: str, target: float, unit: str,
+                 num: str | None = None, den: str | None = None):
+        self.name = name
+        self.stat = stat     # p50|p90|p99|mean|max|rate
+        self.target = float(target)
+        self.unit = unit
+        self.num = num       # rate signals: numerator / denominator
+        self.den = den       # counter names
+        self.breached = False
+        self.bad = 0
+        self.good = 0
+        self.since: float | None = None
+        self.ewma = EwmaZ()
+        self.anomalous = False
+
+
+class SloWatchdog:
+    """Windowed SLO evaluation with hysteresis and anomaly detection.
+
+    Hot path: ``observe(signal, value)`` / ``count(name)`` — one digest
+    or counter update.  Slow path: ``evaluate()`` — compute each tracked
+    signal's window statistic, compare against its target, journal
+    ``slo_breach`` / ``slo_recover`` transitions, update the
+    ``stpu_slo_*`` gauges, and run the EWMA-z anomaly check.  Evaluation
+    and observation may race freely (every structure locks internally).
+    """
+
+    def __init__(self, *, window_s: float = 60.0, hysteresis: int = 2,
+                 anomaly_sigma: float = 6.0, plane: str = "train",
+                 worker: int | None = None, buckets: int = 6):
+        self.window_s = float(window_s)
+        self.hysteresis = max(1, int(hysteresis))
+        self.anomaly_sigma = float(anomaly_sigma)
+        self.plane = plane
+        self.worker = worker
+        self.buckets = buckets
+        self._signals: dict[str, _TrackedSignal] = {}
+        self._digests: dict[str, WindowedDigest] = {}
+        self._counters: dict[str, WindowedCounter] = {}
+        self._lock = threading.Lock()
+        # serializes evaluate(): the breach state machine mutates
+        # per-signal streak counters, and on the thread launcher several
+        # trainers share one watchdog and tick it per epoch
+        self._eval_lock = threading.Lock()
+        self.registry = MetricsRegistry()
+
+    # ---- registration ----
+    def track(self, name: str, *, stat: str = "p99", target: float = 0.0,
+              unit: str = "") -> None:
+        """Track a value signal: window ``stat`` vs ``target`` (0 = no
+        target — gauges + anomaly detection only)."""
+        with self._lock:
+            self._signals[name] = _TrackedSignal(name, stat, target, unit)
+            self._digests.setdefault(
+                name, WindowedDigest(self.window_s, self.buckets))
+
+    def track_rate(self, name: str, *, num: str, den: str,
+                   target: float = 0.0) -> None:
+        """Track a ratio of two windowed counters (e.g. shed fraction:
+        ``num="shed", den="requests"``)."""
+        with self._lock:
+            self._signals[name] = _TrackedSignal(
+                name, "rate", target, "", num=num, den=den)
+            self._counters.setdefault(
+                num, WindowedCounter(self.window_s, self.buckets))
+            self._counters.setdefault(
+                den, WindowedCounter(self.window_s, self.buckets))
+
+    # ---- hot path ----
+    def observe(self, name: str, value: float) -> None:
+        d = self._digests.get(name)
+        if d is None:
+            with self._lock:
+                d = self._digests.setdefault(
+                    name, WindowedDigest(self.window_s, self.buckets))
+        d.add(value)
+
+    def count(self, name: str, n: int = 1) -> None:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(
+                    name, WindowedCounter(self.window_s, self.buckets))
+        c.add(n)
+
+    # ---- slow path ----
+    def _value_of(self, sig: _TrackedSignal,
+                  now: float) -> tuple[float | None, dict | None]:
+        if sig.stat == "rate":
+            den = self._counters[sig.den].total(now)
+            if den == 0:
+                return None, None
+            num = self._counters[sig.num].total(now)
+            return num / den, {"count": den, sig.num: num}
+        snap = self._digests[sig.name].snapshot(now)
+        if snap is None:
+            return None, None
+        return snap.get(sig.stat), snap
+
+    def evaluate(self, now: float | None = None, **ctx: Any) -> list[dict]:
+        """One evaluation tick.  Returns the events it emitted (also
+        journaled via ``obs.journal.emit`` — a no-op without a journal).
+        ``ctx`` fields (e.g. ``epoch=N``) ride every emitted event."""
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+        now = _mono() if now is None else now
+        events: list[dict] = []
+        with self._lock:
+            signals = list(self._signals.values())
+        with self._eval_lock:
+            events = self._evaluate_locked(signals, now, ctx)
+        for ev in events:
+            fields = {k: v for k, v in ev.items() if k != "event"}
+            obs_journal.emit(ev["event"], plane=self.plane,
+                            worker=self.worker, **fields)
+        return events
+
+    def _evaluate_locked(self, signals: list[_TrackedSignal], now: float,
+                         ctx: dict) -> list[dict]:
+        events: list[dict] = []
+        for sig in signals:
+            value, snap = self._value_of(sig, now)
+            gname = f"slo_{sig.name}"
+            if value is not None:
+                self.registry.set_gauge(gname, round(value, 6))
+            if sig.target > 0:
+                self.registry.set_gauge(f"{gname}_target", sig.target)
+            # hysteretic breach state machine.  An EMPTY window (value
+            # None) never starts a breach, but DOES count as a clean
+            # tick: a serve plane whose overload shed every client (no
+            # samples once they give up) must still recover when the
+            # window drains.
+            breaching = (sig.target > 0 and value is not None
+                         and value > sig.target)
+            if breaching:
+                sig.bad += 1
+                sig.good = 0
+                if not sig.breached and sig.bad >= self.hysteresis:
+                    sig.breached = True
+                    sig.since = now
+                    ev = {
+                        "event": "slo_breach", "signal": sig.name,
+                        "value": round(value, 6), "target": sig.target,
+                        "window_s": self.window_s,
+                        "window": _round_snap(snap), **ctx,
+                    }
+                    events.append(ev)
+            else:
+                sig.good += 1
+                sig.bad = 0
+                if sig.breached and sig.good >= self.hysteresis:
+                    sig.breached = False
+                    ev = {
+                        "event": "slo_recover", "signal": sig.name,
+                        "value": (round(value, 6) if value is not None
+                                  else None),
+                        "target": sig.target,
+                        "breach_s": round(now - (sig.since or now), 3),
+                        **ctx,
+                    }
+                    sig.since = None
+                    events.append(ev)
+            self.registry.set_gauge(f"{gname}_breached", int(sig.breached))
+            # EWMA-z anomaly: fires once per excursion past ±sigma, for
+            # signals with no configured target too ("nobody set an SLO
+            # but this just jumped 6σ")
+            if self.anomaly_sigma > 0 and value is not None:
+                z = sig.ewma.update(value)
+                if z is not None:
+                    self.registry.set_gauge(f"{gname}_z", round(z, 3))
+                    if abs(z) >= self.anomaly_sigma and not sig.anomalous:
+                        sig.anomalous = True
+                        events.append({
+                            "event": "slo_anomaly", "signal": sig.name,
+                            "value": round(value, 6), "z": round(z, 2),
+                            "sigma": self.anomaly_sigma, **ctx,
+                        })
+                    elif abs(z) < self.anomaly_sigma:
+                        sig.anomalous = False
+        return events
+
+    # ---- reading ----
+    def state(self) -> dict[str, dict]:
+        """Per-signal state snapshot (tests, /healthz embedding)."""
+        with self._lock:
+            signals = list(self._signals.values())
+        now = _mono()
+        out = {}
+        for sig in signals:
+            value, _ = self._value_of(sig, now)
+            out[sig.name] = {
+                "value": value, "target": sig.target,
+                "breached": sig.breached, "stat": sig.stat,
+            }
+        return out
+
+    def render_prometheus(self) -> str:
+        """``stpu_slo_*`` gauge text, appended by every scrape surface
+        (serve ``/metrics``, the coordinator ``metrics`` op)."""
+        return self.registry.render_prometheus("stpu_")
+
+
+def _round_snap(snap: dict | None) -> dict | None:
+    if snap is None:
+        return None
+    return {k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in snap.items()}
+
+
+def from_config(cfg, *, plane: str = "train",
+                worker: int | None = None) -> SloWatchdog:
+    """Build the plane's watchdog from a resolved ObsConfig: serve
+    planes track request p99 + shed rate, train planes step time + the
+    infeed-wait fraction of the step budget.  The coordinator plane
+    registers the TRAIN signals too: on the thread launcher the workers
+    share the submitter's process and pick up exactly this watchdog
+    (Trainer reads slo.active()), so a coordinator-plane watchdog
+    without them would silently drop the configured train targets; on
+    the process launcher those digests just stay empty (nothing
+    observes or evaluates them there — each subprocess worker runs its
+    own).  Targets of 0 leave a signal untargeted (gauges + anomaly
+    detection only) — the watchdog is always worth installing once obs
+    is on.
+
+    Both train signals are fed ONE sample per epoch (the same
+    ``step_breakdown`` drain), so their window statistics are over
+    epoch-level aggregates: ``train_step_ms`` is the windowed MEAN of
+    per-epoch mean step wall time — not a per-step p99, which the
+    tracer's aggregate span counters cannot provide."""
+    wd = SloWatchdog(
+        window_s=cfg.slo_window_s,
+        hysteresis=cfg.slo_hysteresis,
+        anomaly_sigma=cfg.slo_anomaly_sigma,
+        plane=plane,
+        worker=worker,
+    )
+    if plane == "serve":
+        wd.track("serve_p99_s", stat="p99",
+                 target=cfg.slo_serve_p99_ms / 1000.0, unit="s")
+        wd.track_rate("serve_shed_rate", num="shed", den="requests",
+                      target=cfg.slo_serve_shed_rate)
+    else:  # train — and coordinator, whose process may HOST trainers
+        wd.track("train_step_ms", stat="mean",
+                 target=cfg.slo_step_time_ms, unit="ms")
+        wd.track("train_infeed_frac", stat="mean",
+                 target=cfg.slo_infeed_frac)
+    return wd
+
+
+# ---- process-global hook (mirrors obs.trace / obs.journal) ----
+
+_active: SloWatchdog | None = None
+
+
+def install(watchdog: SloWatchdog) -> SloWatchdog:
+    global _active
+    _active = watchdog
+    return watchdog
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> SloWatchdog | None:
+    return _active

@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                         " reload/shed lifecycle events append here; serve "
                         "workers write <path>.s<i> siblings; read "
                         "with `python -m shifu_tensorflow_tpu.obs`")
+    p.add_argument("--obs-job", default=None, dest="obs_job",
+                   help="(internal) fleet-wide job correlation id stamped "
+                        "on journal events; set by the --serve-workers "
+                        "supervisor so every worker journals the same id")
     return p
 
 
@@ -98,14 +102,20 @@ def main(argv: list[str] | None = None) -> int:
         from shifu_tensorflow_tpu.obs import install_obs, resolve_obs_config
 
         obs_cfg = resolve_obs_config(args, conf)
+        # job correlation id: minted once here, shared by the whole
+        # serve fleet (the supervisor re-execs workers with --obs-job),
+        # so the merged journal can attribute events job-wide
+        import uuid as _uuid
+
+        job_id = args.obs_job or _uuid.uuid4().hex[:8]
         if config.workers > 1 and args.serve_worker_index is None:
             # multi-process scale-out: this invocation becomes the
             # supervisor, each scoring process is a re-exec of this CLI
             # with --worker-index set (and the SAME argv otherwise, so
             # every knob — conf layers included — reaches the workers)
-            return _supervise(argv, config, obs_cfg)
+            return _supervise(argv, config, obs_cfg, job_id)
         install_obs(obs_cfg, plane="serve",
-                    worker_index=args.serve_worker_index)
+                    worker_index=args.serve_worker_index, job=job_id)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -178,7 +188,8 @@ class _Worker:
     supervisor's stderr so the supervisor's OWN stdout keeps the
     one-listening-line / one-stopped-line machine-readable contract)."""
 
-    def __init__(self, index: int, argv: list[str], port: int):
+    def __init__(self, index: int, argv: list[str], port: int,
+                 job_id: str | None = None):
         import subprocess
         import threading
 
@@ -187,11 +198,13 @@ class _Worker:
         self.last_json: dict = {}
         # re-exec this CLI: original argv first, the supervisor's
         # overrides LAST (argparse last-wins) — the resolved port must
-        # replace a possible "--port 0", and the index marks the child
-        # as a worker so it does not recurse into supervision
+        # replace a possible "--port 0", the index marks the child as a
+        # worker so it does not recurse into supervision, and --obs-job
+        # pins the fleet-wide journal correlation id
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "shifu_tensorflow_tpu.serve", *argv,
-             "--port", str(port), "--worker-index", str(index)],
+             "--port", str(port), "--worker-index", str(index),
+             *(["--obs-job", job_id] if job_id else [])],
             stdout=subprocess.PIPE,
         )
         self._reader = threading.Thread(target=self._read, daemon=True)
@@ -233,7 +246,8 @@ def _probe_port(host: str):
     return s, int(s.getsockname()[1])
 
 
-def _supervise(argv: list[str], config, obs_cfg) -> int:
+def _supervise(argv: list[str], config, obs_cfg,
+               job_id: str | None = None) -> int:
     """Parent of ``--serve-workers N``: spawn N scoring processes
     sharing one SO_REUSEPORT port, restart crashes (bounded), propagate
     SIGTERM as a fleet-wide drain, and aggregate the final summary."""
@@ -245,8 +259,9 @@ def _supervise(argv: list[str], config, obs_cfg) -> int:
     from shifu_tensorflow_tpu.obs import journal as obs_journal
 
     # the supervisor journals fleet lifecycle at the BASE path; workers
-    # write <base>.s<i> siblings (install_obs plane="serve")
-    install_obs(obs_cfg, plane="serve")
+    # write <base>.s<i> siblings (install_obs plane="serve") stamped
+    # with the same job id
+    install_obs(obs_cfg, plane="serve", job=job_id)
     n = config.workers
     probe = None
     if config.port:
@@ -285,7 +300,7 @@ def _supervise(argv: list[str], config, obs_cfg) -> int:
     drain_rc = 0
     try:
         for i in range(n):
-            workers.append(_Worker(i, argv, port))
+            workers.append(_Worker(i, argv, port, job_id))
         obs_journal.emit("serve_fleet_start", plane="serve", port=port,
                          workers=n)
         # listening barrier: every worker up (or one dead = fail fast —
@@ -342,7 +357,7 @@ def _supervise(argv: list[str], config, obs_cfg) -> int:
                     restarts += 1
                     recent_restarts.append(now)
                     _time.sleep(0.5)  # a crashing artifact busy-loops
-                    workers[i] = _Worker(w.index, argv, port)
+                    workers[i] = _Worker(w.index, argv, port, job_id)
                     obs_journal.emit("serve_worker_restart", plane="serve",
                                      index=w.index, restarts=restarts)
                     print(f"restarted serve worker {w.index} "

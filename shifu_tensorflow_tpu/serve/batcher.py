@@ -57,6 +57,7 @@ from typing import Callable
 import numpy as np
 
 from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import logs
 
@@ -93,10 +94,11 @@ class RequestTooLarge(ValueError):
 
 
 class _Pending:
-    __slots__ = ("rows", "event", "result", "error", "t_enqueue")
+    __slots__ = ("rows", "rid", "event", "result", "error", "t_enqueue")
 
-    def __init__(self, rows: np.ndarray):
+    def __init__(self, rows: np.ndarray, rid: str | None = None):
         self.rows = rows
+        self.rid = rid  # correlation id minted at serve ingress
         self.event = threading.Event()
         self.result: np.ndarray | None = None
         self.error: BaseException | None = None
@@ -107,7 +109,7 @@ class _Work:
     """One coalesced batch moving through the pipeline stages."""
 
     __slots__ = ("batch", "sizes", "n", "bucket", "padded", "scores",
-                 "error", "dispatch_s")
+                 "error", "dispatch_s", "queue_delay_s")
 
     def __init__(self, batch: list[_Pending]):
         self.batch = batch
@@ -118,6 +120,13 @@ class _Work:
         self.scores: np.ndarray | None = None
         self.error: BaseException | None = None
         self.dispatch_s = 0.0
+        # oldest member's admission → dispatch start: the time these
+        # requests spent waiting on coalescing + the pipeline, split
+        # from the device time in the journaled serve_batch event
+        self.queue_delay_s = 0.0
+
+    def rids(self) -> list[str]:
+        return [p.rid for p in self.batch if p.rid]
 
 
 class MicroBatcher:
@@ -166,6 +175,12 @@ class MicroBatcher:
         # slow scatter catches up without ever stalling the dispatch)
         self._dispatch_q: queue.Queue[_Work | None] = queue.Queue(maxsize=1)
         self._scatter_q: queue.Queue[_Work | None] = queue.Queue(maxsize=2)
+        # the batch the dispatch thread is INSIDE score_fn with right
+        # now: score_fn callbacks (the server's ModelReleasedError retry)
+        # read its rids for their journal events.  Written only by the
+        # dispatch thread; reference assignment, so readers see a whole
+        # _Work or None.
+        self._dispatching: _Work | None = None
         self._threads = [
             threading.Thread(target=self._pack_loop,
                              name="serve-pack", daemon=True),
@@ -184,6 +199,14 @@ class MicroBatcher:
         with self._cond:
             return self._queued_rows + self._inflight_rows
 
+    def dispatching_rids(self) -> list[str]:
+        """Correlation ids of the batch currently inside ``score_fn``
+        (empty outside a dispatch) — the server's ModelReleasedError
+        retry journals these so the event names the requests it
+        re-scored."""
+        work = self._dispatching
+        return work.rids() if work is not None else []
+
     def _jittered_retry_after(self) -> int:
         """Uniform over [0.5x, 1.5x] of the configured value (which is
         therefore the mean), made integral by STOCHASTIC rounding — the
@@ -198,12 +221,17 @@ class MicroBatcher:
             n += 1
         return max(1, n)
 
-    def submit(self, rows: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
+    def submit(self, rows: np.ndarray, timeout_s: float = 30.0,
+               rid: str | None = None) -> np.ndarray:
         """Score ``rows`` (n, f); blocks until the coalesced dispatch that
-        includes them completes.  Raises :class:`ShedLoad` when admission
-        would overflow the queue, :class:`BatcherClosed` when draining,
-        TimeoutError if the dispatch does not complete in time, or the
-        scorer's own exception."""
+        includes them completes.  ``rid`` is the request's correlation id
+        (serve ingress mints it from/instead of ``X-Request-Id``) — it
+        rides the request through pack → dispatch → scatter so the
+        journaled ``serve_batch`` event lists every id its dispatch
+        touched.  Raises :class:`ShedLoad` when admission would overflow
+        the queue, :class:`BatcherClosed` when draining, TimeoutError if
+        the dispatch does not complete in time, or the scorer's own
+        exception."""
         n = rows.shape[0]
         if n < 1:
             raise ValueError("empty batch")
@@ -212,7 +240,7 @@ class MicroBatcher:
                 f"request of {n} rows exceeds the admission bound "
                 f"({self.max_queue_rows}); split it"
             )
-        item = _Pending(rows)
+        item = _Pending(rows, rid=rid)
         with self._cond:
             if self._closed:
                 raise BatcherClosed("batcher is draining")
@@ -317,11 +345,16 @@ class MicroBatcher:
                 return
             if work.error is None:
                 t0 = time.monotonic()
+                work.queue_delay_s = t0 - min(
+                    p.t_enqueue for p in work.batch)
+                self._dispatching = work
                 with obs_trace.span("serve.dispatch"):
                     try:
                         work.scores = np.asarray(self._score(work.padded))
                     except BaseException as e:
                         work.error = e
+                    finally:
+                        self._dispatching = None
                 work.dispatch_s = time.monotonic() - t0
                 work.padded = None  # the pad copy is dead weight now
             self._scatter_q.put(work)
@@ -351,6 +384,21 @@ class MicroBatcher:
             self.metrics.inc("rows_total", work.n)
             self.metrics.inc("padded_rows_total", work.bucket - work.n)
             self.metrics.batch_latency.record(work.dispatch_s)
+        if obs_journal.active() is not None:
+            # one event per coalesced DISPATCH (never per request — the
+            # event rate is bounded by 1/max_delay, not the request
+            # rate), carrying the correlation ids it scored: the causal
+            # record `obs trace <rid>` reconstructs a request's
+            # admission-wait vs device-time split from
+            rids = work.rids()
+            if rids:
+                obs_journal.emit(
+                    "serve_batch", plane="serve", rids=rids,
+                    requests=len(work.batch), rows=work.n,
+                    bucket=work.bucket,
+                    queue_delay_s=round(work.queue_delay_s, 6),
+                    dispatch_s=round(work.dispatch_s, 6),
+                )
         scores = work.scores[:work.n]
         off = 0
         for p, sz in zip(work.batch, work.sizes):

@@ -24,9 +24,11 @@ stop admitting, finish queued dispatches, release the model.
 from __future__ import annotations
 
 import json
+import re
 import socket
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -38,6 +40,8 @@ from shifu_tensorflow_tpu.serve.batcher import (
     ShedLoad,
 )
 from shifu_tensorflow_tpu.export.bucketing import ladder
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.serve.config import ServeConfig
 from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
 from shifu_tensorflow_tpu.serve.model_store import ModelNotLoaded, ModelStore
@@ -48,6 +52,29 @@ log = logs.get("serve")
 
 class _BadRequest(ValueError):
     """Client-side error → 400 with the message."""
+
+
+#: characters a client-supplied X-Request-Id may keep; anything else is
+#: stripped (the id is echoed into headers and journals — header
+#: injection and journal garbage both die here), and an id that strips
+#: to nothing (or is absent) gets a minted one.  ':' is deliberately
+#: NOT kept: `obs trace` reads a `\d+:\d+` argument as worker:epoch,
+#: and a colon-bearing rid would shadow that grammar.
+_RID_OK = re.compile(r"[^0-9A-Za-z._-]+")
+_RID_MAX = 64
+
+
+def resolve_rid(inbound: str | None) -> str:
+    """The request's correlation id: honor a sane inbound
+    ``X-Request-Id``, else mint one.  Every response (429s included)
+    echoes it, and every journal event the request touches carries it —
+    the end of "which request was that?" across a fleet's merged
+    journal."""
+    if inbound:
+        rid = _RID_OK.sub("", inbound)[:_RID_MAX]
+        if rid:
+            return rid
+    return uuid.uuid4().hex[:16]
 
 
 class _ReuseportHTTPServer(ThreadingHTTPServer):
@@ -126,6 +153,13 @@ class ScoringServer:
         # sustained overload at thousands of 429s/s would otherwise
         # rotate the lifecycle events out of the size-capped journal
         self._last_shed_emit = 0.0
+        # SLO watchdog (obs/slo.py, installed by install_obs): the
+        # request path feeds its latency digest + request/shed counters,
+        # and a background tick evaluates targets → journaled
+        # slo_breach/slo_recover + stpu_slo_* gauges on /metrics
+        self._slo = obs_slo.active()
+        self._slo_stop = threading.Event()
+        self._slo_thread: threading.Thread | None = None
 
     def max_body_bytes(self) -> int:
         """Reject-before-read bound on a /score body: the admission queue
@@ -150,12 +184,20 @@ class ScoringServer:
         # coalesced batch".  One retry suffices — current() after a swap
         # returns the already-constructed new model.
         for attempt in (0, 1):
-            model = self.store.current().model
+            loaded = self.store.current()
             try:
-                return model.compute_batch(rows)
+                return loaded.model.compute_batch(rows)
             except ModelReleasedError:
                 if attempt:
                     raise
+                # journaled WITH the ids of the requests the retry
+                # touched: a trace of one of them shows its dispatch hit
+                # the swap window and re-scored on the new model
+                obs_journal.emit(
+                    "model_released_retry", plane="serve",
+                    rids=self.batcher.dispatching_rids(),
+                    old_epoch=loaded.epoch,
+                )
         raise AssertionError("unreachable")
 
     # ---- lifecycle ----
@@ -170,8 +212,26 @@ class ScoringServer:
             target=self.httpd.serve_forever, name="serve-http", daemon=True
         )
         self._serve_thread.start()
+        if self._slo is not None:
+            self._slo_thread = threading.Thread(
+                target=self._slo_loop, name="serve-slo", daemon=True
+            )
+            self._slo_thread.start()
         log.info("scoring server listening on %s:%d (model %s)",
                  self.config.host, self.port, self.config.model_dir)
+
+    def _slo_loop(self) -> None:
+        """Evaluate the SLO watchdog several times per window — breach
+        and recovery transitions journal from HERE, autonomously, so a
+        dead fleet's files still tell the story even if nobody ever
+        scraped /metrics during the incident."""
+        tick = min(5.0, max(0.2, self._slo.window_s / 8.0))
+        while not self._slo_stop.wait(tick):
+            try:
+                self._slo.evaluate()
+            except Exception as e:  # the watchdog must never kill serving
+                log.error("slo evaluation failed: %s: %s",
+                          type(e).__name__, e)
 
     def close(self) -> None:
         if self._closed:
@@ -186,6 +246,9 @@ class ScoringServer:
         self.httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=30.0)
+        self._slo_stop.set()
+        if self._slo_thread is not None:
+            self._slo_thread.join(timeout=10.0)
         self.batcher.close(drain=True)
         self.store.close()
 
@@ -196,7 +259,23 @@ class ScoringServer:
         self.close()
 
     # ---- request handling (HTTP threads) ----
-    def handle_score(self, body: bytes) -> dict:
+    def note_shed(self, rid: str | None) -> None:
+        """Bookkeep one shed refusal: watchdog counters always, journal
+        at most once per 5s window (the journal records the CONDITION,
+        not per-request ticks) — that one event carries the triggering
+        request's id so a trace of a shed request can still find it."""
+        if self._slo is not None:
+            self._slo.count("shed")
+        now = time.monotonic()
+        if now - self._last_shed_emit > 5.0:
+            self._last_shed_emit = now
+            obs_journal.emit(
+                "shed", plane="serve", rid=rid,
+                queue_rows=self.batcher.queued_rows(),
+                shed_total=self.metrics.counters().get("shed_total", 0),
+            )
+
+    def handle_score(self, body: bytes, rid: str | None = None) -> dict:
         try:
             payload = json.loads(body)
         except ValueError as e:
@@ -227,7 +306,15 @@ class ScoringServer:
         if not np.isfinite(rows).all():
             raise _BadRequest("rows contain NaN/Inf")
         self.metrics.inc("requests_total")
-        scores = self.batcher.submit(rows)
+        if self._slo is not None:
+            # "requests" counts every scoring ATTEMPT (a shed raises out
+            # of submit below and still counted here) — the denominator
+            # of the windowed shed-rate signal
+            self._slo.count("requests")
+        t0 = time.monotonic()
+        scores = self.batcher.submit(rows, rid=rid)
+        if self._slo is not None:
+            self._slo.observe("serve_p99_s", time.monotonic() - t0)
         # identity re-read AFTER scoring: a hot reload that swapped while
         # this request was queued means the dispatch scored through the
         # NEW model (the batcher fetches current() at dispatch time), and
@@ -238,11 +325,14 @@ class ScoringServer:
         model = self.store.current()
         out = (scores[:, 0] if scores.ndim == 2 and scores.shape[1] == 1
                else scores)
-        return {
+        resp = {
             "scores": np.asarray(out, np.float64).round(6).tolist(),
             "model_epoch": model.epoch,
             "model_digest": model.digest[:12],
         }
+        if rid is not None:
+            resp["request_id"] = rid
+        return resp
 
     def health(self) -> tuple[int, dict]:
         try:
@@ -274,12 +364,17 @@ class ScoringServer:
             # response carries which one answered
             self.metrics.registry.set_gauge("worker_index",
                                             self.worker_index)
-        return self.metrics.render_prometheus(
+        text = self.metrics.render_prometheus(
             queue_rows=self.batcher.queued_rows(),
             model_epoch=epoch,
             model_digest=digest,
             model_verified=verified,
         )
+        if self._slo is not None:
+            # stpu_slo_* gauges ride every scrape: the supervisor policy
+            # (ROADMAP item 4) reads the same signal the journal records
+            text += self._slo.render_prometheus()
+        return text
 
 
 def _make_handler(server: ScoringServer):
@@ -296,12 +391,22 @@ def _make_handler(server: ScoringServer):
         def log_message(self, fmt, *args):  # route through structured logs
             log.debug("%s " + fmt, self.client_address[0], *args)
 
+        #: correlation id of the request in flight on THIS handler
+        #: thread (BaseHTTPRequestHandler is one-request-at-a-time per
+        #: connection, one handler per connection thread)
+        _rid: str | None = None
+
         def _reply(self, status: int, body: bytes,
                    content_type: str = "application/json",
                    extra_headers: dict | None = None) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if self._rid is not None:
+                # every response — 429 sheds and 500s included — echoes
+                # the id, so a client log line and the fleet journal
+                # meet at the same key
+                self.send_header("X-Request-Id", self._rid)
             for k, v in (extra_headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -313,6 +418,8 @@ def _make_handler(server: ScoringServer):
                         extra_headers=extra_headers)
 
         def do_GET(self):
+            inbound = self.headers.get("X-Request-Id")
+            self._rid = resolve_rid(inbound) if inbound else None
             if self.path == "/healthz":
                 status, obj = server.health()
                 self._reply_json(status, obj)
@@ -323,6 +430,7 @@ def _make_handler(server: ScoringServer):
                 self._reply_json(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
+            self._rid = resolve_rid(self.headers.get("X-Request-Id"))
             if self.path != "/score":
                 self._reply_json(404, {"error": f"unknown path {self.path}"})
                 return
@@ -360,30 +468,15 @@ def _make_handler(server: ScoringServer):
                     })
                     return
                 body = self.rfile.read(length)
-                self._reply_json(200, server.handle_score(body))
+                self._reply_json(200, server.handle_score(body, self._rid))
             except _BadRequest as e:
                 server.metrics.inc("errors_total")
                 self._reply_json(400, {"error": str(e)})
             except ShedLoad as e:
-                # shed counter already bumped by the batcher.  The
-                # journal gets at most one event per 5s window carrying
-                # the running shed_total — the per-request volume lives
-                # in the counter, the journal records the CONDITION
-                # (benign race on the timestamp: a duplicate event, not
-                # a flood)
-                now = time.monotonic()
-                if now - server._last_shed_emit > 5.0:
-                    server._last_shed_emit = now
-                    from shifu_tensorflow_tpu.obs import (
-                        journal as obs_journal,
-                    )
-
-                    obs_journal.emit(
-                        "shed", plane="serve",
-                        queue_rows=server.batcher.queued_rows(),
-                        shed_total=server.metrics.counters().get(
-                            "shed_total", 0),
-                    )
+                # shed counter already bumped by the batcher; note_shed
+                # feeds the SLO shed-rate window and journals the
+                # CONDITION at most once per 5s (with this request's id)
+                server.note_shed(self._rid)
                 self._reply_json(
                     429,
                     {"error": "overloaded, retry later",

@@ -563,10 +563,14 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     mesh_spec = conf.get(K.MESH_SHAPE, K.DEFAULT_MESH_SHAPE)
     mesh = make_mesh(mesh_spec) if mesh_spec != "none" else None
     # observability plane: installed BEFORE make_trainer so the trainer
-    # picks the tracer up at construction (obs/trace.active())
+    # picks the tracer up at construction (obs/trace.active()).  The job
+    # correlation id stamps every journal event this run writes.
+    import uuid as _uuid
+
     from shifu_tensorflow_tpu.obs import install_obs
 
-    install_obs(resolve_obs(args, conf), plane="train")
+    install_obs(resolve_obs(args, conf), plane="train",
+                job=_uuid.uuid4().hex[:8])
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
     extras = trainer_extras(args, conf)
@@ -802,6 +806,13 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # merged dict (not two ** expansions): early-stop forces sync_epochs
     # True over whatever the conf key says — a keyword collision otherwise
     spec_kw = {**job_spec_kwargs(conf), **early_stop_spec_kwargs(args, conf)}
+    # one job correlation id for the whole fleet: the coordinator stamps
+    # it on its journal events and hands it to every worker at
+    # registration (the workers' .w<i> journal siblings carry the same id)
+    import uuid as _uuid
+
+    job_id = _uuid.uuid4().hex[:8]
+    spec_kw["job_id"] = job_id
     spec = make_job_spec(
         conf.get(K.TRAINING_DATA_PATH),
         n_workers,
@@ -848,7 +859,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # WorkerConfig) write <path>.w<index> siblings
     from shifu_tensorflow_tpu.obs import install_obs
 
-    install_obs(resolve_obs(args, conf), plane="coordinator")
+    install_obs(resolve_obs(args, conf), plane="coordinator", job=job_id)
     submitter = JobSubmitter(spec, make_cfg, launcher=args.launcher)
     timeout_ms = conf.get_int(K.APPLICATION_TIMEOUT, K.DEFAULT_APPLICATION_TIMEOUT)
     result = submitter.run(

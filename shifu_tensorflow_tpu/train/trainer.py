@@ -1030,6 +1030,12 @@ class Trainer:
         # make_trainer parameter; None = every instrumented site is one
         # is-None check
         self.tracer = obs_trace.active()
+        # SLO watchdog (obs/slo.py): fed per epoch with the step-time and
+        # infeed-wait-fraction signals the shifu.tpu.slo-* targets judge;
+        # picked up at construction exactly like the tracer
+        from shifu_tensorflow_tpu.obs import slo as _obs_slo
+
+        self.slo = _obs_slo.active()
         # set by the fit loops when an EarlyStopper ends training early
         self.stop_reason: str | None = None
         # keep-best (conf key shifu.tpu.keep-best, validated at the top
@@ -1530,30 +1536,58 @@ class Trainer:
         breakdown against its own epoch's phases, so the off-by-one on
         auxiliary spans is cosmetic and documented here once."""
         j = obs_journal.active()
-        if j is None:
-            return
-        j.emit(
-            "epoch",
-            plane="train",
-            worker=self.worker_index,
-            epoch=stats.current_epoch,
-            train_loss=stats.training_loss,
-            valid_loss=stats.valid_loss,
-            ks=stats.ks,
-            auc=stats.auc,
-            train_time_s=round(stats.training_time_s, 4),
-            valid_time_s=round(stats.valid_time_s, 4),
-            global_step=stats.global_step,
-        )
+        slo = self.slo
         t = self.tracer
-        if t is not None:
+        # the SLO watchdog runs journal-or-not: --obs alone configures
+        # gauges + targets, and a target silently dead because a second
+        # flag was missing is the same bug class the journal-implies-
+        # enabled rule exists for
+        if j is None and slo is None:
+            return
+        if j is not None:
             j.emit(
-                "step_breakdown",
+                "epoch",
                 plane="train",
                 worker=self.worker_index,
                 epoch=stats.current_epoch,
-                **obs_trace.budget_fields(t.take_summary()),
+                train_loss=stats.training_loss,
+                valid_loss=stats.valid_loss,
+                ks=stats.ks,
+                auc=stats.auc,
+                train_time_s=round(stats.training_time_s, 4),
+                valid_time_s=round(stats.valid_time_s, 4),
+                global_step=stats.global_step,
             )
+        fields = None
+        if t is not None:
+            fields = obs_trace.budget_fields(t.take_summary())
+            if j is not None:
+                j.emit(
+                    "step_breakdown",
+                    plane="train",
+                    worker=self.worker_index,
+                    epoch=stats.current_epoch,
+                    # (worker, epoch, global_step) coordinates: with the
+                    # journal's job stamp, the triple locates this record
+                    # in the fleet-wide causal story (`obs trace
+                    # worker:epoch`)
+                    global_step=stats.global_step,
+                    **fields,
+                )
+        if slo is not None and fields is not None:
+            # per-epoch SLO signals from the same drain: mean step wall
+            # time and the infeed-wait share of the epoch — evaluated
+            # immediately (the train plane's tick is the epoch; serve
+            # runs a background tick instead)
+            steps = int(fields.get("steps") or 0)
+            wall = max(stats.training_time_s, 1e-9)
+            if steps > 0:
+                slo.observe("train_step_ms", wall / steps * 1000.0)
+                slo.observe(
+                    "train_infeed_frac",
+                    min(1.0, float(fields.get("infeed_s", 0.0)) / wall),
+                )
+            slo.evaluate(epoch=stats.current_epoch)
 
     def _warn_if_validation_empty(self, stats: EpochStats,
                                   early_stop) -> None:
@@ -2058,19 +2092,20 @@ class Trainer:
             if autotuner is not None:
                 # digest the epoch's stage stats (delivered through the
                 # stream's stats_sink when train_epoch closed it) plus
-                # THIS epoch's step spans.  With the obs journal active,
-                # _obs_epoch's take_summary() drained the tracer at the
-                # end of the previous epoch, so the non-destructive
-                # summary() covers exactly this epoch (and the journal
-                # still gets it).  Without a journal nothing ever drains,
-                # so drain here — a cumulative wait total divided by one
-                # epoch's wall would ratchet the starvation signal toward
-                # 1.0 and the tuner would widen forever on a healthy
-                # pipeline.
+                # THIS epoch's step spans.  With the obs journal (or the
+                # SLO watchdog) active, _obs_epoch's take_summary()
+                # drained the tracer at the end of the previous epoch,
+                # so the non-destructive summary() covers exactly this
+                # epoch (and the journal still gets it).  Without
+                # either, nothing ever drains, so drain here — a
+                # cumulative wait total divided by one epoch's wall
+                # would ratchet the starvation signal toward 1.0 and the
+                # tuner would widen forever on a healthy pipeline.
                 summ = None
                 if self.tracer is not None:
-                    summ = (self.tracer.summary()
-                            if obs_journal.active() is not None
+                    drained_by_obs = (obs_journal.active() is not None
+                                      or self.slo is not None)
+                    summ = (self.tracer.summary() if drained_by_obs
                             else self.tracer.take_summary())
                 autotuner.observe_epoch(summ)
             ev = {"loss": float("nan"), "ks": 0.0, "auc": 0.5}

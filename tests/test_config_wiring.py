@@ -529,6 +529,49 @@ def test_obs_defaults_are_off_and_cli_flags_imply_enabled():
                        _conf({K.OBS_JOURNAL: "/tmp/y.jsonl"})).enabled
 
 
+def test_slo_keys_round_trip_xml_to_dataclass(tmp_path):
+    """shifu.tpu.slo-* keys ride the SAME ObsConfig (and therefore the
+    same WorkerConfig JSON bridge) as the obs keys: Hadoop-XML resource →
+    layered Conf → ObsConfig → JSON round trip."""
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "slo.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.SLO_WINDOW_S: "30",
+        K.SLO_SERVE_P99_MS: "250",
+        K.SLO_SERVE_SHED_RATE: "0.2",
+        K.SLO_STEP_TIME_MS: "50",
+        K.SLO_INFEED_FRAC: "0.3",
+        K.SLO_HYSTERESIS: "3",
+        K.SLO_ANOMALY_SIGMA: "4.5",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.slo_window_s == 30.0
+    assert cfg.slo_serve_p99_ms == 250.0
+    assert cfg.slo_serve_shed_rate == 0.2
+    assert cfg.slo_step_time_ms == 50.0
+    assert cfg.slo_infeed_frac == 0.3
+    assert cfg.slo_hysteresis == 3
+    assert cfg.slo_anomaly_sigma == 4.5
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # defaults: window 60s, hysteresis 2, sigma 6, every target off
+    d = resolve_obs(_args(), _conf({}))
+    assert d.slo_window_s == 60.0 and d.slo_hysteresis == 2
+    assert d.slo_anomaly_sigma == 6.0
+    assert d.slo_serve_p99_ms == d.slo_serve_shed_rate == 0.0
+    assert d.slo_step_time_ms == d.slo_infeed_frac == 0.0
+
+
 def test_obs_keys_reach_worker_config_bridge():
     """run_multi ships the resolved ObsConfig to subprocess workers via
     WorkerConfig.obs (JSON bridge) — and omits it entirely when obs is

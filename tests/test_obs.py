@@ -30,6 +30,9 @@ def _clean_obs_hooks():
     yield
     trace_mod.uninstall()
     journal_mod.uninstall()
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+
+    slo_mod.uninstall()
 
 
 # ---- registry ----
@@ -92,6 +95,45 @@ def test_serve_metrics_format_unchanged_over_registry():
                for l in lines)
     # the full counter set renders even before any event (dashboards)
     assert "stpu_serve_shed_total 0" in lines
+
+
+def test_registry_renders_cumulative_bucket_lines():
+    """Satellite: real `_bucket{le=...}` cumulative lines beside the
+    quantile gauges, so external Prometheus can histogram_quantile()
+    instead of trusting our ladder-bound estimates."""
+    r = MetricsRegistry(bounds=(0.01, 0.1, 1.0))
+    h = r.histogram("lat")
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.record(v)
+    lines = r.render_prometheus("t_").splitlines()
+    assert 't_lat_bucket{le="0.01"} 2' in lines
+    assert 't_lat_bucket{le="0.1"} 3' in lines
+    assert 't_lat_bucket{le="1.0"} 4' in lines
+    assert 't_lat_bucket{le="+Inf"} 5' in lines  # +Inf == _count
+    assert "t_lat_count 5" in lines
+    # the existing quantile gauges stay (dashboards grep them)
+    assert any(l.startswith('t_lat{quantile="0.99"}') for l in lines)
+
+
+def test_serve_scrape_carries_bucket_lines():
+    """Serve /metrics parity after the bucket satellite: cumulative
+    buckets for both latency histograms, +Inf equal to the count."""
+    from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.request_latency.record(0.004)
+    m.request_latency.record(0.2)
+    text = m.render_prometheus(queue_rows=0, model_epoch=0,
+                               model_digest="d", model_verified=True)
+    lines = text.splitlines()
+    assert ('stpu_serve_request_latency_seconds_bucket{le="+Inf"} 2'
+            in lines)
+    assert ('stpu_serve_batch_latency_seconds_bucket{le="+Inf"} 0'
+            in lines)
+    # cumulative: every bucket count is <= the next one
+    counts = [int(l.rsplit(" ", 1)[1]) for l in lines
+              if l.startswith("stpu_serve_request_latency_seconds_bucket")]
+    assert counts == sorted(counts) and counts[-1] == 2
 
 
 def test_latency_histogram_reexports_are_the_same_type():
@@ -218,6 +260,103 @@ def test_journal_discovers_serve_worker_siblings(tmp_path):
     _, j = install_obs(cfg, worker_index=3, plane="serve")
     assert j.path.endswith(".s3")
     journal_mod.uninstall()
+
+
+def test_journal_seq_is_per_writer_monotonic(tmp_path):
+    """Every record carries a monotonic per-writer seq, surviving
+    rotation — `obs trace` renders merge order as causality, so
+    same-microsecond events must keep emission order."""
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, max_bytes=4096, max_files=8) as j:
+        for i in range(300):
+            j.emit("tick", i=i, pad="x" * 40)
+    events = read_events(path)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert [e["i"] for e in events] == sorted(e["i"] for e in events)
+
+
+def test_read_events_merges_same_timestamp_by_seq(tmp_path,
+                                                  monkeypatch):
+    """The satellite's pinned contract: with every event stamped the
+    SAME ts (a frozen clock — the worst case a fast writer can produce),
+    the merged read still returns one writer's events in seq order
+    across a rotation boundary."""
+    import shifu_tensorflow_tpu.obs.journal as jm
+
+    monkeypatch.setattr(jm.time, "time", lambda: 1234.5)
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, max_bytes=4096, max_files=4) as j:
+        for i in range(200):
+            j.emit("tick", i=i, pad="y" * 40)
+    files = journal_files(path)
+    assert len(files) > 1, "the drill needs a rotation to mean anything"
+    events = read_events(path)
+    assert all(e["ts"] == 1234.5 for e in events)
+    ids = [e["i"] for e in events]
+    assert ids == sorted(ids), "same-ts events must merge in seq order"
+
+
+def test_read_events_same_ts_across_writers_stable(tmp_path, monkeypatch):
+    """Equal timestamps across writers keep the deterministic base →
+    .w<k> → .s<k> writer order, each writer internally seq-ordered."""
+    import shifu_tensorflow_tpu.obs.journal as jm
+
+    monkeypatch.setattr(jm.time, "time", lambda: 99.0)
+    base = str(tmp_path / "job.jsonl")
+    with Journal(base + ".s0", plane="serve", worker=0) as js:
+        js.emit("s-first")
+        js.emit("s-second")
+    with Journal(base + ".w1", plane="train", worker=1) as jw:
+        jw.emit("w-first")
+    with Journal(base, plane="coordinator") as j:
+        j.emit("base-first")
+    names = [e["event"] for e in read_events(base)]
+    assert names == ["base-first", "w-first", "s-first", "s-second"]
+
+
+def test_journal_job_stamp_and_install_wiring(tmp_path):
+    """The fleet-wide job correlation id stamps every event the writer
+    emits; install_obs threads it through."""
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    base = str(tmp_path / "j.jsonl")
+    with Journal(base, plane="train", job="abc123") as j:
+        j.emit("epoch", epoch=0)
+    assert read_events(base)[0]["job"] == "abc123"
+    cfg = ObsConfig(enabled=True, journal_path=base)
+    _, jrn = install_obs(cfg, worker_index=1, plane="train", job="abc123")
+    assert jrn.job == "abc123"
+    journal_mod.emit("worker_start")
+    journal_mod.uninstall()
+    ev = [e for e in read_events(base) if e["event"] == "worker_start"][0]
+    assert ev["job"] == "abc123" and ev["worker"] == 1
+
+
+def test_read_events_cache_reuses_unchanged_files(tmp_path, monkeypatch):
+    """The `obs top` refresh contract: with a caller-held cache, files
+    whose (size, mtime) are unchanged are NOT re-parsed — only growth
+    is paid for."""
+    import shifu_tensorflow_tpu.obs.journal as jm
+
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path) as j:
+        for i in range(5):
+            j.emit("tick", i=i)
+    cache: dict = {}
+    first = read_events(path, cache=cache)
+    assert [e["i"] for e in first] == [0, 1, 2, 3, 4]
+    # unchanged file: the parse layer must not even be consulted
+    real_iter = jm.iter_events
+    monkeypatch.setattr(jm, "iter_events",
+                        lambda p: (_ for _ in ()).throw(AssertionError(
+                            f"re-parsed unchanged {p}")))
+    assert [e["i"] for e in read_events(path, cache=cache)] == [0, 1, 2, 3, 4]
+    monkeypatch.setattr(jm, "iter_events", real_iter)
+    # growth invalidates the cached entry and the new event appears
+    with Journal(path) as j:
+        j.emit("tick", i=5)
+    assert [e["i"] for e in read_events(path, cache=cache)][-1] == 5
 
 
 def test_journal_install_emit_is_noop_without_install():
@@ -463,6 +602,123 @@ def test_obs_cli_missing_journal_fails_cleanly(tmp_path, capsys):
     assert obs_main(["summary", "--journal",
                      str(tmp_path / "nope.jsonl")]) == 1
     assert "no journal events" in capsys.readouterr().err
+
+
+def _seed_trace_journal(tmp_path) -> str:
+    """A journal with one scored request (rid riding a serve_batch), one
+    shed rid, and slo transitions — the trace/top fixtures."""
+    base = str(tmp_path / "job.jsonl")
+    with Journal(base, plane="coordinator", job="j1") as j:
+        j.emit("register", worker=0, worker_id="w-0")
+        j.emit("epoch_summary", epoch=1, n_workers=1, ks=0.31)
+    with Journal(f"{base}.w0", plane="train", worker=0, job="j1") as jw:
+        jw.emit("epoch", epoch=1, train_loss=0.4, train_time_s=1.0,
+                global_step=20)
+        jw.emit("step_breakdown", epoch=1, steps=10, infeed_s=0.1,
+                host_s=0.1, dispatch_s=0.7, block_s=0.1, global_step=20)
+    with Journal(f"{base}.s0", plane="serve", worker=0, job="j1") as js:
+        js.emit("serve_start", port=9100)
+        js.emit("serve_batch", rids=["rid-scored-1", "rid-peer"],
+                requests=2, rows=3, bucket=4, queue_delay_s=0.004,
+                dispatch_s=0.002)
+        js.emit("shed", rid="rid-shed-1", queue_rows=64, shed_total=9)
+        js.emit("slo_breach", signal="serve_shed_rate", value=0.4,
+                target=0.2, window_s=5.0,
+                window={"count": 50, "p99": 0.4})
+        js.emit("slo_recover", signal="serve_shed_rate", value=0.0,
+                target=0.2, breach_s=3.5)
+        js.emit("serve_stop", requests_total=40, shed_total=9)
+    return base
+
+
+def test_obs_cli_summary_and_tail_json(tmp_path, capsys):
+    """Satellite: machine-readable output — the autoscaling supervisor
+    must not screen-scrape the human renderer."""
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_trace_journal(tmp_path)
+    assert obs_main(["summary", "--journal", base, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["jobs"] == ["j1"]
+    assert doc["counts"]["serve_batch"] == 1
+    assert doc["budget"]["0"]["steps"] == 10
+    assert doc["budget"]["0"]["pct"]["dispatch"] == 70.0
+    assert doc["serve"]["workers"]["0"]["requests"] == 40
+    slo = doc["slo"]["serve_shed_rate"]
+    assert slo["breaches"] == 1 and slo["breached"] is False
+    assert obs_main(["tail", "--journal", base, "-n", "3", "--json"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 3
+    assert all(json.loads(l)["event"] for l in lines)
+
+
+def test_obs_cli_summary_renders_slo_section(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_trace_journal(tmp_path)
+    assert obs_main(["summary", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "slo" in out and "serve_shed_rate" in out
+    # recovered by the journal's last transition: renders ok, not BREACHED
+    assert "BREACHED" not in out
+
+
+def test_obs_cli_trace_resolves_rid(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_trace_journal(tmp_path)
+    assert obs_main(["trace", "rid-scored-1", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "serve_batch" in out and "rid-scored-1" in out
+    assert "coalesced into a 3-row dispatch" in out
+    # a shed request's id resolves to its shed event
+    assert obs_main(["trace", "rid-shed-1", "--journal", base]) == 0
+    assert "shed" in capsys.readouterr().out
+    # an unknown rid is a clean failure, not a stack trace
+    assert obs_main(["trace", "rid-nope", "--journal", base]) == 1
+    assert "no events for rid" in capsys.readouterr().err
+
+
+def test_obs_cli_trace_colon_rid_falls_back(tmp_path, capsys):
+    """The serve sanitizer strips ':' from new rids, but a hand-written
+    or legacy journal may carry one — a worker:epoch-shaped query that
+    matches nothing falls back to a rid match."""
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = str(tmp_path / "j.jsonl")
+    with Journal(base, plane="serve", worker=0) as j:
+        j.emit("serve_batch", rids=["12:3"], requests=1, rows=1, bucket=8)
+    assert obs_main(["trace", "12:3", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "rid 12:3" in out and "serve_batch" in out
+
+
+def test_obs_cli_trace_worker_epoch(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_trace_journal(tmp_path)
+    assert obs_main(["trace", "0:1", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    # the worker's epoch + breakdown AND the coordinator's quorum record
+    # merge into one causal story
+    assert "step_breakdown" in out and "epoch_summary" in out
+    assert "global_step=20" in out
+
+
+def test_obs_cli_top_once_renders_all_sections(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    base = _seed_trace_journal(tmp_path)
+    assert obs_main(["top", "--journal", base, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "obs top" in out and "job j1" in out
+    assert "slo" in out and "serve_shed_rate" in out
+    assert "train" in out and "serve" in out
+    assert "recent events" in out
+    # dead-fleet contract: an unreachable metrics URL must not break it
+    assert obs_main(["top", "--journal", base, "--once",
+                     "--metrics-url", "http://127.0.0.1:9/metrics"]) == 0
+    assert "scraped 0/1" in capsys.readouterr().out
 
 
 # ---- ObsConfig ----

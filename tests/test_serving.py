@@ -772,6 +772,222 @@ def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
         assert srv.metrics.counters()["shed_total"] >= len(shed)
 
 
+# ------------------------------------- correlation ids + SLO watchdog
+
+
+def _post_rid(port: int, payload: dict, rid: str | None = None):
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30.0)
+    try:
+        c.request("POST", "/score", json.dumps(payload), headers)
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), json.loads(r.read())
+    finally:
+        c.close()
+
+
+def test_resolve_rid_sanitizes_and_mints():
+    from shifu_tensorflow_tpu.serve.server import resolve_rid
+
+    assert resolve_rid("ok-id_1.2") == "ok-id_1.2"
+    # ':' is stripped so a numeric rid can never shadow `obs trace`'s
+    # worker:epoch grammar
+    assert resolve_rid("12:3") == "123"
+    assert resolve_rid("x" * 100) == "x" * 64
+    for hostile in (None, "", "   ", "\t{}"):
+        minted = resolve_rid(hostile)
+        assert len(minted) == 16 and minted.isalnum()
+
+
+@pytest.fixture()
+def obs_env(tmp_path):
+    """Install a serve-plane obs journal (+ watchdog) and return the
+    base path; uninstalls on teardown so module-global hooks never leak
+    into the rest of the suite."""
+    from shifu_tensorflow_tpu.obs import install_obs
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+    from shifu_tensorflow_tpu.obs import trace as trace_mod
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+
+    base = str(tmp_path / "serve-journal.jsonl")
+    install_obs(
+        ObsConfig(enabled=True, journal_path=base, slo_window_s=2.0,
+                  slo_serve_shed_rate=0.25, slo_hysteresis=1),
+        plane="serve", worker_index=0, job="drill001",
+    )
+    yield base
+    trace_mod.uninstall()
+    journal_mod.uninstall()
+    slo_mod.uninstall()
+
+
+def test_request_id_propagates_end_to_end(export_dir, obs_env):
+    """Satellite e2e: the inbound X-Request-Id is echoed on the response
+    AND lands in the journaled serve events that touched the request; a
+    request without one gets a minted id."""
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=64,
+                      max_delay_ms=1.0, reload_poll_ms=0)
+    with ScoringServer(cfg) as srv:
+        srv.start()
+        status, headers, body = _post_rid(
+            srv.port, {"rows": _rows(3).tolist()}, rid="my-rid-001")
+        assert status == 200
+        assert headers.get("X-Request-Id") == "my-rid-001"
+        assert body["request_id"] == "my-rid-001"
+        # no inbound id: one is minted and still echoed
+        status, headers, body = _post_rid(srv.port,
+                                          {"rows": _rows(2).tolist()})
+        assert status == 200
+        minted = headers.get("X-Request-Id")
+        assert minted and body["request_id"] == minted
+        # a hostile id is sanitized before echo/journal (http.client
+        # already refuses CRLF outright; everything else odd strips)
+        status, headers, _ = _post_rid(
+            srv.port, {"rows": _rows(1).tolist()},
+            rid='sp aced "id" {x}!!')
+        assert status == 200
+        assert headers.get("X-Request-Id") == "spacedidx"
+    events = read_events(obs_env)
+    batches = [e for e in events if e["event"] == "serve_batch"]
+    rids = {r for e in batches for r in e["rids"]}
+    assert "my-rid-001" in rids and minted in rids
+    for e in batches:
+        assert e["job"] == "drill001"
+        assert e["rows"] >= 1 and e["dispatch_s"] >= 0.0
+
+
+def test_shed_429_echoes_rid_and_journals_it(export_dir, obs_env):
+    """The 429 path: shed responses echo the id, and the (rate-limited)
+    journaled shed event names a request it refused."""
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=8,
+                      max_delay_ms=1.0, max_queue_rows=16,
+                      reload_poll_ms=0)
+    with ScoringServer(cfg) as srv:
+        inner = srv._score_once
+
+        def slow(rows):
+            time.sleep(0.02)
+            return inner(rows)
+
+        srv.batcher._score = slow
+        srv.start()
+        results = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            for k in range(6):
+                status, headers, _ = _post_rid(
+                    srv.port, {"rows": _rows(4, seed=i).tolist()},
+                    rid=f"flood-{i}-{k}")
+                with lock:
+                    results.append((status, headers))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+    shed = [(s, h) for s, h in results if s == 429]
+    assert shed, "overload never shed"
+    for _, headers in shed:
+        assert headers.get("X-Request-Id", "").startswith("flood-")
+    shed_events = [e for e in read_events(obs_env)
+                   if e["event"] == "shed"]
+    assert shed_events and any(
+        str(e.get("rid", "")).startswith("flood-") for e in shed_events)
+
+
+def test_slo_breach_recover_drill_reconstructible_from_files(
+        export_dir, obs_env, capsys):
+    """The acceptance chaos drill: sustained overload drives the
+    windowed shed rate past its shifu.tpu.slo-serve-shed-rate target →
+    the watchdog journals slo_breach (with the offending window's digest
+    snapshot); the load stops, the window drains, slo_recover lands —
+    and the whole sequence is reconstructible by `obs trace` and `obs
+    top --once` from the dead fleet's files alone."""
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=8,
+                      max_delay_ms=1.0, max_queue_rows=16,
+                      reload_poll_ms=0)
+    with ScoringServer(cfg) as srv:
+        assert srv._slo is not None, "watchdog not picked up at construction"
+        inner = srv._score_once
+
+        def slow(rows):
+            time.sleep(0.02)
+            return inner(rows)
+
+        srv.batcher._score = slow
+        srv.start()
+
+        def client(i: int):
+            for k in range(8):
+                _post_rid(srv.port, {"rows": _rows(4, seed=i).tolist()},
+                          rid=f"drill-{i}-{k}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        # the evaluator thread (0.25s tick at window 2s) must see the
+        # breach while the shed window is still hot
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if any(e["event"] == "slo_breach"
+                   for e in read_events(obs_env)):
+                break
+            time.sleep(0.1)
+        # gauges ride /metrics while the server is alive
+        import urllib.request
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+        assert "stpu_slo_serve_shed_rate" in text
+        # overload over: the window drains and the watchdog recovers
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if any(e["event"] == "slo_recover"
+                   for e in read_events(obs_env)):
+                break
+            time.sleep(0.1)
+    # ---- the fleet is dead; everything below reads its files alone ----
+    events = read_events(obs_env)
+    kinds = [e["event"] for e in events]
+    assert "slo_breach" in kinds, "overload never breached the SLO"
+    assert "slo_recover" in kinds, "watchdog never recovered"
+    breach = next(e for e in events if e["event"] == "slo_breach")
+    recover = next(e for e in events if e["event"] == "slo_recover")
+    assert breach["ts"] < recover["ts"]
+    assert breach["signal"] == "serve_shed_rate"
+    assert breach["value"] > breach["target"] == 0.25
+    # the offending window's digest snapshot rides the breach event
+    assert breach["window"]["count"] > 0 and breach["window"]["shed"] > 0
+    assert recover["breach_s"] > 0
+    # a scored request's rid resolves through `obs trace`
+    scored = next(e for e in events if e["event"] == "serve_batch")
+    rid = scored["rids"][0]
+    assert obs_main(["trace", rid, "--journal", obs_env]) == 0
+    out = capsys.readouterr().out
+    assert "serve_batch" in out and rid in out
+    # and `obs top --once` renders the same story without a live fleet
+    assert obs_main(["top", "--journal", obs_env, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "serve_shed_rate" in out and "recent events" in out
+
+
 # ------------------------------------------------------------ CLI surface
 
 

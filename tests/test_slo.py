@@ -1,0 +1,284 @@
+"""SLO watchdog (obs/slo.py): P² digest accuracy, sliding-window
+expiry, EWMA-z anomaly detection, the hysteretic breach/recover state
+machine with journaled transitions, and the install_obs wiring."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from shifu_tensorflow_tpu.obs import journal as journal_mod
+from shifu_tensorflow_tpu.obs import slo as slo_mod
+from shifu_tensorflow_tpu.obs import trace as trace_mod
+from shifu_tensorflow_tpu.obs.config import ObsConfig
+from shifu_tensorflow_tpu.obs.journal import Journal, read_events
+from shifu_tensorflow_tpu.obs.slo import (
+    EwmaZ,
+    P2Quantile,
+    SloWatchdog,
+    WindowedCounter,
+    WindowedDigest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_hooks():
+    yield
+    trace_mod.uninstall()
+    journal_mod.uninstall()
+    slo_mod.uninstall()
+
+
+# ---- P² quantile estimator ----
+
+@pytest.mark.parametrize("p", [0.5, 0.9, 0.99])
+def test_p2_quantile_tracks_true_quantile(p):
+    rng = random.Random(7)
+    xs = [rng.lognormvariate(0.0, 0.5) for _ in range(20_000)]
+    est = P2Quantile(p)
+    for x in xs:
+        est.add(x)
+    true = sorted(xs)[int(p * len(xs)) - 1]
+    assert est.value() == pytest.approx(true, rel=0.05)
+
+
+def test_p2_quantile_point_estimate_beats_bucket_bound():
+    """The motivating defect: LatencyHistogram.percentile returns the
+    bucket UPPER BOUND — a p99 at 3ms reads as 5ms on the default
+    ladder.  P² interpolates; movement within one bucket is visible."""
+    from shifu_tensorflow_tpu.obs.registry import LatencyHistogram
+
+    rng = random.Random(3)
+    hist = LatencyHistogram()
+    est = P2Quantile(0.99)
+    xs = [0.003 + 0.0002 * rng.random() for _ in range(5000)]
+    for x in xs:
+        hist.record(x)
+        est.add(x)
+    true = sorted(xs)[int(0.99 * len(xs)) - 1]
+    assert hist.percentile(99) == 0.005  # the ladder bound above 3ms
+    assert est.value() == pytest.approx(true, rel=0.02)
+
+
+def test_p2_quantile_small_counts_nearest_rank():
+    est = P2Quantile(0.5)
+    assert est.value() is None
+    for x in (5.0, 1.0, 3.0):
+        est.add(x)
+    assert est.value() == 3.0  # median of {1, 3, 5}
+
+
+# ---- sliding window ----
+
+def test_windowed_digest_expires_old_cells():
+    d = WindowedDigest(window_s=10.0, buckets=5)
+    t = 1000.0
+    for i in range(100):
+        d.add(float(i), now=t + i * 0.01)
+    snap = d.snapshot(now=t + 1.0)
+    assert snap["count"] == 100
+    assert snap["max"] == 99.0
+    assert 0 < snap["p50"] < 99.0
+    # past the window: the signal is ABSENT, not zero
+    assert d.snapshot(now=t + 20.0) is None
+
+
+def test_windowed_digest_window_moves_with_load():
+    """Observations only in the latest window bucket dominate once the
+    older cells expire — a latency spike ages out instead of pinning the
+    p99 forever (the failure mode of a cumulative histogram)."""
+    d = WindowedDigest(window_s=10.0, buckets=5)
+    t = 1000.0
+    for _ in range(500):
+        d.add(5.0, now=t)
+    for i in range(500):
+        d.add(0.001, now=t + 9.0 + i * 0.001)
+    # both cells live: the old spike still in the window stat
+    assert d.snapshot(now=t + 9.5)["p99"] > 1.0
+    # spike cell expired, only the fast cell remains
+    snap = d.snapshot(now=t + 13.0)
+    assert snap["count"] == 500 and snap["p99"] < 0.01
+
+
+def test_windowed_counter_rate_window():
+    c = WindowedCounter(window_s=10.0, buckets=5)
+    t = 1000.0
+    c.add(5, now=t)
+    c.add(3, now=t + 4.0)
+    assert c.total(now=t + 5.0) == 8
+    assert c.total(now=t + 11.0) == 3  # first cell expired
+    assert c.total(now=t + 30.0) == 0
+
+
+# ---- anomaly detection ----
+
+def test_ewma_z_warmup_then_detects_jump():
+    rng = random.Random(0)
+    e = EwmaZ(warmup=8)
+    zs = [e.update(1.0 + 0.02 * rng.random()) for _ in range(20)]
+    assert all(z is None for z in zs[:8])
+    assert all(abs(z) < 3 for z in zs[10:] if z is not None)
+    assert e.update(3.0) > 6.0  # a 3x jump clears any sane sigma
+
+
+# ---- watchdog state machine ----
+
+def _watchdog(**kw):
+    kw.setdefault("window_s", 10.0)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("plane", "serve")
+    return SloWatchdog(**kw)
+
+
+def test_breach_requires_hysteresis_consecutive_ticks():
+    wd = _watchdog()
+    wd.track("lat", stat="p99", target=0.1)
+    wd.observe("lat", 0.5)
+    assert wd.evaluate() == []  # first breaching tick: no event yet
+    events = wd.evaluate()
+    assert [e["event"] for e in events] == ["slo_breach"]
+    ev = events[0]
+    assert ev["signal"] == "lat" and ev["value"] > ev["target"]
+    # the offending window's digest snapshot rides the event
+    assert ev["window"]["count"] == 1 and ev["window"]["p99"] == 0.5
+    assert wd.evaluate() == []  # still breached: state, not a tick flood
+
+
+def test_recover_requires_hysteresis_and_carries_duration():
+    wd = _watchdog(hysteresis=1)
+    wd.track("lat", stat="p99", target=0.1)
+    wd.observe("lat", 0.5)
+    assert [e["event"] for e in wd.evaluate()] == ["slo_breach"]
+    # clean window: one OK tick recovers at hysteresis=1.  The window
+    # still holds the old 0.5 — pass an explicit `now` past the window
+    # so the stat is re-evaluated on fresh (absent) data.
+    t = slo_mod._mono() + 60.0
+    events = wd.evaluate(now=t)
+    assert [e["event"] for e in events] == ["slo_recover"]
+    assert events[0]["breach_s"] == pytest.approx(60.0, abs=1.0)
+
+
+def test_empty_window_counts_as_clean_not_breaching():
+    """A shed storm that drove every client away leaves an empty latency
+    window — that must recover the signal, never pin the breach."""
+    wd = _watchdog(hysteresis=1)
+    wd.track("lat", stat="p99", target=0.1)
+    assert wd.evaluate() == []  # no data, no breach
+    wd.observe("lat", 9.0)
+    assert [e["event"] for e in wd.evaluate()] == ["slo_breach"]
+    assert [e["event"] for e in wd.evaluate(now=slo_mod._mono() + 99.0)] \
+        == ["slo_recover"]
+
+
+def test_rate_signal_breach_and_recover():
+    wd = _watchdog(hysteresis=1, window_s=5.0)
+    wd.track_rate("shed_rate", num="shed", den="requests", target=0.25)
+    for _ in range(10):
+        wd.count("requests")
+    for _ in range(5):
+        wd.count("shed")
+    events = wd.evaluate()
+    assert [e["event"] for e in events] == ["slo_breach"]
+    assert events[0]["value"] == pytest.approx(0.5)
+    # window drains -> denominator 0 -> absent -> clean tick
+    assert [e["event"] for e in wd.evaluate(now=slo_mod._mono() + 30.0)] \
+        == ["slo_recover"]
+
+
+def test_untargeted_signal_never_breaches_but_alarms_on_anomaly():
+    wd = _watchdog(hysteresis=1, anomaly_sigma=6.0)
+    wd.track("lat", stat="p99", target=0.0)
+    rng = random.Random(1)
+    # steady state through warmup: one evaluation per observation so the
+    # EWMA sees a stable signal
+    for i in range(12):
+        wd.observe("lat", 0.010 + 0.0002 * rng.random(),
+                   )
+        assert wd.evaluate(now=slo_mod._mono() + i * 0.1) == []
+    # sustained 20x excursion (a real p99 jump is many slow requests —
+    # P² needs a handful of them to converge onto the new level):
+    # anomaly fires once, not on every following tick
+    for _ in range(20):
+        wd.observe("lat", 0.2)
+    events = wd.evaluate()
+    assert [e["event"] for e in events] == ["slo_anomaly"]
+    assert events[0]["z"] >= 6.0
+    assert wd.evaluate() == []  # same excursion: no repeat
+
+
+def test_watchdog_journals_transitions_with_plane_and_ids(tmp_path):
+    journal_mod.install(Journal(str(tmp_path / "j.jsonl"), plane="serve",
+                                worker=1, job="jobx"))
+    wd = _watchdog(hysteresis=1, plane="serve", worker=1)
+    wd.track("lat", stat="p99", target=0.1)
+    wd.observe("lat", 0.9)
+    wd.evaluate(epoch=3)
+    journal_mod.uninstall()
+    events = read_events(str(tmp_path / "j.jsonl"))
+    assert [e["event"] for e in events] == ["slo_breach"]
+    ev = events[0]
+    assert ev["plane"] == "serve" and ev["worker"] == 1
+    assert ev["job"] == "jobx" and ev["epoch"] == 3
+    assert ev["window"]["count"] == 1
+
+
+def test_watchdog_renders_stpu_slo_gauges():
+    wd = _watchdog(hysteresis=1)
+    wd.track("serve_p99_s", stat="p99", target=0.25)
+    wd.observe("serve_p99_s", 0.5)
+    wd.evaluate()
+    text = wd.render_prometheus()
+    assert "stpu_slo_serve_p99_s 0.5" in text
+    assert "stpu_slo_serve_p99_s_target 0.25" in text
+    assert "stpu_slo_serve_p99_s_breached 1" in text
+
+
+# ---- config + install wiring ----
+
+def test_from_config_registers_plane_signals():
+    cfg = ObsConfig(enabled=True, slo_serve_p99_ms=250.0,
+                    slo_serve_shed_rate=0.2, slo_step_time_ms=50.0,
+                    slo_infeed_frac=0.3, slo_window_s=30.0,
+                    slo_hysteresis=3)
+    serve = slo_mod.from_config(cfg, plane="serve", worker=2)
+    assert set(serve.state()) == {"serve_p99_s", "serve_shed_rate"}
+    assert serve.state()["serve_p99_s"]["target"] == pytest.approx(0.25)
+    assert serve.hysteresis == 3 and serve.window_s == 30.0
+    train = slo_mod.from_config(cfg, plane="train")
+    assert set(train.state()) == {"train_step_ms", "train_infeed_frac"}
+    assert train.state()["train_step_ms"]["target"] == 50.0
+    # epoch-level samples: the step-time stat is a windowed mean, not a
+    # per-step p99 the aggregate tracer cannot provide
+    assert train.state()["train_step_ms"]["stat"] == "mean"
+    # the coordinator plane registers the train signals too — on the
+    # thread launcher its process HOSTS the trainers, which pick this
+    # watchdog up via slo.active(); without them the configured train
+    # targets would be silently dead
+    coord = slo_mod.from_config(cfg, plane="coordinator")
+    assert set(coord.state()) == {"train_step_ms", "train_infeed_frac"}
+    assert coord.state()["train_step_ms"]["target"] == 50.0
+
+
+def test_obs_config_validates_slo_fields():
+    with pytest.raises(ValueError, match="slo-window"):
+        ObsConfig(slo_window_s=0)
+    with pytest.raises(ValueError, match="slo-hysteresis"):
+        ObsConfig(slo_hysteresis=0)
+    with pytest.raises(ValueError, match="slo-serve-p99"):
+        ObsConfig(slo_serve_p99_ms=-1)
+    with pytest.raises(ValueError, match="fraction"):
+        ObsConfig(slo_serve_shed_rate=1.5)
+
+
+def test_install_obs_installs_and_clears_watchdog(tmp_path):
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    install_obs(ObsConfig(enabled=True,
+                          journal_path=str(tmp_path / "j.jsonl")),
+                plane="serve", worker_index=0)
+    wd = slo_mod.active()
+    assert wd is not None and wd.plane == "serve" and wd.worker == 0
+    # a disabled config clears a stale watchdog (process reuse in tests)
+    install_obs(ObsConfig())
+    assert slo_mod.active() is None
