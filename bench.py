@@ -730,6 +730,18 @@ def main() -> None:
         import bench_obs
 
         sys.exit(bench_obs.main())
+    if "serve-tenants" in sys.argv[1:]:
+        # multi-tenant serve benchmark (python bench.py serve-tenants):
+        # N-model consolidation rows/s vs N single-model fleets at equal
+        # total concurrency + p99 isolation under one-tenant overload,
+        # artifact BENCH_SERVE_TENANTS.json — implemented in
+        # scripts/bench_serve_tenants.py.  In-process on the CPU
+        # backend, so the parent's no-jax rule does not apply.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve_tenants
+
+        sys.exit(bench_serve_tenants.main())
     if "serve-scale" in sys.argv[1:]:
         # serve-plane scale benchmark (python bench.py serve-scale):
         # bucket-ladder warm-up latency cliffs (cold start + hot-reload

@@ -253,6 +253,33 @@ DEFAULT_SERVE_RELOAD_POLL_MS = 2000
 SERVE_WORKERS = TPU_PREFIX + "serve-workers"
 DEFAULT_SERVE_WORKERS = 1
 
+# ---- multi-tenant serving (serve/tenancy/: one endpoint, many models) ----
+# A models DIR turns the server multi-tenant: every immediate
+# subdirectory holding an exported bundle is a tenant named by the
+# subdirectory, routed at /score/<model>.  Mutually exclusive with the
+# single-model --model-dir; empty (the default) keeps single-model mode.
+SERVE_MODELS_DIR = TPU_PREFIX + "serve-models-dir"
+DEFAULT_SERVE_MODELS_DIR = ""
+# admission budget in MB of bundle bytes (a proxy for resident model
+# memory: weights + compiled ladder scale with the artifact).  Admitting
+# past it evicts least-recently-used tenants first; a single bundle
+# larger than the whole budget is refused.  0 = unlimited.
+SERVE_MODEL_BUDGET_MB = TPU_PREFIX + "serve-model-budget-mb"
+DEFAULT_SERVE_MODEL_BUDGET_MB = 0.0
+# cold-start guard: how long a request for an evicted-but-admittable
+# model waits on the in-flight admission (verify + warm ladder) before
+# 503 + Retry-After.  The admission itself always runs to completion in
+# the background — a timed-out caller retries into a warm model.
+SERVE_MODEL_ADMIT_WAIT_S = TPU_PREFIX + "serve-model-admit-wait"
+DEFAULT_SERVE_MODEL_ADMIT_WAIT_S = 30.0
+# weighted fair dispatch: per-tenant weight under the shared device
+# scheduler's deficit round-robin (serve/tenancy/scheduler.py).  Append
+# the model name: shifu.tpu.serve-tenant-weight-<model> = 2.0 gives
+# <model> 2x the device rows of a weight-1 tenant under contention;
+# idle tenants cost nothing (work-conserving).
+SERVE_TENANT_WEIGHT_PREFIX = TPU_PREFIX + "serve-tenant-weight-"
+DEFAULT_SERVE_TENANT_WEIGHT = 1.0
+
 # ---- observability plane (obs/: registry + trace + journal) ----
 # Off-by-default-cheap: with every key unset the instrumented seams cost
 # one is-None check.  Enabling turns on step-phase span timing

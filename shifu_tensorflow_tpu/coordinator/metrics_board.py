@@ -20,18 +20,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
-# DEPRECATION NOTE: LatencyHistogram's implementation moved to
-# obs/registry.py — the single metrics-primitive home shared by the
-# serve scrape surface and the coordinator fleet metrics, so no third
-# copy can appear.  Re-exported here (with its bucket ladder) because
-# this module was its public address through PR 3; import from
-# shifu_tensorflow_tpu.obs.registry in new code.
-from shifu_tensorflow_tpu.obs.registry import (  # noqa: F401  (re-export)
-    DEFAULT_BOUNDS as _DEFAULT_BOUNDS,
-    LatencyHistogram,
-)
 from shifu_tensorflow_tpu.train.trainer import EpochStats
 from shifu_tensorflow_tpu.utils import fs
+
+# LatencyHistogram lived here through PR 3, moved to obs/registry.py in
+# PR 4 (one metrics-primitive home behind every scrape surface), and
+# the compatibility re-export was dropped in PR 9 — import it from
+# shifu_tensorflow_tpu.obs.registry.
 
 
 @dataclass

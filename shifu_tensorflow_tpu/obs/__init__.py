@@ -9,8 +9,7 @@ package is the one instrumentation layer all three planes share:
 
 - :mod:`~shifu_tensorflow_tpu.obs.registry` — thread-safe counters,
   gauges, and latency histograms with one Prometheus text renderer.
-  ``serve/metrics.py`` and ``coordinator/metrics_board.py`` are thin
-  wrappers over these types.
+  ``serve/metrics.py`` is a thin wrapper over these types.
 - :mod:`~shifu_tensorflow_tpu.obs.trace` — lightweight span timing for
   the per-step loop (infeed / host / dispatch / block), checkpoint
   save/restore, retry sleeps, and coordinator RPCs.  Spans carry the

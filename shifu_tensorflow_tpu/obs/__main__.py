@@ -251,8 +251,27 @@ def _serve_data(events: list[dict]) -> dict:
     per: dict = defaultdict(lambda: {
         "start_ts": None, "stop_ts": None, "requests": None,
         "reloads": 0, "refused": 0, "shed_events": 0, "shed_total": 0,
+        # multi-tenant shed events carry per-TENANT counters: the
+        # worker total is the SUM of per-model maxima, not a max
+        # across tenants (which would report only the hottest one)
+        "_shed_max": {},
     })
     fleet = {"workers": None, "restarts": 0}
+    # per-MODEL aggregation (multi-tenant serve: events carry a `model`
+    # dimension) — rows/batches from serve_batch, tenancy lifecycle
+    # from model_admit/model_evict/model_admit_failed.  Rows
+    # materialize ONLY in branches that count something: an event kind
+    # this table doesn't track must not mint an all-zero row that
+    # reads as "present and idle".
+    models: dict = defaultdict(lambda: {
+        "rows": 0, "batches": 0, "sheds": 0, "reloads": 0,
+        "refused": 0, "admits": 0, "evicts": 0,
+    })
+
+    def mm_of(ev):
+        mname = ev.get("model")
+        return models[mname] if mname else None
+
     for ev in serve:
         kind = ev.get("event")
         w = ev.get("worker")
@@ -266,12 +285,36 @@ def _serve_data(events: list[dict]) -> dict:
                                   int(ev.get("shed_total", 0) or 0))
         elif kind == "reload":
             a["reloads"] += 1
-        elif kind == "reload_refused":
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["reloads"] += 1
+        elif kind in ("reload_refused", "model_admit_failed"):
             a["refused"] += 1
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["refused"] += 1
         elif kind == "shed":
             a["shed_events"] += 1
-            a["shed_total"] = max(a["shed_total"],
-                                  int(ev.get("shed_total", 0) or 0))
+            key = ev.get("model")
+            a["_shed_max"][key] = max(
+                a["_shed_max"].get(key, 0),
+                int(ev.get("shed_total", 0) or 0))
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["sheds"] += 1
+        elif kind == "serve_batch":
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["batches"] += 1
+                mm["rows"] += int(ev.get("rows", 0) or 0)
+        elif kind == "model_admit":
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["admits"] += 1
+        elif kind == "model_evict":
+            mm = mm_of(ev)
+            if mm is not None:
+                mm["evicts"] += 1
         elif kind == "serve_fleet_start":
             fleet["workers"] = ev.get("workers")
         elif kind in ("serve_worker_restart",):
@@ -287,16 +330,23 @@ def _serve_data(events: list[dict]) -> dict:
                 and a["stop_ts"] is not None
                 and a["stop_ts"] > a["start_ts"]):
             rate = round(a["requests"] / (a["stop_ts"] - a["start_ts"]), 1)
+        # the stop line's counter is the worker-wide aggregate; shed
+        # events each carry one tenant's counter, so their per-model
+        # maxima SUM to the worker total — take whichever saw more
+        a["shed_total"] = max(a["shed_total"],
+                              sum(a["_shed_max"].values()))
         rows[w] = {**{k: v for k, v in a.items()
-                      if k not in ("start_ts", "stop_ts")},
+                      if k not in ("start_ts", "stop_ts", "_shed_max")},
                    "req_per_s": rate}
-    return {"fleet": fleet, "workers": rows}
+    return {"fleet": fleet, "workers": rows,
+            "models": {m: dict(v) for m, v in sorted(models.items())}}
 
 
 def _render_serve(data: dict) -> list[str]:
     if not data:
         return []
     fleet, rows = data["fleet"], data["workers"]
+    models = data.get("models") or {}
     lines = []
     if fleet["workers"]:
         lines.append(f"  fleet: {fleet['workers']} workers"
@@ -322,6 +372,19 @@ def _render_serve(data: dict) -> list[str]:
             f"{rate or '?':<8} {a['shed_total']:<6} {a['reloads']:<8} "
             f"{a['refused']}"
         )
+    if models:
+        # the multi-tenant split: which model the rows/sheds/tenancy
+        # churn belong to — journal-only (the per-process /metrics
+        # can't aggregate a fleet; this table can)
+        lines.append(
+            "  model          rows     batches  shed-ev  reloads  "
+            "refused  admits  evicts")
+        for m, v in models.items():
+            lines.append(
+                f"  {m:<14} {v['rows']:<8} {v['batches']:<8} "
+                f"{v['sheds']:<8} {v['reloads']:<8} {v['refused']:<8} "
+                f"{v['admits']:<7} {v['evicts']}"
+            )
     return lines
 
 

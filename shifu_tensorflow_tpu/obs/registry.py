@@ -143,6 +143,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = (labels, value)
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop a gauge series (no-op when absent): a gauge whose
+        subject is GONE — an evicted serve tenant's SLO signal — must
+        leave the scrape rather than freeze at its last value."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     # ---- histograms ----
     def histogram(self, name: str,
                   bounds: tuple[float, ...] | None = None) -> LatencyHistogram:
@@ -155,27 +162,44 @@ class MetricsRegistry:
             return h
 
     # ---- rendering ----
-    def render_prometheus(self, prefix: str) -> str:
+    def render_prometheus(self, prefix: str, extra_labels: str = "") -> str:
         """The scrape body: every counter (sorted), gauge, and histogram
-        summary under ``prefix`` (e.g. ``"stpu_serve_"``)."""
+        summary under ``prefix`` (e.g. ``"stpu_serve_"``).
+
+        ``extra_labels`` is a pre-rendered label *body* (no braces, e.g.
+        ``'model="alpha"'``) merged into EVERY series this registry
+        renders — the multi-tenant serve plane renders one registry per
+        model and stamps the model dimension here, so per-model and
+        single-model scrapes share one code path (and the default
+        ``extra_labels=""`` render stays byte-identical to pre-tenancy
+        output)."""
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = list(self._gauges.items())
             hists = list(self._hists.items())
+
+        def lbl(existing: str = "") -> str:
+            # merge an existing pre-rendered block ('{digest="..."}' or
+            # 'quantile="0.99"'-style bodies below) with the extra body
+            body = existing.strip("{}")
+            parts = [p for p in (body, extra_labels) if p]
+            return "{%s}" % ",".join(parts) if parts else ""
+
         lines: list[str] = []
         for name, value in counters:
             lines.append(f"# TYPE {prefix}{name} counter")
-            lines.append(f"{prefix}{name} {value}")
+            lines.append(f"{prefix}{name}{lbl()} {value}")
         for name, (labels, value) in gauges:
             lines.append(f"# TYPE {prefix}{name} gauge")
-            lines.append(f"{prefix}{name}{labels} {value}")
+            lines.append(f"{prefix}{name}{lbl(labels)} {value}")
         for name, hist in hists:
             snap = hist.snapshot()
             lines.append(f"# TYPE {prefix}{name} summary")
             for q in (50, 90, 99):
                 lines.append(
-                    '%s%s{quantile="0.%02d"} %g'
-                    % (prefix, name, q, hist.percentile(q))
+                    '%s%s%s %g'
+                    % (prefix, name, lbl('quantile="0.%02d"' % q),
+                       hist.percentile(q))
                 )
             # real CUMULATIVE buckets beside the quantile gauges: the
             # quantiles above are bucket upper bounds (convenient but
@@ -187,9 +211,10 @@ class MetricsRegistry:
             for bound, c in snap["buckets"].items():
                 acc += c
                 lines.append(
-                    '%s%s_bucket{le="%s"} %d' % (prefix, name, bound, acc)
+                    '%s%s_bucket%s %d'
+                    % (prefix, name, lbl('le="%s"' % bound), acc)
                 )
-            lines.append(f"{prefix}{name}_count {snap['count']}")
-            lines.append(f"{prefix}{name}_sum {snap['sum']:.6f}")
+            lines.append(f"{prefix}{name}_count{lbl()} {snap['count']}")
+            lines.append(f"{prefix}{name}_sum{lbl()} {snap['sum']:.6f}")
         return "\n".join(lines) + "\n"
 

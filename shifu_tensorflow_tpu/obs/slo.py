@@ -63,6 +63,26 @@ __all__ = [
 
 _mono = time.monotonic
 
+def _gauge_name(signal: str) -> str:
+    """Signal name → Prometheus-legal gauge name.  Per-tenant signals
+    carry a ``:<model>`` suffix whose charset ([A-Za-z0-9._-]) is wider
+    than metric names allow; the escape is BIJECTIVE ('_' doubles,
+    other illegal chars become two hex digits) so tenants differing
+    only in '.', '-' vs '_' ("a.b" vs "a_b") cannot collide onto one
+    gauge and silently overwrite each other's breach state."""
+    if ":" not in signal:
+        return f"slo_{signal}"
+    base, model = signal.split(":", 1)
+    esc = []
+    for ch in model:
+        if ch.isascii() and ch.isalnum():
+            esc.append(ch)
+        elif ch == "_":
+            esc.append("__")
+        else:
+            esc.append("_%02x" % ord(ch))
+    return f"slo_{base}_{''.join(esc)}"
+
 
 class P2Quantile:
     """Streaming single-quantile estimator (the P² algorithm): five
@@ -327,6 +347,11 @@ class SloWatchdog:
         self._signals: dict[str, _TrackedSignal] = {}
         self._digests: dict[str, WindowedDigest] = {}
         self._counters: dict[str, WindowedCounter] = {}
+        # serve-plane targets remembered by from_config so per-tenant
+        # signals registered AFTER construction (a model admitted at
+        # runtime) inherit the configured targets
+        self.serve_p99_target_s = 0.0
+        self.serve_shed_rate_target = 0.0
         self._lock = threading.Lock()
         # serializes evaluate(): the breach state machine mutates
         # per-signal streak counters, and on the thread launcher several
@@ -356,6 +381,49 @@ class SloWatchdog:
             self._counters.setdefault(
                 den, WindowedCounter(self.window_s, self.buckets))
 
+    def track_serve_tenant(self, model: str) -> None:
+        """Register the per-tenant serve signals (idempotent): the
+        tenancy store calls this on every admission, so each model gets
+        its OWN windowed p99 and shed-rate state machine under the
+        plane-wide targets — per-model ``slo_breach`` events name the
+        tenant via the signal, and one hot tenant's breach does not
+        paint the whole plane red.  Signal names use ``:`` as the model
+        separator; the gauge renderer sanitizes it (Prometheus metric
+        names can't carry it)."""
+        p99 = f"serve_p99_s:{model}"
+        with self._lock:
+            if p99 in self._signals:
+                return
+        self.track(p99, stat="p99", target=self.serve_p99_target_s,
+                   unit="s")
+        self.track_rate(f"serve_shed_rate:{model}",
+                        num=f"shed:{model}", den=f"requests:{model}",
+                        target=self.serve_shed_rate_target)
+
+    def untrack_serve_tenant(self, model: str) -> None:
+        """Drop a tenant's signals and their gauges (eviction): the
+        watchdog must not keep rendering a frozen p99 for a model that
+        is no longer serving — the ROADMAP item-4 autoscaler reads
+        these gauges.  A re-admission re-registers via
+        :meth:`track_serve_tenant`.  Serialized with ``evaluate`` under
+        the eval lock: an in-flight tick that already snapshotted this
+        tenant's signal would otherwise re-set the gauges right after
+        their removal, resurrecting them forever (no later tick would
+        know the signal to clean up)."""
+        p99 = f"serve_p99_s:{model}"
+        rate = f"serve_shed_rate:{model}"
+        with self._eval_lock:
+            with self._lock:
+                self._signals.pop(p99, None)
+                self._signals.pop(rate, None)
+                self._digests.pop(p99, None)
+                self._counters.pop(f"shed:{model}", None)
+                self._counters.pop(f"requests:{model}", None)
+            for base in (p99, rate):
+                g = _gauge_name(base)
+                for suffix in ("", "_target", "_breached", "_z"):
+                    self.registry.remove_gauge(g + suffix)
+
     # ---- hot path ----
     def observe(self, name: str, value: float) -> None:
         d = self._digests.get(name)
@@ -376,13 +444,21 @@ class SloWatchdog:
     # ---- slow path ----
     def _value_of(self, sig: _TrackedSignal,
                   now: float) -> tuple[float | None, dict | None]:
+        # .get, not []: an untrack (tenant eviction) can remove the
+        # backing structures between evaluate()'s signal snapshot and
+        # this read — an absent structure is an absent signal
         if sig.stat == "rate":
-            den = self._counters[sig.den].total(now)
+            den_c = self._counters.get(sig.den)
+            num_c = self._counters.get(sig.num)
+            if den_c is None or num_c is None:
+                return None, None
+            den = den_c.total(now)
             if den == 0:
                 return None, None
-            num = self._counters[sig.num].total(now)
+            num = num_c.total(now)
             return num / den, {"count": den, sig.num: num}
-        snap = self._digests[sig.name].snapshot(now)
+        d = self._digests.get(sig.name)
+        snap = d.snapshot(now) if d is not None else None
         if snap is None:
             return None, None
         return snap.get(sig.stat), snap
@@ -410,7 +486,10 @@ class SloWatchdog:
         events: list[dict] = []
         for sig in signals:
             value, snap = self._value_of(sig, now)
-            gname = f"slo_{sig.name}"
+            # per-tenant signal names carry ':' (serve_p99_s:alpha) —
+            # escaped bijectively for the gauge, Prometheus names can't
+            # hold the tenant charset
+            gname = _gauge_name(sig.name)
             if value is not None:
                 self.registry.set_gauge(gname, round(value, 6))
             if sig.target > 0:
@@ -529,6 +608,10 @@ def from_config(cfg, *, plane: str = "train",
                  target=cfg.slo_serve_p99_ms / 1000.0, unit="s")
         wd.track_rate("serve_shed_rate", num="shed", den="requests",
                       target=cfg.slo_serve_shed_rate)
+        # per-tenant signals reuse these targets when the multi-model
+        # store admits a model at runtime (track_serve_tenant)
+        wd.serve_p99_target_s = cfg.slo_serve_p99_ms / 1000.0
+        wd.serve_shed_rate_target = cfg.slo_serve_shed_rate
     else:  # train — and coordinator, whose process may HOST trainers
         wd.track("train_step_ms", stat="mean",
                  target=cfg.slo_step_time_ms, unit="ms")

@@ -31,8 +31,31 @@ def build_parser() -> argparse.ArgumentParser:
                     "scoring, hot reload, and shed-before-queue "
                     "backpressure.",
     )
-    p.add_argument("--model-dir", required=True,
-                   help="exported bundle dir (export_model output)")
+    p.add_argument("--model-dir", default=None,
+                   help="exported bundle dir (export_model output) — "
+                        "single-model mode; exactly one of this and "
+                        "--models-dir is required")
+    p.add_argument("--models-dir", default=None, dest="models_dir",
+                   help="multi-tenant mode (shifu.tpu.serve-models-dir): "
+                        "every immediate subdirectory holding an export "
+                        "bundle is a tenant, routed at /score/<model> "
+                        "(GET /models lists them)")
+    p.add_argument("--model-budget-mb", type=float, default=None,
+                   dest="model_budget_mb",
+                   help="admission budget in MB of bundle bytes "
+                        "(shifu.tpu.serve-model-budget-mb); past it, "
+                        "least-recently-used tenants evict.  0 = "
+                        "unlimited")
+    p.add_argument("--model-admit-wait", type=float, default=None,
+                   dest="model_admit_wait",
+                   help="cold-start guard seconds a request waits on an "
+                        "in-flight admission before 503 + Retry-After "
+                        "(shifu.tpu.serve-model-admit-wait)")
+    p.add_argument("--tenant-weight", action="append", default=None,
+                   dest="tenant_weight", metavar="MODEL=W",
+                   help="weighted fair dispatch: device-rows weight for "
+                        "one tenant (repeatable; CLI wins over "
+                        "shifu.tpu.serve-tenant-weight-<model> keys)")
     p.add_argument("--globalconfig", action="append", default=[],
                    help="layered config file (XML or JSON); repeatable, "
                         "later wins")
@@ -126,8 +149,12 @@ def main(argv: list[str] | None = None) -> int:
     try:
         server = ScoringServer(config, warm=not args.no_warm,
                                worker_index=args.serve_worker_index)
-    except ArtifactCorrupt as e:
-        print(f"refusing to serve {config.model_dir}: {e}", file=sys.stderr)
+    except (ArtifactCorrupt, ValueError) as e:
+        # single-model: corrupt initial artifact fails fast; multi:
+        # a missing/empty models dir does (per-tenant corruption only
+        # refuses THAT tenant — the fleet still starts)
+        where = config.model_dir or config.models_dir
+        print(f"refusing to serve {where}: {e}", file=sys.stderr)
         return 3
 
     import threading
@@ -148,20 +175,33 @@ def main(argv: list[str] | None = None) -> int:
 
     from shifu_tensorflow_tpu.obs import journal as _obs_journal
 
-    model = server.store.current()
     server.start()
-    _obs_journal.emit("serve_start", plane="serve", port=server.port,
-                      model_epoch=model.epoch,
-                      model_digest=model.digest[:12])
-    ready = {
-        "state": "listening",
-        "host": config.host,
-        "port": server.port,
-        "backend": config.backend,
-        "model_epoch": model.epoch,
-        "model_digest": model.digest[:12],
-        "model_verified": model.verified,
-    }
+    if server.multi is not None:
+        admitted = server.multi.admitted()
+        _obs_journal.emit("serve_start", plane="serve", port=server.port,
+                          models=admitted)
+        ready = {
+            "state": "listening",
+            "host": config.host,
+            "port": server.port,
+            "backend": config.backend,
+            "models": sorted(server.multi.models()),
+            "models_admitted": admitted,
+        }
+    else:
+        model = server.store.current()
+        _obs_journal.emit("serve_start", plane="serve", port=server.port,
+                          model_epoch=model.epoch,
+                          model_digest=model.digest[:12])
+        ready = {
+            "state": "listening",
+            "host": config.host,
+            "port": server.port,
+            "backend": config.backend,
+            "model_epoch": model.epoch,
+            "model_digest": model.digest[:12],
+            "model_verified": model.verified,
+        }
     if args.serve_worker_index is not None:
         ready["worker_index"] = args.serve_worker_index
     print(json.dumps(ready), flush=True)
@@ -171,6 +211,11 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.close()
         counters = server.metrics.counters()
+        if server.multi is not None:
+            # the stopped line aggregates across tenants (the unrouted
+            # surface only carries pre-resolution errors)
+            for k, v in server.multi.aggregate_counters().items():
+                counters[k] = counters.get(k, 0) + v
         _obs_journal.emit("serve_stop", plane="serve",
                           requests_total=counters.get("requests_total", 0),
                           shed_total=counters.get("shed_total", 0))

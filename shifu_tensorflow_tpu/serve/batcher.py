@@ -138,6 +138,16 @@ class MicroBatcher:
     matches; it runs on the dispatch thread only, so a scorer that is
     merely single-thread-safe (EvalModel's documented contract) needs no
     extra locking here.
+
+    Multi-tenant mode: pass ``scheduler`` (a
+    :class:`~shifu_tensorflow_tpu.serve.tenancy.scheduler.DeviceScheduler`)
+    and this batcher keeps its OWN pack and scatter threads but hands
+    packed batches to the shared scheduler instead of a private dispatch
+    thread — the device is one serialized resource, and weighted-fair
+    arbitration between tenants has to happen where the dispatches
+    queue, not per tenant.  ``model`` names the tenant: it rides the
+    scheduler registration, the journaled ``serve_batch``/``shed``
+    events, and the per-model metrics this batcher was handed.
     """
 
     def __init__(
@@ -149,10 +159,14 @@ class MicroBatcher:
         max_queue_rows: int = 4096,
         retry_after_s: int = 1,
         metrics=None,
+        scheduler=None,
+        model: str | None = None,
+        weight: float = 1.0,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self._score = score_fn
+        self.model = model
         self.max_batch = max_batch
         self.max_delay_s = max(0.0, max_delay_s)
         self.max_queue_rows = max(max_batch, max_queue_rows)
@@ -178,17 +192,28 @@ class MicroBatcher:
         # the batch the dispatch thread is INSIDE score_fn with right
         # now: score_fn callbacks (the server's ModelReleasedError retry)
         # read its rids for their journal events.  Written only by the
-        # dispatch thread; reference assignment, so readers see a whole
-        # _Work or None.
+        # dispatching thread (the private dispatch thread, or the shared
+        # scheduler's device thread); reference assignment, so readers
+        # see a whole _Work or None.
         self._dispatching: _Work | None = None
+        self._scheduler = scheduler
+        self._sched_handle = None
+        tag = f"-{model}" if model else ""
         self._threads = [
             threading.Thread(target=self._pack_loop,
-                             name="serve-pack", daemon=True),
-            threading.Thread(target=self._dispatch_loop,
-                             name="serve-dispatch", daemon=True),
+                             name=f"serve-pack{tag}", daemon=True),
             threading.Thread(target=self._scatter_loop,
-                             name="serve-scatter", daemon=True),
+                             name=f"serve-scatter{tag}", daemon=True),
         ]
+        if scheduler is None:
+            self._threads.append(
+                threading.Thread(target=self._dispatch_loop,
+                                 name="serve-dispatch", daemon=True))
+        else:
+            # register BEFORE the pack thread starts: the first packed
+            # batch must find the tenant queue in place
+            self._sched_handle = scheduler.register(
+                model or "", self, weight=weight)
         for t in self._threads:
             t.start()
 
@@ -316,7 +341,31 @@ class MicroBatcher:
         while True:
             batch = self._take_batch()
             if batch is None:
-                self._dispatch_q.put(None)  # cascade the drain sentinel
+                # cascade the drain sentinel.  Scheduler mode: wait for
+                # the shared device thread to finish everything this
+                # tenant submitted (each dispatched work lands in OUR
+                # scatter queue before drain() observes it done), then
+                # leave the scheduler so a later admission can re-use
+                # the tenant name with a fresh batcher.  A drain that
+                # TIMED OUT (wedged scorer) leaves batches staged:
+                # their waiters get a typed BatcherClosed — retryable
+                # at the routing layer — never a silent hang until
+                # their own submit timeout.
+                if self._scheduler is not None:
+                    self._scheduler.drain(self._sched_handle)
+                    dropped = self._scheduler.unregister(
+                        self._sched_handle)
+                    for work in dropped:
+                        with self._cond:
+                            self._inflight_rows -= work.n
+                        err = BatcherClosed(
+                            "tenant drained before dispatch")
+                        for p in work.batch:
+                            p.error = err
+                            p.event.set()
+                    self._scatter_q.put(None)
+                else:
+                    self._dispatch_q.put(None)
                 return
             work = _Work(batch)
             with obs_trace.span("serve.pack"):
@@ -334,30 +383,42 @@ class MicroBatcher:
                     work.padded = pad_rows(x, work.bucket)
                 except BaseException as e:
                     work.error = e
-            self._dispatch_q.put(work)
+            if self._scheduler is not None:
+                self._scheduler.submit(self._sched_handle, work)
+            else:
+                self._dispatch_q.put(work)
 
     # ---- dispatch stage ----
+    def _dispatch_one(self, work: _Work) -> None:
+        """Score one packed batch and hand it to the scatter stage — the
+        dispatch-stage body, shared by the private dispatch thread
+        (single-model mode) and the tenancy DeviceScheduler's device
+        thread (which calls it under its weighted-fair arbitration).
+        Must be entered by one thread at a time per scorer — both
+        callers are single device threads by construction."""
+        if work.error is None:
+            t0 = time.monotonic()
+            work.queue_delay_s = t0 - min(
+                p.t_enqueue for p in work.batch)
+            self._dispatching = work
+            with obs_trace.span("serve.dispatch"):
+                try:
+                    work.scores = np.asarray(self._score(work.padded))
+                except BaseException as e:
+                    work.error = e
+                finally:
+                    self._dispatching = None
+            work.dispatch_s = time.monotonic() - t0
+            work.padded = None  # the pad copy is dead weight now
+        self._scatter_q.put(work)
+
     def _dispatch_loop(self) -> None:
         while True:
             work = self._dispatch_q.get()
             if work is None:
                 self._scatter_q.put(None)
                 return
-            if work.error is None:
-                t0 = time.monotonic()
-                work.queue_delay_s = t0 - min(
-                    p.t_enqueue for p in work.batch)
-                self._dispatching = work
-                with obs_trace.span("serve.dispatch"):
-                    try:
-                        work.scores = np.asarray(self._score(work.padded))
-                    except BaseException as e:
-                        work.error = e
-                    finally:
-                        self._dispatching = None
-                work.dispatch_s = time.monotonic() - t0
-                work.padded = None  # the pad copy is dead weight now
-            self._scatter_q.put(work)
+            self._dispatch_one(work)
 
     # ---- scatter stage ----
     def _scatter_loop(self) -> None:
@@ -392,12 +453,14 @@ class MicroBatcher:
             # admission-wait vs device-time split from
             rids = work.rids()
             if rids:
+                extra = {"model": self.model} if self.model else {}
                 obs_journal.emit(
                     "serve_batch", plane="serve", rids=rids,
                     requests=len(work.batch), rows=work.n,
                     bucket=work.bucket,
                     queue_delay_s=round(work.queue_delay_s, 6),
                     dispatch_s=round(work.dispatch_s, 6),
+                    **extra,
                 )
         scores = work.scores[:work.n]
         off = 0

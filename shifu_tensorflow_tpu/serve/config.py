@@ -18,9 +18,14 @@ from shifu_tensorflow_tpu.config import keys as K
 class ServeConfig:
     """Everything the scoring server needs to run — the WorkerConfig
     analogue for the serving plane (JSON-bridgeable via to/from_json so a
-    supervisor can ship it to a subprocess the same way)."""
+    supervisor can ship it to a subprocess the same way).
 
-    model_dir: str
+    Exactly one of ``model_dir`` (single-model server, the PR-3 path,
+    byte-for-byte unchanged) and ``models_dir`` (multi-tenant: every
+    immediate subdirectory is a tenant routed at ``/score/<model>``)
+    must be set."""
+
+    model_dir: str | None = None
     host: str = K.DEFAULT_SERVE_HOST
     port: int = K.DEFAULT_SERVE_PORT
     backend: str = K.DEFAULT_SERVE_BACKEND
@@ -30,8 +35,30 @@ class ServeConfig:
     retry_after_s: int = K.DEFAULT_SERVE_RETRY_AFTER_S
     reload_poll_ms: int = K.DEFAULT_SERVE_RELOAD_POLL_MS
     workers: int = K.DEFAULT_SERVE_WORKERS
+    # multi-tenant (serve/tenancy/) — shifu.tpu.serve-model-* keys
+    models_dir: str | None = None
+    model_budget_mb: float = K.DEFAULT_SERVE_MODEL_BUDGET_MB
+    model_admit_wait_s: float = K.DEFAULT_SERVE_MODEL_ADMIT_WAIT_S
+    # ((model, weight), ...) — a tuple of pairs, not a dict, so the
+    # frozen dataclass stays hashable and asdict/from_json round-trips
+    tenant_weights: tuple = ()
 
     def __post_init__(self):
+        if bool(self.model_dir) == bool(self.models_dir):
+            raise ValueError(
+                "exactly one of --model-dir (single model) and "
+                f"--models-dir ({K.SERVE_MODELS_DIR}, multi-tenant) "
+                "must be set"
+            )
+        if self.model_budget_mb < 0:
+            raise ValueError(f"{K.SERVE_MODEL_BUDGET_MB} must be >= 0")
+        if self.model_admit_wait_s <= 0:
+            raise ValueError(f"{K.SERVE_MODEL_ADMIT_WAIT_S} must be > 0")
+        for name, w in self.tenant_weights:
+            if float(w) <= 0:
+                raise ValueError(
+                    f"{K.SERVE_TENANT_WEIGHT_PREFIX}{name} must be > 0"
+                )
         if self.workers < 1:
             raise ValueError(f"{K.SERVE_WORKERS} must be >= 1")
         if self.backend not in ("native", "cpp", "saved_model"):
@@ -48,12 +75,43 @@ class ServeConfig:
                 "than one dispatch could never fill a batch"
             )
 
+    def weight_for(self, model: str) -> float:
+        """The tenant's DRR weight (default 1.0)."""
+        for name, w in self.tenant_weights:
+            if name == model:
+                return float(w)
+        return K.DEFAULT_SERVE_TENANT_WEIGHT
+
     def to_json(self) -> dict:
         return asdict(self)
 
     @classmethod
     def from_json(cls, d: dict) -> "ServeConfig":
+        d = dict(d)
+        # JSON turns the weight pairs into lists; restore hashable form
+        d["tenant_weights"] = tuple(
+            (str(n), float(w)) for n, w in d.get("tenant_weights", ())
+        )
         return cls(**d)
+
+
+def _tenant_weights(args, conf) -> tuple:
+    """Merge ``shifu.tpu.serve-tenant-weight-<model>`` conf keys with
+    repeated ``--tenant-weight model=W`` flags (CLI wins per model)."""
+    weights: dict[str, float] = {}
+    for key, value in conf.items():
+        if key.startswith(K.SERVE_TENANT_WEIGHT_PREFIX):
+            model = key[len(K.SERVE_TENANT_WEIGHT_PREFIX):]
+            if model:
+                weights[model] = float(value)
+    for spec in getattr(args, "tenant_weight", None) or ():
+        model, sep, w = spec.partition("=")
+        if not sep or not model:
+            raise ValueError(
+                f"--tenant-weight expects model=WEIGHT, got {spec!r}"
+            )
+        weights[model] = float(w)
+    return tuple(sorted(weights.items()))
 
 
 def resolve_serve_config(args, conf) -> ServeConfig:
@@ -65,8 +123,26 @@ def resolve_serve_config(args, conf) -> ServeConfig:
         v = getattr(args, flag, None)
         return v if v is not None else get(key, default)
 
+    model_dir = getattr(args, "model_dir", None)
+    models_dir = getattr(args, "models_dir", None)
+    if model_dir is None and models_dir is None:
+        # the conf key chooses the serving mode only when NO CLI flag
+        # named a model source: an explicit --model-dir must not be
+        # vetoed by a fleet-wide XML that sets serve-models-dir (CLI
+        # wins, per the resolver's contract)
+        models_dir = conf.get(K.SERVE_MODELS_DIR,
+                              K.DEFAULT_SERVE_MODELS_DIR)
     return ServeConfig(
-        model_dir=args.model_dir,
+        model_dir=model_dir,
+        models_dir=models_dir or None,
+        model_budget_mb=pick("model_budget_mb", K.SERVE_MODEL_BUDGET_MB,
+                             K.DEFAULT_SERVE_MODEL_BUDGET_MB,
+                             conf.get_float),
+        model_admit_wait_s=pick("model_admit_wait",
+                                K.SERVE_MODEL_ADMIT_WAIT_S,
+                                K.DEFAULT_SERVE_MODEL_ADMIT_WAIT_S,
+                                conf.get_float),
+        tenant_weights=_tenant_weights(args, conf),
         host=pick("host", K.SERVE_HOST, K.DEFAULT_SERVE_HOST, conf.get),
         port=pick("port", K.SERVE_PORT, K.DEFAULT_SERVE_PORT, conf.get_int),
         backend=pick("backend", K.SERVE_BACKEND, K.DEFAULT_SERVE_BACKEND,

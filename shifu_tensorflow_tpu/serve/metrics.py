@@ -1,13 +1,15 @@
 """Serving observability: the scoring server's ``/metrics`` surface.
 
-DEPRECATION NOTE: the metrics primitives that used to live here (and in
-``coordinator/metrics_board.py``) are now
-:mod:`shifu_tensorflow_tpu.obs.registry` — the single implementation
-behind every scrape surface.  ``LatencyHistogram`` is re-exported for
-compatibility; import it from ``obs.registry`` in new code so no third
-copy can appear.  This module keeps only the serve-specific composition:
-which counters exist, which gauges the batcher/store contribute at
-render time, and the ``stpu_serve_`` prefix.
+The metrics primitives live in :mod:`shifu_tensorflow_tpu.obs.registry`
+— the single implementation behind every scrape surface
+(``LatencyHistogram`` is re-exported here for compatibility; import it
+from ``obs.registry`` in new code so no third copy can appear — the
+old ``coordinator/metrics_board`` re-export is gone).  This module
+keeps only the serve-specific composition: which counters exist, which
+gauges the batcher/store contribute at render time, and the
+``stpu_serve_`` prefix.  Multi-tenant serving constructs one
+``ServeMetrics`` per admitted model and renders each with a
+``model="<name>"`` label (``extra_labels``).
 """
 
 from __future__ import annotations
@@ -65,10 +67,14 @@ class ServeMetrics:
         model_epoch: int,
         model_digest: str,
         model_verified: bool,
+        extra_labels: str = "",
     ) -> str:
         """The /metrics body.  Gauges (queue depth, loaded-model identity)
         come from the caller — they belong to the batcher/store, and
-        pulling them at render time keeps this module dependency-free."""
+        pulling them at render time keeps this module dependency-free.
+        ``extra_labels`` (e.g. ``'model="alpha"'``) stamps the
+        multi-tenant model dimension onto every series; empty keeps the
+        single-model output byte-identical."""
         self.registry.set_gauge("queue_rows", queue_rows)
         self.registry.set_gauge("model_epoch", model_epoch)
         self.registry.set_gauge("model_verified", int(model_verified))
@@ -76,4 +82,5 @@ class ServeMetrics:
                                 labels='{digest="%s"}' % model_digest)
         self.registry.set_gauge("uptime_seconds",
                                 round(time.time() - self.started_at, 3))
-        return self.registry.render_prometheus("stpu_serve_")
+        return self.registry.render_prometheus("stpu_serve_",
+                                               extra_labels=extra_labels)

@@ -51,6 +51,15 @@ from shifu_tensorflow_tpu.utils.integrity import check_entry
 log = logs.get("serve.store")
 
 
+#: how long (monotonic) the SAME (mtime, content) must be observed
+#: before the fingerprint cache trusts it — must exceed the filesystem's
+#: mtime granularity (1 s on NFSv3/HDFS-style mounts), NOT the poll
+#: interval: a fast poller could otherwise confirm twice inside one
+#: timestamp granule and pin a stale sha against a same-granule
+#: republish forever
+_FP_CONFIRM_S = 2.5
+
+
 class ArtifactCorrupt(RuntimeError):
     """The artifact on disk disagrees with its manifest (or cannot be
     loaded).  Deliberately carries no ``.code`` and subclasses none of
@@ -118,12 +127,26 @@ class ModelStore:
         metrics=None,
         retry_policy: retry_util.RetryPolicy | None = None,
         warm_buckets: tuple[int, ...] = (),
+        model_name: str | None = None,
     ):
         self.model_dir = model_dir
         self.backend = backend
         self.poll_interval_s = poll_interval_s
         self.metrics = metrics
         self._retry_policy = retry_policy
+        # tenant name under the multi-model store (serve/tenancy/):
+        # stamped on this store's journal events and metrics context so
+        # a merged fleet journal can tell WHICH model reloaded/refused
+        self.model_name = model_name
+        # manifest-content cache keyed by the manifest file's mtime_ns:
+        # with nothing new published, each poll costs ONE stat instead
+        # of a full read_text + json parse — at hundreds of tenants
+        # each running its own poller, the idle-poll cost is what
+        # scales.  _fp_seen is the unconfirmed candidate (mtime, fp,
+        # first-seen monotonic); it promotes to the trusted cache only
+        # after _FP_CONFIRM_S of stable observation (see _fingerprint).
+        self._fp_cache: tuple[int, str] | None = None
+        self._fp_seen: tuple[int, str, float] | None = None
         # the bucket ladder pre-compiled BEFORE a model is admitted
         # (initial load and every hot-reload swap): the first request —
         # and the first request after a reload — must never pay a
@@ -242,7 +265,20 @@ class ModelStore:
         re-publishes identical bytes after a refused corrupt generation),
         or the weights file's (mtime, size) for legacy manifest-less
         exports.  None when nothing readable is there (mid-publish; try
-        later)."""
+        later).
+
+        The manifest content read is cached by ``mtime_ns``, but a
+        candidate is only TRUSTED after the same (mtime, content) has
+        been observed for ``_FP_CONFIRM_S`` of LOCAL MONOTONIC time: on
+        a filesystem with coarse timestamp granularity two publishes in
+        quick succession can share an mtime_ns with different bytes,
+        and caching sooner would pin the stale sha forever — once the
+        stable window exceeds the granularity, no same-granule sibling
+        publish can still be coming.  Deliberately independent of the
+        file server's clock (skew-proof) AND of the poll interval (a
+        fast poller must not confirm twice inside one granule).  Steady
+        state is one stat per poll; the cache never skips a CHANGED
+        mtime."""
         mpath = os.path.join(self.model_dir, NATIVE_MANIFEST)
         try:
             if fs.exists(mpath):
@@ -251,14 +287,30 @@ class ModelStore:
                 # matches neither stored fingerprint — the poll then
                 # attempts a reload, i.e. the race fails open
                 mtime = fs.mtime_ns(mpath)
+                cached = self._fp_cache
+                if cached is not None and cached[0] == mtime:
+                    return cached[1]
                 sha = json.loads(fs.read_text(mpath)).get("sha256", "")
-                return f"{sha}:{mtime}"
+                fp = f"{sha}:{mtime}"
+                now = time.monotonic()
+                seen = self._fp_seen
+                if seen is not None and seen[:2] == (mtime, fp):
+                    if now - seen[2] >= _FP_CONFIRM_S:
+                        self._fp_cache = (mtime, fp)
+                else:
+                    self._fp_seen = (mtime, fp, now)
+                return fp
             wpath = os.path.join(self.model_dir, NATIVE_WEIGHTS)
             if fs.exists(wpath):
                 return f"legacy:{fs.mtime_ns(wpath)}:{fs.size(wpath)}"
         except (OSError, ValueError):
             pass
         return None
+
+    def _model_field(self) -> dict:
+        """The ``model=`` journal dimension — empty in single-model mode
+        so pre-tenancy event schemas stay byte-identical."""
+        return {"model": self.model_name} if self.model_name else {}
 
     # ---- public surface ----
     def current(self) -> LoadedModel:
@@ -315,7 +367,7 @@ class ModelStore:
                     # the per-poll re-verification stays, but the event
                     # stream should record state CHANGES, not poll ticks
                     obs_journal.emit("reload_refused", plane="serve",
-                                     why=str(e))
+                                     why=str(e), **self._model_field())
                 refused = fp
                 log_fn(
                     "refusing new artifact at %s (still serving epoch %d, "
@@ -345,7 +397,8 @@ class ModelStore:
                  loaded.verified)
         obs_journal.emit("reload", plane="serve", epoch=loaded.epoch,
                          digest=loaded.digest[:12],
-                         verified=loaded.verified)
+                         verified=loaded.verified,
+                         **self._model_field())
         if old is not None:
             # release AFTER the swap; EvalModel.release takes the compute
             # lock, so an in-flight dispatch on the old model finishes

@@ -45,6 +45,13 @@ from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.serve.config import ServeConfig
 from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
 from shifu_tensorflow_tpu.serve.model_store import ModelNotLoaded, ModelStore
+from shifu_tensorflow_tpu.serve.tenancy.store import (
+    AdmissionRefused,
+    AmbiguousModel,
+    ModelColdStart,
+    MultiModelStore,
+    UnknownModel,
+)
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("serve")
@@ -62,6 +69,11 @@ class _BadRequest(ValueError):
 #: and a colon-bearing rid would shadow that grammar.
 _RID_OK = re.compile(r"[^0-9A-Za-z._-]+")
 _RID_MAX = 64
+
+#: multi-tenant path grammar: /score/<model> and /healthz/<model> — the
+#: model charset matches tenancy's _NAME_OK (no dotfiles, no separators,
+#: so no traversal can reach the route layer either)
+_MODEL_PATH = re.compile(r"/(score|healthz)/((?!\.)[0-9A-Za-z._-]{1,64})")
 
 
 def resolve_rid(inbound: str | None) -> str:
@@ -106,27 +118,40 @@ class ScoringServer:
         self.config = config
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.worker_index = worker_index
-        # pre-warm set: every bucket the admission bound can admit (a
-        # single request may carry up to max_queue_rows rows and is
-        # never split) — compiled at startup and on every hot-reload
-        # admit so no /score ever waits on a trace.  warm=False is the
-        # diagnostic/benchmark arm that shows the compile cliff.
-        warm_buckets = ladder(config.max_queue_rows) if warm else ()
-        self.store = ModelStore(
-            config.model_dir,
-            backend=config.backend,
-            poll_interval_s=config.reload_poll_ms / 1000.0,
-            metrics=self.metrics,
-            warm_buckets=warm_buckets,
-        )
-        self.batcher = MicroBatcher(
-            self._score_once,
-            max_batch=config.max_batch,
-            max_delay_s=config.max_delay_ms / 1000.0,
-            max_queue_rows=config.max_queue_rows,
-            retry_after_s=config.retry_after_s,
-            metrics=self.metrics,
-        )
+        self.store: ModelStore | None = None
+        self.batcher: MicroBatcher | None = None
+        self.multi = None
+        if config.models_dir:
+            # multi-tenant mode (serve/tenancy/): named models admitted
+            # under the memory budget, per-model batchers feeding the
+            # shared weighted-fair device scheduler.  self.metrics stays
+            # the UNROUTED surface (requests that never resolved a
+            # model); per-model counters live on each tenant.
+            self.multi = MultiModelStore(config, warm=warm)
+        else:
+            # single-model mode — the PR-3/PR-5 path, unchanged
+            # pre-warm set: every bucket the admission bound can admit
+            # (a single request may carry up to max_queue_rows rows and
+            # is never split) — compiled at startup and on every
+            # hot-reload admit so no /score ever waits on a trace.
+            # warm=False is the diagnostic/benchmark arm that shows the
+            # compile cliff.
+            warm_buckets = ladder(config.max_queue_rows) if warm else ()
+            self.store = ModelStore(
+                config.model_dir,
+                backend=config.backend,
+                poll_interval_s=config.reload_poll_ms / 1000.0,
+                metrics=self.metrics,
+                warm_buckets=warm_buckets,
+            )
+            self.batcher = MicroBatcher(
+                self._score_once,
+                max_batch=config.max_batch,
+                max_delay_s=config.max_delay_ms / 1000.0,
+                max_queue_rows=config.max_queue_rows,
+                retry_after_s=config.retry_after_s,
+                metrics=self.metrics,
+            )
         handler = _make_handler(self)
         # workers > 1 means this process is ONE of several sharing the
         # port — every one of them must bind with SO_REUSEPORT
@@ -140,19 +165,24 @@ class ScoringServer:
             # e.g. EADDRINUSE: without this, the started batcher thread
             # pins the score_fn closure → store → model, leaking a full
             # model's memory per failed construction attempt
-            self.batcher.close(drain=False)
-            self.store.close()
+            if self.batcher is not None:
+                self.batcher.close(drain=False)
+            if self.store is not None:
+                self.store.close()
+            if self.multi is not None:
+                self.multi.close()
             raise
         self.httpd.daemon_threads = True
         self.port = int(self.httpd.server_address[1])
         self._serve_thread: threading.Thread | None = None
         self._serving = False
         self._closed = False
-        # journal shed events at most once per window: the journal
-        # records STATE (we are shedding), not per-request ticks — a
-        # sustained overload at thousands of 429s/s would otherwise
-        # rotate the lifecycle events out of the size-capped journal
-        self._last_shed_emit = 0.0
+        # journal shed events at most once per window PER MODEL: the
+        # journal records STATE (we are shedding), not per-request
+        # ticks — a sustained overload at thousands of 429s/s would
+        # otherwise rotate the lifecycle events out of the size-capped
+        # journal.  Key None = the single-model plane.
+        self._shed_emits: dict[str | None, float] = {}
         # SLO watchdog (obs/slo.py, installed by install_obs): the
         # request path feeds its latency digest + request/shed counters,
         # and a background tick evaluates targets → journaled
@@ -167,11 +197,18 @@ class ScoringServer:
         must be fully materialized (bytes → json → numpy) before the
         row-level checks can run — so without this cap a multi-GB POST
         would blow memory long before RequestTooLarge/ShedLoad fire.
-        ~40 bytes/feature is generous for JSON float text."""
-        try:
-            nf = self.store.current().model.num_features
-        except ModelNotLoaded:
-            nf = 64
+        ~40 bytes/feature is generous for JSON float text.  Multi-tenant
+        mode bounds on the widest ADMITTED model (resolving the target
+        tenant would itself admit-on-demand — too much work before the
+        length check)."""
+        nf = 64
+        if self.multi is not None:
+            nf = max(nf, self.multi.max_num_features())
+        else:
+            try:
+                nf = self.store.current().model.num_features
+            except ModelNotLoaded:
+                pass
         return max(1 << 20, self.config.max_queue_rows * nf * 40)
 
     # ---- scoring (batcher thread only) ----
@@ -206,7 +243,10 @@ class ScoringServer:
         CLI starts this and parks its main thread on a signal-settable
         event (a foreground serve_forever would deadlock the signal
         handler, which must not call the blocking shutdown() itself)."""
-        self.store.start()
+        if self.store is not None:
+            self.store.start()
+        # multi-tenant: each admitted tenant's reload poller started at
+        # its admission (MultiModelStore), nothing to start here
         self._serving = True
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http", daemon=True
@@ -249,8 +289,12 @@ class ScoringServer:
         self._slo_stop.set()
         if self._slo_thread is not None:
             self._slo_thread.join(timeout=10.0)
-        self.batcher.close(drain=True)
-        self.store.close()
+        if self.batcher is not None:
+            self.batcher.close(drain=True)
+        if self.store is not None:
+            self.store.close()
+        if self.multi is not None:
+            self.multi.close()
 
     def __enter__(self):
         return self
@@ -259,23 +303,50 @@ class ScoringServer:
         self.close()
 
     # ---- request handling (HTTP threads) ----
-    def note_shed(self, rid: str | None) -> None:
-        """Bookkeep one shed refusal: watchdog counters always, journal
-        at most once per 5s window (the journal records the CONDITION,
-        not per-request ticks) — that one event carries the triggering
-        request's id so a trace of a shed request can still find it."""
+    def note_shed(self, rid: str | None, model: str | None = None) -> None:
+        """Bookkeep one shed refusal: watchdog counters always (plane
+        AND per-tenant), journal at most once per 5s window per model
+        (the journal records the CONDITION, not per-request ticks) —
+        that one event carries the triggering request's id so a trace
+        of a shed request can still find it."""
+        t = None
+        if self.multi is not None:
+            # a legacy /score shed still names the tenant whose batcher
+            # shed it (the unambiguous one) — the journaled CONDITION
+            # must carry the real per-model counters, not the unrouted
+            # surface's permanent zeros
+            t = self.multi.peek(model) if model else self.multi.sole()
+            if t is not None:
+                model = t.name
         if self._slo is not None:
             self._slo.count("shed")
+            if model:
+                self._slo.count(f"shed:{model}")
         now = time.monotonic()
-        if now - self._last_shed_emit > 5.0:
-            self._last_shed_emit = now
+        if now - self._shed_emits.get(model, 0.0) <= 5.0:
+            return
+        self._shed_emits[model] = now
+        if self.multi is not None:
+            batcher = t.batcher if t is not None else None
+            metrics = t.metrics if t is not None else None
+            extra = {"model": model} if model else {}
+            obs_journal.emit(
+                "shed", plane="serve", rid=rid,
+                queue_rows=(batcher.queued_rows()
+                            if batcher is not None else 0),
+                shed_total=(metrics.counters().get("shed_total", 0)
+                            if metrics is not None else 0),
+                **extra,
+            )
+        else:
             obs_journal.emit(
                 "shed", plane="serve", rid=rid,
                 queue_rows=self.batcher.queued_rows(),
                 shed_total=self.metrics.counters().get("shed_total", 0),
             )
 
-    def handle_score(self, body: bytes, rid: str | None = None) -> dict:
+    @staticmethod
+    def _parse_raw(body: bytes):
         try:
             payload = json.loads(body)
         except ValueError as e:
@@ -283,12 +354,13 @@ class ScoringServer:
         if not isinstance(payload, dict):
             raise _BadRequest('body must be an object with "rows" or "row"')
         if "rows" in payload:
-            raw = payload["rows"]
-        elif "row" in payload:
-            raw = [payload["row"]]
-        else:
-            raise _BadRequest('body must carry "rows" (list of rows) or "row"')
-        model = self.store.current()
+            return payload["rows"]
+        if "row" in payload:
+            return [payload["row"]]
+        raise _BadRequest('body must carry "rows" (list of rows) or "row"')
+
+    @staticmethod
+    def _to_rows(raw, num_features: int) -> np.ndarray:
         try:
             rows = np.asarray(raw, dtype=np.float32)
         except (TypeError, ValueError) as e:
@@ -298,13 +370,41 @@ class ScoringServer:
                 f"rows must be a non-empty 2-D array, got shape "
                 f"{rows.shape}"
             )
-        if rows.shape[1] != model.model.num_features:
+        if rows.shape[1] != num_features:
             raise _BadRequest(
-                f"model expects {model.model.num_features} features per "
+                f"model expects {num_features} features per "
                 f"row, got {rows.shape[1]}"
             )
         if not np.isfinite(rows).all():
             raise _BadRequest("rows contain NaN/Inf")
+        return rows
+
+    @staticmethod
+    def _score_response(scores: np.ndarray, loaded, rid: str | None,
+                        model: str | None = None) -> dict:
+        """The /score response body, shared by the single-model and
+        multi-tenant paths so rounding and identity stamping can never
+        diverge between them."""
+        out = (scores[:, 0] if scores.ndim == 2 and scores.shape[1] == 1
+               else scores)
+        resp: dict = {
+            "scores": np.asarray(out, np.float64).round(6).tolist(),
+        }
+        if model is not None:
+            resp["model"] = model
+        resp["model_epoch"] = loaded.epoch
+        resp["model_digest"] = loaded.digest[:12]
+        if rid is not None:
+            resp["request_id"] = rid
+        return resp
+
+    def handle_score(self, body: bytes, rid: str | None = None,
+                     model_name: str | None = None) -> dict:
+        raw = self._parse_raw(body)
+        if self.multi is not None:
+            return self._score_multi(raw, rid, model_name)
+        model = self.store.current()
+        rows = self._to_rows(raw, model.model.num_features)
         self.metrics.inc("requests_total")
         if self._slo is not None:
             # "requests" counts every scoring ATTEMPT (a shed raises out
@@ -323,18 +423,87 @@ class ScoringServer:
         # still mislabel, but the stamp now matches the scoring model in
         # every ordering the batcher can actually produce.
         model = self.store.current()
-        out = (scores[:, 0] if scores.ndim == 2 and scores.shape[1] == 1
-               else scores)
-        resp = {
-            "scores": np.asarray(out, np.float64).round(6).tolist(),
-            "model_epoch": model.epoch,
-            "model_digest": model.digest[:12],
-        }
-        if rid is not None:
-            resp["request_id"] = rid
-        return resp
+        return self._score_response(scores, model, rid)
+
+    def _score_multi(self, raw, rid: str | None,
+                     model_name: str | None) -> dict:
+        """The ``/score/<model>`` path: resolve (admitting on demand
+        under the cold-start guard), validate against THAT model's
+        width, feed its micro-batcher, stamp its identity."""
+        tenant = self.multi.acquire(model_name)
+        store = tenant.store
+        if store is None:
+            # evicted in the acquire→here window (a concurrent admission
+            # under budget pressure chose this tenant as LRU victim);
+            # re-acquire re-admits.  Typed None checks, not
+            # except AttributeError — a genuine AttributeError from the
+            # scorer must surface as the bug it is, never be misread as
+            # an eviction and silently re-scored.
+            tenant = self.multi.acquire(tenant.name)
+            store = tenant.store
+            if store is None:
+                raise ModelColdStart(tenant.name)
+        loaded = store.current()
+        rows = self._to_rows(raw, loaded.model.num_features)
+        tenant.metrics.inc("requests_total")
+        if self._slo is not None:
+            self._slo.count("requests")
+            self._slo.count(f"requests:{tenant.name}")
+        t0 = time.monotonic()
+        scores = None
+        for attempt in (0, 1):
+            batcher = tenant.batcher
+            try:
+                if batcher is None:
+                    raise BatcherClosed("tenant evicted mid-request")
+                scores = batcher.submit(rows, rid=rid)
+                break
+            except BatcherClosed:
+                # evicted between acquire and submit (budget pressure
+                # from a concurrent admission): one re-acquire re-admits
+                # — under a thrashing budget the request is slow, not
+                # failed.  Losing the race TWICE degrades to a
+                # retryable 503 (cold start), never a 500.
+                if attempt:
+                    raise ModelColdStart(tenant.name)
+                tenant = self.multi.acquire(tenant.name)
+        dt = time.monotonic() - t0
+        if self._slo is not None:
+            self._slo.observe("serve_p99_s", dt)
+            self._slo.observe(f"serve_p99_s:{tenant.name}", dt)
+        # identity re-stamp, same argument as the single-model path; an
+        # eviction racing this re-read keeps the pre-submit stamp
+        store = tenant.store
+        if store is not None:
+            try:
+                loaded = store.current()
+            except ModelNotLoaded:
+                pass
+        return self._score_response(scores, loaded, rid,
+                                    model=tenant.name)
 
     def health(self) -> tuple[int, dict]:
+        if self.multi is not None:
+            # no disk rescan on the probe path: a balancer polling
+            # /healthz every second must not pay O(models) stats —
+            # discovery refreshes at /models and scoring requests
+            models = self.multi.models(rescan=False)
+            admitted = [n for n, i in models.items()
+                        if i["state"] == "admitted"]
+            out = {
+                "ok": bool(admitted),
+                "backend": self.config.backend,
+                "models": models,
+                "models_admitted": len(admitted),
+                "budget_mb": self.config.model_budget_mb,
+                "uptime_s": round(
+                    time.time() - self.metrics.started_at, 1),
+            }
+            if self.worker_index is not None:
+                out["worker_index"] = self.worker_index
+            # a fleet with nothing admitted can still admit on demand,
+            # but it serves no request RIGHT NOW — that is 503-degraded
+            return (200 if admitted else 503), out
         try:
             m = self.store.current()
         except ModelNotLoaded:
@@ -352,7 +521,38 @@ class ScoringServer:
             out["worker_index"] = self.worker_index
         return 200, out
 
+    def health_model(self, name: str) -> tuple[int, dict]:
+        """``/healthz/<model>``: one tenant's detail.  404 unknown, 200
+        admitted, 503 known-but-unroutable (cold / admitting / refused —
+        the state says which)."""
+        if self.multi is None:
+            return 404, {"error": "single-model server; use /healthz"}
+        models = self.multi.models(rescan=False)
+        info = models.get(name)
+        if info is None and self.multi.refresh_tenant(name):
+            # one TARGETED disk check before 404ing (never a full
+            # rescan — a balancer probing a dead name must not cost
+            # O(models) stats): the probe may be for a bundle published
+            # since the last discovery
+            info = self.multi.models(rescan=False).get(name)
+        if info is None:
+            return 404, {"error": f"unknown model {name!r}"}
+        out = {"ok": info["state"] == "admitted", "model": name, **info}
+        return (200 if out["ok"] else 503), out
+
     def metrics_text(self) -> str:
+        if self.multi is not None:
+            if self.worker_index is not None:
+                self.multi.fleet.set_gauge("worker_index",
+                                           self.worker_index)
+            # fleet gauges + every admitted tenant's stpu_serve_* series
+            # under its model label, + the unrouted surface (requests
+            # that never resolved a tenant: 404s, malformed bodies) —
+            # regrouped into one TYPE block per family inside
+            text = self.multi.metrics_text(unrouted=self.metrics)
+            if self._slo is not None:
+                text += self._slo.render_prometheus()
+            return text
         try:
             m = self.store.current()
             epoch, digest, verified = m.epoch, m.digest[:12], m.verified
@@ -426,14 +626,30 @@ def _make_handler(server: ScoringServer):
             elif self.path == "/metrics":
                 self._reply(200, server.metrics_text().encode("utf-8"),
                             content_type="text/plain; version=0.0.4")
+            elif self.path == "/models" and server.multi is not None:
+                self._reply_json(200, {"models": server.multi.models()})
             else:
+                m = _MODEL_PATH.fullmatch(self.path)
+                if (m is not None and m.group(1) == "healthz"
+                        and server.multi is not None):
+                    status, obj = server.health_model(m.group(2))
+                    self._reply_json(status, obj)
+                    return
                 self._reply_json(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
             self._rid = resolve_rid(self.headers.get("X-Request-Id"))
+            model_name: str | None = None
             if self.path != "/score":
-                self._reply_json(404, {"error": f"unknown path {self.path}"})
-                return
+                m = _MODEL_PATH.fullmatch(self.path)
+                # named model routes exist only on a multi-tenant server
+                # — a single-model server keeps its PR-3 path surface
+                if (m is None or m.group(1) != "score"
+                        or server.multi is None):
+                    self._reply_json(
+                        404, {"error": f"unknown path {self.path}"})
+                    return
+                model_name = m.group(2)
             try:
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -468,15 +684,36 @@ def _make_handler(server: ScoringServer):
                     })
                     return
                 body = self.rfile.read(length)
-                self._reply_json(200, server.handle_score(body, self._rid))
+                self._reply_json(200, server.handle_score(
+                    body, self._rid, model_name))
             except _BadRequest as e:
                 server.metrics.inc("errors_total")
                 self._reply_json(400, {"error": str(e)})
+            except UnknownModel as e:
+                server.metrics.inc("errors_total")
+                self._reply_json(
+                    404, {"error": f"unknown model {e.args[0]!r}; "
+                                   "GET /models lists the tenants"})
+            except AmbiguousModel as e:
+                server.metrics.inc("errors_total")
+                self._reply_json(400, {"error": str(e)})
+            except ModelColdStart as e:
+                # admittable but still verifying/warming: same contract
+                # as a shed — come back shortly, with a hint
+                server.metrics.inc("errors_total")
+                self._reply_json(
+                    503,
+                    {"error": str(e), "retry_after_s": e.retry_after_s},
+                    extra_headers={"Retry-After": str(e.retry_after_s)},
+                )
+            except AdmissionRefused as e:
+                server.metrics.inc("errors_total")
+                self._reply_json(503, {"error": str(e)})
             except ShedLoad as e:
                 # shed counter already bumped by the batcher; note_shed
                 # feeds the SLO shed-rate window and journals the
                 # CONDITION at most once per 5s (with this request's id)
-                server.note_shed(self._rid)
+                server.note_shed(self._rid, model_name)
                 self._reply_json(
                     429,
                     {"error": "overloaded, retry later",

@@ -1,0 +1,40 @@
+"""Multi-tenant serving: one fleet, many models, weighted-fair device
+sharing.
+
+The serve plane through PR 5 ran one model per process; production
+traffic means hundreds of ModelConfigs behind one endpoint (ROADMAP
+item 3; the reference's eval module is exactly a multi-model batch
+scorer — any exported bundle behind the ``Computable`` interface).  This
+package layers tenancy on the existing planes without re-implementing
+any of them:
+
+- :mod:`~shifu_tensorflow_tpu.serve.tenancy.scheduler` — one shared
+  device dispatch thread arbitrating per-tenant micro-batcher queues
+  with weighted deficit round-robin, so a hot tenant cannot starve the
+  rest;
+- :mod:`~shifu_tensorflow_tpu.serve.tenancy.store` — the MultiModelStore:
+  named tenants admitted under a memory budget with LRU eviction, each
+  admission running the full PR-3 verify-before-admit chain and the
+  PR-5 warm ladder BEFORE the model becomes routable, each eviction
+  releasing through the compute-lock discipline.
+
+``serve/server.py`` routes ``/score/<model>`` onto this package when
+``shifu.tpu.serve-models-dir`` is set; the single-model path is
+untouched.
+"""
+
+from shifu_tensorflow_tpu.serve.tenancy.scheduler import DeviceScheduler
+from shifu_tensorflow_tpu.serve.tenancy.store import (
+    AdmissionRefused,
+    ModelColdStart,
+    MultiModelStore,
+    UnknownModel,
+)
+
+__all__ = [
+    "DeviceScheduler",
+    "MultiModelStore",
+    "UnknownModel",
+    "ModelColdStart",
+    "AdmissionRefused",
+]

@@ -1,0 +1,781 @@
+"""MultiModelStore: named tenants admitted under a memory budget with
+LRU eviction, verify-before-admit, and warm-before-routable.
+
+One serving process, many exported bundles: every immediate
+subdirectory of ``shifu.tpu.serve-models-dir`` holding an export bundle
+is a *tenant*, named by the subdirectory and routed at
+``/score/<model>``.  Each tenant composes the SAME machinery the
+single-model server uses — a :class:`~shifu_tensorflow_tpu.serve.
+model_store.ModelStore` (manifest verification, hot reload, warm
+ladder) and a :class:`~shifu_tensorflow_tpu.serve.batcher.MicroBatcher`
+(coalescing, shed-before-queue) — so an admitted tenant scores
+bit-identically to a single-model server on the same bundle.  What this
+layer adds is the tenancy policy:
+
+- **Admission** runs the full PR-3 verify chain and the PR-5 warm
+  ladder *before* the model becomes routable (both happen inside the
+  ModelStore constructor; a corrupt or unwarmable bundle is refused and
+  every other tenant keeps serving).  Admission is single-flight per
+  tenant: concurrent cold-start requests share one admission, waiting
+  at most ``shifu.tpu.serve-model-admit-wait`` seconds (the cold-start
+  guard) before shedding 503 + Retry-After — the admission itself
+  always runs to completion in the background, so a timed-out caller's
+  retry lands on a warm model.
+- **Budget + LRU eviction**: admitted bundle bytes (manifest-covered
+  file sizes — the proxy for resident weights + compiled ladder) are
+  capped at ``shifu.tpu.serve-model-budget-mb``.  Admitting past the
+  cap evicts least-recently-*used* tenants first; eviction drains the
+  tenant's batcher (in-flight dispatches finish) and releases the model
+  through EvalModel's compute lock — the PR-3 discipline, so no
+  dispatch is ever torn down mid-score.  An evicted tenant stays known
+  and re-admits on demand.
+- **Weighted fair dispatch**: every tenant batcher feeds the one shared
+  :class:`~shifu_tensorflow_tpu.serve.tenancy.scheduler.DeviceScheduler`
+  under its ``shifu.tpu.serve-tenant-weight-<model>`` weight.
+- **Per-model observability**: each tenant carries its own ServeMetrics
+  registry (rendered with a ``model="<name>"`` label), its ModelStore
+  journals ``reload``/``reload_refused`` with the model dimension, the
+  store journals ``model_admit``/``model_evict``/``model_admit_failed``
+  lifecycle events, and every admission registers the tenant's SLO
+  signals on the active watchdog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from shifu_tensorflow_tpu.export.bucketing import ladder
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_ARCH,
+    NATIVE_MANIFEST,
+    NATIVE_WEIGHTS,
+)
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import slo as obs_slo
+from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+from shifu_tensorflow_tpu.serve.batcher import MicroBatcher
+from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+from shifu_tensorflow_tpu.serve.model_store import ModelStore
+from shifu_tensorflow_tpu.serve.tenancy.scheduler import DeviceScheduler
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("serve.tenancy")
+
+#: tenant names are export subdirectory names routed in URL paths —
+#: same charset as request ids, no separators, no dotfiles/traversal
+_NAME_OK = re.compile(r"^(?!\.)[0-9A-Za-z._-]{1,64}$")
+
+#: a refused tenant re-attempts admission on demand, but not more often
+#: than this — re-verifying a corrupt bundle reads every covered file,
+#: and a request flood must not turn that into a disk flood
+_REFUSAL_HOLDDOWN_S = 5.0
+
+#: fleet-level counters, pre-registered so the scrape surface is stable
+_FLEET_COUNTERS = (
+    "admissions_total",         # tenants admitted (initial + re-admits)
+    "evictions_total",          # tenants evicted (budget pressure)
+    "admit_failures_total",     # admissions refused (corrupt/budget)
+    "cold_start_timeouts_total",  # requests that outwaited the guard
+    "unknown_model_total",      # /score/<name> for no known tenant
+)
+
+
+def _merge_exposition(parts: list[str]) -> str:
+    """Regroup several Prometheus text renders into one valid
+    exposition: one ``# TYPE`` line per metric family, all its samples
+    contiguous beneath it, family order = first appearance.  The
+    renderer always emits a family's TYPE line before its samples
+    (histogram ``_bucket``/``_count``/``_sum`` lines belong to the
+    family whose TYPE preceded them), so attribution is positional."""
+    type_lines: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+    order: list[str] = []
+    for text in parts:
+        family = None
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                family = line.split()[2]
+                if family not in type_lines:
+                    type_lines[family] = line
+                    samples[family] = []
+                    order.append(family)
+            elif family is not None:
+                samples[family].append(line)
+    out: list[str] = []
+    for family in order:
+        out.append(type_lines[family])
+        out.extend(samples[family])
+    return "\n".join(out) + "\n" if out else ""
+
+
+class UnknownModel(LookupError):
+    """No tenant of that name exists under the models dir → 404."""
+
+
+class AmbiguousModel(RuntimeError):
+    """Legacy ``/score`` (no model segment) against a store with more
+    than one tenant — the client must name one → 400."""
+
+
+class ModelColdStart(RuntimeError):
+    """The model is admittable but its admission (verify + warm) is
+    still running and the caller outwaited the cold-start guard → 503 +
+    Retry-After."""
+
+    def __init__(self, model: str, retry_after_s: int = 2):
+        super().__init__(
+            f"model {model!r} is warming up; retry in {retry_after_s}s"
+        )
+        self.model = model
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionRefused(RuntimeError):
+    """The bundle cannot be admitted: corrupt/unwarmable artifact, or it
+    can never fit the memory budget → 503."""
+
+
+class _Tenant:
+    """One named model's tenancy record.  ``state`` transitions under
+    the store lock: cold → admitting → admitted → cold (evicted) or
+    refused (bad artifact), and back through admitting on demand."""
+
+    __slots__ = ("name", "dir", "state", "store", "batcher", "metrics",
+                 "cost_bytes", "last_used", "admitted_at", "admit_event",
+                 "error", "refused_at")
+
+    def __init__(self, name: str, bundle_dir: str):
+        self.name = name
+        self.dir = bundle_dir
+        self.state = "cold"
+        self.store: ModelStore | None = None
+        self.batcher: MicroBatcher | None = None
+        self.metrics: ServeMetrics | None = None
+        self.cost_bytes = 0
+        self.last_used = 0.0
+        self.admitted_at = 0.0
+        self.admit_event: threading.Event | None = None
+        self.error: str | None = None
+        self.refused_at = 0.0
+
+
+class MultiModelStore:
+    def __init__(self, config, *, warm: bool = True):
+        self.config = config
+        self.root = config.models_dir
+        if not os.path.isdir(self.root):
+            raise ValueError(f"models dir {self.root!r} does not exist")
+        self.budget_bytes = int(config.model_budget_mb * (1 << 20))
+        self.warm_buckets = (
+            ladder(config.max_queue_rows) if warm else ()
+        )
+        self.fleet = MetricsRegistry()
+        for name in _FLEET_COUNTERS:
+            self.fleet.counter(name)
+        self._lock = threading.Lock()
+        # serializes admission + eviction sequences: two concurrent
+        # admissions racing the budget would otherwise both evict
+        self._admit_lock = threading.Lock()
+        self._tenants: dict[str, _Tenant] = {}
+        self._closed = False
+        # fleet-wide max feature width, maintained at admission/
+        # discovery and refreshed from the live stores at most once per
+        # _NF_TTL_S (a hot reload can WIDEN a tenant's model without
+        # re-admission) — the per-request body bound reads one integer,
+        # not O(tenants) lock work per POST.  Monotone: an eviction or
+        # narrowing reload keeps the high-water mark, which only makes
+        # the reject-before-read bound more permissive, never wrong.
+        self._max_nf = 0
+        self._nf_refreshed = 0.0
+        names = self._refresh_discovery()
+        if not names:
+            raise ValueError(
+                f"no exported bundles under {self.root!r} — each tenant "
+                "is an immediate subdirectory holding an export bundle"
+            )
+        # the device thread spawns only AFTER discovery validated: a
+        # ctor that raises above must not leak a parked daemon thread
+        # per failed construction attempt (supervisor retry loops)
+        self.scheduler = DeviceScheduler()
+        # eager admission in name order until the budget stops fitting:
+        # tenants that fit are warm before the first request; the rest
+        # stay cold and admit on demand.  A corrupt bundle refuses ONLY
+        # its tenant — a fleet of hundreds must not fail-fast on one.
+        for name in names:
+            with self._lock:
+                t = self._tenants[name]
+            cost = self._bundle_cost(t.dir)
+            with self._lock:
+                if (self.budget_bytes
+                        and self._admitted_bytes_locked() + cost
+                        > self.budget_bytes):
+                    continue
+            try:
+                self._admit(name, cost=cost)
+            except Exception as e:
+                log.error("startup admission of %s refused: %s", name, e)
+
+    # ---- discovery ----
+    def _is_bundle(self, path: str) -> bool:
+        return (os.path.isfile(os.path.join(path, NATIVE_MANIFEST))
+                or os.path.isfile(os.path.join(path, NATIVE_WEIGHTS)))
+
+    def _bundle_num_features(self, bundle_dir: str) -> int:
+        """Feature width read off the bundle's arch file WITHOUT loading
+        the model — keeps the fleet-wide body bound honest for tenants
+        that are discovered but not (yet) admitted."""
+        try:
+            with open(os.path.join(bundle_dir, NATIVE_ARCH)) as f:
+                return int(json.load(f).get("num_features", 0))
+        except (OSError, ValueError, TypeError):
+            return 0
+
+    def _refresh_discovery(self) -> list[str]:
+        """Scan the models dir for tenant subdirectories: new ones gain
+        cold records (a bundle dropped in after startup becomes
+        admittable without a restart), and unadmitted records whose
+        directory no longer holds a bundle are PRUNED — a deleted
+        tenant must go back to 404, not haunt /models and burn a disk
+        admission attempt per holddown window.  Admitted tenants keep
+        serving from memory regardless of what happened on disk (their
+        own reload poller reports the missing artifact).  Returns the
+        sorted known names.
+
+        All filesystem work runs OUTSIDE the store lock (a hung
+        network-mounted models dir must never stall the scoring fast
+        path, which takes the same lock); the map merge under the lock
+        is pure memory."""
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError as e:
+            log.error("cannot list models dir %s: %s", self.root, e)
+            entries = []
+        live: dict[str, str] = {}  # name -> path, disk-verified
+        for name in entries:
+            path = os.path.join(self.root, name)
+            if (_NAME_OK.match(name) and os.path.isdir(path)
+                    and self._is_bundle(path)):
+                live[name] = path
+        with self._lock:
+            known = set(self._tenants)
+        new_nf = 0
+        for name in live:
+            if name not in known:
+                new_nf = max(new_nf,
+                             self._bundle_num_features(live[name]))
+        with self._lock:
+            for name, path in live.items():
+                if name not in self._tenants:
+                    self._tenants[name] = _Tenant(name, path)
+            for name in list(self._tenants):
+                t = self._tenants[name]
+                if (name not in live
+                        and t.state in ("cold", "refused")
+                        and t.admit_event is None):
+                    del self._tenants[name]
+            self._max_nf = max(self._max_nf, new_nf)
+            return sorted(self._tenants)
+
+    def _bundle_cost(self, bundle_dir: str) -> int:
+        """Bundle bytes as the admission cost: every file under the
+        bundle directory, RECURSIVELY — a SavedModel export keeps its
+        weights in a ``variables/`` subdirectory, and skipping subdirs
+        would under-count exactly the bytes that become resident model
+        memory.  A stable proxy for the admission budget."""
+        total = 0
+        try:
+            for root, _dirs, files in os.walk(bundle_dir):
+                for f in files:
+                    try:
+                        total += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
+
+    # ---- resolution ----
+    def _sole_locked(self) -> _Tenant | None:
+        """The unambiguous tenant legacy ``/score`` resolves to:
+        exactly one known, else exactly one admitted.  Caller holds the
+        lock.  ONE home for this rule — routing and shed attribution
+        must never disagree on which model an unnamed request meant."""
+        if len(self._tenants) == 1:
+            return next(iter(self._tenants.values()))
+        admitted = [t for t in self._tenants.values()
+                    if t.state == "admitted"]
+        return admitted[0] if len(admitted) == 1 else None
+
+    def _resolve(self, name: str | None) -> _Tenant:
+        """Name → tenant record, creating/pruning records from targeted
+        disk checks as needed.  Disk I/O happens OUTSIDE the store lock
+        — only map reads/writes run under it, so a slow models mount
+        can't stall requests for admitted tenants."""
+        with self._lock:
+            if name is None:
+                t = self._sole_locked()
+                if t is not None:
+                    return t
+                raise AmbiguousModel(
+                    f"{len(self._tenants)} models are served here; "
+                    "POST /score/<model>"
+                )
+            t = self._tenants.get(name)
+            check_gone = (t is not None and t.admit_event is None
+                          and t.state in ("cold", "refused"))
+        if t is not None:
+            if check_gone and not self._is_bundle(t.dir):
+                # the bundle was deleted out from under an unadmitted
+                # record: back to 404, not a doomed 503 admission loop
+                with self._lock:
+                    cur = self._tenants.get(name)
+                    if (cur is t and t.admit_event is None
+                            and t.state in ("cold", "refused")):
+                        del self._tenants[name]
+                        self.fleet.inc("unknown_model_total")
+                        raise UnknownModel(name)
+                    t = cur
+                if t is None:
+                    raise UnknownModel(name)
+            return t
+        # unknown: one targeted disk check so a bundle published after
+        # the last scan is admittable without waiting for a rescan
+        path = os.path.join(self.root, name)
+        if not (_NAME_OK.match(name) and os.path.isdir(path)
+                and self._is_bundle(path)):
+            self.fleet.inc("unknown_model_total")
+            raise UnknownModel(name)
+        nf = self._bundle_num_features(path)
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                t = _Tenant(name, path)
+                self._tenants[name] = t
+            # the body bound must see this tenant's width BEFORE its
+            # first (possibly large) request admits it
+            self._max_nf = max(self._max_nf, nf)
+            return t
+
+    def acquire(self, name: str | None, wait_s: float | None = None):
+        """The routable tenant for ``name`` (or the unambiguous tenant
+        for legacy ``/score``), admitted on demand and LRU-touched.
+        Raises :class:`UnknownModel`, :class:`AmbiguousModel`,
+        :class:`ModelColdStart` (admission still running past the
+        guard), or :class:`AdmissionRefused`."""
+        if wait_s is None:
+            wait_s = self.config.model_admit_wait_s
+        deadline = time.monotonic() + wait_s
+        while True:
+            t = self._resolve(name)
+            with self._lock:
+                if self._closed:
+                    raise AdmissionRefused("store is draining")
+                if t.state == "admitted":
+                    t.last_used = time.monotonic()
+                    return t
+                if (t.state == "refused"
+                        and time.monotonic() - t.refused_at
+                        < _REFUSAL_HOLDDOWN_S):
+                    raise AdmissionRefused(t.error or "admission refused")
+                if t.admit_event is None:
+                    # single-flight: the first cold-start request spawns
+                    # the admission; everyone else shares its event
+                    t.admit_event = threading.Event()
+                    threading.Thread(
+                        target=self._admit_bg,
+                        args=(t.name, t.admit_event),
+                        name=f"serve-admit-{t.name}", daemon=True,
+                    ).start()
+                ev = t.admit_event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ev.wait(remaining):
+                self.fleet.inc("cold_start_timeouts_total")
+                raise ModelColdStart(t.name)
+            # read the state the admission WE waited on left behind: a
+            # refusal surfaces as the refusal, not as another spin of
+            # the admission loop
+            with self._lock:
+                if t.state == "admitted":
+                    t.last_used = time.monotonic()
+                    return t
+                if t.state == "refused":
+                    raise AdmissionRefused(
+                        t.error or "admission refused")
+            # else: evicted again already (budget thrash) — loop
+
+    # ---- admission ----
+    def _admit_bg(self, name: str, ev: threading.Event) -> None:
+        try:
+            self._admit(name)
+        except Exception:
+            pass  # recorded on the tenant by _admit
+        finally:
+            # set the event the WAITERS hold, not one re-looked-up from
+            # the map: a discovery prune can orphan the record between
+            # spawn and here, and a never-set event would hang every
+            # waiter for the full cold-start guard instead of letting
+            # them loop into a prompt 404
+            with self._lock:
+                t = self._tenants.get(name)
+                if t is not None and t.admit_event is ev:
+                    t.admit_event = None
+            ev.set()
+
+    def _make_score_fn(self, t: _Tenant, store: ModelStore):
+        def score(rows):
+            from shifu_tensorflow_tpu.export.eval_model import (
+                ModelReleasedError,
+            )
+
+            # the STORE is bound into the closure (not read off the
+            # tenant record): an eviction's drain keeps dispatching
+            # through this fn after the tenant is unrouted, and the
+            # dispatch must reach the store it was admitted with
+            for attempt in (0, 1):
+                loaded = store.current()
+                try:
+                    return loaded.model.compute_batch(rows)
+                except ModelReleasedError:
+                    if attempt:
+                        raise
+                    batcher = t.batcher
+                    obs_journal.emit(
+                        "model_released_retry", plane="serve",
+                        model=t.name,
+                        rids=(batcher.dispatching_rids()
+                              if batcher is not None else []),
+                        old_epoch=loaded.epoch,
+                    )
+            raise AssertionError("unreachable")
+
+        return score
+
+    def _admitted_bytes_locked(self) -> int:
+        return sum(t.cost_bytes for t in self._tenants.values()
+                   if t.state == "admitted")
+
+    def _admit(self, name: str, cost: int | None = None) -> _Tenant:
+        """Synchronous verify→warm→register admission, evicting LRU
+        tenants as the budget requires.  Runs under the admission lock;
+        requests for already-admitted tenants never touch it.
+        ``cost`` lets the startup fit-check pass its already-scanned
+        bundle size instead of re-statting the directory."""
+        with self._admit_lock:
+            with self._lock:
+                t = self._tenants.get(name)
+                if t is None:
+                    # pruned between spawn and here (bundle deleted);
+                    # the waiter's re-resolve turns this into a 404
+                    raise AdmissionRefused(
+                        f"tenant {name!r} disappeared before admission")
+                if t.state == "admitted":
+                    return t
+                t.state = "admitting"
+            t0 = time.monotonic()
+            try:
+                if cost is None:
+                    cost = self._bundle_cost(t.dir)
+                if self.budget_bytes and cost > self.budget_bytes:
+                    raise AdmissionRefused(
+                        f"bundle is {cost} bytes, over the whole "
+                        f"{self.budget_bytes}-byte budget"
+                    )
+                # LRU eviction until the newcomer fits
+                while self.budget_bytes:
+                    with self._lock:
+                        if (self._admitted_bytes_locked() + cost
+                                <= self.budget_bytes):
+                            break
+                        victims = [x for x in self._tenants.values()
+                                   if x.state == "admitted"]
+                        victim = (min(victims, key=lambda x: x.last_used)
+                                  if victims else None)
+                    if victim is None:
+                        raise AdmissionRefused(
+                            f"budget cannot fit {name} and nothing is "
+                            "evictable"
+                        )
+                    self._evict(victim, reason="budget")
+                # per-tenant metrics are created ONCE and survive
+                # evict→re-admit cycles: counters must stay monotonic
+                # for scrapers, and the drain summary must not forget a
+                # tenant's pre-eviction traffic
+                metrics = (t.metrics if t.metrics is not None
+                           else ServeMetrics())
+                # the full verify-before-admit chain AND the warm ladder
+                # run inside this constructor — the model is not
+                # routable until both passed
+                store = ModelStore(
+                    t.dir,
+                    backend=self.config.backend,
+                    poll_interval_s=self.config.reload_poll_ms / 1000.0,
+                    metrics=metrics,
+                    warm_buckets=self.warm_buckets,
+                    model_name=name,
+                )
+                store.start()  # per-tenant hot-reload poller
+                try:
+                    batcher = MicroBatcher(
+                        self._make_score_fn(t, store),
+                        max_batch=self.config.max_batch,
+                        max_delay_s=self.config.max_delay_ms / 1000.0,
+                        max_queue_rows=self.config.max_queue_rows,
+                        retry_after_s=self.config.retry_after_s,
+                        metrics=metrics,
+                        scheduler=self.scheduler,
+                        model=name,
+                        weight=self.config.weight_for(name),
+                    )
+                except BaseException:
+                    # a failure PAST the store construction (e.g. the
+                    # scheduler closed under a racing shutdown) must not
+                    # leak the fully loaded model + its poller thread
+                    store.close()
+                    raise
+            except Exception as e:
+                with self._lock:
+                    t.state = "refused"
+                    t.error = f"{type(e).__name__}: {e}"
+                    t.refused_at = time.monotonic()
+                self.fleet.inc("admit_failures_total")
+                obs_journal.emit("model_admit_failed", plane="serve",
+                                 model=name, why=str(e))
+                log.error("admission of %s refused: %s", name, e)
+                raise
+            now = time.monotonic()
+            try:
+                nf = int(store.current().model.num_features)
+            except Exception:
+                nf = 0
+            with self._lock:
+                t.store, t.batcher, t.metrics = store, batcher, metrics
+                t.cost_bytes = cost
+                t.state = "admitted"
+                t.error = None
+                t.admitted_at = t.last_used = now
+                self._max_nf = max(self._max_nf, nf)
+            self.fleet.inc("admissions_total")
+            wd = obs_slo.active()
+            if wd is not None:
+                wd.track_serve_tenant(name)
+            obs_journal.emit(
+                "model_admit", plane="serve", model=name,
+                cost_bytes=cost, admit_ms=round((now - t0) * 1000.0, 1),
+                digest=store.current().digest[:12],
+                verified=store.current().verified,
+            )
+            log.info("admitted model %s (%d bytes, %.0f ms)",
+                     name, cost, (now - t0) * 1000.0)
+            return t
+
+    # ---- eviction ----
+    def _evict(self, t: _Tenant, reason: str) -> None:
+        """Unroute, drain, release.  The tenant record survives — a
+        later request re-admits it on demand.
+
+        Ordering matters: the state flips to ``cold`` FIRST (acquire
+        stops routing here), but ``t.store``/``t.batcher`` stay set
+        until the drain completes — the drain dispatches every queued
+        batch through the tenant's score_fn, and a request that raced
+        the eviction must finish (or get a typed BatcherClosed it can
+        retry), never an AttributeError on a nulled reference."""
+        with self._lock:
+            if t.state != "admitted":
+                return
+            t.state = "cold"  # unroutable from here on
+            store, batcher = t.store, t.batcher
+            idle_s = time.monotonic() - t.last_used
+            freed = t.cost_bytes
+        # drain OUTSIDE the locks the request path takes: in-flight
+        # dispatches for this tenant finish (the pack thread drains its
+        # scheduler queue and unregisters), then the model releases
+        # through EvalModel's compute lock — never under a running score
+        batcher.close(drain=True)
+        store.close()
+        with self._lock:
+            t.store = t.batcher = None
+            t.cost_bytes = 0
+        wd = obs_slo.active()
+        if wd is not None:
+            # the tenant's SLO gauges leave the scrape with it — a
+            # frozen last-known p99 for a model that isn't serving
+            # would mislead the autoscaler these gauges exist for
+            wd.untrack_serve_tenant(t.name)
+        self.fleet.inc("evictions_total")
+        obs_journal.emit("model_evict", plane="serve", model=t.name,
+                         reason=reason, freed_bytes=freed,
+                         idle_s=round(idle_s, 3))
+        log.info("evicted model %s (%s, freed %d bytes, idle %.1fs)",
+                 t.name, reason, freed, idle_s)
+
+    # ---- reading ----
+    def models(self, rescan: bool = True) -> dict:
+        """Per-tenant detail for ``/models`` (``rescan=True``: pick up
+        bundles dropped in after startup) and ``/healthz``
+        (``rescan=False``: a load balancer probing every second must
+        not pay O(entries) disk syscalls per probe — a new tenant still
+        appears at its first ``/models`` hit or scoring request)."""
+        if rescan:
+            self._refresh_discovery()
+        depths = self.scheduler.queue_depths()
+        out: dict[str, dict] = {}
+        with self._lock:
+            tenants = sorted(self._tenants.items())
+        for name, t in tenants:
+            info: dict = {
+                "state": t.state,
+                "weight": self.config.weight_for(name),
+            }
+            if t.state == "admitted" and t.store is not None:
+                try:
+                    m = t.store.current()
+                except Exception:  # racing an eviction
+                    info["state"] = "cold"
+                    out[name] = info
+                    continue
+                info.update({
+                    "model_epoch": m.epoch,
+                    "model_digest": m.digest[:12],
+                    "model_verified": m.verified,
+                    "cost_bytes": t.cost_bytes,
+                    "queue_rows": (t.batcher.queued_rows()
+                                   if t.batcher is not None else 0),
+                    "queued_batches": depths.get(name, 0),
+                    "idle_s": round(
+                        max(0.0, time.monotonic() - t.last_used), 1),
+                })
+            elif t.state == "refused":
+                info["error"] = t.error
+            out[name] = info
+        return out
+
+    def peek(self, name: str) -> _Tenant | None:
+        """The tenant record without admission or LRU touch (shed
+        bookkeeping), or None when unknown."""
+        with self._lock:
+            return self._tenants.get(name)
+
+    def refresh_tenant(self, name: str) -> bool:
+        """Targeted single-name discovery — one disk check, no full
+        models-dir rescan (the /healthz/<model> miss path; a balancer
+        probing a dead name must not cost O(models) stats per probe).
+        True when the tenant is (now) known."""
+        try:
+            self._resolve(name)
+            return True
+        except (UnknownModel, AmbiguousModel):
+            return False
+
+    def sole(self) -> _Tenant | None:
+        """The unambiguous legacy-``/score`` tenant or None — shed
+        bookkeeping for unnamed requests reads this so the journal can
+        still say WHICH model shed (same rule as routing, one home)."""
+        with self._lock:
+            return self._sole_locked()
+
+    def admitted(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, t in self._tenants.items()
+                          if t.state == "admitted")
+
+    def aggregate_counters(self) -> dict[str, int]:
+        """Every tenant's counters summed — the CLI's final stopped line
+        and the supervisor's fleet aggregate read this (tenant metrics
+        survive eviction, so a drained fleet still reports its totals)."""
+        totals: dict[str, int] = {}
+        with self._lock:
+            tenants = list(self._tenants.values())
+        for t in tenants:
+            if t.metrics is None:
+                continue
+            for k, v in t.metrics.counters().items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
+    #: how stale the feature-width high-water mark may run before the
+    #: next request re-reads the live stores (a hot reload that widened
+    #: a model becomes visible to the body bound within this window)
+    _NF_TTL_S = 5.0
+
+    def max_num_features(self) -> int:
+        """High-water mark of known models' feature widths — the
+        server's reject-before-read body bound.  One integer read on
+        the request path; at most once per ``_NF_TTL_S`` it re-reads
+        the admitted stores so a reload-widened model isn't 413'd below
+        what its own single-model server would accept.  0 before any
+        discovery (the caller floors it)."""
+        now = time.monotonic()
+        if now - self._nf_refreshed < self._NF_TTL_S:
+            return self._max_nf
+        self._nf_refreshed = now
+        with self._lock:
+            stores = [t.store for t in self._tenants.values()
+                      if t.state == "admitted" and t.store is not None]
+        nf = self._max_nf
+        for store in stores:
+            try:
+                nf = max(nf, store.current().model.num_features)
+            except Exception:
+                pass
+        self._max_nf = nf
+        return nf
+
+    def metrics_text(self, unrouted=None) -> str:
+        """Fleet gauges + every admitted tenant's registry rendered with
+        its ``model`` label — the per-model dimension on every
+        ``stpu_serve_*`` series.  ``unrouted`` is the server's
+        pre-resolution ServeMetrics, rendered under ``model="_unrouted"``
+        and merged here so the whole serve block regroups into ONE
+        ``# TYPE`` line per metric family with contiguous samples — a
+        naive concat repeats the TYPE line per tenant, which strict
+        exposition-format parsers reject outright."""
+        with self._lock:
+            known = len(self._tenants)
+            admitted = [(n, t) for n, t in sorted(self._tenants.items())
+                        if t.state == "admitted"]
+            admitted_bytes = self._admitted_bytes_locked()
+        self.fleet.set_gauge("models_known", known)
+        self.fleet.set_gauge("models_admitted", len(admitted))
+        self.fleet.set_gauge("budget_bytes", self.budget_bytes)
+        self.fleet.set_gauge("admitted_bytes", admitted_bytes)
+        parts = [self.fleet.render_prometheus("stpu_serve_fleet_")]
+        for name, t in admitted:
+            metrics, store, batcher = t.metrics, t.store, t.batcher
+            if metrics is None or store is None or batcher is None:
+                continue  # racing an eviction
+            try:
+                m = store.current()
+                epoch, digest, verified = m.epoch, m.digest[:12], m.verified
+            except Exception:
+                epoch, digest, verified = -1, "", False
+            parts.append(metrics.render_prometheus(
+                queue_rows=batcher.queued_rows(),
+                model_epoch=epoch,
+                model_digest=digest,
+                model_verified=verified,
+                extra_labels=f'model="{name}"',
+            ))
+        if unrouted is not None:
+            parts.append(unrouted.registry.render_prometheus(
+                "stpu_serve_", extra_labels='model="_unrouted"'))
+        return _merge_exposition(parts)
+
+    # ---- lifecycle ----
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        with self._admit_lock:
+            with self._lock:
+                admitted = [t for t in self._tenants.values()
+                            if t.state == "admitted"]
+            for t in admitted:
+                self._evict(t, reason="shutdown")
+        self.scheduler.close()

@@ -433,6 +433,80 @@ def test_serve_config_rejects_misconfiguration():
         ServeConfig(model_dir="/m", max_batch=0)
     with pytest.raises(ValueError, match="serve-workers"):
         ServeConfig(model_dir="/m", workers=0)
+    # tenancy: exactly one of model_dir/models_dir, positive weights
+    with pytest.raises(ValueError, match="exactly one"):
+        ServeConfig(model_dir="/m", models_dir="/ms")
+    with pytest.raises(ValueError, match="exactly one"):
+        ServeConfig()
+    with pytest.raises(ValueError, match="tenant-weight"):
+        ServeConfig(models_dir="/ms", tenant_weights=(("a", 0.0),))
+    with pytest.raises(ValueError, match="model-budget"):
+        ServeConfig(models_dir="/ms", model_budget_mb=-1)
+
+
+def test_serve_tenancy_keys_round_trip(tmp_path):
+    """The multi-tenant keys (shifu.tpu.serve-models-dir /
+    serve-model-budget-mb / serve-model-admit-wait /
+    serve-tenant-weight-<model>) resolve XML → CLI-wins → ServeConfig →
+    JSON bridge, with per-model weight merge (CLI overrides the conf
+    key for the SAME model only)."""
+    from shifu_tensorflow_tpu.serve import resolve_serve_config
+    from shifu_tensorflow_tpu.serve.__main__ import (
+        build_parser as serve_parser,
+    )
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+
+    xml = tmp_path / "tenancy.xml"
+    values = {
+        K.SERVE_MODELS_DIR: "/models",
+        K.SERVE_MODEL_BUDGET_MB: "512.5",
+        K.SERVE_MODEL_ADMIT_WAIT_S: "12",
+        K.SERVE_TENANT_WEIGHT_PREFIX + "alpha": "2.0",
+        K.SERVE_TENANT_WEIGHT_PREFIX + "beta": "0.5",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_serve_config(serve_parser().parse_args([]), conf)
+    assert cfg.models_dir == "/models" and cfg.model_dir is None
+    assert cfg.model_budget_mb == 512.5
+    assert cfg.model_admit_wait_s == 12.0
+    assert cfg.weight_for("alpha") == 2.0
+    assert cfg.weight_for("beta") == 0.5
+    assert cfg.weight_for("other") == K.DEFAULT_SERVE_TENANT_WEIGHT
+    # CLI wins: models-dir, budget, and the alpha weight (beta's conf
+    # weight survives the merge)
+    args = serve_parser().parse_args(
+        ["--models-dir", "/other", "--model-budget-mb", "64",
+         "--model-admit-wait", "5", "--tenant-weight", "alpha=4",
+         "--tenant-weight", "gamma=3"]
+    )
+    cfg = resolve_serve_config(args, conf)
+    assert cfg.models_dir == "/other"
+    assert cfg.model_budget_mb == 64.0
+    assert cfg.model_admit_wait_s == 5.0
+    assert (cfg.weight_for("alpha"), cfg.weight_for("beta"),
+            cfg.weight_for("gamma")) == (4.0, 0.5, 3.0)
+    # JSON bridge round-trips the weight pairs back to hashable form
+    assert ServeConfig.from_json(cfg.to_json()) == cfg
+    # defaults: no tenancy keys → single-model mode requirements hold
+    d = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), Conf()
+    )
+    assert d.models_dir is None and d.tenant_weights == ()
+    assert d.model_budget_mb == K.DEFAULT_SERVE_MODEL_BUDGET_MB
+    # CLI --model-dir beats a fleet-wide conf serve-models-dir key: an
+    # explicit single-model flag must not be vetoed into a hard error
+    # by shared XML (CLI wins over the conf layer)
+    s = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), conf
+    )
+    assert s.model_dir == "/m" and s.models_dir is None
 
 
 def test_health_keys_drive_worker_and_spec_fields():

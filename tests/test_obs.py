@@ -136,14 +136,15 @@ def test_serve_scrape_carries_bucket_lines():
     assert counts == sorted(counts) and counts[-1] == 2
 
 
-def test_latency_histogram_reexports_are_the_same_type():
-    """Satellite: serve/metrics and coordinator/metrics_board are
-    re-exports of the obs registry types — no third copy can appear."""
+def test_latency_histogram_lives_only_in_the_registry():
+    """serve/metrics re-exports the obs registry type (no third copy),
+    and the coordinator/metrics_board deprecation shim is GONE — the
+    PR-4 migration window closed, obs.registry is the one address."""
     from shifu_tensorflow_tpu.coordinator import metrics_board
     from shifu_tensorflow_tpu.serve import metrics as serve_metrics
 
     assert serve_metrics.LatencyHistogram is LatencyHistogram
-    assert metrics_board.LatencyHistogram is LatencyHistogram
+    assert not hasattr(metrics_board, "LatencyHistogram")
 
 
 def test_coordinator_metrics_render_through_registry():
