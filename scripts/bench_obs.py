@@ -135,6 +135,8 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
     XLA, no scheduler contention in the loop."""
     import uuid
 
+    from shifu_tensorflow_tpu.obs import compile as obs_compile
+    from shifu_tensorflow_tpu.obs import memory as obs_memory
     from shifu_tensorflow_tpu.obs.journal import Journal
     from shifu_tensorflow_tpu.obs.slo import SloWatchdog
     from shifu_tensorflow_tpu.obs.trace import Tracer, budget_fields
@@ -168,6 +170,17 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
     for _ in range(n):
         uuid.uuid4().hex[:16]
     rid_us = (time.perf_counter() - t0) / n * 1e6
+    # compile-site hop (PR 10): what an observe()-wrapped step fn adds
+    # per CALL once everything is compiled — push/pop of the
+    # attribution frame + two perf_counter reads; no compile fires, so
+    # no signature/analysis/journal work is on this path
+    rec = obs_compile.install(obs_compile.CompileRecorder(plane="train"))
+    observed = obs_compile.observe(lambda *a: None, "bench.step")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        observed(1, 2)
+    compile_site_us = (time.perf_counter() - t0) / n * 1e6
+    obs_compile.uninstall()
     t.take_summary()  # drain before the journal-emit measurement
     j = Journal(os.path.join(journal_dir, "micro.jsonl"), plane="train")
     m = 500
@@ -179,14 +192,36 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
                **budget_fields(t.take_summary()))
         wd.evaluate()
     per_epoch_us = (time.perf_counter() - t0) / m * 1e6
+    # device-memory snapshot (PR 10): one per EPOCH on the train plane
+    # (jax.live_arrays walk + bucket attribution + journal write) —
+    # amortizes over the epoch's steps exactly like the breakdown write
+    import jax.numpy as jnp
+
+    mem = obs_memory.MemoryAccountant(plane="train")
+    params = {f"l{k}": jnp.ones((64, 64)) for k in range(6)}
+    opt = {f"l{k}": jnp.ones((64, 64)) for k in range(6)}
+    m2 = 200
+    t0 = time.perf_counter()
+    for i in range(m2):
+        mem.snapshot(params=params, opt_state=opt, epoch=i)
+    mem_snapshot_us = (time.perf_counter() - t0) / m2 * 1e6
+    # compile recorder storm tick: the other per-epoch device hook
+    t0 = time.perf_counter()
+    for _ in range(m2):
+        rec.tick()
+    tick_us = (time.perf_counter() - t0) / m2 * 1e6
     j.close()
+    per_epoch_total = per_epoch_us + mem_snapshot_us + tick_us
     return {
         "span_us": per_step_us,
         "digest_us": digest_us,
         "rid_us": rid_us,
+        "compile_site_us": compile_site_us,
         "epoch_us": per_epoch_us,
-        "total_us": (per_step_us + digest_us + rid_us
-                     + per_epoch_us / max(1, steps_per_epoch)),
+        "mem_snapshot_us": mem_snapshot_us,
+        "storm_tick_us": tick_us,
+        "total_us": (per_step_us + digest_us + rid_us + compile_site_us
+                     + per_epoch_total / max(1, steps_per_epoch)),
     }
 
 
@@ -257,12 +292,20 @@ def main() -> int:
             # spans = wrap_iter + timed + span (the PR-4 tracer seams);
             # digest = one windowed P² add (PR-7 SLO hot-path signal);
             # rid = one serve-ingress uuid4 mint (PR-7 correlation id);
-            # epoch = journal step_breakdown write + watchdog evaluate,
-            # amortized over steps_per_epoch in the headline
+            # compile_site = the PR-10 observe() frame push/pop every
+            # step pays once programs are compiled (compile events
+            # themselves are rare by construction and off the steady
+            # state); per_epoch = journal step_breakdown write +
+            # watchdog evaluate; mem_snapshot + storm_tick = the PR-10
+            # per-epoch device hooks — all three amortized over
+            # steps_per_epoch in the headline
             "spans": round(micro["span_us"], 3),
             "digest_update": round(micro["digest_us"], 3),
             "rid_stamp": round(micro["rid_us"], 3),
+            "compile_site": round(micro["compile_site_us"], 3),
             "per_epoch": round(micro["epoch_us"], 2),
+            "mem_snapshot": round(micro["mem_snapshot_us"], 2),
+            "storm_tick": round(micro["storm_tick_us"], 3),
         },
         "micro_pct_of_median_step": round(micro_pct, 3),
         "pair_ratio_p10_p50_p90": [

@@ -311,6 +311,24 @@ DEFAULT_OBS_TRACE_SAMPLE = 1
 # ladder, obs/registry.DEFAULT_BOUNDS)
 OBS_HIST_BUCKETS = TPU_PREFIX + "obs-hist-buckets"
 DEFAULT_OBS_HIST_BUCKETS = ""
+# compile flight recorder (obs/compile.py) analysis depth: "full" adds
+# compiled.memory_analysis() bytes to each journaled compile event at
+# the price of a SECOND backend compile per new signature (negligible on
+# CPU, seconds per program on real accelerators); "cost" keeps the cheap
+# Lowered.cost_analysis() flops/bytes fields only; "off" journals timing
+# alone.  "auto" (default) resolves per plane: full on train/coordinator
+# (compiles are rare and off any request path), cost on serve — a
+# request-path compile there runs under the compute lock on the dispatch
+# thread, and doubling it would double the very latency cliff the storm
+# detector exists to diagnose.
+OBS_COMPILE_ANALYSIS = TPU_PREFIX + "obs-compile-analysis"
+DEFAULT_OBS_COMPILE_ANALYSIS = "auto"
+# recompile-storm threshold: this many NON-warm compiles inside one
+# slo-window opens a storm (journals recompile_storm naming the churning
+# callable+signature; clears at half the threshold).  Warm-ladder
+# compiles never count — pre-warming is the cure, not the disease.
+OBS_COMPILE_STORM = TPU_PREFIX + "obs-compile-storm"
+DEFAULT_OBS_COMPILE_STORM = 8
 
 # ---- SLO watchdog (obs/slo.py: windowed quantile digests + breach
 # events) ----
@@ -338,6 +356,15 @@ DEFAULT_SLO_HYSTERESIS = 2
 # EWMA-z anomaly threshold in sigmas (0 disables anomaly detection)
 SLO_ANOMALY_SIGMA = TPU_PREFIX + "slo-anomaly-sigma"
 DEFAULT_SLO_ANOMALY_SIGMA = 6.0
+# device/compiler leg (PR 10).  slo-compile-s: window MAX of journaled
+# backend-compile seconds (one slow compile is the breach); 0 = no
+# target.  slo-devmem-frac: device bytes-in-use / bytes-limit from the
+# backend's memory_stats (absent on backends that don't report a limit,
+# e.g. CPU — the signal is then absent, never zero); 0 = no target.
+SLO_COMPILE_S = TPU_PREFIX + "slo-compile-s"  # seconds; 0 = no target
+DEFAULT_SLO_COMPILE_S = 0.0
+SLO_DEVMEM_FRAC = TPU_PREFIX + "slo-devmem-frac"  # 0..1; 0 = no target
+DEFAULT_SLO_DEVMEM_FRAC = 0.0
 
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib

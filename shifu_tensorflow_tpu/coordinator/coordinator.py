@@ -1127,7 +1127,13 @@ class Coordinator:
             # the thread launcher the coordinator shares the process
             # with its workers, so the train watchdog renders here too
             text += watchdog.render_prometheus()
-        return text
+        # device/compiler leg + build identity (same thread-launcher
+        # argument: trainers hosted in this process feed exactly this
+        # recorder/accountant) — one shared renderer for every scrape
+        # surface (obs.device_obs_text)
+        from shifu_tensorflow_tpu.obs import device_obs_text
+
+        return text + device_obs_text()
 
     # ---- TCP plumbing ----
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:

@@ -18,6 +18,8 @@ stability needs a ladder, not exact sizes.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 #: smallest bucket — single-row requests pad to this, so the per-row
@@ -26,6 +28,22 @@ DEFAULT_MIN_BUCKET = 8
 #: largest power-of-two bucket; beyond it, sizes round up to a multiple
 #: of this (a fixed-batch-size offline eval loop then compiles once)
 DEFAULT_MAX_BUCKET = 4096
+
+#: drill/debug knob (env STPU_NO_BUCKET=1, or set_ladder_disabled()):
+#: bucket_size() becomes the identity, deliberately re-creating the
+#: classic unpadded-shape bug — every distinct batch length compiles its
+#: own program.  Exists so the recompile-storm detector (obs/compile.py)
+#: can be drilled end-to-end; never set it on a production fleet.
+_LADDER_DISABLED = os.environ.get("STPU_NO_BUCKET", "") not in ("", "0")
+
+
+def ladder_disabled() -> bool:
+    return _LADDER_DISABLED
+
+
+def set_ladder_disabled(disabled: bool) -> None:
+    global _LADDER_DISABLED
+    _LADDER_DISABLED = bool(disabled)
 
 
 def bucket_size(
@@ -38,6 +56,8 @@ def bucket_size(
     [min_bucket, max_bucket], then multiples of max_bucket above it."""
     if n < 1:
         raise ValueError(f"batch length must be >= 1, got {n}")
+    if _LADDER_DISABLED:
+        return n
     if n >= max_bucket:
         return ((n + max_bucket - 1) // max_bucket) * max_bucket
     b = min_bucket

@@ -125,7 +125,16 @@ class EvalModel:
             self._trace_count += 1
             return model.apply({"params": params}, x)
 
-        self._apply = jax.jit(fwd)
+        # compile flight recorder seam (obs/compile.py): each bucket's
+        # compile journals a `compile` event naming this bundle — with
+        # no recorder installed the wrap is one is-None check per call
+        from shifu_tensorflow_tpu.obs import compile as obs_compile
+
+        self._apply = obs_compile.observe(
+            jax.jit(fwd), "eval.native_score",
+            model=os.path.basename(self.model_dir.rstrip("/")) or None,
+            bucket_from=lambda params, x: x.shape[0],
+        )
 
     def _init_cpp(self) -> None:
         from shifu_tensorflow_tpu.export.native_scorer import NativeScorer
@@ -202,23 +211,43 @@ class EvalModel:
         :class:`ModelReleasedError` after release()."""
         if self.backend != "native":
             return 0
+        from shifu_tensorflow_tpu.obs import compile as obs_compile
+
         with self._compute_lock:
             if getattr(self, "_released", False):
                 raise ModelReleasedError(self.model_dir)
             before = self._trace_count
-            for b in sorted({int(b) for b in buckets}):
-                if b < 1:
-                    raise ValueError(f"bucket must be >= 1, got {b}")
-                # zeros are fine: compilation keys on SHAPE, and the
-                # scores of a warm-up batch are never observed.  The
-                # value FETCH matters: dispatch alone returns futures,
-                # and a warm() that only enqueued would let the model be
-                # swapped in while its warm-up programs still occupy the
-                # device — the first real request would queue behind
-                # them, re-creating (a smaller) latency cliff.
-                x = self._jnp.zeros((b, self.num_features), self._jnp.float32)
-                np.asarray(self._apply(self._params, x))
+            # warm_section: these compiles journal kind="warm" and never
+            # count toward a recompile storm — the ladder pre-warm is
+            # deliberate churn (and the storm's cure)
+            with obs_compile.warm_section():
+                for b in sorted({int(b) for b in buckets}):
+                    if b < 1:
+                        raise ValueError(f"bucket must be >= 1, got {b}")
+                    # zeros are fine: compilation keys on SHAPE, and the
+                    # scores of a warm-up batch are never observed.  The
+                    # value FETCH matters: dispatch alone returns
+                    # futures, and a warm() that only enqueued would let
+                    # the model be swapped in while its warm-up programs
+                    # still occupy the device — the first real request
+                    # would queue behind them, re-creating (a smaller)
+                    # latency cliff.
+                    x = self._jnp.zeros((b, self.num_features),
+                                        self._jnp.float32)
+                    np.asarray(self._apply(self._params, x))
             return self._trace_count - before
+
+    def device_bytes(self) -> int:
+        """Device-resident bytes this model holds (native backend: the
+        weight pytree placed by ``device_put``; other backends hold no
+        jax buffers and report 0).  Read by the serve tenancy plane's
+        memory accountant so the LRU budget's dashboard shows *device*
+        bytes per tenant, not just bundle bytes on disk."""
+        if self.backend != "native" or getattr(self, "_released", False):
+            return 0
+        from shifu_tensorflow_tpu.obs.memory import tree_device_bytes
+
+        return tree_device_bytes(getattr(self, "_params", None))
 
     @property
     def native_trace_count(self) -> int:

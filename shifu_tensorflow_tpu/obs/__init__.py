@@ -40,7 +40,30 @@ __all__ = [
     "LatencyHistogram",
     "MetricsRegistry",
     "install_obs",
+    "device_obs_text",
 ]
+
+
+def device_obs_text() -> str:
+    """The device/compiler leg's scrape suffix, shared by every
+    ``/metrics`` surface (serve single-model, serve multi-tenant, the
+    coordinator ``metrics`` op): ``stpu_compile_*`` (the executable
+    registry + storm state) and ``stpu_devmem_*`` (the memory
+    accountant's last snapshot) when the leg is installed, plus —
+    always — the ``stpu_build_info`` identity gauge saying WHAT build
+    answered the scrape."""
+    from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import memory as memory_mod
+    from shifu_tensorflow_tpu.obs.registry import build_info_text
+
+    text = ""
+    rec = compile_mod.active()
+    if rec is not None:
+        text += rec.render_prometheus()
+    mem = memory_mod.active()
+    if mem is not None:
+        text += mem.render_prometheus()
+    return text + build_info_text()
 
 
 def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
@@ -58,13 +81,19 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     CLI (workers receive it via the register reply / ``--obs-job``), so
     one merged journal can tell two jobs' events apart.
     """
+    from shifu_tensorflow_tpu.obs import compile as compile_mod
     from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs import memory as memory_mod
+    from shifu_tensorflow_tpu.obs import profile as profile_mod
     from shifu_tensorflow_tpu.obs import registry as registry_mod
     from shifu_tensorflow_tpu.obs import slo as slo_mod
     from shifu_tensorflow_tpu.obs import trace as trace_mod
 
     if not cfg.enabled:
         slo_mod.uninstall()
+        compile_mod.uninstall()
+        memory_mod.uninstall()
+        profile_mod.unconfigure()
         return None, None
     if cfg.hist_buckets:
         # scrape surfaces construct their registries AFTER the CLI
@@ -98,4 +127,26 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     # slo.active() the same way the trainer picks up the tracer
     slo_mod.install(slo_mod.from_config(cfg, plane=plane,
                                         worker=worker_index))
+    # device/compiler leg (PR 10): the compile flight recorder and the
+    # device-memory accountant install beside the watchdog — seams pick
+    # them up via compile.active()/memory.active() exactly like the
+    # tracer; the profiler trigger polls only when a journal exists (the
+    # journal base is the operator's rendezvous point)
+    analysis = getattr(cfg, "compile_analysis", "auto")
+    if analysis == "auto":
+        # full memory_analysis costs a second backend compile: fine on
+        # the train plane (compiles are rare and off any request path),
+        # not on serve, where a request-path compile runs under the
+        # compute lock on the dispatch thread
+        analysis = "cost" if plane == "serve" else "full"
+    compile_mod.install(compile_mod.CompileRecorder(
+        plane=plane, worker=worker_index,
+        analysis=analysis,
+        storm_window_s=cfg.slo_window_s,
+        storm_threshold=getattr(cfg, "compile_storm", 8),
+    ))
+    memory_mod.install(memory_mod.MemoryAccountant(
+        plane=plane, worker=worker_index))
+    profile_mod.configure(cfg.journal_path or None, plane=plane,
+                          worker=worker_index)
     return tracer, jrn

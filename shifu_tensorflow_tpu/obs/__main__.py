@@ -3,9 +3,14 @@ traces, and a live dashboard.
 
     python -m shifu_tensorflow_tpu.obs summary --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs tail    --journal /tmp/job.jsonl -n 40
+    python -m shifu_tensorflow_tpu.obs tail    --journal ... --follow
     python -m shifu_tensorflow_tpu.obs trace 4f2a91b0c3d4e5f6 --journal ...
     python -m shifu_tensorflow_tpu.obs trace 0:3 --journal ...
     python -m shifu_tensorflow_tpu.obs top     --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs compile --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs mem     --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs profile --journal ... --request \
+        --dir /tmp/dump --seconds 5
 
 Works on a finished or a RUNNING job: readers never lock writers, and a
 torn final line (writer killed mid-event) is skipped, not fatal.  The
@@ -18,9 +23,15 @@ torn final line (writer killed mid-event) is skipped, not fatal.  The
 serve ingress / supplied via ``X-Request-Id``) or one worker's epoch
 (``worker:epoch``) across every plane that touched it.  ``top`` is a
 live terminal dashboard (``--once`` for CI) that tails the journals and
-optionally scrapes ``/metrics`` URLs.  ``summary`` and ``tail`` take
-``--json`` for machine-readable output — scripts and the autoscaling
-supervisor must not screen-scrape the human renderer.
+optionally scrapes ``/metrics`` URLs.  ``compile`` renders the compile
+flight recorder's history (per-callable costs, signatures, recompile
+storms — which signature churned and when the storm started and
+cleared), ``mem`` the device-memory accountant's bucket split and
+high-water marks, and ``profile`` lists journaled ``jax.profiler``
+captures or (``--request``) asks the running fleet for one.  Every
+reading subcommand takes ``--json`` for machine-readable output —
+scripts and the autoscaling supervisor must not screen-scrape the
+human renderer.
 
 stdlib-only and jax-free: this must run on an operator's laptop against
 a journal scp'd out of a dead fleet.
@@ -60,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
                       help="events to show (default 20)")
     tail.add_argument("--json", action="store_true", dest="as_json",
                       help="raw events, one JSON object per line")
+    tail.add_argument("--follow", "-f", action="store_true",
+                      help="live-tail: keep polling the journals and "
+                           "print events as they land (rotation-aware; "
+                           "re-reads only growing files)")
+    tail.add_argument("--interval", type=float, default=1.0,
+                      help="--follow poll seconds (default 1)")
 
     summ = sub.add_parser(
         "summary",
@@ -84,6 +101,47 @@ def build_parser() -> argparse.ArgumentParser:
                        help="journal base path (shifu.tpu.obs-journal)")
     trace.add_argument("--json", action="store_true", dest="as_json",
                        help="matching events, one JSON object per line")
+
+    comp = sub.add_parser(
+        "compile",
+        help="compile flight-recorder history: per-callable compile "
+             "costs, signatures, and recompile-storm excursions",
+    )
+    comp.add_argument("--journal", required=True,
+                      help="journal base path (shifu.tpu.obs-journal)")
+    comp.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable compile document")
+
+    mem = sub.add_parser(
+        "mem",
+        help="device-memory accounting: per-worker bucket split, "
+             "high-water marks, per-model device bytes",
+    )
+    mem.add_argument("--journal", required=True,
+                     help="journal base path (shifu.tpu.obs-journal)")
+    mem.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable memory document")
+
+    prof = sub.add_parser(
+        "profile",
+        help="list journaled jax.profiler captures, or --request one "
+             "from the running fleet",
+    )
+    prof.add_argument("--journal", required=True,
+                      help="journal base path (shifu.tpu.obs-journal)")
+    prof.add_argument("--request", action="store_true",
+                      help="write a capture trigger beside the journal; "
+                           "the fleet's next obs tick starts the window")
+    prof.add_argument("--dir", dest="out_dir",
+                      help="dump directory for --request")
+    prof.add_argument("--seconds", type=float, default=5.0,
+                      help="capture window length for --request "
+                           "(default 5)")
+    prof.add_argument("--worker", type=int, default=None,
+                      help="pin --request to one worker index "
+                           "(default: first poller wins)")
+    prof.add_argument("--json", action="store_true", dest="as_json",
+                      help="capture events, one JSON object per line")
 
     top = sub.add_parser(
         "top",
@@ -126,6 +184,8 @@ def _short(v) -> str:
 
 
 def cmd_tail(args) -> int:
+    if getattr(args, "follow", False):
+        return _tail_follow(args)
     events = read_events(args.journal)
     if not events:
         print(f"no journal events under {args.journal!r} "
@@ -141,6 +201,54 @@ def cmd_tail(args) -> int:
     for ev in shown:
         print(_fmt_event(ev, t0))
     return 0
+
+
+def _event_key(ev: dict) -> tuple:
+    """Identity of one journal event for follow-mode dedup: (ts, writer
+    coordinates, seq) — unique per event by the Journal's contract (one
+    monotonic seq per writer).  Bounded memory: the journal itself is
+    rotation-bounded, so the set of live keys is too."""
+    return (ev.get("ts", 0.0), ev.get("plane"), ev.get("worker"),
+            ev.get("seq"), ev.get("event"))
+
+
+def _tail_follow(args) -> int:
+    """Live tail: poll the journal set, print what's new.  Reuses the
+    read_events parse cache, so each poll re-parses only files whose
+    (size, mtime, inode) changed — the growing active file, not the
+    whole rotation set (satellite of the PR-7 `obs top` cache)."""
+    cache: dict = {}
+    seen: set = set()
+    t0 = None
+    first = True
+    while True:
+        events = read_events(args.journal, cache=cache)
+        if events and t0 is None:
+            t0 = events[0].get("ts", 0.0)
+        new = [ev for ev in events if _event_key(ev) not in seen]
+        if first:
+            # start like plain tail: the last N events, then the stream
+            new = new[-args.count:]
+            seen.update(_event_key(ev) for ev in events)
+            first = False
+        else:
+            seen.update(_event_key(ev) for ev in new)
+            if events:
+                # prune keys that rotated out of the journal set — the
+                # seen-set tracks the live window, not the whole run
+                min_ts = events[0].get("ts", 0.0)
+                if len(seen) > 4 * len(events):
+                    seen = {k for k in seen if k[0] >= min_ts}
+        for ev in new:
+            if args.as_json:
+                print(json.dumps(ev, separators=(",", ":"), default=str),
+                      flush=True)
+            else:
+                print(_fmt_event(ev, t0 or 0.0), flush=True)
+        try:
+            time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
 
 
 # ---- step budget (data + renderer) ----
@@ -584,6 +692,246 @@ def cmd_trace(args) -> int:
     return 0
 
 
+# ---- compile flight recorder (data + renderer) ----
+
+def _compile_data(events: list[dict]) -> dict:
+    """Aggregate `compile` + `recompile_storm[_clear]` events into the
+    per-callable cost table and the storm excursion list — entirely from
+    journal files (a dead fleet's included)."""
+    per: dict = defaultdict(lambda: {
+        "compiles": 0, "compile_s": 0.0, "max_s": 0.0, "wall_s": 0.0,
+        "signatures": set(), "warm": 0, "workers": set(),
+        "flops_max": None, "code_bytes": 0,
+    })
+    storms: list[dict] = []
+    open_storms: dict = {}  # (plane, worker) -> storm record
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "compile":
+            a = per[ev.get("name", "?")]
+            a["compiles"] += 1
+            s = float(ev.get("compile_s", 0.0) or 0.0)
+            a["compile_s"] += s
+            a["max_s"] = max(a["max_s"], s)
+            a["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
+            a["signatures"].add(ev.get("signature", "?"))
+            if ev.get("kind") == "warm":
+                a["warm"] += 1
+            if ev.get("worker") is not None:
+                a["workers"].add(ev["worker"])
+            if ev.get("flops") is not None:
+                a["flops_max"] = max(a["flops_max"] or 0.0,
+                                     float(ev["flops"]))
+            if ev.get("code_bytes"):
+                a["code_bytes"] = max(a["code_bytes"],
+                                      int(ev["code_bytes"]))
+        elif kind == "recompile_storm":
+            rec = {
+                "started_ts": ev.get("ts"),
+                "cleared_ts": None,
+                "storm_s": None,
+                "culprit": ev.get("culprit"),
+                "signature": ev.get("signature"),
+                "compiles_in_window": ev.get("compiles_in_window"),
+                "plane": ev.get("plane"),
+                "worker": ev.get("worker"),
+            }
+            storms.append(rec)
+            open_storms[(ev.get("plane"), ev.get("worker"))] = rec
+        elif kind == "recompile_storm_clear":
+            rec = open_storms.pop((ev.get("plane"), ev.get("worker")),
+                                  None)
+            if rec is not None:
+                rec["cleared_ts"] = ev.get("ts")
+                rec["storm_s"] = ev.get("storm_s")
+    callables = {
+        name: {
+            "compiles": a["compiles"],
+            "warm": a["warm"],
+            "signatures": len(a["signatures"]),
+            "compile_s": round(a["compile_s"], 4),
+            "max_s": round(a["max_s"], 4),
+            "workers": sorted(a["workers"]),
+            **({"flops_max": a["flops_max"]}
+               if a["flops_max"] is not None else {}),
+            **({"code_bytes": a["code_bytes"]}
+               if a["code_bytes"] else {}),
+        }
+        for name, a in sorted(per.items())
+    }
+    return {"callables": callables, "storms": storms}
+
+
+def cmd_compile(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _compile_data(events)
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    t0 = events[0].get("ts", 0.0)
+    if not data["callables"]:
+        print("no compile events — was the run traced with obs enabled "
+              "on a jax build that reports compile durations?")
+        return 1
+    total_s = sum(a["compile_s"] for a in data["callables"].values())
+    total_n = sum(a["compiles"] for a in data["callables"].values())
+    print(f"compile flight recorder — {total_n} compilation(s), "
+          f"{total_s:.2f}s total compile time")
+    print("  callable                 compiles  warm  signatures  "
+          "compile_s  max_s")
+    for name, a in data["callables"].items():
+        print(f"  {name:<24} {a['compiles']:<9} {a['warm']:<5} "
+              f"{a['signatures']:<11} {a['compile_s']:<10.3f} "
+              f"{a['max_s']:.3f}")
+    if data["storms"]:
+        print()
+        print("recompile storms")
+        for s in data["storms"]:
+            start = (s["started_ts"] or t0) - t0
+            if s["cleared_ts"] is not None:
+                span = (f"+{start:.1f}s .. +{s['cleared_ts'] - t0:.1f}s "
+                        f"({s['storm_s']:.1f}s)")
+            else:
+                span = f"+{start:.1f}s .. STILL ACTIVE"
+            print(f"  {span}  worker {s['worker']}  "
+                  f"{s['compiles_in_window']} compiles/window")
+            print(f"    churning: {s['culprit']}  last signature "
+                  f"{s['signature']}")
+    else:
+        print("\n  no recompile storms")
+    return 0
+
+
+# ---- device memory (data + renderer) ----
+
+def _mem_data(events: list[dict]) -> dict:
+    """Latest + high-water device-memory state per (plane, worker) from
+    `device_mem` events, plus the per-model last-known device bytes."""
+    per: dict = {}
+    models: dict = {}
+    for ev in events:
+        if ev.get("event") == "model_evict":
+            # the eviction's post-release snapshot omits the tenant; a
+            # merge-only table would show its bytes forever — exactly
+            # inverting the leak diagnosis the snapshot exists for.  A
+            # re-admission's device_mem re-adds it below.
+            models.pop(ev.get("model"), None)
+            continue
+        if ev.get("event") != "device_mem":
+            continue
+        key = f"{ev.get('plane', '?')}/w{ev.get('worker')}" \
+            if ev.get("worker") is not None else ev.get("plane", "?")
+        a = per.setdefault(key, {"snapshots": 0, "hwm_bytes": 0,
+                                 "hwm_ts": None, "last": None})
+        a["snapshots"] += 1
+        total = int(ev.get("total_bytes", 0) or 0)
+        if total >= a["hwm_bytes"]:
+            a["hwm_bytes"] = total
+            a["hwm_ts"] = ev.get("ts")
+        a["last"] = {
+            k: ev.get(k) for k in (
+                "ts", "total_bytes", "params_bytes", "opt_bytes",
+                "infeed_bytes", "exec_bytes", "other_bytes", "arrays",
+                "bytes_in_use", "bytes_limit", "devmem_frac", "epoch")
+            if ev.get(k) is not None
+        }
+        for m, b in (ev.get("models") or {}).items():
+            models[m] = int(b)
+    return {"workers": per, "models": models}
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def cmd_mem(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _mem_data(events)
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    if not data["workers"]:
+        print("no device_mem events — the device-memory accountant "
+              "snapshots per train epoch and per serve admission; was "
+              "obs enabled?")
+        return 1
+    print("device memory accountant")
+    print("  writer          snaps  high-water  last-total  params    "
+          "opt       infeed    other")
+    for key, a in sorted(data["workers"].items()):
+        last = a["last"] or {}
+        print(
+            f"  {key:<15} {a['snapshots']:<6} "
+            f"{_fmt_bytes(a['hwm_bytes']):<11} "
+            f"{_fmt_bytes(last.get('total_bytes')):<11} "
+            f"{_fmt_bytes(last.get('params_bytes')):<9} "
+            f"{_fmt_bytes(last.get('opt_bytes')):<9} "
+            f"{_fmt_bytes(last.get('infeed_bytes')):<9} "
+            f"{_fmt_bytes(last.get('other_bytes'))}"
+        )
+        if last.get("bytes_limit"):
+            print(f"                  backend: "
+                  f"{_fmt_bytes(last.get('bytes_in_use'))} in use of "
+                  f"{_fmt_bytes(last['bytes_limit'])} limit "
+                  f"({100.0 * (last.get('devmem_frac') or 0):.1f}%)")
+    if data["models"]:
+        print("  model           device-bytes")
+        for m, b in sorted(data["models"].items()):
+            print(f"  {m:<15} {_fmt_bytes(b)}")
+    return 0
+
+
+# ---- profile captures ----
+
+def cmd_profile(args) -> int:
+    if args.request:
+        from shifu_tensorflow_tpu.obs import profile as obs_profile
+
+        if not args.out_dir:
+            print("--request needs --dir (where the profiler dump "
+                  "should land)", file=sys.stderr)
+            return 2
+        path = obs_profile.request(args.journal, args.out_dir,
+                                   seconds=args.seconds,
+                                   worker=args.worker)
+        print(f"capture requested: trigger {path} "
+              f"({args.seconds:.1f}s window -> {args.out_dir}); the "
+              "fleet's next obs tick starts it")
+        return 0
+    events = read_events(args.journal)
+    caps = [e for e in events if e.get("event") == "profile_capture"]
+    if args.as_json:
+        for ev in caps:
+            print(json.dumps(ev, separators=(",", ":"), default=str))
+        return 0 if caps else 1
+    if not caps:
+        print(f"no profile_capture events under {args.journal!r}; "
+              "request one with: obs profile --journal ... --request "
+              "--dir <dump-dir>", file=sys.stderr)
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    print(f"profiler captures ({len(caps)} event(s))")
+    for ev in caps:
+        print(" " + _fmt_event(ev, t0))
+    return 0
+
+
 # ---- top ----
 
 def _scrape(url: str, timeout: float = 2.0) -> dict[str, float]:
@@ -723,6 +1071,12 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_trace(args)
         if args.cmd == "top":
             return cmd_top(args)
+        if args.cmd == "compile":
+            return cmd_compile(args)
+        if args.cmd == "mem":
+            return cmd_mem(args)
+        if args.cmd == "profile":
+            return cmd_profile(args)
         return cmd_summary(args)
     except KeyboardInterrupt:
         return 0

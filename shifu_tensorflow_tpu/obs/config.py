@@ -36,6 +36,12 @@ class ObsConfig:
     slo_infeed_frac: float = K.DEFAULT_SLO_INFEED_FRAC
     slo_hysteresis: int = K.DEFAULT_SLO_HYSTERESIS
     slo_anomaly_sigma: float = K.DEFAULT_SLO_ANOMALY_SIGMA
+    # device/compiler leg (obs/compile.py + obs/memory.py) — flat fields
+    # for the same JSON-bridge reason as the slo block above
+    compile_analysis: str = K.DEFAULT_OBS_COMPILE_ANALYSIS
+    compile_storm: int = K.DEFAULT_OBS_COMPILE_STORM
+    slo_compile_s: float = K.DEFAULT_SLO_COMPILE_S
+    slo_devmem_frac: float = K.DEFAULT_SLO_DEVMEM_FRAC
 
     def __post_init__(self):
         if self.journal_max_bytes < 4096:
@@ -63,14 +69,25 @@ class ObsConfig:
                          (K.SLO_SERVE_SHED_RATE, self.slo_serve_shed_rate),
                          (K.SLO_STEP_TIME_MS, self.slo_step_time_ms),
                          (K.SLO_INFEED_FRAC, self.slo_infeed_frac),
-                         (K.SLO_ANOMALY_SIGMA, self.slo_anomaly_sigma)):
+                         (K.SLO_ANOMALY_SIGMA, self.slo_anomaly_sigma),
+                         (K.SLO_COMPILE_S, self.slo_compile_s),
+                         (K.SLO_DEVMEM_FRAC, self.slo_devmem_frac)):
             if val < 0:
                 raise ValueError(f"{key} must be >= 0 (0 = disabled), "
                                  f"got {val}")
         for key, val in ((K.SLO_SERVE_SHED_RATE, self.slo_serve_shed_rate),
-                         (K.SLO_INFEED_FRAC, self.slo_infeed_frac)):
+                         (K.SLO_INFEED_FRAC, self.slo_infeed_frac),
+                         (K.SLO_DEVMEM_FRAC, self.slo_devmem_frac)):
             if val > 1:
                 raise ValueError(f"{key} is a fraction in [0, 1], got {val}")
+        if self.compile_analysis not in ("auto", "full", "cost", "off"):
+            raise ValueError(
+                f"{K.OBS_COMPILE_ANALYSIS} must be auto|full|cost|off, "
+                f"got {self.compile_analysis!r}")
+        if self.compile_storm < 2:
+            raise ValueError(f"{K.OBS_COMPILE_STORM} must be >= 2, got "
+                             f"{self.compile_storm} (a 1-compile 'storm' "
+                             "would fire on every cold start)")
 
     def to_json(self) -> dict:
         d = asdict(self)
@@ -138,4 +155,13 @@ def resolve_obs_config(args, conf) -> ObsConfig:
                                     K.DEFAULT_SLO_HYSTERESIS),
         slo_anomaly_sigma=conf.get_float(K.SLO_ANOMALY_SIGMA,
                                          K.DEFAULT_SLO_ANOMALY_SIGMA),
+        compile_analysis=(conf.get(K.OBS_COMPILE_ANALYSIS,
+                                   K.DEFAULT_OBS_COMPILE_ANALYSIS)
+                          or K.DEFAULT_OBS_COMPILE_ANALYSIS).strip(),
+        compile_storm=conf.get_int(K.OBS_COMPILE_STORM,
+                                   K.DEFAULT_OBS_COMPILE_STORM),
+        slo_compile_s=conf.get_float(K.SLO_COMPILE_S,
+                                     K.DEFAULT_SLO_COMPILE_S),
+        slo_devmem_frac=conf.get_float(K.SLO_DEVMEM_FRAC,
+                                       K.DEFAULT_SLO_DEVMEM_FRAC),
     )

@@ -298,7 +298,14 @@ def read_events(base: str, cache: dict | None = None) -> list[dict]:
         if cache is not None:
             try:
                 st = os.stat(path)
-                sig = (st.st_size, st.st_mtime_ns)
+                # st_ino travels WITH the content across a rotation
+                # rename (path -> path.1 keeps the inode): on a
+                # coarse-mtime filesystem two successive rotations can
+                # leave path.1 with the same (size, mtime) as its
+                # previous occupant, and without the inode the cache
+                # would serve the older file's parsed events as the new
+                # one's
+                sig = (st.st_size, st.st_mtime_ns, st.st_ino)
             except OSError:
                 continue
             hit = cache.get(path)

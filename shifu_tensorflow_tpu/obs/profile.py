@@ -1,0 +1,167 @@
+"""On-demand ``jax.profiler`` capture windows, journaled.
+
+``utils/profiling.trace_if`` could always wrap a whole run in a
+profiler trace — but a *production* question ("why did p99 double five
+minutes ago?") needs a capture you can start against a RUNNING fleet,
+bounded in time, whose dump you can later find.  This module is that
+promotion:
+
+- :func:`request` (what ``obs profile --request`` calls) drops a small
+  JSON trigger file beside the fleet's journal base —
+  ``<journal>.profile-request`` — naming the dump directory and the
+  window length.  Writing a file is the one RPC every plane already
+  shares (they all own the journal directory), and it works from a
+  jax-free operator CLI.
+- :func:`poll` runs on the planes' existing slow ticks (the trainer's
+  per-epoch obs hook, the serve SLO evaluator thread).  The first
+  poller to see the trigger consumes it (one capture per request, by
+  design — ``worker`` in the trigger pins a specific worker index) and
+  runs ``jax.profiler.start_trace``/``stop_trace`` for the requested
+  window on a background thread, journaling ``profile_capture`` events
+  at start and completion with the dump path — the pointer ``obs
+  profile`` renders from a dead fleet's files.
+
+Off-by-default-cheap: an un-configured process never stats anything;
+a configured one pays one ``os.path.exists`` per slow tick.
+stdlib-only at import; jax loads inside the capture thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("obs")
+
+__all__ = ["configure", "unconfigure", "trigger_path", "request", "poll"]
+
+_lock = threading.Lock()
+_trigger: str | None = None     # trigger file this process polls
+_worker: int | None = None      # this process's worker index
+_plane: str = "train"
+_capturing = False
+
+
+def trigger_path(journal_base: str) -> str:
+    """Where a capture request for the fleet journaled at ``base``
+    lives (one well-known name: the CLI writes it, the planes poll)."""
+    return f"{os.fspath(journal_base)}.profile-request"
+
+
+def configure(journal_base: str | None, *, plane: str = "train",
+              worker: int | None = None) -> None:
+    """Arm polling for this process (install_obs calls this whenever a
+    journal is configured — the journal base is the rendezvous)."""
+    global _trigger, _worker, _plane
+    with _lock:
+        _trigger = trigger_path(journal_base) if journal_base else None
+        _worker = worker
+        _plane = plane
+
+
+def unconfigure() -> None:
+    configure(None)
+
+
+def request(journal_base: str, out_dir: str, *, seconds: float = 5.0,
+            worker: int | None = None) -> str:
+    """Write the trigger (the ``obs profile --request`` body).  Returns
+    the trigger path.  ``worker`` restricts which worker may consume it
+    (None = first poller wins)."""
+    path = trigger_path(journal_base)
+    body: dict[str, Any] = {"dir": os.fspath(out_dir),
+                            "seconds": float(seconds),
+                            "requested_ts": round(time.time(), 3)}
+    if worker is not None:
+        body["worker"] = int(worker)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(body, f)
+    os.replace(tmp, path)  # atomic: a poller never reads a torn trigger
+    return path
+
+
+def poll() -> bool:
+    """One slow-tick check; True when this call consumed a trigger and
+    started a capture.  Never raises — a broken trigger file is removed
+    and logged, not allowed to wedge the tick that polls it."""
+    global _capturing
+    trig = _trigger
+    if trig is None or not os.path.exists(trig):
+        return False
+    with _lock:
+        if _capturing:
+            return False
+        try:
+            with open(trig) as f:
+                body = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("removing unreadable profile trigger %s (%s)",
+                        trig, e)
+            _remove(trig)
+            return False
+        want = body.get("worker")
+        if want is not None and _worker is not None and int(want) != _worker:
+            return False  # addressed to a sibling; leave it for them
+        # consume by ATOMIC CLAIM, not unlink: sibling fleet processes
+        # poll the same path on independent ticks, and a read-then-unlink
+        # window would let two of them both start the capture.  rename is
+        # atomic on POSIX — exactly one poller wins; the losers see
+        # FileNotFoundError and walk away.
+        claim = f"{trig}.claim.{os.getpid()}"
+        try:
+            os.rename(trig, claim)
+        except OSError:
+            return False  # a sibling claimed it first
+        _remove(claim)
+        out_dir = body.get("dir") or os.path.dirname(trig) or "."
+        seconds = max(0.1, float(body.get("seconds", 5.0)))
+        _capturing = True
+    t = threading.Thread(target=_capture, args=(out_dir, seconds),
+                         name="obs-profile-capture", daemon=True)
+    t.start()
+    return True
+
+
+def _remove(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _capture(out_dir: str, seconds: float) -> None:
+    """The capture window itself (background thread: the profiler traces
+    the whole process, so the polling thread need not stall for it)."""
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    global _capturing
+    t0 = time.time()
+    try:
+        import jax
+
+        os.makedirs(out_dir, exist_ok=True)
+        obs_journal.emit("profile_capture", plane=_plane, worker=_worker,
+                         status="started", dir=out_dir, seconds=seconds)
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        obs_journal.emit("profile_capture", plane=_plane, worker=_worker,
+                         status="done", dir=out_dir,
+                         wall_s=round(time.time() - t0, 3))
+    except Exception as e:
+        log.warning("profiler capture to %s failed (%s: %s)",
+                    out_dir, type(e).__name__, e)
+        obs_journal.emit("profile_capture", plane=_plane, worker=_worker,
+                         status="failed", dir=out_dir,
+                         error=f"{type(e).__name__}: {e}")
+    finally:
+        with _lock:
+            _capturing = False

@@ -17,6 +17,7 @@ one lock per primitive, explicit snapshots, no background machinery —
 from __future__ import annotations
 
 import bisect
+import sys
 import threading
 
 #: default latency ladder: ~100µs .. 60s, roughly ×2 per bucket — wide
@@ -43,6 +44,62 @@ def set_default_bounds(bounds: tuple[float, ...] | None) -> None:
 
 def default_bounds() -> tuple[float, ...]:
     return _default_bounds
+
+
+_build_info_cache: dict[str, str] = {}
+
+
+def build_info_text(prefix: str = "stpu_") -> str:
+    """The ``stpu_build_info`` gauge: one constant-1 series whose labels
+    say WHAT is running — package version, jax/jaxlib versions, backend
+    platform — appended to every ``/metrics`` surface (serve workers,
+    the coordinator ``metrics`` op) so a scrape identifies the build
+    without shelling into the container.
+
+    Versions are gathered lazily and cached for the process lifetime.
+    jax is probed only if ALREADY IMPORTED, and the backend only if
+    already initialized — a scrape must never pay jax import or backend
+    startup (the coordinator's metrics op can run in a process that
+    never touches a device).  The cache deliberately re-resolves while
+    any field is still unknown, so the first scrape after jax comes up
+    fills it in."""
+    cached = _build_info_cache.get(prefix)
+    if cached is not None and "unknown" not in cached:
+        return cached
+    version = jax_v = jaxlib_v = backend = "unknown"
+    try:
+        import shifu_tensorflow_tpu as pkg
+
+        version = getattr(pkg, "__version__", None) or "unknown"
+    except Exception:
+        pass
+    if version == "unknown":
+        try:
+            from importlib import metadata
+
+            version = metadata.version("shifu-tensorflow-tpu")
+        except Exception:
+            pass
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        jax_v = getattr(jax_mod, "__version__", "unknown")
+        jaxlib_mod = sys.modules.get("jaxlib")
+        if jaxlib_mod is not None:
+            jaxlib_v = getattr(jaxlib_mod, "__version__", "unknown")
+        try:
+            xb = sys.modules.get("jax._src.xla_bridge")
+            if xb is not None and getattr(xb, "_default_backend",
+                                          None) is not None:
+                backend = jax_mod.default_backend()
+        except Exception:
+            pass
+    text = (
+        f'# TYPE {prefix}build_info gauge\n'
+        f'{prefix}build_info{{version="{version}",jax="{jax_v}",'
+        f'jaxlib="{jaxlib_v}",backend="{backend}"}} 1\n'
+    )
+    _build_info_cache[prefix] = text
+    return text
 
 
 class LatencyHistogram:

@@ -617,6 +617,16 @@ def from_config(cfg, *, plane: str = "train",
                  target=cfg.slo_step_time_ms, unit="ms")
         wd.track("train_infeed_frac", stat="mean",
                  target=cfg.slo_infeed_frac)
+    # device/compiler signals ride EVERY plane: the compile flight
+    # recorder feeds compile_s per compilation (window MAX — one slow
+    # compile is the breach, an average of fast ones is not), and the
+    # memory accountant feeds devmem_frac whenever the backend reports a
+    # bytes limit (TPU/GPU; absent on CPU, so the signal stays absent
+    # rather than reading 0 forever)
+    wd.track("compile_s", stat="max",
+             target=getattr(cfg, "slo_compile_s", 0.0), unit="s")
+    wd.track("devmem_frac", stat="max",
+             target=getattr(cfg, "slo_devmem_frac", 0.0))
     return wd
 
 

@@ -103,6 +103,8 @@ def embedding_gather(
 
 
 def _gather_impl(ids, table, block_rows, h_tile, interpret):
+    from shifu_tensorflow_tpu.obs import compile as obs_compile
+
     (n,) = ids.shape
     hash_size, dim = table.shape
     rb, ht = _block_shapes(n, hash_size, block_rows, h_tile)
@@ -113,17 +115,21 @@ def _gather_impl(ids, table, block_rows, h_tile, interpret):
                   constant_values=-1)
     tp = jnp.pad(table, ((0, h_pad - hash_size), (0, 0)))
 
-    out = pl.pallas_call(
-        partial(_gather_kernel, h_tile=ht),
-        grid=(n_pad // rb, h_pad // ht),
-        in_specs=[
-            pl.BlockSpec((rb, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((ht, dim), lambda i, j: (j, 0)),
-        ],
-        out_specs=pl.BlockSpec((rb, dim), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n_pad, dim), table.dtype),
-        interpret=_resolve_interpret(interpret),
-    )(idp, tp)
+    # compile-attribution region (obs/compile.py): an eager call's
+    # kernel compile journals under the pallas name; traced into a
+    # jitted step, the compile lands on that step's observed call
+    with obs_compile.attribute("pallas.embedding_gather"):
+        out = pl.pallas_call(
+            partial(_gather_kernel, h_tile=ht),
+            grid=(n_pad // rb, h_pad // ht),
+            in_specs=[
+                pl.BlockSpec((rb, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((ht, dim), lambda i, j: (j, 0)),
+            ],
+            out_specs=pl.BlockSpec((rb, dim), lambda i, j: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_pad, dim), table.dtype),
+            interpret=_resolve_interpret(interpret),
+        )(idp, tp)
     return out[:n]
 
 

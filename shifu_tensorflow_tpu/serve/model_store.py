@@ -43,6 +43,7 @@ from shifu_tensorflow_tpu.export.saved_model import (
     NATIVE_WEIGHTS,
 )
 from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import memory as obs_memory
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import faults, fs, logs
 from shifu_tensorflow_tpu.utils import retry as retry_util
@@ -416,4 +417,19 @@ class ModelStore:
                     loaded.model.warm((min(self.warm_buckets),))
                 except Exception:
                     pass
+        # device-memory snapshot at the swap (obs/memory.py): a reload
+        # is the single-model plane's admission/eviction rolled into
+        # one transition — the journaled device_mem pair around it is
+        # how a leaked old model shows up (the `other` bucket keeps the
+        # released weights' bytes)
+        mem = obs_memory.active()
+        if mem is not None:
+            try:
+                models = {}
+                name = self._model_field().get("model")
+                models[name or "default"] = loaded.model.device_bytes()
+                mem.snapshot(models=models, epoch=loaded.epoch,
+                             reason="reload")
+            except Exception:
+                pass
         return loaded

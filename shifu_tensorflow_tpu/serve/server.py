@@ -264,11 +264,21 @@ class ScoringServer:
         """Evaluate the SLO watchdog several times per window — breach
         and recovery transitions journal from HERE, autonomously, so a
         dead fleet's files still tell the story even if nobody ever
-        scraped /metrics during the incident."""
+        scraped /metrics during the incident.  The same tick drives the
+        device/compiler leg: the compile recorder's storm state machine
+        (a recompile storm whose compiles STOPPED clears only on a
+        tick) and the on-demand profiler trigger poll."""
+        from shifu_tensorflow_tpu.obs import compile as obs_compile
+        from shifu_tensorflow_tpu.obs import profile as obs_profile
+
         tick = min(5.0, max(0.2, self._slo.window_s / 8.0))
         while not self._slo_stop.wait(tick):
             try:
                 self._slo.evaluate()
+                rec = obs_compile.active()
+                if rec is not None:
+                    rec.tick()
+                obs_profile.poll()
             except Exception as e:  # the watchdog must never kill serving
                 log.error("slo evaluation failed: %s: %s",
                           type(e).__name__, e)
@@ -549,10 +559,12 @@ class ScoringServer:
             # under its model label, + the unrouted surface (requests
             # that never resolved a tenant: 404s, malformed bodies) —
             # regrouped into one TYPE block per family inside
+            from shifu_tensorflow_tpu.obs import device_obs_text
+
             text = self.multi.metrics_text(unrouted=self.metrics)
             if self._slo is not None:
                 text += self._slo.render_prometheus()
-            return text
+            return text + device_obs_text()
         try:
             m = self.store.current()
             epoch, digest, verified = m.epoch, m.digest[:12], m.verified
@@ -574,7 +586,11 @@ class ScoringServer:
             # stpu_slo_* gauges ride every scrape: the supervisor policy
             # (ROADMAP item 4) reads the same signal the journal records
             text += self._slo.render_prometheus()
-        return text
+        # device/compiler leg + build identity, one shared renderer for
+        # every scrape surface (obs.device_obs_text)
+        from shifu_tensorflow_tpu.obs import device_obs_text
+
+        return text + device_obs_text()
 
 
 def _make_handler(server: ScoringServer):

@@ -55,6 +55,7 @@ from shifu_tensorflow_tpu.export.saved_model import (
     NATIVE_WEIGHTS,
 )
 from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import memory as obs_memory
 from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
 from shifu_tensorflow_tpu.serve.batcher import MicroBatcher
@@ -564,15 +565,53 @@ class MultiModelStore:
             wd = obs_slo.active()
             if wd is not None:
                 wd.track_serve_tenant(name)
+            # device-memory accounting (obs/memory.py): admission is the
+            # serve plane's snapshot cadence — the journaled device_mem
+            # and stpu_devmem_model_bytes gauge show what each tenant
+            # holds ON DEVICE (the LRU budget above counts bundle bytes
+            # on disk; a quantized or host-offloaded model's device
+            # footprint can differ several-fold)
+            device_bytes = self._devmem_snapshot(event_model=name)
             obs_journal.emit(
                 "model_admit", plane="serve", model=name,
                 cost_bytes=cost, admit_ms=round((now - t0) * 1000.0, 1),
+                device_bytes=device_bytes.get(name, 0),
                 digest=store.current().digest[:12],
                 verified=store.current().verified,
             )
             log.info("admitted model %s (%d bytes, %.0f ms)",
                      name, cost, (now - t0) * 1000.0)
             return t
+
+    def _devmem_snapshot(self, event_model: str | None = None,
+                         **ctx) -> dict[str, int]:
+        """One device-memory accounting pass over every admitted tenant
+        (obs/memory.py): per-model device bytes journaled as
+        ``device_mem`` and exported as ``stpu_devmem_model_bytes``
+        gauges.  Returns {model: device_bytes}.  Never raises and never
+        holds the store lock across the accounting walk — admission and
+        eviction call this on their (rare) transitions."""
+        mem = obs_memory.active()
+        if mem is None:
+            return {}
+        with self._lock:
+            admitted = [(t.name, t.store)
+                        for t in self._tenants.values()
+                        if t.state == "admitted" and t.store is not None]
+        models: dict[str, int] = {}
+        for name, store in admitted:
+            try:
+                models[name] = store.current().model.device_bytes()
+            except Exception:
+                continue  # racing evict/reload: skip, not fail
+        try:
+            mem.snapshot(models=models,
+                         **({"model": event_model} if event_model else {}),
+                         **ctx)
+        except Exception as e:
+            log.warning("device-memory snapshot failed: %s: %s",
+                        type(e).__name__, e)
+        return models
 
     # ---- eviction ----
     def _evict(self, t: _Tenant, reason: str) -> None:
@@ -607,10 +646,17 @@ class MultiModelStore:
             # frozen last-known p99 for a model that isn't serving
             # would mislead the autoscaler these gauges exist for
             wd.untrack_serve_tenant(t.name)
+        mem = obs_memory.active()
+        if mem is not None:
+            mem.drop_model(t.name)
         self.fleet.inc("evictions_total")
         obs_journal.emit("model_evict", plane="serve", model=t.name,
                          reason=reason, freed_bytes=freed,
                          idle_s=round(idle_s, 3))
+        # post-release snapshot: the device_mem event after an eviction
+        # is the proof the bytes actually left the device (a leaked
+        # reference shows up as `other` growing by exactly this model)
+        self._devmem_snapshot(event_model=t.name, reason=reason)
         log.info("evicted model %s (%s, freed %d bytes, idle %.1fs)",
                  t.name, reason, freed, idle_s)
 

@@ -51,6 +51,7 @@ from shifu_tensorflow_tpu.data.dataset import (
     close_stream,
     prefetch_to_device,
 )
+from shifu_tensorflow_tpu.obs import compile as obs_compile
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
 from shifu_tensorflow_tpu.parallel.mesh import DATA_AXIS
@@ -154,7 +155,7 @@ def make_sagn_step(
         )
         return state, jnp.where(has_rows, loss, jnp.nan)
 
-    return sagn_step
+    return obs_compile.observe(sagn_step, "train.sagn_step")
 
 
 class SAGNTrainer(Trainer):

@@ -41,6 +41,7 @@ from shifu_tensorflow_tpu.data.dataset import (
 )
 from shifu_tensorflow_tpu.models.factory import build_model
 from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import compile as obs_compile
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops import metrics as M
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
@@ -581,7 +582,9 @@ def make_train_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
         donate = donation_is_safe()
     body = make_train_step_body(apply_fn, loss_name, l2,
                                 with_grad_norm=with_grad_norm)
-    return partial(jax.jit, donate_argnums=(0,) if donate else ())(body)
+    return obs_compile.observe(
+        partial(jax.jit, donate_argnums=(0,) if donate else ())(body),
+        "train.step")
 
 
 def make_host_emb_train_step(apply_fn, raw_width: int,
@@ -620,7 +623,7 @@ def make_host_emb_train_step(apply_fn, raw_width: int,
         g_emb = jnp.where(has_rows, gx[:, raw_width:], 0.0)
         return state, jnp.where(has_rows, loss, jnp.nan), g_emb
 
-    return step
+    return obs_compile.observe(step, "train.host_emb_step")
 
 
 def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
@@ -645,7 +648,7 @@ def make_scan_epoch(apply_fn, loss_name: str = "mse", l2: float = 0.0,
     def scan_epoch(state: TrainState, stacked: Batch):
         return jax.lax.scan(body, state, stacked)
 
-    return scan_epoch
+    return obs_compile.observe(scan_epoch, "train.scan_epoch")
 
 
 def make_accum_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
@@ -711,7 +714,7 @@ def make_accum_step(apply_fn, loss_name: str = "mse", l2: float = 0.0,
         )
         return state, jnp.where(has_rows, loss, jnp.nan)
 
-    return accum_step
+    return obs_compile.observe(accum_step, "train.accum_step")
 
 
 def make_eval_step_body(apply_fn, loss_name: str = "mse"):
@@ -730,7 +733,9 @@ def make_eval_step_body(apply_fn, loss_name: str = "mse"):
 
 
 def make_eval_step(apply_fn, loss_name: str = "mse"):
-    return jax.jit(make_eval_step_body(apply_fn, loss_name))
+    return obs_compile.observe(
+        jax.jit(make_eval_step_body(apply_fn, loss_name)),
+        "train.eval_step")
 
 
 class Trainer:
@@ -1588,6 +1593,24 @@ class Trainer:
                     min(1.0, float(fields.get("infeed_s", 0.0)) / wall),
                 )
             slo.evaluate(epoch=stats.current_epoch)
+        # device/compiler leg (PR 10), same per-epoch cadence: one
+        # device-memory snapshot attributing the TrainState's trees
+        # (params vs opt-state vs everything else), the compile flight
+        # recorder's storm tick (a storm whose compiles stopped clears
+        # here), and the on-demand profiler trigger poll — each an
+        # is-None check when the leg is off
+        from shifu_tensorflow_tpu.obs import memory as _obs_memory
+        from shifu_tensorflow_tpu.obs import profile as _obs_profile
+
+        mem = _obs_memory.active()
+        if mem is not None:
+            mem.snapshot(params=self.state.params,
+                         opt_state=self.state.opt_state,
+                         epoch=stats.current_epoch)
+        rec = obs_compile.active()
+        if rec is not None:
+            rec.tick()
+        _obs_profile.poll()
 
     def _warn_if_validation_empty(self, stats: EpochStats,
                                   early_stop) -> None:
@@ -2031,8 +2054,8 @@ class Trainer:
                 )
             return jax.lax.scan(body, state, stacked)
 
-        cache[key] = epoch_fn
-        return epoch_fn
+        cache[key] = obs_compile.observe(epoch_fn, "train.resident_epoch")
+        return cache[key]
 
     def _make_device_eval(self, steps: int, batch_size: int):
         """Scanned validation pass: (losses, preds) in one dispatch.
@@ -2058,8 +2081,8 @@ class Trainer:
             _, (losses, preds) = jax.lax.scan(body, None, stacked)
             return losses, preds
 
-        cache[key] = eval_fn
-        return eval_fn
+        cache[key] = obs_compile.observe(eval_fn, "train.resident_eval")
+        return cache[key]
 
     def fit_stream(
         self,

@@ -26,14 +26,33 @@ from typing import Any, Iterator
 
 @contextlib.contextmanager
 def trace_if(trace_dir: str | None) -> Iterator[None]:
-    """``jax.profiler.trace`` when a directory is given; no-op otherwise."""
+    """``jax.profiler.trace`` when a directory is given; no-op otherwise.
+
+    When the obs journal is installed, the capture is journaled as
+    ``profile_capture`` events (start + done, with the dump dir) — the
+    same pointer contract as the on-demand window (obs/profile.py), so
+    ``obs profile --journal ...`` lists planned-in-advance captures and
+    requested ones alike."""
     if not trace_dir:
         yield
         return
+    import time as _time
+
     import jax
 
-    with jax.profiler.trace(trace_dir):
-        yield
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    t0 = _time.time()
+    obs_journal.emit("profile_capture", status="started", dir=trace_dir)
+    ok = False
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+            ok = True
+    finally:
+        obs_journal.emit("profile_capture",
+                         status="done" if ok else "failed", dir=trace_dir,
+                         wall_s=round(_time.time() - t0, 3))
 
 
 def annotate(name: str):

@@ -644,6 +644,51 @@ def test_slo_keys_round_trip_xml_to_dataclass(tmp_path):
     assert d.slo_anomaly_sigma == 6.0
     assert d.slo_serve_p99_ms == d.slo_serve_shed_rate == 0.0
     assert d.slo_step_time_ms == d.slo_infeed_frac == 0.0
+    assert d.slo_compile_s == d.slo_devmem_frac == 0.0
+
+
+def test_device_obs_keys_round_trip_xml_to_dataclass(tmp_path):
+    """The PR-10 device/compiler keys ride the same ObsConfig chain:
+    compile-analysis depth, storm threshold, and the two new watchdog
+    targets — XML → Conf → ObsConfig → JSON bridge."""
+    import pytest
+
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "devobs.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.OBS_COMPILE_ANALYSIS: "cost",
+        K.OBS_COMPILE_STORM: "12",
+        K.SLO_COMPILE_S: "2.5",
+        K.SLO_DEVMEM_FRAC: "0.9",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.compile_analysis == "cost"
+    assert cfg.compile_storm == 12
+    assert cfg.slo_compile_s == 2.5
+    assert cfg.slo_devmem_frac == 0.9
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # defaults: auto analysis (full on train, cost on serve — resolved
+    # per plane by install_obs), storm threshold 8, targets off
+    d = resolve_obs(_args(), _conf({}))
+    assert d.compile_analysis == "auto" and d.compile_storm == 8
+    # misconfiguration fails loudly
+    with pytest.raises(ValueError, match="obs-compile-analysis"):
+        ObsConfig(compile_analysis="verbose")
+    with pytest.raises(ValueError, match="obs-compile-storm"):
+        ObsConfig(compile_storm=1)
+    with pytest.raises(ValueError, match="slo-devmem-frac"):
+        ObsConfig(slo_devmem_frac=1.5)
 
 
 def test_obs_keys_reach_worker_config_bridge():

@@ -241,12 +241,15 @@ def test_from_config_registers_plane_signals():
                     slo_serve_shed_rate=0.2, slo_step_time_ms=50.0,
                     slo_infeed_frac=0.3, slo_window_s=30.0,
                     slo_hysteresis=3)
+    # the device/compiler signals (PR 10) ride every plane
+    device = {"compile_s", "devmem_frac"}
     serve = slo_mod.from_config(cfg, plane="serve", worker=2)
-    assert set(serve.state()) == {"serve_p99_s", "serve_shed_rate"}
+    assert set(serve.state()) == {"serve_p99_s", "serve_shed_rate"} | device
     assert serve.state()["serve_p99_s"]["target"] == pytest.approx(0.25)
     assert serve.hysteresis == 3 and serve.window_s == 30.0
     train = slo_mod.from_config(cfg, plane="train")
-    assert set(train.state()) == {"train_step_ms", "train_infeed_frac"}
+    assert set(train.state()) == {"train_step_ms",
+                                  "train_infeed_frac"} | device
     assert train.state()["train_step_ms"]["target"] == 50.0
     # epoch-level samples: the step-time stat is a windowed mean, not a
     # per-step p99 the aggregate tracer cannot provide
@@ -256,7 +259,8 @@ def test_from_config_registers_plane_signals():
     # watchdog up via slo.active(); without them the configured train
     # targets would be silently dead
     coord = slo_mod.from_config(cfg, plane="coordinator")
-    assert set(coord.state()) == {"train_step_ms", "train_infeed_frac"}
+    assert set(coord.state()) == {"train_step_ms",
+                                  "train_infeed_frac"} | device
     assert coord.state()["train_step_ms"]["target"] == 50.0
 
 
