@@ -210,8 +210,32 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
     for _ in range(m2):
         rec.tick()
     tick_us = (time.perf_counter() - t0) / m2 * 1e6
+    # fleet leg (PR 11), both per-EPOCH costs: one coordinator-side
+    # FleetMonitor.observe_epoch (digest feeds + skew + hysteresis —
+    # runs in the epoch-report RPC) and one ClockSync update + journal
+    # offset stamp (runs once per RPC; one per epoch is the steady-state
+    # report cadence, heartbeats ride a background thread off the step
+    # path)
+    from shifu_tensorflow_tpu.obs.fleet import ClockSync, FleetMonitor
+
+    mon = FleetMonitor(warmup_epochs=0)
+    phases = {"host_s": 0.1, "infeed_s": 0.2, "dispatch_s": 0.5,
+              "block_s": 0.1, "steps": 64, "barrier_s": 0.01,
+              "offset_s": 0.0001}
+    t0 = time.perf_counter()
+    for i in range(m2):
+        mon.observe_epoch(0, i, 1.0, phases=phases, n_workers=2)
+        mon.observe_epoch(1, i, 1.0, phases=phases, n_workers=2)
+    fleet_observe_us = (time.perf_counter() - t0) / (2 * m2) * 1e6
+    cs = ClockSync()
+    t0 = time.perf_counter()
+    for i in range(m2):
+        cs.update(100.0 + i, 105.0 + i, 105.0 + i, 100.001 + i)
+        j.set_offset(cs.offset())
+    clock_update_us = (time.perf_counter() - t0) / m2 * 1e6
     j.close()
-    per_epoch_total = per_epoch_us + mem_snapshot_us + tick_us
+    per_epoch_total = (per_epoch_us + mem_snapshot_us + tick_us
+                       + fleet_observe_us + clock_update_us)
     return {
         "span_us": per_step_us,
         "digest_us": digest_us,
@@ -220,6 +244,8 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
         "epoch_us": per_epoch_us,
         "mem_snapshot_us": mem_snapshot_us,
         "storm_tick_us": tick_us,
+        "fleet_observe_us": fleet_observe_us,
+        "clock_update_us": clock_update_us,
         "total_us": (per_step_us + digest_us + rid_us + compile_site_us
                      + per_epoch_total / max(1, steps_per_epoch)),
     }
@@ -306,6 +332,11 @@ def main() -> int:
             "per_epoch": round(micro["epoch_us"], 2),
             "mem_snapshot": round(micro["mem_snapshot_us"], 2),
             "storm_tick": round(micro["storm_tick_us"], 3),
+            # fleet leg (PR 11): coordinator-side skew fold per epoch
+            # report + the worker's clock-sync update/offset stamp per
+            # RPC — both per-epoch, amortized like the journal write
+            "fleet_observe": round(micro["fleet_observe_us"], 2),
+            "clock_update": round(micro["clock_update_us"], 3),
         },
         "micro_pct_of_median_step": round(micro_pct, 3),
         "pair_ratio_p10_p50_p90": [

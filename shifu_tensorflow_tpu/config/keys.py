@@ -365,6 +365,20 @@ SLO_COMPILE_S = TPU_PREFIX + "slo-compile-s"  # seconds; 0 = no target
 DEFAULT_SLO_COMPILE_S = 0.0
 SLO_DEVMEM_FRAC = TPU_PREFIX + "slo-devmem-frac"  # 0..1; 0 = no target
 DEFAULT_SLO_DEVMEM_FRAC = 0.0
+# fleet leg (obs/fleet.py).  slo-straggler-skew: watchdog target on the
+# window MAX of per-rank relative step-time skew (rank window mean over
+# the median of its peers'); 0 = no target — the straggler detect/clear
+# events below still fire.  Must be > 1 when set: a fleet at parity has
+# skew exactly 1.
+SLO_STRAGGLER_SKEW = TPU_PREFIX + "slo-straggler-skew"
+DEFAULT_SLO_STRAGGLER_SKEW = 0.0
+# straggler detection threshold: a rank whose relative skew holds at or
+# above this for slo-hysteresis consecutive epochs journals
+# straggler_detect (naming the rank and its dominant phase);
+# straggler_clear on the same count of clean epochs.  Relative, so a
+# uniformly slow fleet never alarms.
+FLEET_SKEW_THRESHOLD = TPU_PREFIX + "fleet-skew-threshold"
+DEFAULT_FLEET_SKEW_THRESHOLD = 1.5
 
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib

@@ -586,6 +586,21 @@ class Coordinator:
 
     def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
         stats = EpochStats(**stats_dict)
+        # fleet leg (obs/fleet.py): per-rank skew digests fed from the
+        # phase summary the worker attached (EpochStats.phases, the same
+        # budget_fields drain its own journal got) — straggler
+        # detect/clear, the fleet_skew record on epoch quorum, and the
+        # slo-straggler-skew watchdog signal all run in the reporter's
+        # request.  One is-None check when obs is off.
+        from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
+        mon = obs_fleet.active()
+        if mon is not None:
+            mon.observe_epoch(
+                stats.worker_index, stats.current_epoch,
+                stats.training_time_s, phases=stats.phases,
+                n_workers=self.spec.n_workers,
+            )
         if (
             self._early_stopper is not None
             and not self.spec.spmd
@@ -1119,6 +1134,30 @@ class Coordinator:
                 "state_info", 1, labels='{state="%s"}' % self.state.value
             )
         text = self.registry.render_prometheus("stpu_coord_")
+        # per-worker heartbeat ages: liveness as a SCRAPEABLE series, not
+        # just a post-mortem diagnostics bundle — hand-rendered because
+        # the per-worker label set shares one metric name, which the
+        # one-label-set-per-gauge registry cannot express
+        ages = self.liveness.ages()
+        if ages:
+            with self._lock:
+                by_id = {wid: rec.worker_index
+                         for wid, rec in self.workers.items()}
+            lines = ["# TYPE stpu_coord_heartbeat_age_seconds gauge"]
+            for wid in sorted(ages, key=lambda w: by_id.get(w, -1)):
+                idx = by_id.get(wid)
+                who = wid if idx is None else str(idx)
+                lines.append(
+                    'stpu_coord_heartbeat_age_seconds{worker="%s"} %.3f'
+                    % (who, ages[wid]))
+            text += "\n".join(lines) + "\n"
+        # fleet leg: per-rank skew/step-time/offset gauges + straggler
+        # state + collective byte counters (obs/fleet.py)
+        from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
+        fleet_mon = obs_fleet.active()
+        if fleet_mon is not None:
+            text += fleet_mon.render_prometheus()
         from shifu_tensorflow_tpu.obs import slo as obs_slo
 
         watchdog = obs_slo.active()
@@ -1163,8 +1202,19 @@ class Coordinator:
         delivery token (see _op_cache).  The replay window assumes retries
         are SERIAL per logical call — the client only re-sends after its
         previous attempt failed — so two in-flight deliveries of one token
-        cannot race the cache."""
+        cannot race the cache.
+
+        Every reply is stamped with the server's receive/send wall times
+        (``srv_recv_ts``/``srv_ts``): with the client's own send/receive
+        times that is the full NTP four-tuple, from which CoordinatorClient
+        estimates its clock offset against the coordinator — no extra
+        traffic, and barrier ops that block for minutes server-side cancel
+        out of the estimate (obs/fleet.ClockSync).  Stamps are applied
+        AFTER the replay cache, per delivery: a replayed response must
+        describe THIS exchange's timing, not the original's."""
+        t_recv = time.time()
         token = msg.get("token")
+        cached = None
         if token is not None:
             with self._lock:
                 cached = self._op_cache.get(token)
@@ -1174,14 +1224,19 @@ class Coordinator:
             if cached is not None:
                 log.info("replaying cached response for duplicate %s "
                          "delivery (token %s)", msg.get("op"), token)
-                return cached
-        resp = self._dispatch(msg)
-        if token is not None:
-            with self._lock:
-                self._op_cache[token] = resp
-                while len(self._op_cache) > self._OP_CACHE_MAX:
-                    self._op_cache.popitem(last=False)
-        return resp
+        if cached is not None:
+            resp = cached
+        else:
+            resp = self._dispatch(msg)
+            if token is not None:
+                with self._lock:
+                    self._op_cache[token] = resp
+                    while len(self._op_cache) > self._OP_CACHE_MAX:
+                        self._op_cache.popitem(last=False)
+        stamped = dict(resp)
+        stamped["srv_recv_ts"] = round(t_recv, 6)
+        stamped["srv_ts"] = round(time.time(), 6)
+        return stamped
 
     def _dispatch(self, msg: dict[str, Any]) -> dict[str, Any]:
         op = msg.get("op")
@@ -1260,6 +1315,18 @@ class CoordinatorClient:
         self.timeout_s = timeout_s
         # None = resolve the process default per call (set_default_policy)
         self._retry_policy = retry_policy
+        # NTP-style clock-offset estimator against the coordinator, fed
+        # by every reply's srv_recv_ts/srv_ts stamps (obs/fleet.py).  A
+        # relaunched worker builds a fresh client, so the estimate never
+        # survives the process whose clock it describes.
+        from shifu_tensorflow_tpu.obs.fleet import ClockSync
+
+        self.clock = ClockSync()
+
+    def clock_offset(self) -> float | None:
+        """Estimated coordinator-clock minus local-clock seconds (None
+        before the first stamped exchange)."""
+        return self.clock.offset()
 
     def call(
         self, msg: dict[str, Any], timeout_s: float | str = "default"
@@ -1269,6 +1336,7 @@ class CoordinatorClient:
 
         def attempt() -> dict[str, Any]:
             faults.check("rpc.connect")
+            t0 = time.time()
             with socket.create_connection(self.addr, timeout=timeout) as s:
                 f = s.makefile("rwb")
                 f.write(payload)
@@ -1282,7 +1350,19 @@ class CoordinatorClient:
                 if not line.endswith(b"\n"):
                     # torn mid-reply: transport failure, not a protocol error
                     raise ConnectionError("truncated coordinator reply")
-                return json.loads(line)
+                t3 = time.time()
+                resp = json.loads(line)
+                if isinstance(resp, dict) and "srv_ts" in resp:
+                    # full NTP four-tuple: server processing time (a
+                    # barrier can block for minutes) cancels; the
+                    # min-delay filter inside ClockSync bounds the
+                    # residual error by half the network round trip
+                    self.clock.update(t0, resp.get("srv_recv_ts"),
+                                      resp["srv_ts"], t3)
+                    from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
+                    obs_fleet.note_offset(self.clock.offset())
+                return resp
 
         policy = (self._retry_policy if self._retry_policy is not None
                   else retry_util.default_policy())

@@ -82,6 +82,7 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     one merged journal can tell two jobs' events apart.
     """
     from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
     from shifu_tensorflow_tpu.obs import journal as journal_mod
     from shifu_tensorflow_tpu.obs import memory as memory_mod
     from shifu_tensorflow_tpu.obs import profile as profile_mod
@@ -93,6 +94,7 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
         slo_mod.uninstall()
         compile_mod.uninstall()
         memory_mod.uninstall()
+        fleet_mod.uninstall()
         profile_mod.unconfigure()
         return None, None
     if cfg.hist_buckets:
@@ -147,6 +149,14 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     ))
     memory_mod.install(memory_mod.MemoryAccountant(
         plane=plane, worker=worker_index))
+    # fleet leg (PR 11): the coordinator feeds it from workers' epoch
+    # reports (fleet.active() in report_epoch); on planes that never see
+    # fleet traffic it idles at zero cost like the other legs
+    fleet_mod.install(fleet_mod.FleetMonitor(
+        skew_threshold=getattr(cfg, "fleet_skew_threshold", 1.5),
+        hysteresis=cfg.slo_hysteresis,
+        plane=plane,
+    ))
     profile_mod.configure(cfg.journal_path or None, plane=plane,
                           worker=worker_index)
     return tracer, jrn

@@ -7,6 +7,7 @@ traces, and a live dashboard.
     python -m shifu_tensorflow_tpu.obs trace 4f2a91b0c3d4e5f6 --journal ...
     python -m shifu_tensorflow_tpu.obs trace 0:3 --journal ...
     python -m shifu_tensorflow_tpu.obs top     --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs fleet   --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs compile --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs mem     --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs profile --journal ... --request \
@@ -21,7 +22,11 @@ torn final line (writer killed mid-event) is skipped, not fatal.  The
 
 ``trace`` reconstructs ONE causal story: a request id (as minted at
 serve ingress / supplied via ``X-Request-Id``) or one worker's epoch
-(``worker:epoch``) across every plane that touched it.  ``top`` is a
+(``worker:epoch``) across every plane that touched it — rendered on a
+fleet-aligned clock when the writers stamped ``offset=`` estimates
+(obs/fleet.ClockSync; ``--json`` keeps the raw wall clocks).  ``fleet``
+renders the per-rank skew table and straggler excursions
+(``straggler_detect``/``straggler_clear``) the coordinator journaled.  ``top`` is a
 live terminal dashboard (``--once`` for CI) that tails the journals and
 optionally scrapes ``/metrics`` URLs.  ``compile`` renders the compile
 flight recorder's history (per-callable costs, signatures, recompile
@@ -102,6 +107,16 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument("--json", action="store_true", dest="as_json",
                        help="matching events, one JSON object per line")
 
+    fleet = sub.add_parser(
+        "fleet",
+        help="per-rank skew table + straggler excursions (fleet_skew / "
+             "straggler_detect / straggler_clear events)",
+    )
+    fleet.add_argument("--journal", required=True,
+                       help="journal base path (shifu.tpu.obs-journal)")
+    fleet.add_argument("--json", action="store_true", dest="as_json",
+                       help="machine-readable fleet document")
+
     comp = sub.add_parser(
         "compile",
         help="compile flight-recorder history: per-callable compile "
@@ -169,7 +184,10 @@ def _fmt_event(ev: dict, t0: float) -> str:
     plane = ev.get("plane", "?")
     worker = ev.get("worker")
     who = f"{plane} w{worker}" if worker is not None else plane
-    skip = {"ts", "event", "plane", "worker", "seq", "job"}
+    # offset is the writer's clock stamp, not event payload — rendered
+    # timelines use it for alignment (trace) or the fleet table; the
+    # raw value stays in --json output
+    skip = {"ts", "event", "plane", "worker", "seq", "job", "offset"}
     detail = " ".join(
         f"{k}={_short(v)}" for k, v in ev.items() if k not in skip
     )
@@ -571,6 +589,7 @@ def _build_summary(base: str, cache: dict | None = None) -> dict | None:
         "budget": _budget_data(events),
         "serve": _serve_data(events),
         "slo": _slo_data(events),
+        "fleet": _fleet_data(events),
         "_events": events,  # stripped before --json output
     }
 
@@ -607,6 +626,12 @@ def cmd_summary(args) -> int:
     if slo_lines:
         print("slo")
         for line in slo_lines:
+            print(line)
+        print()
+    fleet_lines = _render_fleet(data["fleet"], t0)
+    if fleet_lines:
+        print("fleet skew")
+        for line in fleet_lines:
             print(line)
         print()
     print("fleet timeline")
@@ -670,16 +695,36 @@ def cmd_trace(args) -> int:
               file=sys.stderr)
         return 1
     if args.as_json:
+        # raw wall clocks preserved: each event keeps its writer's ts
+        # (and its offset= estimate, when stamped) untouched
         for ev in sel:
             print(json.dumps(ev, separators=(",", ":"), default=str))
         return 0
-    t0 = sel[0].get("ts", 0.0)
+    # offset-aligned rendering: workers stamp offset= (coordinator clock
+    # minus theirs, obs/fleet.ClockSync), so ts + offset maps every
+    # writer onto ONE clock — the merged timeline then reflects
+    # causality across machines, not whose wall clock ran fast.  Events
+    # without a stamp (the coordinator's own, pre-offset builds) align
+    # at offset 0.
+    aligned = any(e.get("offset") for e in sel)
+    if aligned:
+        sel = sorted(
+            sel, key=lambda e: (e.get("ts", 0.0)
+                                + float(e.get("offset", 0.0) or 0.0)))
+    t0 = sel[0].get("ts", 0.0) + float(sel[0].get("offset", 0.0) or 0.0)
     planes = sorted({e.get("plane", "?") for e in sel})
     jobs = sorted({e["job"] for e in sel if "job" in e})
     print(f"trace {what}: {len(sel)} event(s) across "
           f"plane(s) {', '.join(planes)}"
           + (f"  [job {', '.join(jobs)}]" if jobs else ""))
+    if aligned:
+        print("  (timestamps offset-aligned to the coordinator clock; "
+              "--json keeps raw wall clocks)")
     for ev in sel:
+        if aligned and ev.get("offset"):
+            ev = {**ev, "ts": (ev.get("ts", 0.0)
+                               + float(ev.get("offset") or 0.0))}
+            ev.pop("offset", None)
         print(" " + _fmt_event(ev, t0))
     # the request's phase split, when a serve_batch dispatch carried it
     for ev in sel:
@@ -689,6 +734,140 @@ def cmd_trace(args) -> int:
                   f"{ev.get('requests', '?')} request(s)): waited "
                   f"{ev.get('queue_delay_s', 0.0):.4f}s, device "
                   f"{ev.get('dispatch_s', 0.0):.4f}s")
+    return 0
+
+
+# ---- fleet skew (data + renderer) ----
+
+def _fleet_data(events: list[dict]) -> dict:
+    """Per-rank skew state + straggler excursions from the coordinator's
+    ``fleet_skew`` / ``straggler_detect`` / ``straggler_clear`` events,
+    plus the per-epoch ``comm`` drains — entirely from journal files, so
+    a dead fleet's straggler story reconstructs on a jax-free laptop."""
+    ranks: dict = {}
+    excursions: list[dict] = []
+    open_exc: dict = {}
+    comm: dict = defaultdict(lambda: {"calls": 0, "bytes": 0})
+    epochs = 0
+    straggler = None
+    max_skew = None
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "fleet_skew":
+            epochs += 1
+            straggler = ev.get("straggler")
+            max_skew = ev.get("max_skew")
+            for w, r in (ev.get("ranks") or {}).items():
+                ranks[w] = dict(r)
+        elif kind == "straggler_detect":
+            rec = {
+                "worker": ev.get("worker"),
+                "phase": ev.get("phase"),
+                "skew": ev.get("skew"),
+                "step_s": ev.get("step_s"),
+                "fleet_step_s": ev.get("fleet_step_s"),
+                "detect_ts": ev.get("ts"),
+                "detect_epoch": ev.get("epoch"),
+                "clear_ts": None,
+                "clear_epoch": None,
+                "straggler_s": None,
+            }
+            excursions.append(rec)
+            open_exc[ev.get("worker")] = rec
+        elif kind == "straggler_clear":
+            rec = open_exc.pop(ev.get("worker"), None)
+            if rec is not None:
+                rec["clear_ts"] = ev.get("ts")
+                rec["clear_epoch"] = ev.get("epoch")
+                rec["straggler_s"] = ev.get("straggler_s")
+        elif kind == "comm":
+            for k, v in (ev.get("kinds") or {}).items():
+                comm[k]["calls"] += int(v.get("calls", 0) or 0)
+                comm[k]["bytes"] += int(v.get("bytes", 0) or 0)
+    if not ranks and not excursions and not comm:
+        return {}
+    def rank_key(kv):
+        # ranks are JSON string keys: numeric order, not "0,1,10,11,2"
+        try:
+            return (0, int(kv[0]))
+        except (TypeError, ValueError):
+            return (1, kv[0])
+
+    return {
+        "ranks": dict(sorted(ranks.items(), key=rank_key)),
+        "excursions": excursions,
+        "epochs": epochs,
+        "straggler": straggler,
+        "max_skew": max_skew,
+        "comm": {k: dict(v) for k, v in sorted(comm.items())},
+    }
+
+
+def _render_fleet(data: dict, t0: float) -> list[str]:
+    if not data:
+        return []
+    lines = []
+    if data["ranks"]:
+        lines.append(
+            "  rank  step_s    skew    phase      barrier_s  offset_s"
+            "    state")
+        for w, r in data["ranks"].items():
+            state = "STRAGGLER" if r.get("straggler") else "ok"
+            barrier = r.get("barrier_s")
+            offset = r.get("offset_s")
+            lines.append(
+                f"  {w:<5} {r.get('step_s', 0.0):<9.4f} "
+                f"{r.get('skew', 1.0):<7.3f} "
+                f"{r.get('phase', '?'):<10} "
+                f"{('-' if barrier is None else f'{barrier:.4f}'):<10} "
+                f"{('-' if offset is None else f'{offset:+.6f}'):<11}"
+                f"{state}"
+            )
+    for e in data["excursions"]:
+        start = (e["detect_ts"] or t0) - t0
+        if e["clear_ts"] is not None:
+            span = (f"+{start:.1f}s .. +{e['clear_ts'] - t0:.1f}s "
+                    f"({e['straggler_s']:.1f}s, epochs "
+                    f"{e['detect_epoch']}..{e['clear_epoch']})")
+        else:
+            span = f"+{start:.1f}s .. STILL STRAGGLING"
+        lines.append(
+            f"  straggler: worker {e['worker']}  {span}  "
+            f"skew {e.get('skew', 0.0):.2f}  dominant phase "
+            f"{e.get('phase', '?')}")
+    if not data["excursions"] and data["ranks"]:
+        lines.append("  no straggler excursions")
+    if data["comm"]:
+        lines.append("  collective      calls     bytes")
+        for k, v in data["comm"].items():
+            lines.append(
+                f"  {k:<15} {v['calls']:<9} {_fmt_bytes(v['bytes'])}")
+    return lines
+
+
+def cmd_fleet(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _fleet_data(events)
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0 if data else 1
+    if not data:
+        print("no fleet events — the coordinator journals fleet_skew / "
+              "straggler_detect once workers attach phase summaries "
+              "(obs enabled on a multi-worker run)")
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    n = len(data["ranks"])
+    print(f"fleet skew — {n} rank(s), {data['epochs']} fleet epoch(s)"
+          + (f", max skew {data['max_skew']:.2f}"
+             if data.get("max_skew") is not None else ""))
+    for line in _render_fleet(data, t0):
+        print(line)
     return 0
 
 
@@ -1015,6 +1194,15 @@ def _render_top(base: str, urls: list[str],
                 f"{a['pct']['other']:.1f}"
             )
         lines.append("")
+    # fleet panel: per-rank skew + straggler state beside the serve
+    # panel (journal-fed; the live stpu_fleet_* gauges ride the
+    # coordinator metrics op, which top's --metrics-url can scrape)
+    fleet = data.get("fleet") or {}
+    if fleet.get("ranks") or fleet.get("excursions"):
+        lines.append("fleet")
+        for line in _render_fleet(fleet, data["t0"]):
+            lines.append(line)
+        lines.append("")
     # serve plane: journal rows, live counters when scraped
     serve = data["serve"]
     if serve and (serve["workers"] or serve["fleet"]["workers"]):
@@ -1071,6 +1259,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_trace(args)
         if args.cmd == "top":
             return cmd_top(args)
+        if args.cmd == "fleet":
+            return cmd_fleet(args)
         if args.cmd == "compile":
             return cmd_compile(args)
         if args.cmd == "mem":

@@ -42,6 +42,11 @@ class ObsConfig:
     compile_storm: int = K.DEFAULT_OBS_COMPILE_STORM
     slo_compile_s: float = K.DEFAULT_SLO_COMPILE_S
     slo_devmem_frac: float = K.DEFAULT_SLO_DEVMEM_FRAC
+    # fleet leg (obs/fleet.py) — straggler skew watchdog target (0 =
+    # untargeted) and the detect/clear threshold; flat for the same
+    # JSON-bridge reason as the blocks above
+    slo_straggler_skew: float = K.DEFAULT_SLO_STRAGGLER_SKEW
+    fleet_skew_threshold: float = K.DEFAULT_FLEET_SKEW_THRESHOLD
 
     def __post_init__(self):
         if self.journal_max_bytes < 4096:
@@ -80,6 +85,19 @@ class ObsConfig:
                          (K.SLO_DEVMEM_FRAC, self.slo_devmem_frac)):
             if val > 1:
                 raise ValueError(f"{key} is a fraction in [0, 1], got {val}")
+        if self.slo_straggler_skew < 0:
+            raise ValueError(f"{K.SLO_STRAGGLER_SKEW} must be >= 0 "
+                             f"(0 = disabled), got {self.slo_straggler_skew}")
+        if 0 < self.slo_straggler_skew <= 1:
+            raise ValueError(
+                f"{K.SLO_STRAGGLER_SKEW} must be > 1 when set (skew is a "
+                f"ratio; the fleet sits at 1 when balanced), got "
+                f"{self.slo_straggler_skew}")
+        if self.fleet_skew_threshold <= 1:
+            raise ValueError(
+                f"{K.FLEET_SKEW_THRESHOLD} must be > 1 (a rank is a "
+                f"straggler when it is that many times its peers), got "
+                f"{self.fleet_skew_threshold}")
         if self.compile_analysis not in ("auto", "full", "cost", "off"):
             raise ValueError(
                 f"{K.OBS_COMPILE_ANALYSIS} must be auto|full|cost|off, "
@@ -164,4 +182,8 @@ def resolve_obs_config(args, conf) -> ObsConfig:
                                      K.DEFAULT_SLO_COMPILE_S),
         slo_devmem_frac=conf.get_float(K.SLO_DEVMEM_FRAC,
                                        K.DEFAULT_SLO_DEVMEM_FRAC),
+        slo_straggler_skew=conf.get_float(K.SLO_STRAGGLER_SKEW,
+                                          K.DEFAULT_SLO_STRAGGLER_SKEW),
+        fleet_skew_threshold=conf.get_float(
+            K.FLEET_SKEW_THRESHOLD, K.DEFAULT_FLEET_SKEW_THRESHOLD),
     )

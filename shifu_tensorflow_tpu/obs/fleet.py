@@ -1,0 +1,668 @@
+"""Fleet skew observability: straggler detection, clock alignment, and
+collective/transfer accounting — the fleet leg of the obs plane.
+
+A synchronous fleet is only as fast as its slowest rank (the reason the
+reference ran backup workers behind ``SyncReplicasOptimizer``, PAPER.md
+L2/L3), yet the host (PR 4), causal/SLO (PR 7), and device (PR 10) legs
+can only describe ONE process at a time.  This module answers the three
+fleet questions they cannot:
+
+- **which rank is slow, and why** — :class:`FleetMonitor` keeps one
+  :class:`~shifu_tensorflow_tpu.obs.slo.WindowedDigest` of per-epoch
+  step time per rank (fed by the coordinator from the phase summaries
+  workers attach to their epoch reports), computes each rank's
+  *relative skew* (its window mean over the median of its peers'), and
+  runs a hysteretic state machine per rank: ``skew >= threshold`` for
+  ``hysteresis`` consecutive epochs journals ``straggler_detect``
+  naming the rank AND its dominant phase (the step phase whose excess
+  over the fleet median is largest — "rank 1 is 1.8x the fleet and the
+  time went to infeed"); recovery journals ``straggler_clear`` with
+  the excursion length.  Barrier waits attribute the inverse view: the
+  rank everyone else ``step.block``s on is the one with the SMALLEST
+  barrier wait.  ``stpu_fleet_*`` gauges render on the coordinator
+  ``metrics`` op, and the window-max skew feeds the
+  ``shifu.tpu.slo-straggler-skew`` watchdog target — the exact signal
+  the ROADMAP item-3 standby-takeover/autoscaler policy consumes.
+- **what time it was** — :class:`ClockSync` estimates each worker's
+  clock offset against the coordinator NTP-style, from the four
+  timestamps of RPCs the worker already makes (client send / server
+  receive / server send / client receive; no new traffic).  Server
+  processing time — minutes inside an epoch barrier — cancels out of
+  ``offset = ((t1-t0) + (t2-t3)) / 2``; the residual error is bounded
+  by half the network round trip, and the estimator keeps the
+  minimum-delay sample of a sliding window (the NTP discipline) so one
+  congested exchange cannot skew it.  Each worker's
+  :class:`~shifu_tensorflow_tpu.obs.journal.Journal` stamps the
+  current estimate as an ``offset=`` field, so ``obs trace`` can
+  render a fleet-aligned timeline instead of interleaving
+  unsynchronized wall clocks.
+- **what the collectives cost** — :func:`comm_region` wraps the
+  host-callable collective entry points (``parallel/ring.py``
+  rotations and all-to-alls, ``parallel/shmap.py`` shard_map calls,
+  ``parallel/distributed.py`` bring-up and global device_put) in a
+  tracer span (``comm.<kind>``, drained into ``step_breakdown`` per
+  epoch like any auxiliary span), a PR-10 ``attribute()`` region (a
+  compile inside lands on the collective's name), and a bytes-moved
+  counter rendered as ``stpu_fleet_comm_*`` gauges and journaled per
+  epoch as a ``comm`` event — per-step comm cost for the day sharded
+  SPMD (ROADMAP item 1) and pipeline stages (item 5) land.
+
+stdlib-only at import and off-by-default-cheap like its siblings: with
+no monitor installed every seam is one module-global ``is None`` check,
+and ``comm_region`` with nothing installed is a nullcontext.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from shifu_tensorflow_tpu.obs.slo import WindowedDigest
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("obs")
+
+__all__ = [
+    "ClockSync",
+    "FleetMonitor",
+    "comm_region",
+    "add_comm_bytes",
+    "take_comm",
+    "comm_text",
+    "note_offset",
+    "clock_offset",
+    "install",
+    "uninstall",
+    "active",
+]
+
+_mono = time.monotonic
+
+#: the disjoint step phases a straggler's excess is attributed to (the
+#: step_breakdown schema's wall-clock split; "other" is wall minus the
+#: named four)
+PHASES = ("host", "infeed", "dispatch", "block", "other")
+
+
+class ClockSync:
+    """NTP-style clock-offset estimator over an existing RPC channel.
+
+    Feed :meth:`update` the four timestamps of each request/reply
+    exchange: ``t0`` client send, ``t1`` server receive, ``t2`` server
+    send, ``t3`` client receive — all raw ``time.time()`` readings from
+    their respective clocks.  The estimate::
+
+        offset = ((t1 - t0) + (t2 - t3)) / 2     # server − client
+        delay  = (t3 - t0) - (t2 - t1)           # network round trip
+
+    cancels server processing time exactly (an epoch barrier can hold a
+    reply for minutes without corrupting the estimate) and is wrong by
+    at most ``delay / 2`` under asymmetric network legs — the classic
+    NTP error bound, which :meth:`offset` minimizes by returning the
+    minimum-delay sample of the last ``keep`` exchanges.  A worker
+    restart constructs a fresh client and therefore a fresh estimator:
+    offsets never survive the process whose clock they describe."""
+
+    def __init__(self, keep: int = 8):
+        self._samples: deque = deque(maxlen=max(1, int(keep)))
+        self._lock = threading.Lock()
+
+    def update(self, t0: float, t1: float, t2: float,
+               t3: float) -> float | None:
+        """Fold in one exchange; returns this sample's offset estimate
+        (or None for an unusable sample — missing/absurd stamps)."""
+        try:
+            t0, t1, t2, t3 = (float(t0), float(t1), float(t2), float(t3))
+        except (TypeError, ValueError):
+            return None
+        if t3 < t0 or t2 < t1:
+            return None  # a clock ran backwards mid-exchange
+        delay = max(0.0, (t3 - t0) - (t2 - t1))
+        offset = ((t1 - t0) + (t2 - t3)) / 2.0
+        with self._lock:
+            self._samples.append((delay, offset))
+        return offset
+
+    def offset(self) -> float | None:
+        """Best current estimate (the minimum-delay sample's offset),
+        None before the first usable exchange."""
+        with self._lock:
+            if not self._samples:
+                return None
+            return min(self._samples)[1]
+
+    def delay(self) -> float | None:
+        """The best sample's round-trip delay — the error bound on
+        :meth:`offset` is half of this."""
+        with self._lock:
+            if not self._samples:
+                return None
+            return min(self._samples)[0]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+
+class _RankState:
+    """One rank's windowed statistics + straggler state machine.
+
+    The digests are EPOCH-denominated: samples are added at
+    ``now=epoch``, so "window" means the last ``window_epochs`` epochs
+    this rank reported — the natural unit for a one-sample-per-epoch
+    signal (a wall-clock window would hold a fast fleet's entire
+    history in one bucket and never let a recovered straggler clear
+    until real minutes passed).  Deterministic under test for free."""
+
+    __slots__ = ("step", "phases", "barrier", "offset_s", "bad", "good",
+                 "straggler", "since_ts", "since_epoch", "last_skew",
+                 "last_epoch")
+
+    def __init__(self, window_epochs: int):
+        buckets = max(2, int(window_epochs))
+        self.step = WindowedDigest(window_epochs, buckets, quantiles=())
+        self.phases = {
+            p: WindowedDigest(window_epochs, buckets, quantiles=())
+            for p in PHASES
+        }
+        self.barrier = WindowedDigest(window_epochs, buckets,
+                                      quantiles=())
+        self.offset_s: float | None = None
+        self.bad = 0
+        self.good = 0
+        self.straggler = False
+        self.since_ts: float | None = None
+        self.since_epoch: int | None = None
+        self.last_skew = 1.0
+        self.last_epoch = -1
+
+
+class FleetMonitor:
+    """Per-rank skew aggregation at the coordinator.
+
+    ``observe_epoch`` is called by ``Coordinator.report_epoch`` with
+    each worker's epoch wall time and the phase summary it attached
+    (``EpochStats.phases`` — the same ``budget_fields`` drain
+    ``Trainer._obs_epoch`` journals).  Detection is *relative*: a
+    rank's skew is its window-mean step time over the median of its
+    PEERS' window means, so a uniformly slow fleet (bigger model, cold
+    cache) never alarms — only divergence between ranks does.
+    Hysteresis mirrors the SLO watchdog: ``hysteresis`` consecutive
+    breaching epochs to detect, the same count of clean ones to clear.
+    """
+
+    def __init__(self, *, window_epochs: int = 8,
+                 skew_threshold: float = 1.5, hysteresis: int = 2,
+                 warmup_epochs: int = 1, plane: str = "coordinator"):
+        if skew_threshold <= 1.0:
+            raise ValueError(
+                f"fleet skew threshold must be > 1 (a rank is a straggler "
+                f"when it is THAT many times its peers), got {skew_threshold}")
+        self.window_epochs = max(2, int(window_epochs))
+        self.skew_threshold = float(skew_threshold)
+        self.hysteresis = max(1, int(hysteresis))
+        # epoch 0 is compile-dominated and its wall time is whoever won
+        # the XLA race, not a data-path skew: warmup epochs neither feed
+        # the digests nor advance the streaks (feeding them would
+        # pollute the window for window_epochs MORE epochs)
+        self.warmup_epochs = max(0, int(warmup_epochs))
+        self.plane = plane
+        self._lock = threading.Lock()
+        self._ranks: dict[int, _RankState] = {}
+        self._epoch_seen: dict[int, set[int]] = {}
+        self.stragglers_total = 0
+
+    # ---- feeding (coordinator side) ----
+    def observe_epoch(self, worker: int, epoch: int, wall_s: float,
+                      phases: dict | None = None,
+                      n_workers: int | None = None) -> list[dict]:
+        """Fold one rank's epoch report in; returns the events emitted
+        (also journaled).  ``phases`` is the worker-attached
+        ``step_breakdown`` field dict (host_s/infeed_s/... totals plus
+        optional ``barrier_s``/``offset_s``)."""
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+
+        worker = int(worker)
+        if int(epoch) < self.warmup_epochs:
+            return []
+        # the digests run on the EPOCH clock (see _RankState): a sample
+        # ages out after window_epochs epochs, not wall seconds
+        now = float(int(epoch)) + 0.5
+        events: list[dict] = []
+        with self._lock:
+            rank = self._ranks.get(worker)
+            if rank is not None and int(epoch) < rank.last_epoch:
+                # epoch numbers regressed: a health rollback restarted
+                # training from a checkpoint.  The digests are indexed
+                # by epoch, so re-adding at an old epoch would RESET the
+                # ring cell holding the newest samples and poison every
+                # window mean for the next window_epochs — drop the
+                # rank's history instead (skew re-establishes within a
+                # couple of epochs) while carrying the straggler state
+                # machine across, so an open excursion still closes with
+                # a straggler_clear rather than dangling forever
+                fresh = _RankState(self.window_epochs)
+                fresh.straggler = rank.straggler
+                fresh.since_ts = rank.since_ts
+                fresh.since_epoch = rank.since_epoch
+                fresh.bad, fresh.good = rank.bad, rank.good
+                fresh.offset_s = rank.offset_s
+                rank = self._ranks[worker] = fresh
+                for e in [e for e in self._epoch_seen if e >= int(epoch)]:
+                    del self._epoch_seen[e]
+            if rank is None:
+                rank = self._ranks[worker] = _RankState(
+                    self.window_epochs)
+            wall = max(0.0, float(wall_s))
+            rank.step.add(wall, now=now)
+            rank.last_epoch = int(epoch)
+            if phases:
+                named = 0.0
+                for p in PHASES[:-1]:
+                    v = float(phases.get(f"{p}_s", 0.0) or 0.0)
+                    named += v
+                    rank.phases[p].add(v, now=now)
+                rank.phases["other"].add(max(0.0, wall - named), now=now)
+                if phases.get("barrier_s") is not None:
+                    rank.barrier.add(float(phases["barrier_s"]), now=now)
+                if phases.get("offset_s") is not None:
+                    rank.offset_s = float(phases["offset_s"])
+            # hysteretic straggler state machine for THE REPORTING rank
+            # only — each rank's streak advances once per ITS epochs,
+            # so a fleet where one rank reports twice as often cannot
+            # double-count breaches for its peers
+            skew = self._skew_locked(worker, now)
+            rank.last_skew = skew
+            if skew >= self.skew_threshold:
+                rank.bad += 1
+                rank.good = 0
+                if not rank.straggler and rank.bad >= self.hysteresis:
+                    rank.straggler = True
+                    rank.since_ts = _mono()  # wall clock: excursion length
+                    rank.since_epoch = int(epoch)
+                    self.stragglers_total += 1
+                    phase, excess = self._dominant_phase_locked(worker, now)
+                    events.append({
+                        "event": "straggler_detect",
+                        "worker": worker,
+                        "epoch": int(epoch),
+                        "skew": round(skew, 4),
+                        "threshold": self.skew_threshold,
+                        "phase": phase,
+                        "phase_excess_s": round(excess, 6),
+                        "step_s": round(self._mean_locked(worker, now)
+                                        or 0.0, 6),
+                        "fleet_step_s": round(
+                            self._peer_median_locked(worker, now) or 0.0,
+                            6),
+                        **self._barrier_attr_locked(now),
+                    })
+            else:
+                rank.good += 1
+                rank.bad = 0
+                if rank.straggler and rank.good >= self.hysteresis:
+                    rank.straggler = False
+                    events.append({
+                        "event": "straggler_clear",
+                        "worker": worker,
+                        "epoch": int(epoch),
+                        "skew": round(skew, 4),
+                        "straggler_s": round(
+                            _mono() - (rank.since_ts or _mono()), 3),
+                        "since_epoch": rank.since_epoch,
+                    })
+                    rank.since_ts = None
+                    rank.since_epoch = None
+            # quorum bookkeeping: one fleet_skew record per epoch, from
+            # whichever report completes it (or from the first report
+            # past a fleet whose size we were never told)
+            seen = self._epoch_seen.setdefault(int(epoch), set())
+            seen.add(worker)
+            quorum = (n_workers is not None
+                      and len(seen) >= int(n_workers))
+            if quorum:
+                del self._epoch_seen[int(epoch)]
+                # drop stale partial epochs a restart leapfrogged
+                for e in [e for e in self._epoch_seen if e <= int(epoch)]:
+                    del self._epoch_seen[e]
+                ranks, max_skew = self._table_locked(now)
+                events.append({
+                    "event": "fleet_skew",
+                    "epoch": int(epoch),
+                    "n_workers": int(n_workers),
+                    "max_skew": round(max_skew, 4),
+                    "straggler": self._current_straggler_locked(),
+                    "ranks": ranks,
+                })
+        for ev in events:
+            fields = {k: v for k, v in ev.items() if k != "event"}
+            if ev["event"] in ("straggler_detect", "straggler_clear"):
+                log.warning("%s: worker %s skew %.2f (epoch %s)",
+                            ev["event"], ev.get("worker"),
+                            ev.get("skew", 0.0), ev.get("epoch"))
+            obs_journal.emit(ev["event"], plane=self.plane, **fields)
+        if any(e["event"] == "fleet_skew" for e in events):
+            wd = obs_slo.active()
+            if wd is not None:
+                # the slo-straggler-skew watchdog target judges the
+                # window MAX of this signal; evaluated HERE because the
+                # coordinator is the only process that can see fleet
+                # skew (on the process launcher nothing else ticks its
+                # plane's watchdog)
+                max_skew = next(e["max_skew"] for e in events
+                                if e["event"] == "fleet_skew")
+                wd.observe("fleet_skew", max_skew)
+                wd.evaluate(epoch=int(epoch))
+        return events
+
+    # ---- math (callers hold the lock) ----
+    def _mean_locked(self, worker: int, now: float) -> float | None:
+        snap = self._ranks[worker].step.snapshot(now)
+        return None if snap is None else snap["mean"]
+
+    def _peer_median_locked(self, worker: int,
+                            now: float) -> float | None:
+        """Median of the OTHER ranks' window means — self-exclusion so
+        a 2-worker fleet's straggler cannot halve its own yardstick."""
+        means = sorted(
+            m for w, r in self._ranks.items()
+            if w != worker
+            for m in [self._mean_locked(w, now)]
+            if m is not None and m > 0
+        )
+        if not means:
+            return None
+        mid = len(means) // 2
+        if len(means) % 2:
+            return means[mid]
+        return (means[mid - 1] + means[mid]) / 2.0
+
+    def _skew_locked(self, worker: int, now: float) -> float:
+        mine = self._mean_locked(worker, now)
+        peers = self._peer_median_locked(worker, now)
+        if mine is None or peers is None or peers <= 0:
+            return 1.0
+        return mine / peers
+
+    def _dominant_phase_locked(self, worker: int,
+                               now: float) -> tuple[str, float]:
+        """The phase whose excess over the fleet's per-phase median is
+        largest — "WHERE the extra time went", not merely the biggest
+        phase (a dispatch-dominated fleet where one rank's infeed grew
+        3x must name infeed).  Falls back to the rank's own largest
+        phase when no peer has phase data."""
+        best, best_excess = "?", float("-inf")
+        own_best, own_best_v = "?", float("-inf")
+        for p in PHASES:
+            snap = self._ranks[worker].phases[p].snapshot(now)
+            if snap is None:
+                continue
+            mine = snap["mean"]
+            if mine > own_best_v:
+                own_best, own_best_v = p, mine
+            peers = sorted(
+                s["mean"]
+                for w, r in self._ranks.items()
+                if w != worker
+                for s in [r.phases[p].snapshot(now)]
+                if s is not None
+            )
+            if not peers:
+                continue
+            med = peers[len(peers) // 2]
+            excess = mine - med
+            if excess > best_excess:
+                best, best_excess = p, excess
+        if best_excess == float("-inf"):
+            return own_best, max(0.0, own_best_v)
+        return best, max(0.0, best_excess)
+
+    def _barrier_attr_locked(self, now: float) -> dict:
+        """Barrier-wait attribution: everyone waits at the epoch
+        barrier FOR the straggler, so the rank with the smallest mean
+        barrier wait is the one being waited on.  Only meaningful when
+        at least two ranks report barrier spans and they diverge."""
+        waits = {
+            w: s["mean"]
+            for w, r in self._ranks.items()
+            for s in [r.barrier.snapshot(now)]
+            if s is not None
+        }
+        if len(waits) < 2:
+            return {}
+        lo = min(waits, key=waits.get)
+        hi = max(waits.values())
+        if hi <= 0:
+            return {}
+        return {"blocked_on": lo,
+                "barrier_wait_s": round(waits[lo], 6),
+                "peer_barrier_wait_s": round(hi, 6)}
+
+    def _table_locked(self, now: float) -> tuple[dict, float]:
+        ranks: dict[str, dict] = {}
+        max_skew = 1.0
+        for w in sorted(self._ranks):
+            r = self._ranks[w]
+            mean = self._mean_locked(w, now)
+            skew = self._skew_locked(w, now)
+            max_skew = max(max_skew, skew)
+            phase, _ = self._dominant_phase_locked(w, now)
+            barrier = r.barrier.snapshot(now)
+            entry: dict[str, Any] = {
+                "step_s": round(mean or 0.0, 6),
+                "skew": round(skew, 4),
+                "phase": phase,
+                "straggler": r.straggler,
+                "epoch": r.last_epoch,
+            }
+            if barrier is not None:
+                entry["barrier_s"] = round(barrier["mean"], 6)
+            if r.offset_s is not None:
+                entry["offset_s"] = round(r.offset_s, 6)
+            ranks[str(w)] = entry
+        return ranks, max_skew
+
+    def _current_straggler_locked(self) -> int | None:
+        for w, r in self._ranks.items():
+            if r.straggler:
+                return w
+        return None
+
+    # ---- reading ----
+    def state(self) -> dict:
+        with self._lock:
+            # evaluate at the fleet's newest epoch (the digests run on
+            # the epoch clock)
+            now = max(
+                (r.last_epoch for r in self._ranks.values()),
+                default=0,
+            ) + 0.5
+            ranks, max_skew = self._table_locked(now)
+            return {
+                "ranks": ranks,
+                "max_skew": max_skew,
+                "straggler": self._current_straggler_locked(),
+                "stragglers_total": self.stragglers_total,
+            }
+
+    def render_prometheus(self, prefix: str = "stpu_") -> str:
+        """``stpu_fleet_*`` gauge text for the coordinator's scrape
+        surface.  Hand-rendered: per-rank series share one metric name
+        across ``worker=`` label values, which the one-label-set-per-
+        gauge registry cannot express."""
+        s = self.state()
+        lines = [
+            f"# TYPE {prefix}fleet_skew gauge",
+        ]
+        for w, r in s["ranks"].items():
+            lines.append(
+                f'{prefix}fleet_skew{{worker="{w}"}} {r["skew"]}')
+        lines.append(f"# TYPE {prefix}fleet_step_seconds gauge")
+        for w, r in s["ranks"].items():
+            lines.append(
+                f'{prefix}fleet_step_seconds{{worker="{w}"}} '
+                f'{r["step_s"]}')
+        offsets = {w: r["offset_s"] for w, r in s["ranks"].items()
+                   if "offset_s" in r}
+        if offsets:
+            lines.append(f"# TYPE {prefix}fleet_clock_offset_seconds "
+                         f"gauge")
+            for w, off in offsets.items():
+                lines.append(
+                    f'{prefix}fleet_clock_offset_seconds{{worker="{w}"}} '
+                    f'{off}')
+        lines.append(f"# TYPE {prefix}fleet_straggler gauge")
+        lines.append(f"{prefix}fleet_straggler "
+                     f"{-1 if s['straggler'] is None else s['straggler']}")
+        lines.append(f"# TYPE {prefix}fleet_max_skew gauge")
+        lines.append(f"{prefix}fleet_max_skew {round(s['max_skew'], 4)}")
+        lines.append(f"# TYPE {prefix}fleet_stragglers_total counter")
+        lines.append(f"{prefix}fleet_stragglers_total "
+                     f"{s['stragglers_total']}")
+        return "\n".join(lines) + "\n" + comm_text(prefix)
+
+
+# ---- collective/transfer accounting (worker side) ----
+
+class _CommStats:
+    """Process-wide bytes-moved counters per collective kind.  One dict
+    update per collective call — noise against an actual transfer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_kind: dict[str, list] = {}  # kind -> [calls, bytes]
+
+    def add(self, kind: str, nbytes: int) -> None:
+        with self._lock:
+            e = self._by_kind.get(kind)
+            if e is None:
+                self._by_kind[kind] = [1, int(nbytes)]
+            else:
+                e[0] += 1
+                e[1] += int(nbytes)
+
+    def snapshot(self, reset: bool = False) -> dict[str, dict]:
+        with self._lock:
+            out = {k: {"calls": v[0], "bytes": v[1]}
+                   for k, v in self._by_kind.items()}
+            if reset:
+                self._by_kind = {}
+            return out
+
+
+_comm = _CommStats()
+#: lifetime totals for the scrape surface (snapshot(reset) drains the
+#: per-epoch view into the journal; gauges must keep counting)
+_comm_total = _CommStats()
+
+
+def add_comm_bytes(kind: str, nbytes: int) -> None:
+    """Count one collective/transfer call's bytes moved (a static
+    estimate from the argument shapes is fine — the point is relative
+    attribution, not a NIC counter)."""
+    _comm.add(kind, nbytes)
+    _comm_total.add(kind, nbytes)
+
+
+def take_comm() -> dict[str, dict]:
+    """Drain the per-epoch comm snapshot (``Trainer._obs_epoch``
+    journals it as a ``comm`` event); lifetime gauges keep counting."""
+    return _comm.snapshot(reset=True)
+
+
+def comm_text(prefix: str = "stpu_") -> str:
+    """``stpu_fleet_comm_*`` series (lifetime totals per kind)."""
+    snap = _comm_total.snapshot()
+    if not snap:
+        return ""
+    lines = [f"# TYPE {prefix}fleet_comm_calls_total counter"]
+    for kind in sorted(snap):
+        lines.append(
+            f'{prefix}fleet_comm_calls_total{{kind="{kind}"}} '
+            f'{snap[kind]["calls"]}')
+    lines.append(f"# TYPE {prefix}fleet_comm_bytes_total counter")
+    for kind in sorted(snap):
+        lines.append(
+            f'{prefix}fleet_comm_bytes_total{{kind="{kind}"}} '
+            f'{snap[kind]["bytes"]}')
+    return "\n".join(lines) + "\n"
+
+
+@contextlib.contextmanager
+def comm_region(kind: str, nbytes: int = 0):
+    """Instrument one collective/transfer entry point: a tracer span
+    (``comm.<kind>`` — drains into the epoch's ``step_breakdown`` spans
+    like any auxiliary span), a PR-10 compile-attribution region (a
+    compile fired inside lands on the collective's name), and the
+    bytes-moved counters.  Each leg is one ``is None`` check when its
+    plane is off; with nothing installed only the byte counters run.
+
+    Counting unit: one HOST-LEVEL call.  An eager entry point (the
+    pipelined device_put, a direct ring call) counts once per step; a
+    collective invoked from inside an enclosing ``jit`` runs this
+    wrapper only while XLA TRACES, so it counts once per compile — the
+    device-side repetitions execute inside the compiled program, where
+    host instrumentation cannot see them (the same rule the PR-10
+    Pallas seams follow).  The counters are call/shape attribution, not
+    a NIC counter; per-step device comm cost under jit is the enclosing
+    observed step's wall time."""
+    from shifu_tensorflow_tpu.obs import compile as obs_compile
+    from shifu_tensorflow_tpu.obs import trace as obs_trace
+
+    if nbytes:
+        add_comm_bytes(kind, nbytes)
+    with obs_trace.span(f"comm.{kind}"):
+        with obs_compile.attribute(f"comm.{kind}"):
+            yield
+
+
+# ---- worker-side clock plumbing ----
+
+_last_offset: float | None = None
+
+
+def note_offset(offset: float | None) -> None:
+    """Record this process's current clock-offset estimate (coordinator
+    clock minus local clock).  Called by ``CoordinatorClient`` after
+    each timestamped exchange; the active Journal stamps it onto every
+    subsequent event as ``offset=`` so readers can align the fleet's
+    timelines onto the coordinator's clock."""
+    global _last_offset
+    if offset is None:
+        return
+    _last_offset = float(offset)
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    j = obs_journal.active()
+    if j is not None:
+        j.set_offset(_last_offset)
+
+
+def clock_offset() -> float | None:
+    """This process's last clock-offset estimate (None before the first
+    timestamped coordinator exchange)."""
+    return _last_offset
+
+
+# ---- process-global hook (mirrors the sibling legs) ----
+
+_active: FleetMonitor | None = None
+
+
+def install(monitor: FleetMonitor) -> FleetMonitor:
+    global _active
+    _active = monitor
+    return monitor
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> FleetMonitor | None:
+    return _active

@@ -84,6 +84,17 @@ class Journal:
         self._warned = False
         #: events dropped because the filesystem failed (diagnostics)
         self.dropped = 0
+        # clock-offset estimate (coordinator clock minus this writer's,
+        # obs/fleet.ClockSync): stamped as offset= on every event once
+        # known, so read_events/`obs trace` can render a fleet-aligned
+        # timeline (ts + offset ≈ coordinator time) while --json keeps
+        # the raw wall clock.  Plain attribute write/read: a float slot
+        # is atomic under the GIL and a torn update is impossible.
+        self._offset: float | None = None
+
+    def set_offset(self, offset: float | None) -> None:
+        """Update the writer's clock-offset estimate (None clears it)."""
+        self._offset = None if offset is None else float(offset)
 
     # ---- writing ----
     def emit(self, event: str, **fields: Any) -> None:
@@ -95,6 +106,9 @@ class Journal:
             rec["worker"] = self.worker
         if self.job is not None:
             rec["job"] = self.job
+        offset = self._offset
+        if offset is not None:
+            rec["offset"] = round(offset, 6)
         rec.update(fields)
         try:
             line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"

@@ -617,6 +617,14 @@ def from_config(cfg, *, plane: str = "train",
                  target=cfg.slo_step_time_ms, unit="ms")
         wd.track("train_infeed_frac", stat="mean",
                  target=cfg.slo_infeed_frac)
+        # fleet leg (obs/fleet.py): per-epoch MAX of per-rank relative
+        # step-time skew, fed by the coordinator's FleetMonitor on each
+        # epoch quorum — the signal ROADMAP item-3's standby-takeover /
+        # autoscaler policy consumes.  Registered on the train plane
+        # too for the thread launcher, where the coordinator and its
+        # workers share one process-wide watchdog.
+        wd.track("fleet_skew", stat="max",
+                 target=getattr(cfg, "slo_straggler_skew", 0.0))
     # device/compiler signals ride EVERY plane: the compile flight
     # recorder feeds compile_s per compilation (window MAX — one slow
     # compile is the breach, an average of fast ones is not), and the

@@ -163,11 +163,18 @@ def initialize(topology: ProcessTopology) -> None:
             f"{topology.num_processes} processes"
         )
     _pin_collective_transport(topology.local_host)
-    jax.distributed.initialize(
-        coordinator_address=topology.coordinator_address,
-        num_processes=topology.num_processes,
-        process_id=topology.process_id,
-    )
+    from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
+    # the bring-up barrier is the fleet's first collective: its wall
+    # time (everyone waits for the slowest process to dial in) lands in
+    # the span budget as comm.dist_initialize, so a slow-to-start rank
+    # is visible before the first step runs
+    with obs_fleet.comm_region("dist_initialize"):
+        jax.distributed.initialize(
+            coordinator_address=topology.coordinator_address,
+            num_processes=topology.num_processes,
+            process_id=topology.process_id,
+        )
     _initialized = True
 
 
@@ -224,10 +231,16 @@ def put_process_local(batch: dict, sharding) -> dict:
     """
     import jax
 
-    return {
-        k: jax.make_array_from_process_local_data(sharding, v)
-        for k, v in batch.items()
-    }
+    from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
+    # journaled as comm.device_put_global with the local bytes placed —
+    # the host->device leg of every SPMD step's transfer cost
+    nbytes = sum(int(getattr(v, "nbytes", 0) or 0) for v in batch.values())
+    with obs_fleet.comm_region("device_put_global", nbytes=nbytes):
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()
+        }
 
 
 def local_rows(global_array) -> "Any":

@@ -307,26 +307,52 @@ def ulysses_attention(
     return a2a(out, split_axis=1, concat_axis=2)
 
 
-def _sharded(fn, mesh, axis_name):
+def _sharded(fn, mesh, axis_name, comm_label=None):
     from shifu_tensorflow_tpu.parallel.shmap import shard_map
 
     spec = P(None, axis_name, None, None)
     return shard_map(
         fn, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        comm_label=comm_label,
     )
+
+
+def _nbytes(*arrays) -> int:
+    return sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
 
 
 def ring_attention_sharded(
     mesh, q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False
 ):
     """shard_map-wrapped ring attention: q/k/v are global (B, S, H, D)
-    arrays; S is sharded over ``axis_name`` of ``mesh``."""
+    arrays; S is sharded over ``axis_name`` of ``mesh``.
+
+    The call runs under an obs comm region (``comm.ring_attention``
+    tracer span + compile-attribution frame + bytes-moved counter): the
+    ring rotates the full K/V once per step for ``P`` steps, so the
+    static bytes-moved estimate is ``(|K| + |V|) * P`` — attribution,
+    not a NIC counter.  Counted per HOST call: eager use counts every
+    invocation; from inside an enclosing ``jit`` (the sequence model's
+    attention fn) the region runs at trace time, i.e. once per compile
+    (obs/fleet.comm_region)."""
+    from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
     fn = partial(ring_attention, axis_name=axis_name, causal=causal)
-    return _sharded(fn, mesh, axis_name)(q, k, v)
+    p = int(mesh.shape[axis_name])
+    with obs_fleet.comm_region("ring_attention",
+                               nbytes=_nbytes(k, v) * max(1, p)):
+        return _sharded(fn, mesh, axis_name, comm_label=None)(q, k, v)
 
 
 def ulysses_attention_sharded(
     mesh, q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False
 ):
+    """Ulysses all-to-all under ``comm.all_to_all``: four re-shards
+    (q/k/v in, out back), each moving ~(P-1)/P of its tensor — the
+    static estimate charges the four tensors once."""
+    from shifu_tensorflow_tpu.obs import fleet as obs_fleet
+
     fn = partial(ulysses_attention, axis_name=axis_name, causal=causal)
-    return _sharded(fn, mesh, axis_name)(q, k, v)
+    with obs_fleet.comm_region("all_to_all",
+                               nbytes=_nbytes(q, k, v) + _nbytes(q)):
+        return _sharded(fn, mesh, axis_name, comm_label=None)(q, k, v)

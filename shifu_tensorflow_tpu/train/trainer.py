@@ -42,6 +42,7 @@ from shifu_tensorflow_tpu.data.dataset import (
 from shifu_tensorflow_tpu.models.factory import build_model
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import compile as obs_compile
+from shifu_tensorflow_tpu.obs import fleet as _obs_fleet
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.ops import metrics as M
 from shifu_tensorflow_tpu.ops.losses import get_loss, l2_penalty
@@ -68,6 +69,12 @@ class EpochStats:
     global_step: int
     ks: float = 0.0
     auc: float = 0.0
+    # per-epoch step-phase summary (host/infeed/dispatch/block seconds,
+    # steps, barrier wait, clock offset) attached by Trainer._obs_epoch
+    # from the same budget_fields drain its journal gets — rides the
+    # epoch-report RPC so the coordinator's FleetMonitor can attribute
+    # skew to a phase (obs/fleet.py).  None when obs is off.
+    phases: dict | None = None
 
 
 MetricsCallback = Callable[[EpochStats], None]
@@ -467,6 +474,19 @@ class HealthGuard:
     def close(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+
+
+def _fault_lagged(batches: "Iterable[Batch]", worker_index: int):
+    """Straggler-drill chaos seam: consult the fault plan once per host
+    batch at ``train.step.w<index>`` (the `slow` kind sleeps there; an
+    exception kind raises, like any other seam).  Installed by
+    ``_train_epoch_dispatch`` only while a plan is active."""
+    from shifu_tensorflow_tpu.utils import faults
+
+    site = f"train.step.w{worker_index}"
+    for batch in batches:
+        faults.check(site)
+        yield batch
 
 
 def _unbox_params(tree):
@@ -1150,6 +1170,18 @@ class Trainer:
                                   depth=self.prefetch_depth)
 
     def _train_epoch_dispatch(self, batches: Iterable[Batch]) -> tuple[float, int]:
+        from shifu_tensorflow_tpu.utils import faults as _faults
+
+        if _faults.active() is not None:
+            # straggler-drill seam (utils/faults.py `slow` kind): one
+            # check per host batch under site train.step.w<index>, so a
+            # plan term like "train.step.w1:slow@1.0" deterministically
+            # lags exactly one rank.  Wrapped only while a plan is
+            # active — the per-step cost without one stays zero.  Placed
+            # BEFORE the tracer's wrap_iter below, so the injected sleep
+            # lands inside the host/production phase and the
+            # coordinator's dominant-phase attribution can name it.
+            batches = _fault_lagged(batches, self.worker_index)
         guard = self.health_guard
         if guard is not None:
             # instrument the stream BEFORE path dispatch: real-row
@@ -1579,6 +1611,30 @@ class Trainer:
                     global_step=stats.global_step,
                     **fields,
                 )
+            # fleet leg: attach the phase summary to the stats the epoch
+            # callback reports, so the coordinator's FleetMonitor can
+            # attribute this rank's skew to a phase without new traffic.
+            # The barrier wait rides from the PREVIOUS epoch's
+            # rpc.epoch_barrier span (this drain runs before on_epoch's
+            # barrier — the same documented one-epoch lag every
+            # auxiliary span has); the clock offset is the client's
+            # current NTP-style estimate (obs/fleet.ClockSync).
+            phases = {k: v for k, v in fields.items() if k != "spans"}
+            barrier = (fields.get("spans") or {}).get("rpc.epoch_barrier")
+            if barrier is not None:
+                phases["barrier_s"] = barrier["total_s"]
+            offset = _obs_fleet.clock_offset()
+            if offset is not None:
+                phases["offset_s"] = round(offset, 6)
+            stats.phases = phases
+            # per-epoch collective/transfer drain (ring rotations,
+            # all-to-alls, shard_map calls, global device_puts): bytes
+            # moved per kind since the last epoch, beside the comm.*
+            # spans already in this breakdown
+            comm = _obs_fleet.take_comm()
+            if comm and j is not None:
+                j.emit("comm", plane="train", worker=self.worker_index,
+                       epoch=stats.current_epoch, kinds=comm)
         if slo is not None and fields is not None:
             # per-epoch SLO signals from the same drain: mean step wall
             # time and the infeed-wait share of the epoch — evaluated

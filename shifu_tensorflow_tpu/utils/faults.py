@@ -64,6 +64,11 @@ site               where
                    callable — transient read faults at the reload path
 ``health.nan-loss.e<N>``  trainer health guard, once per training step
                    (``poll`` with the step index) — NaN-loss injection
+``train.step.w<i>``  trainer per-step loop (``check``, once per host
+                   batch; wrapped only while a plan is active) — the
+                   ``slow``/``slow<ms>`` kinds sleep here, producing a
+                   deterministically-lagged rank for the straggler
+                   drills (obs/fleet.py)
 =================  =========================================================
 """
 
@@ -103,6 +108,22 @@ _KINDS = {
 _MUTATE_KINDS = ("bitflip", "truncate")
 #: boolean flag kinds, consulted via :func:`poll`
 _FLAG_KINDS = ("nan-loss",)
+
+#: default injected lag for the bare ``slow`` kind (milliseconds)
+_SLOW_DEFAULT_MS = 50
+
+
+def _slow_ms(kind: str) -> int | None:
+    """``slow`` / ``slow<ms>`` → injected sleep in milliseconds, None
+    for any other kind.  The sleep kind fires through :func:`check` like
+    the exception kinds — same seams, same determinism — but SLEEPS
+    instead of raising: the fault being modeled is a lagging dependency
+    (straggler rank, slow disk), not a failing one."""
+    if kind == "slow":
+        return _SLOW_DEFAULT_MS
+    if kind.startswith("slow") and kind[4:].isdigit():
+        return int(kind[4:])
+    return None
 
 
 class _Term:
@@ -186,10 +207,11 @@ class FaultPlan:
             except ValueError as e:
                 raise ValueError(
                     f"bad fault term {raw!r} (want site:kind@rate)") from e
-            if not kind.isdigit() and kind not in all_kinds:
+            if (not kind.isdigit() and kind not in all_kinds
+                    and _slow_ms(kind) is None):
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {raw!r} "
-                    f"(HTTP status | {' | '.join(all_kinds)})")
+                    f"(HTTP status | slow[<ms>] | {' | '.join(all_kinds)})")
             at_step = None
             if "." not in rate_s and rate >= 2.0:
                 # bare integer >= 2: deterministic at-step trigger (fire
@@ -203,21 +225,35 @@ class FaultPlan:
         return cls(terms)
 
     def check(self, site: str) -> None:
-        """Raise the planned fault for ``site`` if a matching term fires.
-        Mutation/flag kinds never raise — they have their own entry points
-        (:meth:`mutate` / :meth:`poll`) and their counters are untouched
-        here, so one term's pattern never depends on unrelated seams."""
+        """Raise the planned fault for ``site`` if a matching term fires —
+        or SLEEP, for ``slow`` kinds (a deterministically-lagged seam,
+        the straggler drill's injection point; the sleep happens outside
+        the lock so a lagged site cannot serialize other threads'
+        checks).  Mutation/flag kinds never raise — they have their own
+        entry points (:meth:`mutate` / :meth:`poll`) and their counters
+        are untouched here, so one term's pattern never depends on
+        unrelated seams."""
+        sleep_s = 0.0
         with self._lock:
             for term in self._terms:
                 if (term.matches(site)
                         and term.kind not in _MUTATE_KINDS
                         and term.kind not in _FLAG_KINDS):
+                    ms = _slow_ms(term.kind)
+                    if ms is not None:
+                        if term._fires(None):
+                            sleep_s += ms / 1000.0
+                        continue
                     exc = term.roll(site)
                     if exc is not None:
                         log.info("injecting %s at %s (term %s:%s@%g, "
                                  "fire #%d)", type(exc).__name__, site,
                                  term.site, term.kind, term.rate, term.fired)
                         raise exc
+        if sleep_s > 0.0:
+            import time
+
+            time.sleep(sleep_s)
 
     def mutate(self, site: str, data: bytes) -> bytes:
         """Pass payload bytes through matching at-rest corruption terms."""

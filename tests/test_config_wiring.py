@@ -691,6 +691,49 @@ def test_device_obs_keys_round_trip_xml_to_dataclass(tmp_path):
         ObsConfig(slo_devmem_frac=1.5)
 
 
+def test_fleet_obs_keys_round_trip_xml_to_dataclass(tmp_path):
+    """The PR-11 fleet keys ride the same ObsConfig chain: the
+    straggler-skew watchdog target and the detect/clear threshold —
+    XML → Conf → ObsConfig → JSON bridge."""
+    import pytest
+
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "fleetobs.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.SLO_STRAGGLER_SKEW: "2.5",
+        K.FLEET_SKEW_THRESHOLD: "1.8",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.slo_straggler_skew == 2.5
+    assert cfg.fleet_skew_threshold == 1.8
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # the target reaches the watchdog signal on train/coordinator planes
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+
+    wd = slo_mod.from_config(cfg, plane="coordinator")
+    assert wd.state()["fleet_skew"]["target"] == 2.5
+    # defaults: no watchdog target, detection threshold 1.5
+    d = resolve_obs(_args(), _conf({}))
+    assert d.slo_straggler_skew == 0.0
+    assert d.fleet_skew_threshold == 1.5
+    # misconfiguration fails loudly: skew is a RATIO, 1 means balanced
+    with pytest.raises(ValueError, match="slo-straggler-skew"):
+        ObsConfig(slo_straggler_skew=0.8)
+    with pytest.raises(ValueError, match="fleet-skew-threshold"):
+        ObsConfig(fleet_skew_threshold=1.0)
+
+
 def test_obs_keys_reach_worker_config_bridge():
     """run_multi ships the resolved ObsConfig to subprocess workers via
     WorkerConfig.obs (JSON bridge) — and omits it entirely when obs is

@@ -685,3 +685,308 @@ def test_unhealthy_non_spmd_directive_does_not_leak_to_peers():
     assert rc["health"]["lr_scale"] == pytest.approx(1.0)
     assert rc["health"]["skip"] is None
     coord.liveness.stop()
+
+
+# ---- fleet skew observability (obs/fleet.py, PR 11) ----
+
+def _phases(host=0.0, infeed=0.0, dispatch=0.0, block=0.0, steps=4,
+            barrier=None, offset=None):
+    d = {"host_s": host, "infeed_s": infeed, "dispatch_s": dispatch,
+         "block_s": block, "steps": steps}
+    if barrier is not None:
+        d["barrier_s"] = barrier
+    if offset is not None:
+        d["offset_s"] = offset
+    return d
+
+
+def test_fleet_monitor_detects_names_phase_and_clears():
+    """Skew-digest aggregation unit: rank 1 runs 3x its peer with the
+    excess in infeed -> straggler_detect names rank 1 + infeed after the
+    hysteresis; parity restored -> straggler_clear once the slow epochs
+    age out of the (epoch-denominated) window."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=2, window_epochs=4,
+                       warmup_epochs=0)
+    events = []
+    for epoch in range(4):
+        events += mon.observe_epoch(
+            0, epoch, 1.0,
+            phases=_phases(host=0.1, infeed=0.2, dispatch=0.5, block=0.1),
+            n_workers=2)
+        events += mon.observe_epoch(
+            1, epoch, 3.0,
+            phases=_phases(host=0.1, infeed=2.2, dispatch=0.5, block=0.1),
+            n_workers=2)
+    det = [e for e in events if e["event"] == "straggler_detect"]
+    assert len(det) == 1  # hysteretic: one transition, not one per epoch
+    assert det[0]["worker"] == 1
+    assert det[0]["phase"] == "infeed"
+    assert det[0]["skew"] == pytest.approx(3.0)
+    # one fleet_skew record per QUORUM epoch, naming the straggler
+    fs = [e for e in events if e["event"] == "fleet_skew"]
+    assert len(fs) == 4
+    assert fs[-1]["straggler"] == 1
+    assert fs[-1]["ranks"]["1"]["straggler"] is True
+    # recovery: parity for long enough that the slow samples age out
+    for epoch in range(4, 12):
+        events += mon.observe_epoch(0, epoch, 1.0, n_workers=2)
+        events += mon.observe_epoch(1, epoch, 1.0, n_workers=2)
+    clr = [e for e in events if e["event"] == "straggler_clear"]
+    assert len(clr) == 1 and clr[0]["worker"] == 1
+    assert clr[0]["since_epoch"] == det[0]["epoch"]
+    assert mon.state()["straggler"] is None
+
+
+def test_fleet_monitor_rollback_epoch_regression_resets_history():
+    """Epoch numbers regress after a health rollback: the epoch-indexed
+    digests must drop their history (re-adding at an old epoch would
+    clobber the ring cell holding the newest samples and poison every
+    window mean) and re-establish skew cleanly — no spurious detect."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=2, warmup_epochs=0)
+    events = []
+    for epoch in range(10):
+        for w in (0, 1):
+            events += mon.observe_epoch(w, epoch, 1.0, n_workers=2)
+    assert not [e for e in events if e["event"] == "straggler_detect"]
+    # rollback: the fleet re-reports from epoch 2 at the same parity
+    for epoch in range(2, 8):
+        for w in (0, 1):
+            events += mon.observe_epoch(w, epoch, 1.0, n_workers=2)
+    assert not [e for e in events if e["event"] == "straggler_detect"]
+    st = mon.state()
+    assert st["ranks"]["0"]["skew"] == pytest.approx(1.0)
+    assert st["ranks"]["1"]["skew"] == pytest.approx(1.0)
+    # and a rank that comes back genuinely slow after the rollback is
+    # still caught by the re-established window
+    for epoch in range(8, 12):
+        events += mon.observe_epoch(0, epoch, 1.0, n_workers=2)
+        events += mon.observe_epoch(1, epoch, 4.0, n_workers=2)
+    det = [e for e in events if e["event"] == "straggler_detect"]
+    assert det and det[0]["worker"] == 1
+
+
+def test_fleet_monitor_uniformly_slow_fleet_never_alarms():
+    """Skew is RELATIVE: the whole fleet slowing down together (bigger
+    model, cold cache) is not a straggler."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=1, warmup_epochs=0)
+    events = []
+    for epoch in range(8):
+        wall = 0.5 * 1.2 ** epoch  # every epoch slower than the last
+        for w in (0, 1, 2):
+            events += mon.observe_epoch(w, epoch, wall, n_workers=3)
+    assert not [e for e in events if e["event"] == "straggler_detect"]
+
+
+def test_fleet_monitor_warmup_epochs_ignore_compile_skew():
+    """Epoch 0 is compile-dominated: whoever lost the XLA race looks
+    10x slow.  Warmup epochs must neither alarm nor pollute the window."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=1)  # warmup 1
+    events = mon.observe_epoch(0, 0, 0.1, n_workers=2)
+    events += mon.observe_epoch(1, 0, 20.0, n_workers=2)  # compiling
+    assert events == []
+    for epoch in (1, 2):
+        events += mon.observe_epoch(0, epoch, 0.1, n_workers=2)
+        events += mon.observe_epoch(1, epoch, 0.1, n_workers=2)
+    assert not [e for e in events if e["event"] == "straggler_detect"]
+    # the compile epoch never entered the digests
+    assert mon.state()["ranks"]["1"]["skew"] == pytest.approx(1.0)
+
+
+def test_fleet_monitor_barrier_attribution_points_at_straggler():
+    """The rank everyone else step.blocks on is the one with the
+    SMALLEST barrier wait — the inverse signal of the skew itself."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=1, warmup_epochs=0)
+    events = []
+    for epoch in range(3):
+        # rank 0 and 2 wait 2s at the barrier FOR rank 1, which waits ~0
+        events += mon.observe_epoch(
+            0, epoch, 1.0, phases=_phases(dispatch=0.9, barrier=2.0),
+            n_workers=3)
+        events += mon.observe_epoch(
+            2, epoch, 1.0, phases=_phases(dispatch=0.9, barrier=2.1),
+            n_workers=3)
+        events += mon.observe_epoch(
+            1, epoch, 3.0, phases=_phases(dispatch=2.9, barrier=0.01),
+            n_workers=3)
+    det = next(e for e in events if e["event"] == "straggler_detect")
+    assert det["worker"] == 1
+    assert det["blocked_on"] == 1
+    assert det["barrier_wait_s"] == pytest.approx(0.01, rel=0.1)
+
+
+def test_report_epoch_feeds_fleet_monitor_and_metrics_op(tmp_path):
+    """The coordinator wires workers' attached phase summaries into the
+    installed FleetMonitor; straggler events land in the journal and the
+    metrics op exposes stpu_fleet_* plus per-worker heartbeat ages."""
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs.journal import Journal, read_events
+
+    base = str(tmp_path / "coord.jsonl")
+    journal_mod.install(Journal(base, plane="coordinator"))
+    fleet_mod.install(fleet_mod.FleetMonitor(skew_threshold=1.5,
+                                             hysteresis=1,
+                                             warmup_epochs=0))
+    coord = Coordinator(_spec(2))
+    try:
+        coord.register("a", 0, host="h")
+        coord.register("b", 1, host="h")
+        for epoch in range(2):
+            for w, wall in ((0, 0.1), (1, 0.7)):
+                s = _stats(w, epoch)
+                s.training_time_s = wall
+                s.phases = _phases(host=wall * 0.8, dispatch=wall * 0.1,
+                                   offset=0.001 * (w + 1))
+                coord.report_epoch(s.__dict__)
+        text = coord.metrics_text()
+        assert 'stpu_fleet_skew{worker="1"}' in text
+        assert 'stpu_coord_heartbeat_age_seconds{worker="0"}' in text
+        assert 'stpu_coord_heartbeat_age_seconds{worker="1"}' in text
+        assert "stpu_fleet_straggler 1" in text
+        assert 'stpu_fleet_clock_offset_seconds{worker="1"} 0.002' in text
+    finally:
+        coord.liveness.stop()
+        journal_mod.uninstall()
+        fleet_mod.uninstall()
+    events = read_events(base)
+    det = [e for e in events if e["event"] == "straggler_detect"]
+    assert det and det[0]["worker"] == 1 and det[0]["plane"] == "coordinator"
+    assert [e for e in events if e["event"] == "fleet_skew"]
+
+
+def test_slow_fault_kind_sleeps_deterministically():
+    """utils/faults `slow` kind: fires by the same seeded/at-step rules
+    as every other term, but SLEEPS instead of raising."""
+    import time as _time
+
+    from shifu_tensorflow_tpu.utils import faults
+
+    plan = faults.FaultPlan.parse(
+        "train.step.w1:slow50@1.0,other.site:slow@1.0")
+    t0 = _time.perf_counter()
+    plan.check("train.step.w1")
+    lagged = _time.perf_counter() - t0
+    assert lagged >= 0.045
+    # rank 0's site does not match: no sleep
+    t0 = _time.perf_counter()
+    plan.check("train.step.w0")
+    assert _time.perf_counter() - t0 < 0.02
+    assert plan.fired()["train.step.w1:slow50"] == 1
+    # at-step trigger: fires exactly once, at the Nth matching check
+    plan2 = faults.FaultPlan.parse("train.step:slow50@2")
+    t0 = _time.perf_counter()
+    plan2.check("train.step.w0")  # check 1: no fire
+    assert _time.perf_counter() - t0 < 0.02
+    t0 = _time.perf_counter()
+    plan2.check("train.step.w0")  # check 2: fires
+    assert _time.perf_counter() - t0 >= 0.045
+    plan2.check("train.step.w0")  # never again
+    assert plan2.fired()["train.step:slow50"] == 1
+    with pytest.raises(ValueError, match="slow"):
+        faults.FaultPlan.parse("a:slowly@0.5")
+
+
+@pytest.mark.parametrize("inject", [True, False],
+                         ids=["slow-rank-1", "control"])
+def test_two_worker_straggler_drill(psv_dataset, tmp_path,
+                                    job_model_config, inject):
+    """The acceptance drill: a 2-worker thread fleet with a `slow` fault
+    plan lagging rank 1's first epochs -> straggler_detect names rank 1
+    with a host/infeed dominant phase, then straggler_clear once the lag
+    stops and the slow epochs age out of the window; `obs fleet`
+    reconstructs the excursion from the dead fleet's files alone.  The
+    control arm (no plan) journals no straggler events."""
+    import json as _json
+    import subprocess as _subprocess
+    import sys as _sys
+
+    from shifu_tensorflow_tpu.obs import ObsConfig, install_obs
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+    from shifu_tensorflow_tpu.obs import trace as trace_mod
+    from shifu_tensorflow_tpu.obs.journal import read_events
+    from shifu_tensorflow_tpu.utils import faults
+
+    base = str(tmp_path / "drill.jsonl")
+    epochs = 16
+    schema = RecordSchema(
+        feature_columns=tuple(psv_dataset["feature_cols"]),
+        target_column=psv_dataset["target_col"],
+        weight_column=psv_dataset["weight_col"],
+    )
+    obs_cfg = ObsConfig(enabled=True, journal_path=base)
+
+    def make(worker_id, addr):
+        return WorkerConfig(
+            worker_id=worker_id,
+            coordinator_host=addr[0],
+            coordinator_port=addr[1],
+            model_config=job_model_config,
+            schema=schema,
+            batch_size=100,
+            heartbeat_interval_s=0.2,
+            obs=obs_cfg.to_json(),
+        )
+
+    if inject:
+        # deterministic lag: rank 1's host batches 2..13 (4 train
+        # steps/epoch at batch 100 over its ~400-row train split) each
+        # sleep 120ms via at-step triggers — epochs 1-3 run ~10x slow
+        # (epoch 0 is warmup either way), everything after runs at
+        # parity, so the clear leg is part of the same run
+        plan = ",".join(f"train.step.w1:slow120@{n}" for n in range(2, 14))
+        faults.set_plan(faults.FaultPlan.parse(plan))
+    try:
+        install_obs(obs_cfg, plane="coordinator", job="drill")
+        spec = make_job_spec(psv_dataset["root"], 2, epochs=epochs,
+                             registration_timeout_s=20.0)
+        sub = JobSubmitter(spec, make)
+        result = sub.run(timeout_s=180.0)
+        assert result.state == JobState.FINISHED, result.failure_reason
+    finally:
+        faults.set_plan(None)
+        journal_mod.uninstall()
+        trace_mod.uninstall()
+        slo_mod.uninstall()
+        fleet_mod.uninstall()
+
+    events = read_events(base)
+    det = [e for e in events if e["event"] == "straggler_detect"]
+    clr = [e for e in events if e["event"] == "straggler_clear"]
+    if not inject:
+        # control arm: parity fleet, no alarms
+        assert det == [] and clr == []
+        return
+    assert det, "slow rank never detected"
+    assert det[0]["worker"] == 1
+    # the sleep lands in host-batch production: consumer-visible as the
+    # host phase (unthreaded) or the infeed wait (pipelined put thread)
+    assert det[0]["phase"] in ("host", "infeed")
+    assert det[0]["skew"] >= 1.5
+    assert clr, "straggler never cleared after the lag stopped"
+    assert clr[0]["worker"] == 1
+    assert clr[0]["epoch"] > det[0]["epoch"]
+    # workers journaled their clock offsets (loopback: sub-second)
+    offs = [e["offset"] for e in events if "offset" in e]
+    assert offs and all(abs(o) < 1.0 for o in offs)
+    # the dead-fleet CLI reconstructs the excursion, jax-free
+    out = _subprocess.run(
+        [_sys.executable, "-m", "shifu_tensorflow_tpu.obs", "fleet",
+         "--journal", base, "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = _json.loads(out.stdout)
+    exc = doc["excursions"][0]
+    assert exc["worker"] == 1 and exc["clear_epoch"] is not None

@@ -32,9 +32,11 @@ def _clean_obs_hooks():
     yield
     trace_mod.uninstall()
     journal_mod.uninstall()
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
     from shifu_tensorflow_tpu.obs import slo as slo_mod
 
     slo_mod.uninstall()
+    fleet_mod.uninstall()
 
 
 # ---- registry ----
@@ -930,3 +932,256 @@ def test_run_worker_does_not_clobber_shared_process_obs(tmp_path):
     tm.uninstall()
     ev = read_events(base)[0]
     assert ev["plane"] == "train" and ev["worker"] == 1
+
+
+# ---- fleet leg: clock sync, journal offsets, comm spans, CLI ----
+
+def test_clock_sync_symmetric_exchange_recovers_offset():
+    from shifu_tensorflow_tpu.obs.fleet import ClockSync
+
+    cs = ClockSync()
+    # frozen clocks: server 5s AHEAD, 10ms symmetric network legs, 2s of
+    # server processing (a barrier hold) — processing must cancel exactly
+    assert cs.offset() is None
+    cs.update(t0=100.0, t1=105.010, t2=107.010, t3=102.020)
+    assert cs.offset() == pytest.approx(5.0, abs=1e-9)
+    assert cs.delay() == pytest.approx(0.020, abs=1e-9)
+
+
+def test_clock_sync_asymmetric_latency_error_bounded_by_half_delay():
+    from shifu_tensorflow_tpu.obs.fleet import ClockSync
+
+    cs = ClockSync()
+    # request leg 10ms, reply leg 50ms: the symmetric assumption is off
+    # by (50-10)/2 = 20ms — exactly the NTP bound delay/2 = 30ms
+    cs.update(t0=100.0, t1=105.010, t2=105.010, t3=100.060)
+    err = abs(cs.offset() - 5.0)
+    assert err <= cs.delay() / 2 + 1e-12
+    assert err == pytest.approx(0.020, abs=1e-9)
+    # a later LOW-delay exchange wins over the congested one
+    cs.update(t0=200.0, t1=205.001, t2=205.001, t3=200.002)
+    assert cs.offset() == pytest.approx(5.0, abs=1e-3)
+    assert cs.delay() == pytest.approx(0.002, abs=1e-9)
+
+
+def test_clock_sync_rejects_garbage_and_resets():
+    from shifu_tensorflow_tpu.obs.fleet import ClockSync
+
+    cs = ClockSync()
+    assert cs.update(1.0, None, 2.0, 3.0) is None
+    assert cs.update(10.0, 5.0, 4.0, 11.0) is None  # t2 < t1
+    assert cs.offset() is None
+    cs.update(100.0, 105.0, 105.0, 100.1)
+    assert cs.offset() is not None
+    # worker restart semantics: a fresh estimator has no carry-over
+    cs.reset()
+    assert cs.offset() is None and cs.delay() is None
+
+
+def test_client_clock_resets_with_the_client():
+    """A relaunched worker builds a fresh CoordinatorClient; its clock
+    estimate must not survive the process whose clock it described."""
+    from shifu_tensorflow_tpu.coordinator.coordinator import (
+        CoordinatorClient,
+    )
+
+    c1 = CoordinatorClient("127.0.0.1", 1)
+    c1.clock.update(100.0, 105.0, 105.0, 100.1)
+    assert c1.clock_offset() is not None
+    c2 = CoordinatorClient("127.0.0.1", 1)
+    assert c2.clock_offset() is None
+
+
+def test_journal_stamps_offset_once_known(tmp_path):
+    base = str(tmp_path / "off.jsonl")
+    j = Journal(base, plane="train", worker=1)
+    j.emit("before")
+    j.set_offset(0.125)
+    j.emit("after")
+    j.set_offset(None)
+    j.emit("cleared")
+    j.close()
+    evs = read_events(base)
+    assert "offset" not in evs[0]
+    assert evs[1]["offset"] == pytest.approx(0.125)
+    assert "offset" not in evs[2]
+
+
+def test_note_offset_reaches_active_journal(tmp_path):
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
+
+    base = str(tmp_path / "noted.jsonl")
+    journal_mod.install(Journal(base, plane="train", worker=0))
+    fleet_mod.note_offset(0.25)
+    assert fleet_mod.clock_offset() == pytest.approx(0.25)
+    journal_mod.emit("ev", plane="train")
+    journal_mod.uninstall()
+    assert read_events(base)[0]["offset"] == pytest.approx(0.25)
+
+
+def test_comm_region_records_span_bytes_and_epoch_drain():
+    from shifu_tensorflow_tpu.obs import fleet as fleet_mod
+
+    t = trace_mod.install(Tracer(worker_index=0))
+    fleet_mod.take_comm()  # drain residue other tests' collectives left
+    with fleet_mod.comm_region("ring_attention", nbytes=1024):
+        pass
+    with fleet_mod.comm_region("ring_attention", nbytes=1024):
+        pass
+    summ = t.summary()
+    assert summ["comm.ring_attention"]["count"] == 2
+    drained = fleet_mod.take_comm()
+    assert drained["ring_attention"] == {"calls": 2, "bytes": 2048}
+    # the per-epoch drain resets; the scrape-surface totals do not
+    # (process-lifetime counters — assert presence, not a value other
+    # tests' collectives would shift)
+    assert fleet_mod.take_comm() == {}
+    assert 'fleet_comm_bytes_total{kind="ring_attention"}' in \
+        fleet_mod.comm_text()
+
+
+def test_shard_map_calls_run_under_comm_region():
+    import jax.numpy as jnp
+
+    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+    from shifu_tensorflow_tpu.parallel.shmap import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    t = trace_mod.install(Tracer(worker_index=0))
+    mesh = make_mesh("data:-1")
+
+    def double(x):
+        return x * 2
+
+    fn = shard_map(double, mesh, in_specs=(P("data"),), out_specs=P("data"))
+    out = fn(jnp.ones((8, 2)))
+    assert out.shape == (8, 2)
+    assert "comm.shmap.double" in t.summary()
+    # call sites that run their own comm region can opt out
+    bare = shard_map(double, mesh, in_specs=(P("data"),),
+                     out_specs=P("data"), comm_label=None)
+    t.take_summary()
+    bare(jnp.ones((8, 2)))
+    assert "comm.shmap.double" not in t.summary()
+
+
+def _write_fleet_journal(tmp_path):
+    base = str(tmp_path / "fleet.jsonl")
+    j = Journal(base, plane="coordinator")
+    j.emit("register", worker=0)
+    j.emit("straggler_detect", worker=1, epoch=2, skew=2.5,
+           phase="infeed", step_s=0.9, fleet_step_s=0.36, threshold=1.5)
+    j.emit("fleet_skew", epoch=2, n_workers=2, max_skew=2.5, straggler=1,
+           ranks={"0": {"step_s": 0.36, "skew": 0.4, "phase": "dispatch",
+                        "straggler": False, "epoch": 2,
+                        "offset_s": 0.0001},
+                  "1": {"step_s": 0.9, "skew": 2.5, "phase": "infeed",
+                        "straggler": True, "epoch": 2, "barrier_s": 0.01,
+                        "offset_s": -0.002}})
+    j.emit("comm", plane="train", worker=1, epoch=2,
+           kinds={"ring_attention": {"calls": 4, "bytes": 4096}})
+    j.emit("straggler_clear", worker=1, epoch=7, skew=1.1,
+           straggler_s=12.5, since_epoch=2)
+    j.close()
+    return base
+
+
+def test_obs_cli_fleet_renders_table_and_excursions(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main
+
+    base = _write_fleet_journal(tmp_path)
+    assert main(["fleet", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "fleet skew" in out
+    assert "STRAGGLER" in out or "straggler: worker 1" in out
+    assert "infeed" in out
+    assert "ring_attention" in out
+    # machine-readable: excursion carries detect AND clear coordinates
+    assert main(["fleet", "--journal", base, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    exc = doc["excursions"][0]
+    assert exc["worker"] == 1 and exc["phase"] == "infeed"
+    assert exc["clear_epoch"] == 7 and exc["straggler_s"] == 12.5
+    assert doc["ranks"]["1"]["skew"] == 2.5
+    assert doc["comm"]["ring_attention"]["bytes"] == 4096
+
+
+def test_obs_cli_fleet_clean_miss(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main
+
+    base = str(tmp_path / "empty.jsonl")
+    j = Journal(base, plane="train")
+    j.emit("worker_start", worker=0)
+    j.close()
+    assert main(["fleet", "--journal", base]) == 1
+    assert "no fleet events" in capsys.readouterr().out
+
+
+def test_obs_cli_top_renders_fleet_panel(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main
+
+    base = _write_fleet_journal(tmp_path)
+    assert main(["top", "--once", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "fleet" in out
+    assert "STRAGGLER" in out
+
+
+def test_obs_cli_summary_renders_fleet_section(tmp_path, capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main
+
+    base = _write_fleet_journal(tmp_path)
+    assert main(["summary", "--journal", base]) == 0
+    assert "fleet skew" in capsys.readouterr().out
+    assert main(["summary", "--journal", base, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fleet"]["ranks"]["1"]["straggler"] is True
+
+
+def test_obs_cli_trace_renders_offset_aligned(tmp_path, capsys):
+    """Two writers whose wall clocks disagree by 10s: the raw merge
+    interleaves wrong, the offset-aligned trace restores causality —
+    and --json preserves the raw clocks untouched."""
+    import time as _time
+
+    from shifu_tensorflow_tpu.obs.__main__ import main
+
+    base = str(tmp_path / "aligned.jsonl")
+    now = _time.time()
+    coord = Journal(base, plane="coordinator")
+    # worker 1's clock runs 10s BEHIND the coordinator: offset=+10
+    w1 = Journal(base + ".w1", plane="train", worker=1)
+    w1.set_offset(10.0)
+    # hand-build timestamps: the coordinator publishes the epoch at
+    # now+1; the worker's step_breakdown happened at now+0.5 REAL time
+    # but its skewed clock wrote now-9.5
+    coord._file = None  # force open at emit
+    import json as _json
+    import os as _os
+
+    def raw(journal_path, rec):
+        with open(journal_path, "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+
+    raw(base, {"ts": now + 1.0, "seq": 0, "event": "epoch_summary",
+               "plane": "coordinator", "epoch": 3})
+    raw(base + ".w1", {"ts": now - 9.5, "seq": 0, "event":
+                       "step_breakdown", "plane": "train", "worker": 1,
+                       "epoch": 3, "offset": 10.0, "steps": 4})
+    coord.close()
+    w1.close()
+    assert main(["trace", "1:3", "--journal", base]) == 0
+    out = capsys.readouterr().out
+    assert "offset-aligned" in out
+    # aligned: the worker event (+0.5) renders BEFORE the coordinator's
+    # (+1.0) despite its raw ts sorting 10.5s earlier
+    lines = [ln for ln in out.splitlines() if "+" in ln]
+    bd = next(i for i, ln in enumerate(lines) if "step_breakdown" in ln)
+    es = next(i for i, ln in enumerate(lines) if "epoch_summary" in ln)
+    assert bd < es
+    assert main(["trace", "1:3", "--journal", base, "--json"]) == 0
+    docs = [json.loads(ln) for ln in
+            capsys.readouterr().out.splitlines()]
+    w1_ev = next(d for d in docs if d["event"] == "step_breakdown")
+    assert w1_ev["ts"] == pytest.approx(now - 9.5)  # raw clock preserved
+    assert w1_ev["offset"] == 10.0

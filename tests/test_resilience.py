@@ -691,7 +691,16 @@ def test_register_duplicate_delivery_replays_cached_response():
            "host": "h1", "jax_port": None, "token": "tok-reg-1"}
     r1 = coord.dispatch(dict(msg))
     r2 = coord.dispatch(dict(msg))
-    assert r1 == r2
+
+    # the replay is byte-identical MINUS the clock stamps, which
+    # describe each delivery's own exchange (obs/fleet.ClockSync must
+    # never estimate an offset from the ORIGINAL delivery's times)
+    def unstamped(r):
+        return {k: v for k, v in r.items()
+                if k not in ("srv_ts", "srv_recv_ts")}
+
+    assert unstamped(r1) == unstamped(r2)
+    assert r1["srv_ts"] <= r2["srv_ts"]
     assert r1["worker_index"] == 0
     assert coord.status()["registered"] == 1
     assert coord.op_replays == 1

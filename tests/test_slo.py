@@ -247,20 +247,24 @@ def test_from_config_registers_plane_signals():
     assert set(serve.state()) == {"serve_p99_s", "serve_shed_rate"} | device
     assert serve.state()["serve_p99_s"]["target"] == pytest.approx(0.25)
     assert serve.hysteresis == 3 and serve.window_s == 30.0
+    # the fleet leg (PR 11) adds the straggler-skew signal on the
+    # train/coordinator planes (fed by the coordinator's FleetMonitor)
+    train_set = {"train_step_ms", "train_infeed_frac",
+                 "fleet_skew"} | device
     train = slo_mod.from_config(cfg, plane="train")
-    assert set(train.state()) == {"train_step_ms",
-                                  "train_infeed_frac"} | device
+    assert set(train.state()) == train_set
     assert train.state()["train_step_ms"]["target"] == 50.0
     # epoch-level samples: the step-time stat is a windowed mean, not a
     # per-step p99 the aggregate tracer cannot provide
     assert train.state()["train_step_ms"]["stat"] == "mean"
+    # one slow rank is the breach, not the fleet's average skew
+    assert train.state()["fleet_skew"]["stat"] == "max"
     # the coordinator plane registers the train signals too — on the
     # thread launcher its process HOSTS the trainers, which pick this
     # watchdog up via slo.active(); without them the configured train
     # targets would be silently dead
     coord = slo_mod.from_config(cfg, plane="coordinator")
-    assert set(coord.state()) == {"train_step_ms",
-                                  "train_infeed_frac"} | device
+    assert set(coord.state()) == train_set
     assert coord.state()["train_step_ms"]["target"] == 50.0
 
 
