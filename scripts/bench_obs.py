@@ -234,6 +234,50 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
         j.set_offset(cs.offset())
     clock_update_us = (time.perf_counter() - t0) / m2 * 1e6
     j.close()
+    # data leg (PR 12): sketch_tap = what the TRAIN STEP PATH actually
+    # pays per sampled ingest block — a bounded strided row copy + a
+    # queue append (TrainDataSketch.add_block; the fold itself runs on
+    # the folder thread, deliberately OFF the streaming path so sketch
+    # work can never read as per-rank step skew to the fleet monitor).
+    # sketch_fold = the background fold of one default-sized block and
+    # drift_evaluate = one monitor tick (serve SLO loop) — both
+    # reported for visibility, neither on the train-step headline.
+    from shifu_tensorflow_tpu.obs.datastats import (
+        DataDriftMonitor,
+        DataSketch,
+        TrainDataSketch,
+    )
+
+    block = np.random.default_rng(0).normal(
+        size=(1 << 16, 30)).astype(np.float32)
+    batches_per_block = (1 << 16) // BATCH
+    tap = TrainDataSketch()
+    tap.add_block(block)  # thread start out of the timed loop
+    m3 = 50
+    t0 = time.perf_counter()
+    for _ in range(m3):
+        tap.add_block(block)
+    sketch_tap_us = (time.perf_counter() - t0) / m3 * 1e6
+    tap._flush()
+    sk = DataSketch()
+    sk.add_batch(block)  # allocation out of the timed loop
+    m3 = 30
+    t0 = time.perf_counter()
+    for _ in range(m3):
+        sk.add_batch(block)
+    sketch_add_us = (time.perf_counter() - t0) / m3 * 1e6
+    base_sk = DataSketch()
+    for i in range(0, 8192, 512):
+        base_sk.add_batch(np.random.default_rng(i).normal(
+            size=(512, 30)).astype(np.float32))
+    mon = DataDriftMonitor(window_s=60.0)
+    mon.register("bench", base_sk.snapshot())
+    mon.observe("bench", np.random.default_rng(1).normal(
+        size=(256, 30)).astype(np.float32))
+    t0 = time.perf_counter()
+    for _ in range(m2):
+        mon.evaluate()
+    drift_evaluate_us = (time.perf_counter() - t0) / m2 * 1e6
     per_epoch_total = (per_epoch_us + mem_snapshot_us + tick_us
                        + fleet_observe_us + clock_update_us)
     return {
@@ -246,7 +290,17 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
         "storm_tick_us": tick_us,
         "fleet_observe_us": fleet_observe_us,
         "clock_update_us": clock_update_us,
+        "sketch_tap_us": sketch_tap_us,
+        "sketch_fold_us": sketch_add_us,
+        "sketch_batches_per_block": batches_per_block,
+        "drift_evaluate_us": drift_evaluate_us,
+        # the train tap fires once per INGEST BLOCK, not per step: the
+        # measured copy+enqueue amortizes over the batches the block
+        # contains.  The fold runs on the folder thread and the serve
+        # pack tap on the pack thread — both off the step path, and the
+        # WindowedDataSketch cell cap bounds serve work per window.
         "total_us": (per_step_us + digest_us + rid_us + compile_site_us
+                     + sketch_tap_us / max(1, batches_per_block)
                      + per_epoch_total / max(1, steps_per_epoch)),
     }
 
@@ -337,6 +391,16 @@ def main() -> int:
             # RPC — both per-epoch, amortized like the journal write
             "fleet_observe": round(micro["fleet_observe_us"], 2),
             "clock_update": round(micro["clock_update_us"], 3),
+            # data leg (PR 12): sketch_tap = the step path's cost per
+            # SAMPLED BLOCK (bounded row copy + enqueue, amortized over
+            # batches_per_block in the headline); sketch_fold = the
+            # folder THREAD's fold of that block and drift_evaluate =
+            # the serve SLO tick's evaluation — both off the step path
+            # by construction, reported but not gated here.
+            "sketch_tap": round(micro["sketch_tap_us"], 1),
+            "sketch_fold": round(micro["sketch_fold_us"], 1),
+            "sketch_batches_per_block": micro["sketch_batches_per_block"],
+            "drift_evaluate": round(micro["drift_evaluate_us"], 1),
         },
         "micro_pct_of_median_step": round(micro_pct, 3),
         "pair_ratio_p10_p50_p90": [

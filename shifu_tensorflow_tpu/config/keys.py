@@ -379,6 +379,21 @@ DEFAULT_SLO_STRAGGLER_SKEW = 0.0
 # uniformly slow fleet never alarms.
 FLEET_SKEW_THRESHOLD = TPU_PREFIX + "fleet-skew-threshold"
 DEFAULT_FLEET_SKEW_THRESHOLD = 1.5
+# data leg (obs/datastats.py).  slo-data-drift: watchdog target on the
+# window MAX of per-model drift scores (live windowed feature sketch vs
+# the bundle-shipped feature_stats.json baseline); 0 = no target — the
+# per-feature data_drift/data_drift_clear events below still fire.
+SLO_DATA_DRIFT = TPU_PREFIX + "slo-data-drift"
+DEFAULT_SLO_DATA_DRIFT = 0.0
+# per-feature drift detection threshold: a feature whose drift score
+# (max of mean/std/quantile displacement in baseline-spread units and
+# 4x the missing/inf-rate deltas) holds at or above this for
+# slo-hysteresis consecutive evaluations journals data_drift naming the
+# model, feature, and offending statistic; data_drift_clear on the same
+# count of clean evaluations.  1.0 ≈ "the live mean moved one baseline
+# sigma" — a real shift, not batch noise.
+DATA_DRIFT_THRESHOLD = TPU_PREFIX + "data-drift-threshold"
+DEFAULT_DATA_DRIFT_THRESHOLD = 1.0
 
 # ---- transient-fault retry envelope (utils/retry.py) ----
 # The reference inherited retry from YARN/ZooKeeper/DFSClient; our stdlib

@@ -193,6 +193,11 @@ class Column:
     column_type: str = "N"  # N numeric | C categorical
     mean: float = 0.0
     stddev: float = 1.0
+    #: whether columnStats actually carried mean/stdDev — the 0.0/1.0
+    #: above are then REAL statistics, not the silent substitution a
+    #: half-populated ColumnConfig would otherwise smuggle into ZSCALE
+    #: normalization (zscale_stats warns + journals when False)
+    has_stats: bool = True
 
     @property
     def is_target(self) -> bool:
@@ -224,6 +229,11 @@ class ColumnConfig:
                     column_type=str(c.get("columnType", "N")),
                     mean=float(stats.get("mean") or 0.0),
                     stddev=float(stats.get("stdDev") or 1.0),
+                    # stdDev=0.0 parses to the SUBSTITUTED 1.0 above
+                    # (the "or" swallows it), so zero-std counts as
+                    # unusable here — zscale_stats warns for it too
+                    has_stats=(stats.get("mean") is not None
+                               and bool(stats.get("stdDev"))),
                 )
             )
         return cls(columns=tuple(cols))
@@ -273,8 +283,63 @@ class ColumnConfig:
             (by_num[n].stddev if n in by_num and by_num[n].stddev else 1.0)
             for n in column_nums
         ]
+        # columns the ZSCALE constants are SUBSTITUTED for rather than
+        # computed: absent from ColumnConfig entirely, present with an
+        # empty/partial columnStats, or carrying stdDev=0.0 (which the
+        # std list above silently replaces with 1.0 — same substitution,
+        # different disguise).  Silently mis-normalizing them is the
+        # classic half-populated-ColumnConfig failure — say so once
+        # (per distinct set) and journal it so a dead fleet's files
+        # still show it.
+        missing = sorted(
+            n for n in column_nums
+            if n not in by_num or not by_num[n].has_stats
+            or not by_num[n].stddev
+        )
+        if missing:
+            _warn_stats_missing(tuple(missing), len(column_nums))
         return means, stds
 
 
 def _decode_delimiter(d: str) -> str:
     return {"\\|": "|", "\\t": "\t"}.get(d, d) or "|"
+
+
+#: column-number sets already warned about — one warning per distinct
+#: set per process, not one per stream build (every epoch path resolves
+#: zscale stats, and a page of repeated warnings hides the real one)
+_warned_stats_missing: set[tuple[int, ...]] = set()
+
+
+def _warn_stats_missing(missing: tuple[int, ...], total: int) -> None:
+    if missing in _warned_stats_missing:
+        return
+    _warned_stats_missing.add(missing)
+    from shifu_tensorflow_tpu.utils import logs
+
+    shown = list(missing[:20])
+    suffix = f" (+{len(missing) - 20} more)" if len(missing) > 20 else ""
+    logs.get("config").warning(
+        "ColumnConfig carries no usable columnStats (missing mean/stdDev "
+        "or stdDev=0) for %d of %d selected columns: %s%s — ZSCALE "
+        "substitutes defaults (mean=0 and/or std=1) for them, which "
+        "silently mis-normalizes any column whose true distribution is "
+        "not standard normal",
+        len(missing), total, shown, suffix,
+    )
+    # journal the condition too: the data-drift leg exists because
+    # mis-normalized features are invisible in latency metrics, and this
+    # is the config-side edition.  Config resolution runs BEFORE the CLI
+    # installs obs, so the emit is DEFERRED to journal install (fires
+    # immediately when one is already active) — without that, the
+    # process-level warn dedup above would eat every later chance and a
+    # dead fleet's files would never show the record.
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    def _emit(shown=shown, n=len(missing), total=total):
+        obs_journal.emit(
+            "config_stats_missing", plane="train",
+            columns=shown, missing=n, selected=total,
+        )
+
+    obs_journal.notify_on_install(_emit)

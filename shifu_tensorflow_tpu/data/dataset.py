@@ -430,10 +430,17 @@ class ShardStream:
             shuffled_blocks,
         )
 
+        from shifu_tensorflow_tpu.obs import datastats as obs_datastats
         from shifu_tensorflow_tpu.obs import trace as obs_trace
 
         stats = StageStats()
         tracer = obs_trace.active() if self.traced else None
+        # data-observability tap (obs/datastats.py): TRAIN-emit streams
+        # only — the exported feature baseline must describe what the
+        # model trained on, not the validation split's reweighted view
+        # (same per-emit discipline as the tracer above)
+        stats_tap = (obs_datastats.train_active()
+                     if self.emit != "valid" else None)
         pipe = ShardPipeline(
             self.paths, self.schema,
             salt=self.salt,
@@ -460,6 +467,7 @@ class ShardStream:
             yield from blocks_to_batches(
                 blocks, self.batch_size, self.schema.num_features,
                 drop_remainder=self.drop_remainder,
+                stats_tap=stats_tap,
             )
         finally:
             pipe.close()

@@ -652,17 +652,29 @@ def blocks_to_batches(
     num_features: int,
     *,
     drop_remainder: bool = False,
+    stats_tap=None,
 ) -> Iterator[dict]:
     """Fixed-size batch formation with a single global carry.  Full
     batches inside a block are pure slices (views — zero copy on the
     memmap'd cache path); only carry top-ups at block boundaries copy.
     Because the pipeline is order-preserving, there is exactly ONE tail
-    (at most batch_size-1 rows) regardless of reader count."""
+    (at most batch_size-1 rows) regardless of reader count.
+
+    ``stats_tap`` is the data-observability feed (an object with
+    ``add_block(features)`` — obs/datastats.TrainDataSketch): each
+    PRE-batching block's feature matrix is offered before slicing, so
+    the sketch never sees the zero-weight padding rows the tail batch
+    gains below.  Explicit-sink discipline, like the pipeline tracer:
+    the caller decides which streams feed it (train-emit only — a
+    validation stream polluting the exported baseline would hide
+    exactly the train/serve skew the sketch exists to catch)."""
     from shifu_tensorflow_tpu.data.dataset import make_batch, pad_to_batch
 
     B = batch_size
     carry: ParsedBlock | None = None
     for block in blocks:
+        if stats_tap is not None and len(block):
+            stats_tap.add_block(block.features)
         i = 0
         if carry is not None and len(carry):
             take = min(B - len(carry), len(block))

@@ -52,6 +52,15 @@ NATIVE_WEIGHTS = "shifu_tpu_weights.npz"
 #: Written LAST (after every file it covers commits), so a manifest's
 #: presence implies a complete bundle.
 NATIVE_MANIFEST = "shifu_tpu_export.manifest.json"
+#: training-side per-feature distribution sketch (obs/datastats.py
+#: snapshot: count/mean/std/min/max/missing/inf rates + P² quantiles
+#: per feature) shipped WITH the bundle — the serve-side skew
+#: detector's baseline.  Covered by the manifest like every artifact: a
+#: bit-flipped stats file refuses admission, because a model silently
+#: drift-checked against corrupt statistics is worse than one not
+#: drift-checked at all.  Optional: bundles exported without the obs
+#: data leg simply omit it (and serving skips drift detection).
+FEATURE_STATS = "feature_stats.json"
 
 
 def generic_model_config_json() -> str:
@@ -109,11 +118,18 @@ def export_native_bundle(
     feature_columns=None,
     zscale_means=None,
     zscale_stds=None,
+    feature_stats=None,
 ) -> None:
     """Write the TF-free artifact: architecture JSON + weights npz, plus
     the sidecar manifest (size+CRC32+SHA-256 per file) that the serving
     reload path verifies before admitting the bundle.  Every file commits
-    via tmp+rename; the manifest commits last."""
+    via tmp+rename; the manifest commits last.
+
+    ``feature_stats`` is the training data's per-feature sketch snapshot
+    (obs/datastats.DataSketch.snapshot) — written as
+    ``feature_stats.json`` and digested into the manifest, so the serve
+    admission that verifies the weights verifies the drift baseline with
+    them."""
     fs.mkdirs(export_dir)
     arch = {
         "format_version": 1,
@@ -171,14 +187,24 @@ def export_native_bundle(
     weights_bytes = buf.getvalue()
     generic_bytes = generic_model_config_json().encode("utf-8")
     weights_entry = _digest_entry(weights_bytes)  # hash the payload once
+    files = {
+        NATIVE_ARCH: _digest_entry(arch_bytes),
+        NATIVE_WEIGHTS: weights_entry,
+        GENERIC_CONFIG: _digest_entry(generic_bytes),
+    }
+    stats_bytes = None
+    if feature_stats is not None:
+        stats_bytes = json.dumps({
+            "format_version": 1,
+            "feature_columns": list(feature_columns or
+                                    range(num_features)),
+            "stats": feature_stats,
+        }, indent=2).encode("utf-8")
+        files[FEATURE_STATS] = _digest_entry(stats_bytes)
     manifest = json.dumps({
         "format_version": 1,
         "sha256": weights_entry["sha256"],  # bundle identity
-        "files": {
-            NATIVE_ARCH: _digest_entry(arch_bytes),
-            NATIVE_WEIGHTS: weights_entry,
-            GENERIC_CONFIG: _digest_entry(generic_bytes),
-        },
+        "files": files,
         "written_by": str(os.getpid()),
     }, indent=2)
     # at-rest corruption seam (chaos drills): applied AFTER the digests,
@@ -188,6 +214,17 @@ def export_native_bundle(
     _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes)
     _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes)
     _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes)
+    if stats_bytes is not None:
+        _commit_bytes(os.path.join(export_dir, FEATURE_STATS), stats_bytes)
+    else:
+        # a re-export WITHOUT stats must not leave a stale baseline from
+        # a previous generation beside a manifest that no longer vouches
+        # for it (the loader only trusts manifest-covered stats, but a
+        # legacy manifest-less reader would happily read the orphan)
+        try:
+            os.remove(os.path.join(export_dir, FEATURE_STATS))
+        except OSError:
+            pass
     # manifest LAST: its presence implies every covered file committed
     _commit_bytes(
         os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8")
@@ -272,6 +309,7 @@ def export_model(
     feature_columns=None,
     zscale_means=None,
     zscale_stds=None,
+    feature_stats=None,
 ) -> dict[str, bool]:
     """One-call export of both artifacts from a Trainer.
 
@@ -311,6 +349,20 @@ def export_model(
             "hashed_columns": {"table": np.asarray(table)},
             "base": export_params,
         }
+    if feature_stats is None:
+        # bundle-shipped drift baseline: the process-wide train data
+        # sketch (obs/datastats.py), fed by this trainer's ingest taps —
+        # shipped only when its width matches the serving contract (a
+        # second trainer of a different width in this process resets the
+        # sketch; never ship a mismatched baseline)
+        from shifu_tensorflow_tpu.obs import datastats as obs_datastats
+
+        sk = obs_datastats.train_active()
+        if sk is not None:
+            snap = sk.snapshot()
+            if snap is not None and \
+                    snap["num_features"] == trainer.num_features:
+                feature_stats = snap
     export_native_bundle(
         export_dir,
         export_params,
@@ -319,6 +371,7 @@ def export_model(
         feature_columns=feature_columns,
         zscale_means=zscale_means,
         zscale_stds=zscale_stds,
+        feature_stats=feature_stats,
     )
     # deep-copy: ModelConfig.from_json keeps a reference to the nested
     # dicts, so mutating a shallow copy would rewrite the live trainer's

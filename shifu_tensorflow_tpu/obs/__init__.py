@@ -53,6 +53,7 @@ def device_obs_text() -> str:
     always — the ``stpu_build_info`` identity gauge saying WHAT build
     answered the scrape."""
     from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import datastats as datastats_mod
     from shifu_tensorflow_tpu.obs import memory as memory_mod
     from shifu_tensorflow_tpu.obs.registry import build_info_text
 
@@ -63,6 +64,10 @@ def device_obs_text() -> str:
     mem = memory_mod.active()
     if mem is not None:
         text += mem.render_prometheus()
+    mon = datastats_mod.active()
+    if mon is not None:
+        # stpu_data_* per-model drift gauges (the data leg)
+        text += mon.render_prometheus()
     return text + build_info_text()
 
 
@@ -82,6 +87,7 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     one merged journal can tell two jobs' events apart.
     """
     from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import datastats as datastats_mod
     from shifu_tensorflow_tpu.obs import fleet as fleet_mod
     from shifu_tensorflow_tpu.obs import journal as journal_mod
     from shifu_tensorflow_tpu.obs import memory as memory_mod
@@ -95,6 +101,8 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
         compile_mod.uninstall()
         memory_mod.uninstall()
         fleet_mod.uninstall()
+        datastats_mod.uninstall()
+        datastats_mod.uninstall_train()
         profile_mod.unconfigure()
         return None, None
     if cfg.hist_buckets:
@@ -157,6 +165,20 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
         hysteresis=cfg.slo_hysteresis,
         plane=plane,
     ))
+    # data leg (PR 12): the drift monitor (serve batchers feed it per
+    # dispatch, model stores register bundle baselines, the SLO tick
+    # evaluates it) and the train-side sketch (ingest taps feed it
+    # under the trace-sample discipline; export ships its snapshot as
+    # the bundle's feature_stats.json)
+    datastats_mod.install(datastats_mod.DataDriftMonitor(
+        threshold=getattr(cfg, "data_drift_threshold", 1.0),
+        hysteresis=cfg.slo_hysteresis,
+        window_s=cfg.slo_window_s,
+        plane=plane,
+        worker=worker_index,
+    ))
+    datastats_mod.install_train(datastats_mod.TrainDataSketch(
+        sample_every=cfg.trace_sample))
     profile_mod.configure(cfg.journal_path or None, plane=plane,
                           worker=worker_index)
     return tracer, jrn

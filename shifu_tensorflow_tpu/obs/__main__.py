@@ -117,6 +117,26 @@ def build_parser() -> argparse.ArgumentParser:
     fleet.add_argument("--json", action="store_true", dest="as_json",
                        help="machine-readable fleet document")
 
+    data = sub.add_parser(
+        "data",
+        help="per-feature train-baseline-vs-live-serve table, drift "
+             "excursions (data_drift / data_drift_clear events)",
+    )
+    data.add_argument("--journal", required=True,
+                      help="journal base path (shifu.tpu.obs-journal)")
+    data.add_argument("--bundle", action="append", default=[],
+                      dest="bundles",
+                      help="an exported bundle dir (or a multi-tenant "
+                           "models dir) whose feature_stats.json is the "
+                           "train baseline (repeatable); without it the "
+                           "baseline comes from journaled train-plane "
+                           "data_stats events")
+    data.add_argument("--features", type=int, default=20,
+                      help="max feature rows per model, highest drift "
+                           "score first (default 20; 0 = all)")
+    data.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable data document")
+
     comp = sub.add_parser(
         "compile",
         help="compile flight-recorder history: per-callable compile "
@@ -590,8 +610,53 @@ def _build_summary(base: str, cache: dict | None = None) -> dict | None:
         "serve": _serve_data(events),
         "slo": _slo_data(events),
         "fleet": _fleet_data(events),
+        "data": _data_summary(events),
         "_events": events,  # stripped before --json output
     }
+
+
+def _data_summary(events: list[dict]) -> dict:
+    """The data leg's compact summary (the full per-feature table is
+    ``obs data``'s job): per-model live rows + drift score, train
+    sketch presence, excursion counts."""
+    d = _data_data(events)
+    if not d:
+        return {}
+    return {
+        "train_workers": sorted(d["train"], key=lambda w: (w is None, w)),
+        "models": {
+            m: {
+                "live_rows": v["stats"].get("rows"),
+                "drift_score": v.get("drift_score"),
+                "drifting": v.get("drifting") or 0,
+            }
+            for m, v in d["serve"].items()
+        },
+        "excursions": len(d["excursions"]),
+        "open_excursions": sum(
+            1 for e in d["excursions"] if e["clear_ts"] is None),
+    }
+
+
+def _render_data_brief(d: dict) -> list[str]:
+    if not d:
+        return []
+    lines = []
+    for m, v in sorted(d["models"].items()):
+        score = v.get("drift_score")
+        lines.append(
+            f"  model {m}: live {v['live_rows']} rows"
+            + (f", drift score {score:.3g}" if score is not None else "")
+            + (f", {v['drifting']} feature(s) DRIFTING"
+               if v["drifting"] else "")
+        )
+    if d["train_workers"]:
+        lines.append(f"  train sketches from worker(s) "
+                     f"{d['train_workers']}")
+    lines.append(f"  drift excursions: {d['excursions']} "
+                 f"({d['open_excursions']} open)  — `obs data` for the "
+                 f"per-feature table")
+    return lines
 
 
 def cmd_summary(args) -> int:
@@ -632,6 +697,12 @@ def cmd_summary(args) -> int:
     if fleet_lines:
         print("fleet skew")
         for line in fleet_lines:
+            print(line)
+        print()
+    data_lines = _render_data_brief(data["data"])
+    if data_lines:
+        print("data plane")
+        for line in data_lines:
             print(line)
         print()
     print("fleet timeline")
@@ -867,6 +938,253 @@ def cmd_fleet(args) -> int:
           + (f", max skew {data['max_skew']:.2f}"
              if data.get("max_skew") is not None else ""))
     for line in _render_fleet(data, t0):
+        print(line)
+    return 0
+
+
+# ---- data distribution (train baseline vs live serve) ----
+
+def _data_data(events: list[dict]) -> dict:
+    """Aggregate the data leg's journal: per-worker train sketches
+    (``data_stats`` plane=train), per-model live windowed sketches
+    (``data_stats`` plane=serve), drift excursions, and any
+    ``config_stats_missing`` records — entirely from journal files."""
+    train: dict = {}
+    serve: dict = {}
+    excursions: list[dict] = []
+    open_: dict = {}
+    stats_missing: list[dict] = []
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "data_stats":
+            stats = ev.get("stats")
+            if not isinstance(stats, dict):
+                continue
+            if ev.get("plane") == "train":
+                train[ev.get("worker")] = {
+                    "stats": stats, "epoch": ev.get("epoch"),
+                    "ts": ev.get("ts"),
+                }
+            else:
+                serve[ev.get("model") or "default"] = {
+                    "stats": stats, "ts": ev.get("ts"),
+                    "drift_score": ev.get("drift_score"),
+                    "drifting": ev.get("drifting"),
+                }
+        elif kind == "data_drift":
+            key = (ev.get("model"), ev.get("feature"))
+            exc = {
+                "model": ev.get("model"), "feature": ev.get("feature"),
+                "column": ev.get("column"), "stat": ev.get("stat"),
+                "score": ev.get("score"), "detect_ts": ev.get("ts"),
+                "clear_ts": None, "drift_s": None,
+            }
+            open_[key] = exc
+            excursions.append(exc)
+        elif kind == "data_drift_clear":
+            exc = open_.pop((ev.get("model"), ev.get("feature")), None)
+            if exc is not None:
+                exc["clear_ts"] = ev.get("ts")
+                exc["drift_s"] = ev.get("drift_s")
+        elif kind == "config_stats_missing":
+            stats_missing.append({
+                "columns": ev.get("columns"),
+                "missing": ev.get("missing"),
+                "selected": ev.get("selected"),
+            })
+    if not (train or serve or excursions):
+        return {}
+    return {"train": train, "serve": serve, "excursions": excursions,
+            "config_stats_missing": stats_missing}
+
+
+def _merged_train_stats(train: dict) -> dict | None:
+    """One train baseline out of the per-worker journal snapshots —
+    count-weighted merge when numpy is importable (obs/datastats.py),
+    else the biggest worker's snapshot (this CLI stays usable on a
+    box with nothing but the stdlib)."""
+    snaps = [v["stats"] for v in train.values() if v.get("stats")]
+    if not snaps:
+        return None
+    if len(snaps) == 1:
+        return snaps[0]
+    try:
+        from shifu_tensorflow_tpu.obs.datastats import merge_snapshots
+
+        return merge_snapshots(snaps)
+    except Exception:
+        return max(snaps, key=lambda s: s.get("rows", 0))
+
+
+def _bundle_baselines(paths: list[str]) -> dict[str, dict]:
+    """feature_stats.json baselines out of export dirs: each ``--bundle``
+    is either one bundle (name "default") or a multi-tenant models dir
+    (one baseline per tenant subdirectory)."""
+    import os
+
+    out: dict[str, dict] = {}
+
+    def load(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return doc.get("stats") or None
+        except (OSError, ValueError):
+            return None
+
+    for p in paths:
+        single = os.path.join(p, "feature_stats.json")
+        if os.path.isfile(single):
+            stats = load(single)
+            if stats:
+                out["default"] = stats
+            continue
+        try:
+            names = sorted(os.listdir(p))
+        except OSError:
+            continue
+        for name in names:
+            sub = os.path.join(p, name, "feature_stats.json")
+            if os.path.isfile(sub):
+                stats = load(sub)
+                if stats:
+                    out[name] = stats
+    return out
+
+
+def _fmt_stat(snap: dict, j: int) -> str:
+    mean = snap["mean"][j]
+    std = snap["std"][j]
+    if mean is None:
+        return "-"
+    return f"{mean:.4g}±{0.0 if std is None else std:.3g}"
+
+
+def _pct(snap: dict, key: str, j: int) -> str:
+    rates = snap.get(key) or []
+    v = rates[j] if j < len(rates) else None
+    return "-" if v is None else f"{100.0 * v:.3g}%"
+
+
+def _render_data(data: dict, baselines: dict, t0: float,
+                 max_features: int = 20) -> list[str]:
+    lines: list[str] = []
+    train_stats = _merged_train_stats(data.get("train") or {})
+    open_excs = {(e["model"], e["feature"])
+                 for e in data.get("excursions", [])
+                 if e["clear_ts"] is None}
+    models = sorted(data.get("serve") or {})
+    for model in models:
+        live_doc = data["serve"][model]
+        live = live_doc["stats"]
+        base = baselines.get(model)
+        base_src = "bundle"
+        if base is None and len(baselines) == 1 and len(models) == 1:
+            base = next(iter(baselines.values()))
+        if base is None:
+            base, base_src = train_stats, "journal"
+        score = live_doc.get("drift_score")
+        lines.append(
+            f"  model {model}: live window {live['rows']} rows"
+            + (f", baseline {base['rows']} rows [{base_src}]"
+               if base else ", NO BASELINE")
+            + (f", drift score {score:.3g}" if score is not None else "")
+            + (f", {live_doc['drifting']} drifting"
+               if live_doc.get("drifting") else "")
+        )
+        if base is None or base.get("num_features") != live.get(
+                "num_features"):
+            continue
+        rows = []
+        try:
+            from shifu_tensorflow_tpu.obs.datastats import drift_components
+        except Exception:
+            drift_components = None
+        for j in range(live["num_features"]):
+            score_j, stat_j = None, "-"
+            if drift_components is not None:
+                comps = drift_components(base, live, j)
+                stat_j, score_j = max(comps.items(), key=lambda kv: kv[1])
+            rows.append((j, score_j, stat_j))
+        rows.sort(key=lambda r: -(r[1] or 0.0))
+        shown = rows if not max_features else rows[:max_features]
+        lines.append(
+            "    feat  base mean±std     live mean±std     base p50"
+            "   live p50   miss%      score   stat          state")
+        bq = (base.get("quantiles") or {}).get("0.5") or []
+        lq = (live.get("quantiles") or {}).get("0.5") or []
+        for j, score_j, stat_j in shown:
+            bp50 = bq[j] if j < len(bq) and bq[j] is not None else None
+            lp50 = lq[j] if j < len(lq) and lq[j] is not None else None
+            state = ("DRIFTING" if (model, j) in open_excs else "ok")
+            lines.append(
+                f"    {j:<5} {_fmt_stat(base, j):<17} "
+                f"{_fmt_stat(live, j):<17} "
+                f"{'-' if bp50 is None else f'{bp50:.4g}':<10} "
+                f"{'-' if lp50 is None else f'{lp50:.4g}':<10} "
+                f"{_pct(base, 'missing_rate', j)}/"
+                f"{_pct(live, 'missing_rate', j):<7} "
+                f"{'-' if score_j is None else f'{score_j:.3g}':<7} "
+                f"{stat_j:<13} {state}"
+            )
+        if len(shown) < len(rows):
+            lines.append(f"    ... {len(rows) - len(shown)} more features "
+                         f"(--features 0 for all)")
+    if not models and train_stats:
+        lines.append(
+            f"  train baseline only: {train_stats['rows']} rows, "
+            f"{train_stats['num_features']} features (no serve-plane "
+            "data_stats journaled)")
+    for e in data.get("excursions", []):
+        start = (e["detect_ts"] or t0) - t0
+        where = f"model {e['model']} feature {e['feature']}"
+        if e.get("column") is not None:
+            where += f" (column {e['column']})"
+        if e["clear_ts"] is not None:
+            span = (f"+{start:.1f}s .. +{e['clear_ts'] - t0:.1f}s "
+                    f"({(e['drift_s'] or 0.0):.1f}s)")
+        else:
+            span = f"+{start:.1f}s .. STILL DRIFTING"
+        lines.append(f"  drift: {where}  {span}  stat {e['stat']}  "
+                     f"score {e['score']:.3g}")
+    if models and not data.get("excursions"):
+        lines.append("  no drift excursions")
+    for m in data.get("config_stats_missing", []):
+        lines.append(
+            f"  config: {m['missing']}/{m['selected']} selected columns "
+            f"had no columnStats (ZSCALE substituted mean=0/std=1): "
+            f"{m['columns']}")
+    return lines
+
+
+def cmd_data(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _data_data(events)
+    baselines = _bundle_baselines(args.bundles)
+    if args.as_json:
+        doc = dict(data) if data else {}
+        doc["baselines"] = baselines
+        doc["train_merged"] = _merged_train_stats(
+            (data or {}).get("train") or {})
+        print(json.dumps(doc, indent=2, default=str))
+        return 0 if (data or baselines) else 1
+    if not data and not baselines:
+        print("no data-plane events — the train sketch journals "
+              "data_stats per epoch and the serve drift monitor per "
+              "window once obs is enabled (shifu.tpu.obs-*)")
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    n_models = len((data or {}).get("serve") or {})
+    print(f"data distribution — {n_models} serving model(s), "
+          f"{len((data or {}).get('train') or {})} train worker sketch(es), "
+          f"{len(baselines)} bundle baseline(s)")
+    for line in _render_data(data or {}, baselines, t0,
+                             max_features=args.features):
         print(line)
     return 0
 
@@ -1203,6 +1521,15 @@ def _render_top(base: str, urls: list[str],
         for line in _render_fleet(fleet, data["t0"]):
             lines.append(line)
         lines.append("")
+    # data panel: per-model drift state from the journaled windowed
+    # sketches (live stpu_data_* gauges ride the same /metrics scrape
+    # as everything else when --metrics-url is given)
+    data_leg = data.get("data") or {}
+    if data_leg:
+        lines.append("data")
+        for line in _render_data_brief(data_leg):
+            lines.append(line)
+        lines.append("")
     # serve plane: journal rows, live counters when scraped
     serve = data["serve"]
     if serve and (serve["workers"] or serve["fleet"]["workers"]):
@@ -1261,6 +1588,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_top(args)
         if args.cmd == "fleet":
             return cmd_fleet(args)
+        if args.cmd == "data":
+            return cmd_data(args)
         if args.cmd == "compile":
             return cmd_compile(args)
         if args.cmd == "mem":

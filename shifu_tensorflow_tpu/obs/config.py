@@ -47,6 +47,10 @@ class ObsConfig:
     # JSON-bridge reason as the blocks above
     slo_straggler_skew: float = K.DEFAULT_SLO_STRAGGLER_SKEW
     fleet_skew_threshold: float = K.DEFAULT_FLEET_SKEW_THRESHOLD
+    # data leg (obs/datastats.py) — drift-score watchdog target (0 =
+    # untargeted) and the per-feature detect/clear threshold
+    slo_data_drift: float = K.DEFAULT_SLO_DATA_DRIFT
+    data_drift_threshold: float = K.DEFAULT_DATA_DRIFT_THRESHOLD
 
     def __post_init__(self):
         if self.journal_max_bytes < 4096:
@@ -98,6 +102,14 @@ class ObsConfig:
                 f"{K.FLEET_SKEW_THRESHOLD} must be > 1 (a rank is a "
                 f"straggler when it is that many times its peers), got "
                 f"{self.fleet_skew_threshold}")
+        if self.slo_data_drift < 0:
+            raise ValueError(f"{K.SLO_DATA_DRIFT} must be >= 0 "
+                             f"(0 = disabled), got {self.slo_data_drift}")
+        if self.data_drift_threshold <= 0:
+            raise ValueError(
+                f"{K.DATA_DRIFT_THRESHOLD} must be > 0 (a 0 threshold "
+                f"would flag every feature on every tick), got "
+                f"{self.data_drift_threshold}")
         if self.compile_analysis not in ("auto", "full", "cost", "off"):
             raise ValueError(
                 f"{K.OBS_COMPILE_ANALYSIS} must be auto|full|cost|off, "
@@ -186,4 +198,8 @@ def resolve_obs_config(args, conf) -> ObsConfig:
                                           K.DEFAULT_SLO_STRAGGLER_SKEW),
         fleet_skew_threshold=conf.get_float(
             K.FLEET_SKEW_THRESHOLD, K.DEFAULT_FLEET_SKEW_THRESHOLD),
+        slo_data_drift=conf.get_float(K.SLO_DATA_DRIFT,
+                                      K.DEFAULT_SLO_DATA_DRIFT),
+        data_drift_threshold=conf.get_float(
+            K.DATA_DRIFT_THRESHOLD, K.DEFAULT_DATA_DRIFT_THRESHOLD),
     )

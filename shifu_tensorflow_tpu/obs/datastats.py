@@ -1,0 +1,956 @@
+"""Data-plane observability: streaming per-feature sketches and
+train/serve skew detection.
+
+The obs plane watches time (PR 4), requests (PR 7), devices (PR 10),
+and the fleet (PR 11) — this leg watches the *data*.  Shifu's whole
+pipeline is built around per-column statistics (``ColumnConfig``
+mean/stdDev feeding ZSCALE normalization), and a tabular serving fleet
+dies silently from feature drift and train/serve skew long before any
+latency SLO fires: the model keeps answering, the scores are just
+quietly wrong.  The tf.data lesson (arxiv 2101.12127) applied to the
+data itself — instrument the pipeline per element, compare live against
+the training distribution, and turn the comparison into journaled state
+transitions a supervisor can act on.
+
+Three layers, stdlib + numpy, bounded memory, off-by-default-cheap
+(every tap is one ``is None`` check when obs is off):
+
+- :class:`DataSketch` — one streaming sketch over a feature matrix:
+  per-feature count, mean/std (Welford, merged batch-at-a-time with
+  Chan's parallel update — vectorized, no per-value Python), min/max,
+  NaN ("missing") and ±inf rates, plus P² quantile estimators
+  (:class:`~shifu_tensorflow_tpu.obs.slo.P2Quantile`) fed from a
+  bounded per-batch row subsample so quantile cost cannot scale with
+  batch size × width.
+- :class:`WindowedDataSketch` — the serve-side live window: a ring of
+  time cells (the :class:`~shifu_tensorflow_tpu.obs.slo.WindowedDigest`
+  discipline), each holding one DataSketch; cells expire by ring reuse
+  and :func:`merge_snapshots` combines the live cells count-weighted.
+  An empty window is signal ABSENT, never a drift of zero.
+- :class:`DataDriftMonitor` — per-model :class:`SkewDetector` comparing
+  the live windowed sketch against the model's *baseline* (the training
+  sketch shipped in the bundle as ``feature_stats.json``, verified by
+  the PR-3 manifest chain like any artifact).  Per feature it computes
+  a PSI-style normalized displacement score — mean shift and std shift
+  in units of the baseline's (robust) spread, max quantile
+  displacement, missing/inf-rate deltas — and runs a hysteretic state
+  machine per (model, feature): ``data_drift`` journaled with the
+  model, feature index/column, offending statistic and score;
+  ``data_drift_clear`` when the live window returns to the baseline.
+  ``stpu_data_*`` gauges ride every ``/metrics`` surface
+  (obs.device_obs_text) and the fleet-wide max score feeds the
+  ``shifu.tpu.slo-data-drift`` watchdog target.
+
+Taps: the ingest pipeline feeds the TRAIN sketch at batch formation
+(``data/pipeline.blocks_to_batches``, train-emit streams only, sampled
+under the ``shifu.tpu.obs-trace-sample`` discipline); the in-memory and
+device-resident fit paths fold their dataset once per fit.  The serve
+batcher feeds the LIVE sketch at its pack stage — once per coalesced
+dispatch, pre-padding, so ladder padding can never read as drift.  At
+export the train snapshot lands in the bundle; at admission the
+ModelStore registers it as the baseline.  The whole story reconstructs
+jax-free from a dead fleet's journals + bundle files (``obs data``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from shifu_tensorflow_tpu.obs.registry import (
+    escape_label_suffix as _esc,  # one escape across every obs leg
+)
+from shifu_tensorflow_tpu.obs.slo import P2Quantile
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("obs.datastats")
+
+__all__ = [
+    "DataSketch",
+    "WindowedDataSketch",
+    "merge_snapshots",
+    "drift_components",
+    "SkewDetector",
+    "DataDriftMonitor",
+    "TrainDataSketch",
+    "install",
+    "uninstall",
+    "active",
+    "install_train",
+    "uninstall_train",
+    "train_active",
+    "baseline_from_journal",
+]
+
+_mono = time.monotonic
+
+#: quantiles every sketch tracks: the median for center displacement,
+#: the 5/95 tails for shape — (p95 - p5)/3.29 doubles as a robust std
+#: estimate for the drift score's scale when the baseline std is
+#: degenerate (constant or outlier-inflated features)
+QUANTILES = (0.05, 0.5, 0.95)
+
+#: per-tap cap on P² updates (P2Quantile.add is ~3µs of Python, and a
+#: tap fires on the serve pack thread / per sampled ingest block): rows
+#: fed per add_batch = budget // (width × quantiles), floored at 1 — so
+#: the per-tap Python cost is ~budget × 3µs regardless of width.  The
+#: drift score discounts the resulting sampling noise by the cumulative
+#: fed-row count (``qrows``), so a small per-tap feed costs resolution,
+#: never correctness — over a window/epoch the rows accumulate.
+QUANTILE_BUDGET = 96
+
+#: per-tap cap on rows folded into the vectorized moment/rate stats: a
+#: 64k-row ingest block would otherwise cost ~10 numpy passes over 2M
+#: elements per tap.  Rows beyond the cap are evenly strided out; all
+#: rates stay unbiased, and ``rows`` counts what was actually folded.
+#: 2048/tap × the dozens of taps per epoch/window is tens of thousands
+#: of folded rows — sampling error far below the drift threshold.
+MOMENT_ROW_CAP = 2048
+
+#: sampling-noise allowance subtracted from the quantile drift
+#: component: a p05/p95 estimate from n fed rows wobbles ~O(1/√n)
+#: baseline-sigmas even with NO drift, and alarming on that would be
+#: alarming on the estimator, not the data
+QUANTILE_NOISE_K = 3.0
+
+#: a live window below this many rows never evaluates: a handful of
+#: requests is a sample, not a distribution, and drift alarms off six
+#: rows would train operators to ignore the event
+MIN_EVAL_ROWS = 32
+
+#: extra weight on the missing/inf RATE deltas in the drift score —
+#: rates live in [0, 1] while the moment shifts are in baseline-sigmas,
+#: so a 25-point missing-rate change scores 1.0 (the default threshold)
+RATE_WEIGHT = 4.0
+
+
+
+
+def _round_list(vals, nd: int = 5) -> list:
+    out = []
+    for v in vals:
+        v = float(v)
+        out.append(round(v, nd) if math.isfinite(v) else None)
+    return out
+
+
+class DataSketch:
+    """Streaming per-feature statistics over ``add_batch(x)`` calls
+    (``x`` is ``(rows, features)``).  All moment/extreme/rate stats are
+    exact over every row seen (vectorized numpy, float64 accumulators);
+    the P² quantiles see a bounded evenly-strided row subsample per
+    batch.  Thread-safe; ``snapshot()`` is JSON-ready."""
+
+    def __init__(self, num_features: int | None = None,
+                 quantiles: tuple[float, ...] = QUANTILES,
+                 quantile_budget: int = QUANTILE_BUDGET):
+        self.quantiles = tuple(quantiles)
+        self.quantile_budget = max(1, int(quantile_budget))
+        self._lock = threading.Lock()
+        self.rows = 0
+        self.num_features = 0
+        self._count = self._missing = self._inf = None
+        self._mean = self._m2 = self._min = self._max = None
+        self._p2: list[dict[float, P2Quantile]] = []
+        if num_features:
+            self._alloc(int(num_features))
+
+    def _alloc(self, f: int) -> None:
+        self.num_features = f
+        self.rows = 0
+        self.qrows = 0
+        self._count = np.zeros(f, np.int64)
+        self._missing = np.zeros(f, np.int64)
+        self._inf = np.zeros(f, np.int64)
+        self._mean = np.zeros(f, np.float64)
+        self._m2 = np.zeros(f, np.float64)
+        self._min = np.full(f, np.inf)
+        self._max = np.full(f, -np.inf)
+        self._p2 = [{q: P2Quantile(q) for q in self.quantiles}
+                    for _ in range(f)]
+
+    def add_batch(self, x) -> None:
+        x = np.asarray(x)
+        if x.ndim != 2 or x.shape[0] == 0:
+            return
+        if x.shape[0] > MOMENT_ROW_CAP:
+            # bounded per-tap cost: evenly strided row subsample — every
+            # rate stays unbiased, `rows` counts what was folded.  The
+            # contiguous copy matters: ten numpy passes over a strided
+            # view of a 64k-row block cost ~3× the one gather.
+            x = np.ascontiguousarray(x[:: -(-x.shape[0] // MOMENT_ROW_CAP)])
+        n, f = x.shape
+        with self._lock:
+            if self._count is None or f != self.num_features:
+                # width change (a new trainer in the same process, a
+                # reloaded model): restart rather than mix two schemas
+                self._alloc(f)
+            xf = x.astype(np.float64, copy=False)
+            finite = np.isfinite(xf)
+            nan = np.isnan(xf)
+            self.rows += n
+            cnt = finite.sum(axis=0)
+            nnan = nan.sum(axis=0)
+            self._missing += nnan
+            self._inf += n - cnt - nnan
+            vals = np.where(finite, xf, 0.0)
+            has = cnt > 0
+            bsum = vals.sum(axis=0)
+            bmean = np.divide(bsum, cnt, out=np.zeros_like(bsum),
+                              where=has)
+            bm2 = (np.where(finite, xf - bmean, 0.0) ** 2).sum(axis=0)
+            # Chan's parallel combine of (count, mean, M2) pairs
+            tot = self._count + cnt
+            safe = np.maximum(tot, 1)
+            delta = bmean - self._mean
+            self._mean = np.where(
+                has, self._mean + delta * (cnt / safe), self._mean)
+            self._m2 = np.where(
+                has, self._m2 + bm2 + delta ** 2 * (self._count * cnt / safe),
+                self._m2)
+            self._count = tot
+            self._min = np.minimum(
+                self._min, np.where(finite, xf, np.inf).min(axis=0))
+            self._max = np.maximum(
+                self._max, np.where(finite, xf, -np.inf).max(axis=0))
+            # bounded quantile feed: a handful of evenly-strided rows
+            # per tap (P2Quantile.add is scalar Python — the budget
+            # counts CALLS, width × quantiles of them per row; the
+            # drift score's √qrows discount absorbs the small feed)
+            k = max(1, self.quantile_budget
+                    // max(1, f * len(self.quantiles)))
+            stride = max(1, n // k)
+            for i in range(0, n, stride):
+                row = xf[i]
+                ok = finite[i]
+                self.qrows += 1
+                for j in range(f):
+                    if ok[j]:
+                        for p2 in self._p2[j].values():
+                            p2.add(row[j])
+
+    def _q_value(self, j: int, q: float) -> float:
+        p2 = self._p2[j][q]
+        v = p2.value() if p2.count else None
+        return float("nan") if v is None else v
+
+    def snapshot(self) -> dict | None:
+        """JSON-ready struct-of-arrays snapshot, or None before any
+        data.  ``count`` is finite observations per feature; min/max are
+        None for features that never saw a finite value."""
+        with self._lock:
+            if self._count is None or self.rows == 0:
+                return None
+            cnt = self._count
+            safe = np.maximum(cnt, 1)
+            var = np.where(cnt > 1, self._m2 / safe, 0.0)
+            seen = np.maximum(cnt + self._missing + self._inf, 1)
+            snap: dict[str, Any] = {
+                "rows": int(self.rows),
+                "qrows": int(self.qrows),
+                "num_features": self.num_features,
+                "count": [int(c) for c in cnt],
+                "missing": [int(m) for m in self._missing],
+                "inf": [int(m) for m in self._inf],
+                "mean": _round_list(self._mean),
+                "std": _round_list(np.sqrt(np.maximum(var, 0.0))),
+                "min": _round_list(self._min),
+                "max": _round_list(self._max),
+                "missing_rate": _round_list(self._missing / seen, 6),
+                "inf_rate": _round_list(self._inf / seen, 6),
+                "quantiles": {
+                    str(q): _round_list(
+                        [self._q_value(j, q)
+                         for j in range(self.num_features)])
+                    for q in self.quantiles
+                },
+            }
+            return snap
+
+
+def merge_snapshots(snaps: Sequence[dict]) -> dict | None:
+    """Count-weighted combine of :meth:`DataSketch.snapshot` dicts with
+    a common width (window cells, fleet workers): counts sum, means and
+    M2 merge via Chan, min/max extremize, quantiles average count-
+    weighted (the WindowedDigest estimate — exact when the parts saw
+    similar distributions, which is precisely the no-drift case).
+
+    Mixed widths cannot merge; the LAST snapshot's width wins, so
+    callers must pass oldest-first when their parts can disagree (the
+    windowed ring sorts cells by start; the journal reconstruction
+    sorts by event timestamp)."""
+    snaps = [s for s in snaps if s and s.get("rows")]
+    if not snaps:
+        return None
+    widths = {s["num_features"] for s in snaps}
+    if len(widths) > 1:
+        w = snaps[-1]["num_features"]
+        snaps = [s for s in snaps if s["num_features"] == w]
+    f = snaps[0]["num_features"]
+    qrows = sum(int(s.get("qrows", 0)) for s in snaps)
+    count = np.zeros(f, np.float64)
+    missing = np.zeros(f, np.float64)
+    inf = np.zeros(f, np.float64)
+    mean = np.zeros(f, np.float64)
+    m2 = np.zeros(f, np.float64)
+    mn = np.full(f, np.inf)
+    mx = np.full(f, -np.inf)
+    rows = 0
+    qkeys = list(snaps[0].get("quantiles", {}))
+    qnum = {q: np.zeros(f, np.float64) for q in qkeys}
+    qden = {q: np.zeros(f, np.float64) for q in qkeys}
+    for s in snaps:
+        rows += int(s["rows"])
+        c = np.asarray(s["count"], np.float64)
+        missing += np.asarray(s["missing"], np.float64)
+        inf += np.asarray(s["inf"], np.float64)
+        sm = np.array([v if v is not None else 0.0 for v in s["mean"]])
+        sd = np.array([v if v is not None else 0.0 for v in s["std"]])
+        tot = count + c
+        safe = np.maximum(tot, 1)
+        delta = sm - mean
+        has = c > 0
+        mean = np.where(has, mean + delta * (c / safe), mean)
+        m2 = np.where(has, m2 + sd ** 2 * c + delta ** 2 * (count * c / safe),
+                      m2)
+        count = tot
+        mn = np.minimum(mn, np.array(
+            [v if v is not None else np.inf for v in s["min"]]))
+        mx = np.maximum(mx, np.array(
+            [v if v is not None else -np.inf for v in s["max"]]))
+        for q in qkeys:
+            vals = s.get("quantiles", {}).get(q)
+            if vals is None:
+                continue
+            v = np.array([x if x is not None else np.nan for x in vals])
+            ok = np.isfinite(v) & has
+            qnum[q] += np.where(ok, v * c, 0.0)
+            qden[q] += np.where(ok, c, 0.0)
+    safe = np.maximum(count, 1)
+    seen = np.maximum(count + missing + inf, 1)
+    return {
+        "rows": rows,
+        "qrows": qrows,
+        "num_features": f,
+        "count": [int(c) for c in count],
+        "missing": [int(m) for m in missing],
+        "inf": [int(m) for m in inf],
+        "mean": _round_list(mean),
+        "std": _round_list(np.sqrt(np.maximum(m2 / safe, 0.0))),
+        "min": _round_list(mn),
+        "max": _round_list(mx),
+        "missing_rate": _round_list(missing / seen, 6),
+        "inf_rate": _round_list(inf / seen, 6),
+        "quantiles": {
+            q: _round_list(np.divide(qnum[q], qden[q],
+                                     out=np.full(f, np.nan),
+                                     where=qden[q] > 0))
+            for q in qkeys
+        },
+    }
+
+
+class WindowedDataSketch:
+    """Sliding live window as a ring of time-cell DataSketches (the
+    obs/slo.py WindowedDigest discipline): a cell whose slot comes
+    around again is reset, so rows older than the window can never
+    contribute.  ``snapshot`` merges live cells; None when empty.
+
+    ``cell_row_cap`` bounds the work per time cell: once a cell has
+    folded that many rows, further taps are ONE attribute read until
+    the ring rolls — total sketch work per window is capped at
+    buckets × cap rows no matter the request rate, which is what lets
+    the serve pack thread call this per dispatch unconditionally.
+    (Statistics come from the cell's first ``cap`` rows — a time-
+    leading sample within one short bucket, fine for drift.)"""
+
+    def __init__(self, window_s: float = 60.0, buckets: int = 4,
+                 quantile_budget: int = QUANTILE_BUDGET,
+                 cell_row_cap: int = 4096):
+        self.window_s = float(window_s)
+        self.buckets = max(2, int(buckets))
+        self.bucket_s = self.window_s / self.buckets
+        self.quantile_budget = quantile_budget
+        self.cell_row_cap = int(cell_row_cap)
+        self._cells: list[list] = [None] * self.buckets  # [start, sketch]
+        self._lock = threading.Lock()
+
+    def add(self, x, now: float | None = None) -> None:
+        now = _mono() if now is None else now
+        start = (now // self.bucket_s) * self.bucket_s
+        idx = int(now // self.bucket_s) % self.buckets
+        with self._lock:
+            cell = self._cells[idx]
+            if cell is None or cell[0] != start:
+                cell = [start, DataSketch(
+                    quantile_budget=self.quantile_budget)]
+                self._cells[idx] = cell
+            sketch = cell[1]
+        if self.cell_row_cap and sketch.rows >= self.cell_row_cap:
+            return
+        sketch.add_batch(x)
+
+    def rows(self, now: float | None = None) -> int:
+        now = _mono() if now is None else now
+        with self._lock:
+            return sum(c[1].rows for c in self._cells
+                       if c is not None and now - c[0] < self.window_s)
+
+    def snapshot(self, now: float | None = None) -> dict | None:
+        now = _mono() if now is None else now
+        with self._lock:
+            # oldest-first: merge_snapshots keeps the LAST snapshot's
+            # width on a mixed-width window (a reload that changed the
+            # model's feature count), and "last" must mean newest — the
+            # ring's index order is arbitrary
+            live = sorted(
+                (c for c in self._cells
+                 if c is not None and now - c[0] < self.window_s),
+                key=lambda c: c[0])
+            live = [c[1] for c in live]
+        snaps = [s for s in (sk.snapshot() for sk in live) if s]
+        return merge_snapshots(snaps) if snaps else None
+
+
+# ---- drift scoring ----------------------------------------------------------
+
+def _feature_scale(base: dict, j: int) -> float:
+    """The baseline's per-feature spread, robustly: max of its std and
+    the (p95 - p5)/3.29 robust std (a heavy-tailed baseline would
+    otherwise inflate the scale and hide a real shift; a clipped one
+    would deflate it and alarm on noise).  A constant feature falls
+    back to 1% of |mean| so ANY movement off the constant scores
+    large — which is what a constant training column drifting at serve
+    should do."""
+    std = base["std"][j] or 0.0
+    q = base.get("quantiles", {})
+    p5 = (q.get("0.05") or [None] * (j + 1))[j]
+    p95 = (q.get("0.95") or [None] * (j + 1))[j]
+    robust = 0.0
+    if p5 is not None and p95 is not None:
+        robust = (p95 - p5) / 3.29
+    scale = max(std, robust)
+    if scale <= 0.0:
+        scale = 0.01 * abs(base["mean"][j] or 0.0)
+    return max(scale, 1e-9)
+
+
+def drift_components(base: dict, live: dict, j: int) -> dict[str, float]:
+    """Per-feature drift components, each dimensionless and ~1.0 at
+    "clearly drifted": mean/std displacement in baseline-scale units,
+    max quantile displacement, and weighted missing/inf rate deltas.
+    The max of these is the feature's drift score and the argmax names
+    the offending statistic in the journaled event."""
+    scale = _feature_scale(base, j)
+
+    def g(snap, key):
+        v = snap[key][j]
+        return float(v) if v is not None else 0.0
+
+    comps = {
+        "mean": abs(g(live, "mean") - g(base, "mean")) / scale,
+        "std": abs(g(live, "std") - g(base, "std")) / scale,
+        "missing_rate": RATE_WEIGHT * abs(
+            g(live, "missing_rate") - g(base, "missing_rate")),
+        "inf_rate": RATE_WEIGHT * abs(
+            g(live, "inf_rate") - g(base, "inf_rate")),
+    }
+    qshift = 0.0
+    bq, lq = base.get("quantiles", {}), live.get("quantiles", {})
+    for q in bq:
+        bv = (bq.get(q) or [])
+        lv = (lq.get(q) or [])
+        if j < len(bv) and j < len(lv) and bv[j] is not None \
+                and lv[j] is not None:
+            qshift = max(qshift, abs(lv[j] - bv[j]) / scale)
+    # discount the estimators' own sampling noise: the quantile feed is
+    # a bounded row subsample, and a tail estimate from n rows wobbles
+    # ~O(1/√n) sigmas drift-free — without this, a quiet low-traffic
+    # window would alarm on estimator variance
+    n = min(int(base.get("qrows", 0) or 0) or 10 ** 9,
+            int(live.get("qrows", 0) or 0) or 10 ** 9)
+    comps["quantile"] = max(
+        0.0, qshift - QUANTILE_NOISE_K / math.sqrt(max(n, 1)))
+    return comps
+
+
+class _FeatureState:
+    __slots__ = ("bad", "good", "breached", "since", "stat", "score")
+
+    def __init__(self):
+        self.bad = 0
+        self.good = 0
+        self.breached = False
+        self.since: float | None = None
+        self.stat = ""
+        self.score = 0.0
+
+
+class SkewDetector:
+    """One model's live-vs-baseline comparison: a windowed live sketch,
+    the bundle-shipped baseline, and a hysteretic per-feature state
+    machine.  ``evaluate`` returns the events to journal (the monitor
+    owns journaling so the plane/worker stamps stay in one place)."""
+
+    def __init__(self, model: str, baseline: dict | None, *,
+                 columns: Sequence[int] | None = None,
+                 threshold: float = 1.0, hysteresis: int = 2,
+                 window_s: float = 60.0, min_rows: int = MIN_EVAL_ROWS):
+        self.model = model
+        self.baseline = baseline if baseline and baseline.get("rows") else None
+        self.columns = list(columns) if columns else None
+        self.threshold = float(threshold)
+        self.hysteresis = max(1, int(hysteresis))
+        self.min_rows = int(min_rows)
+        self.live = WindowedDataSketch(window_s=window_s)
+        self._state: dict[int, _FeatureState] = {}
+        self.last_score = 0.0
+        self.last_live: dict | None = None
+
+    def column_of(self, j: int):
+        if self.columns and j < len(self.columns):
+            return self.columns[j]
+        return None
+
+    def observe(self, x, now: float | None = None) -> None:
+        self.live.add(x, now=now)
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        now = _mono() if now is None else now
+        live = self.live.snapshot(now=now)
+        self.last_live = live
+        events: list[dict] = []
+        base = self.baseline
+        if base is None:
+            self.last_score = 0.0
+            return events
+        # an EMPTY window (live None) still ticks the state machine as
+        # clean: a tenant whose traffic stopped entirely must clear its
+        # open drift, not hold it forever (the slo.py empty-window rule)
+        evaluable = (live is not None
+                     and live["rows"] >= self.min_rows
+                     and live["num_features"] == base["num_features"])
+        max_score = 0.0
+        for j in range(base["num_features"]):
+            st = self._state.setdefault(j, _FeatureState())
+            if not evaluable:
+                # signal absent: never starts a breach, counts clean
+                # (the slo.py empty-window rule — a tenant whose traffic
+                # stopped entirely must still clear)
+                breaching = False
+                st.score = 0.0
+            else:
+                comps = drift_components(base, live, j)
+                st.stat, st.score = max(comps.items(), key=lambda kv: kv[1])
+                breaching = st.score >= self.threshold
+                max_score = max(max_score, st.score)
+            if breaching:
+                st.bad += 1
+                st.good = 0
+                if not st.breached and st.bad >= self.hysteresis:
+                    st.breached = True
+                    st.since = now
+                    ev = {"event": "data_drift", "model": self.model,
+                          "feature": j, "stat": st.stat,
+                          "score": round(st.score, 4),
+                          "threshold": self.threshold,
+                          "live_rows": live["rows"],
+                          "value": live["mean"][j],
+                          "baseline": base["mean"][j]}
+                    col = self.column_of(j)
+                    if col is not None:
+                        ev["column"] = col
+                    events.append(ev)
+            else:
+                st.good += 1
+                st.bad = 0
+                if st.breached and st.good >= self.hysteresis:
+                    st.breached = False
+                    ev = {"event": "data_drift_clear", "model": self.model,
+                          "feature": j, "stat": st.stat,
+                          "score": round(st.score, 4),
+                          "drift_s": round(now - (st.since or now), 3)}
+                    col = self.column_of(j)
+                    if col is not None:
+                        ev["column"] = col
+                    st.since = None
+                    events.append(ev)
+        self.last_score = max_score
+        return events
+
+    def drifting(self) -> int:
+        return sum(1 for st in self._state.values() if st.breached)
+
+
+class DataDriftMonitor:
+    """Process-wide registry of per-model skew detectors (the
+    install/active pattern every obs leg uses).  The serve batcher's
+    pack stage calls ``observe`` per coalesced dispatch; the serve SLO
+    tick calls ``evaluate`` — which journals ``data_drift``/
+    ``data_drift_clear`` transitions, refreshes the ``stpu_data_*``
+    gauges, journals one windowed ``data_stats`` snapshot per model per
+    window (the dead-fleet record ``obs data`` reads), and feeds the
+    fleet-wide max score to the ``slo-data-drift`` watchdog target."""
+
+    def __init__(self, *, threshold: float = 1.0, hysteresis: int = 2,
+                 window_s: float = 60.0, plane: str = "serve",
+                 worker: int | None = None,
+                 min_rows: int = MIN_EVAL_ROWS):
+        from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+
+        self.threshold = float(threshold)
+        self.hysteresis = int(hysteresis)
+        self.window_s = float(window_s)
+        self.plane = plane
+        self.worker = worker
+        self.min_rows = int(min_rows)
+        self.registry = MetricsRegistry()
+        self._detectors: dict[str, SkewDetector] = {}
+        self._last_stats_emit: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._eval_lock = threading.Lock()
+        self._warned = False
+
+    # ---- registration ----
+    def _close_open_breaches(self, det: "SkewDetector | None",
+                             reason: str) -> None:
+        """Journal ``data_drift_clear`` for every feature a discarded
+        detector left BREACHED: a reload (new baseline = new contract)
+        or an eviction ends the excursion, and leaving it open in the
+        journal forever would render as STILL DRIFTING in `obs data`
+        long after the condition stopped existing."""
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+        if det is None:
+            return
+        now = _mono()
+        for j, st in det._state.items():
+            if not st.breached:
+                continue
+            fields = {"model": det.model, "feature": j, "stat": st.stat,
+                      "score": round(st.score, 4),
+                      "drift_s": round(now - (st.since or now), 3),
+                      "reason": reason}
+            col = det.column_of(j)
+            if col is not None:
+                fields["column"] = col
+            obs_journal.emit("data_drift_clear", plane=self.plane,
+                             worker=self.worker, **fields)
+
+    def register(self, model: str, baseline: dict | None, *,
+                 columns: Sequence[int] | None = None) -> SkewDetector:
+        """(Re-)register a model's detector.  ``baseline`` is the
+        ``stats`` dict out of the bundle's ``feature_stats.json`` (None
+        = no shipped baseline: the live sketch still collects — visible
+        in ``obs data`` and the gauges — but nothing can breach).
+        Re-registration (hot reload) keeps the live window and resets
+        the baseline + state machines: the new bundle's distribution is
+        a new contract — any open drift clears (journaled) with the old
+        baseline it was measured against."""
+        with self._lock:
+            old = self._detectors.get(model)
+            det = SkewDetector(
+                model, baseline, columns=columns,
+                threshold=self.threshold, hysteresis=self.hysteresis,
+                window_s=self.window_s, min_rows=self.min_rows)
+            if old is not None:
+                det.live = old.live  # keep the live window across reloads
+            self._detectors[model] = det
+        self._close_open_breaches(old, reason="reload")
+        return det
+
+    def unregister(self, model: str) -> None:
+        """Drop a model (eviction/close): its gauges leave the scrape
+        with it — a frozen drift score for an unrouted tenant would
+        mislead exactly the autoscaler these gauges feed — and any open
+        drift excursion clears in the journal (reason=evict)."""
+        with self._eval_lock:
+            with self._lock:
+                old = self._detectors.pop(model, None)
+                self._last_stats_emit.pop(model, None)
+            self._close_open_breaches(old, reason="evict")
+            esc = _esc(model)
+            for g in ("data_drift_score_", "data_drifting_features_",
+                      "data_live_rows_", "data_baseline_rows_"):
+                self.registry.remove_gauge(g + esc)
+
+    def detector(self, model: str) -> SkewDetector | None:
+        with self._lock:
+            return self._detectors.get(model)
+
+    # ---- hot path ----
+    def observe(self, model: str, x) -> None:
+        """Feed one pre-padding feature matrix into ``model``'s live
+        window (auto-registering a baseline-less detector for an
+        unknown name).  Never raises — a sketch bug must not take down
+        the dispatch path it instruments."""
+        try:
+            det = self._detectors.get(model)
+            if det is None:
+                det = self.register(model, None)
+            det.observe(x)
+        except Exception as e:
+            if not self._warned:
+                self._warned = True
+                log.warning("data sketch observe failed (disabled for "
+                            "this message): %s: %s", type(e).__name__, e)
+
+    # ---- slow path ----
+    def evaluate(self, now: float | None = None, **ctx: Any) -> list[dict]:
+        """One evaluation tick over every registered model (the serve
+        SLO loop's cadence).  Returns the journaled events."""
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+
+        with self._lock:
+            detectors = list(self._detectors.items())
+        events: list[dict] = []
+        fleet_max = None
+        with self._eval_lock:
+            for model, det in detectors:
+                events.extend(det.evaluate(now=now))
+                esc = _esc(model)
+                live = det.last_live
+                self.registry.set_gauge(f"data_drift_score_{esc}",
+                                        round(det.last_score, 4))
+                self.registry.set_gauge(f"data_drifting_features_{esc}",
+                                        det.drifting())
+                self.registry.set_gauge(f"data_live_rows_{esc}",
+                                        live["rows"] if live else 0)
+                self.registry.set_gauge(
+                    f"data_baseline_rows_{esc}",
+                    det.baseline["rows"] if det.baseline else 0)
+                if live is not None and det.baseline is not None:
+                    fleet_max = max(fleet_max or 0.0, det.last_score)
+                # one windowed snapshot per model per window: the
+                # journal records state, not tick noise — and `obs
+                # data` renders the live table from exactly these
+                mono = _mono() if now is None else now
+                last = self._last_stats_emit.get(model, 0.0)
+                if live is not None and mono - last >= self.window_s:
+                    self._last_stats_emit[model] = mono
+                    obs_journal.emit(
+                        "data_stats", plane=self.plane, worker=self.worker,
+                        model=model, stats=live,
+                        drift_score=round(det.last_score, 4),
+                        drifting=det.drifting(), **ctx)
+        for ev in events:
+            fields = {k: v for k, v in ev.items() if k != "event"}
+            obs_journal.emit(ev["event"], plane=self.plane,
+                             worker=self.worker, **fields, **ctx)
+        wd = obs_slo.active()
+        if wd is not None and fleet_max is not None:
+            # window MAX across models: one drifted tenant IS the
+            # breach — averaging it against healthy peers would hide it
+            wd.observe("data_drift_score", fleet_max)
+        return events
+
+    def render_prometheus(self) -> str:
+        """``stpu_data_*`` gauges — appended to every scrape surface by
+        ``obs.device_obs_text``."""
+        return self.registry.render_prometheus("stpu_")
+
+
+class TrainDataSketch:
+    """The training-side accumulator: one process-wide DataSketch fed
+    from the ingest tap (sampled every Nth block under the trace-sample
+    discipline) and, for in-memory fits, one whole-dataset fold per
+    ``fit``.  Its snapshot is the baseline the export ships as
+    ``feature_stats.json``.
+
+    Generation semantics: every trainer fit path brackets itself with
+    ``begin_fit``/``end_fit``.  Concurrent fits (a thread-launcher
+    fleet's workers — one job, one data distribution) SHARE the sketch;
+    a fit starting after every previous fit ended is a NEW training
+    (same process, possibly a different dataset of the same width) and
+    RESETS it — without this, the second training's export would ship a
+    baseline blended with the first one's data.
+
+    The block tap is ASYNCHRONOUS: ``add_block`` copies a bounded row
+    subsample (microseconds) and a single background folder thread runs
+    the actual fold — the GIL-bound sketch work must not sit inside a
+    worker's streaming step path, where it would read as per-rank step
+    skew to the very fleet monitor the obs plane runs (measured: the
+    in-line fold intermittently tripped the straggler drill's no-fault
+    control arm on a 2-core host).  The queue is bounded; a producer
+    outpacing the folder drops samples (counted), never blocks.
+    ``snapshot`` flushes the queue first, so exports see every fed
+    block."""
+
+    def __init__(self, sample_every: int = 1):
+        self.sample_every = max(1, int(sample_every))
+        self._lock = threading.Lock()
+        self._active_fits: set[int] = set()
+        self._had_fits = False
+        self._pending: list = []
+        self._cond = threading.Condition(self._lock)
+        self._folding = 0
+        self.dropped = 0
+        self._thread: threading.Thread | None = None
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self.sketch = DataSketch()
+        self._n = 0
+        self._pending.clear()
+        # id -> weakref of folded dataset arrays: the weakref guards the
+        # CPython id-reuse hazard (a freed array's id can be handed to a
+        # later, different array — a bare id set would silently skip it)
+        self._datasets: dict[int, Any] = {}
+
+    def begin_fit(self, owner: int) -> None:
+        with self._lock:
+            if not self._active_fits and self._had_fits:
+                self._reset_locked()
+            self._had_fits = True
+            self._active_fits.add(owner)
+
+    def end_fit(self, owner: int) -> None:
+        with self._lock:
+            self._active_fits.discard(owner)
+
+    def add_block(self, x) -> None:
+        """Ingest tap: one pre-batching feature block (padding-free by
+        construction), sampled.  Cheap by contract — a strided bounded
+        copy plus a queue append; the fold happens on the folder
+        thread."""
+        self._n += 1
+        if self._n % self.sample_every:
+            return
+        x = np.asarray(x)
+        if x.ndim != 2 or x.shape[0] == 0:
+            return
+        if x.shape[0] > MOMENT_ROW_CAP:
+            x = x[:: -(-x.shape[0] // MOMENT_ROW_CAP)]
+        # copy: the pipeline recycles/mutates block buffers, and the
+        # fold happens later on another thread
+        sample = np.array(x, copy=True)
+        with self._lock:
+            if len(self._pending) >= 16:
+                self.dropped += 1
+                return
+            self._pending.append(sample)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._fold_loop, name="stpu-data-sketch",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _fold_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    # park; a long-idle folder thread just sleeps on the
+                    # condition (daemon — dies with the process)
+                    self._cond.wait()
+                sample = self._pending.pop(0)
+                sketch = self.sketch
+                self._folding += 1
+            try:
+                sketch.add_batch(sample)
+            except Exception:  # the folder must never die mute mid-job
+                pass
+            finally:
+                with self._cond:
+                    self._folding -= 1
+                    self._cond.notify_all()
+
+    def _flush(self, timeout_s: float = 5.0) -> None:
+        deadline = _mono() + timeout_s
+        with self._cond:
+            while (self._pending or self._folding) and _mono() < deadline:
+                self._cond.wait(timeout=0.05)
+
+    def add_dataset(self, x) -> None:
+        """In-memory fit tap: fold the whole training matrix once per
+        distinct array (epochs re-iterate the same rows — re-folding
+        them every epoch would just weight the identical distribution
+        by the epoch count).  Chunked, so the per-call quantile-feed
+        cap applies per chunk and a one-shot fold still gives the P²
+        estimators a real sample, not budget-many rows of a million."""
+        import weakref
+
+        x = np.asarray(x)
+        key = id(x)
+        with self._lock:
+            ref = self._datasets.get(key)
+            if ref is not None and ref() is x:
+                return
+            try:
+                self._datasets[key] = weakref.ref(x)
+            except TypeError:  # non-weakrefable base: fold every call
+                self._datasets.pop(key, None)
+            sketch = self.sketch
+        # 512-row chunks: the per-call quantile budget then feeds the
+        # estimators a real sample of the whole matrix (a one-time cost
+        # at fit start, ~100ms per million rows)
+        for i in range(0, len(x), 512):
+            sketch.add_batch(x[i:i + 512])
+
+    def snapshot(self) -> dict | None:
+        self._flush()
+        return self.sketch.snapshot()
+
+
+# ---- process-global hooks (mirror obs.trace / obs.slo) ----
+
+_active: DataDriftMonitor | None = None
+_train: TrainDataSketch | None = None
+
+
+def install(monitor: DataDriftMonitor) -> DataDriftMonitor:
+    global _active
+    _active = monitor
+    return monitor
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> DataDriftMonitor | None:
+    return _active
+
+
+def install_train(sketch: TrainDataSketch) -> TrainDataSketch:
+    global _train
+    _train = sketch
+    return sketch
+
+
+def uninstall_train() -> None:
+    global _train
+    _train = None
+
+
+def train_active() -> TrainDataSketch | None:
+    return _train
+
+
+def baseline_from_journal(journal_base: str) -> dict | None:
+    """Reconstruct a train-side feature snapshot from a fleet's
+    journals: the LAST ``data_stats`` event per train-plane worker,
+    merged count-weighted.  The fleet export path uses this — the
+    submitter process restores weights from the checkpoint, but the
+    data flowed through the WORKERS' processes, whose sketches live in
+    their journal siblings."""
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    latest: dict[Any, tuple] = {}
+    for ev in read_events(journal_base):
+        if ev.get("event") == "data_stats" and ev.get("plane") == "train":
+            stats = ev.get("stats")
+            if isinstance(stats, dict) and stats.get("rows"):
+                latest[ev.get("worker")] = (ev.get("ts", 0.0), stats)
+    if not latest:
+        return None
+    # oldest-first by event time: if the workers' schemas ever disagree
+    # (a mid-job width change), merge_snapshots keeps the NEWEST width
+    ordered = sorted(latest.values(), key=lambda t: t[0])
+    return merge_snapshots([s for _, s in ordered])

@@ -209,10 +209,35 @@ class Journal:
 
 _active: Journal | None = None
 
+#: callables to fire when a journal next installs — the backlog channel
+#: for conditions detected BEFORE the CLI installs obs (config parsing
+#: runs first): the detector registers a deferred emit instead of
+#: silently losing the record.  Fired once each, best-effort.
+_install_hooks: list = []
+
+
+def notify_on_install(fn) -> None:
+    """Run ``fn`` now if a journal is active, else when one installs.
+    ``fn`` fires at most once; exceptions are swallowed (the journal
+    contract: observability never takes down what it observes)."""
+    if _active is not None:
+        try:
+            fn()
+        except Exception:
+            pass
+    else:
+        _install_hooks.append(fn)
+
 
 def install(journal: Journal) -> Journal:
     global _active
     _active = journal
+    hooks, _install_hooks[:] = list(_install_hooks), []
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
     return journal
 
 

@@ -45,7 +45,10 @@ import threading
 import time
 from typing import Any
 
-from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+from shifu_tensorflow_tpu.obs.registry import (
+    MetricsRegistry,
+    escape_label_suffix as _escape,  # one escape across every obs leg
+)
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("obs")
@@ -56,21 +59,6 @@ __all__ = [
     "uninstall",
     "active",
 ]
-
-
-def _escape(model: str) -> str:
-    """Model name -> Prometheus-name-legal suffix (same bijective escape
-    as obs/slo's per-tenant gauges: '_' doubles, other illegal chars
-    become two hex digits — "a.b" and "a_b" cannot collide)."""
-    out = []
-    for ch in model:
-        if ch.isascii() and ch.isalnum():
-            out.append(ch)
-        elif ch == "_":
-            out.append("__")
-        else:
-            out.append("_%02x" % ord(ch))
-    return "".join(out)
 
 
 def _array_bytes(a: Any) -> int:

@@ -46,6 +46,28 @@ def default_bounds() -> tuple[float, ...]:
     return _default_bounds
 
 
+def escape_label_suffix(name: str) -> str:
+    """Tenant/model name → Prometheus-metric-name-legal suffix, used by
+    every surface that folds a name into a GAUGE NAME (per-tenant SLO
+    signals, per-model device bytes, per-model data-drift scores).  The
+    escape is BIJECTIVE — '_' doubles, any other char outside
+    [A-Za-z0-9] becomes two hex digits — so names differing only in
+    '.', '-' vs '_' ("a.b" vs "a_b") cannot collide onto one gauge and
+    silently overwrite each other's state.  ONE home on purpose: a fix
+    applied to one leg's copy but not another's would render the same
+    tenant to different suffixes across scrape surfaces and break every
+    dashboard join on the name."""
+    out = []
+    for ch in name:
+        if ch.isascii() and ch.isalnum():
+            out.append(ch)
+        elif ch == "_":
+            out.append("__")
+        else:
+            out.append("_%02x" % ord(ch))
+    return "".join(out)
+
+
 _build_info_cache: dict[str, str] = {}
 
 

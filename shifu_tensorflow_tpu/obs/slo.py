@@ -47,7 +47,10 @@ import threading
 import time
 from typing import Any
 
-from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+from shifu_tensorflow_tpu.obs.registry import (
+    MetricsRegistry,
+    escape_label_suffix,
+)
 
 __all__ = [
     "P2Quantile",
@@ -66,22 +69,14 @@ _mono = time.monotonic
 def _gauge_name(signal: str) -> str:
     """Signal name → Prometheus-legal gauge name.  Per-tenant signals
     carry a ``:<model>`` suffix whose charset ([A-Za-z0-9._-]) is wider
-    than metric names allow; the escape is BIJECTIVE ('_' doubles,
-    other illegal chars become two hex digits) so tenants differing
+    than metric names allow; the shared bijective escape
+    (obs/registry.escape_label_suffix) guarantees tenants differing
     only in '.', '-' vs '_' ("a.b" vs "a_b") cannot collide onto one
     gauge and silently overwrite each other's breach state."""
     if ":" not in signal:
         return f"slo_{signal}"
     base, model = signal.split(":", 1)
-    esc = []
-    for ch in model:
-        if ch.isascii() and ch.isalnum():
-            esc.append(ch)
-        elif ch == "_":
-            esc.append("__")
-        else:
-            esc.append("_%02x" % ord(ch))
-    return f"slo_{base}_{''.join(esc)}"
+    return f"slo_{base}_{escape_label_suffix(model)}"
 
 
 class P2Quantile:
@@ -635,6 +630,14 @@ def from_config(cfg, *, plane: str = "train",
              target=getattr(cfg, "slo_compile_s", 0.0), unit="s")
     wd.track("devmem_frac", stat="max",
              target=getattr(cfg, "slo_devmem_frac", 0.0))
+    # data leg (obs/datastats.py): the drift monitor feeds the
+    # fleet-wide MAX per-model drift score on every evaluation tick —
+    # one drifted tenant IS the breach, an average against healthy
+    # peers would hide it.  Every plane: serve is the primary feeder,
+    # but a train-plane monitor comparing against a previous baseline
+    # rides the same signal.
+    wd.track("data_drift_score", stat="max",
+             target=getattr(cfg, "slo_data_drift", 0.0))
     return wd
 
 

@@ -57,6 +57,7 @@ from typing import Callable
 import numpy as np
 
 from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.utils import logs
@@ -380,6 +381,14 @@ class MicroBatcher:
                     x = (batch[0].rows if len(batch) == 1
                          else np.concatenate([p.rows for p in batch],
                                              axis=0))
+                    # data-observability tap (obs/datastats.py): feed
+                    # the PRE-padding concat into this model's live
+                    # windowed sketch — once per coalesced dispatch, on
+                    # the pack thread (off the device path), before the
+                    # ladder's zero rows could read as a distribution
+                    mon = obs_datastats.active()
+                    if mon is not None:
+                        mon.observe(self.model or "default", x)
                     work.padded = pad_rows(x, work.bucket)
                 except BaseException as e:
                     work.error = e

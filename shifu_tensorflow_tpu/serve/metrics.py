@@ -29,6 +29,9 @@ _COUNTERS = (
     "batches_total",        # device dispatches by the micro-batcher
     "padded_rows_total",    # padding rows added by the bucket ladder
     "shed_total",           # requests shed with 429 (backpressure)
+    "nan_rows_total",       # payload rows carrying NaN/inf (rejected 400,
+                            # counted per tenant — garbage in is a data
+                            # signal, not just a client error)
     "errors_total",         # requests failed with 4xx/5xx (excl. 429)
     "reloads_total",        # hot-reload swaps admitted
     "reload_failures_total",  # reload attempts refused (corrupt artifact)

@@ -39,9 +39,11 @@ import time
 from dataclasses import dataclass
 
 from shifu_tensorflow_tpu.export.saved_model import (
+    FEATURE_STATS,
     NATIVE_MANIFEST,
     NATIVE_WEIGHTS,
 )
+from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import memory as obs_memory
 from shifu_tensorflow_tpu.obs import trace as obs_trace
@@ -80,6 +82,11 @@ class LoadedModel:
     verified: bool         # manifest present and checked
     loaded_at: float
     fingerprint: str = ""  # change-detector value captured at load time
+    #: parsed feature_stats.json (the training-distribution baseline the
+    #: skew detector compares live traffic against), or None when the
+    #: bundle shipped without one.  Trusted only when the manifest
+    #: covered it (or the whole bundle is legacy manifest-less).
+    feature_stats: dict | None = None
 
 
 def _verify_manifest(model_dir: str) -> dict | None:
@@ -161,6 +168,7 @@ class ModelStore:
         # that can only 503 (or worse, serve garbage) helps nobody — the
         # operator points it at a good export instead
         self._current = self._load(epoch=0)
+        self._register_baseline(self._current)
         log.info(
             "loaded model from %s (digest %s, verified=%s)",
             model_dir, self._current.digest[:12] or "<legacy>",
@@ -229,11 +237,47 @@ class ModelStore:
                 verified=manifest is not None,
                 loaded_at=time.time(),
                 fingerprint=fingerprint,
+                feature_stats=self._load_feature_stats(manifest),
             )
 
         return retry_util.call(
             attempt, policy=self._retry_policy, site="serve.reload"
         )
+
+    def _load_feature_stats(self, manifest: dict | None) -> dict | None:
+        """The bundle-shipped drift baseline (feature_stats.json), read
+        ONLY when the manifest vouches for it — its bytes were already
+        digest-verified by ``_verify_manifest`` on this load attempt (a
+        bit-flipped stats file therefore refuses admission before this
+        runs).  A legacy manifest-less bundle reads it best-effort.  A
+        stale orphan beside a manifest that does not cover it is
+        ignored: nothing vouches for which generation it belongs to."""
+        if manifest is not None and FEATURE_STATS not in manifest.get(
+                "files", {}):
+            return None
+        path = os.path.join(self.model_dir, FEATURE_STATS)
+        try:
+            if not fs.exists(path):
+                return None
+            return json.loads(fs.read_text(path))
+        except (OSError, ValueError) as e:
+            log.warning("unreadable %s at %s: %s", FEATURE_STATS,
+                        self.model_dir, e)
+            return None
+
+    def _register_baseline(self, loaded: "LoadedModel") -> None:
+        """Hand the (re)loaded bundle's baseline to the data-drift
+        monitor under this store's tenant name — serving traffic starts
+        comparing against the NEW training distribution the moment the
+        swap lands.  A bundle without stats still registers (live
+        distribution stays observable via `obs data`; nothing can
+        breach)."""
+        mon = obs_datastats.active()
+        if mon is None:
+            return
+        stats = loaded.feature_stats or {}
+        mon.register(self.model_name or "default", stats.get("stats"),
+                     columns=stats.get("feature_columns"))
 
     def _warm(self, model) -> None:
         """Compile the full bucket ladder on ``model`` BEFORE it is
@@ -339,6 +383,13 @@ class ModelStore:
             current, self._current = self._current, None
         if current is not None:
             current.model.release()
+            # the tenant's drift detector (and its stpu_data_* gauges)
+            # leaves with the model — tenancy eviction closes through
+            # here, and a frozen drift score for an unrouted tenant
+            # would mislead the autoscaler exactly like a frozen p99
+            mon = obs_datastats.active()
+            if mon is not None:
+                mon.unregister(self.model_name or "default")
 
     def _poll_loop(self) -> None:
         # the last fingerprint we refused, for LOG de-duplication only —
@@ -391,6 +442,7 @@ class ModelStore:
         loaded = self._load(epoch=next_epoch)
         with self._lock:
             old, self._current = self._current, loaded
+        self._register_baseline(loaded)
         if self.metrics is not None:
             self.metrics.inc("reloads_total")
         log.info("hot-reloaded model epoch %d (digest %s, verified=%s)",

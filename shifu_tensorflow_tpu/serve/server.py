@@ -40,6 +40,7 @@ from shifu_tensorflow_tpu.serve.batcher import (
     ShedLoad,
 )
 from shifu_tensorflow_tpu.export.bucketing import ladder
+from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.serve.config import ServeConfig
@@ -278,6 +279,12 @@ class ScoringServer:
                 rec = obs_compile.active()
                 if rec is not None:
                     rec.tick()
+                # data leg: live-vs-baseline skew evaluation on the same
+                # tick (journals data_drift/data_drift_clear + feeds the
+                # slo-data-drift target the evaluate() above judges)
+                mon = obs_datastats.active()
+                if mon is not None:
+                    mon.evaluate()
                 obs_profile.poll()
             except Exception as e:  # the watchdog must never kill serving
                 log.error("slo evaluation failed: %s: %s",
@@ -385,9 +392,28 @@ class ScoringServer:
                 f"model expects {num_features} features per "
                 f"row, got {rows.shape[1]}"
             )
-        if not np.isfinite(rows).all():
-            raise _BadRequest("rows contain NaN/Inf")
         return rows
+
+    @staticmethod
+    def _reject_nonfinite(rows: np.ndarray, metrics,
+                          model: str | None) -> None:
+        """NaN/inf payload rows are still a client error (400, as
+        always) — but now a COUNTED one: ``stpu_serve_nan_rows_total``
+        per tenant (satellite of the data-obs leg; a client whose
+        upstream feature join broke sends NaN at scale, and a counter
+        is how the operator notices before the client does), and the
+        offending rows feed the tenant's live data sketch so the
+        missing-rate drift signal sees traffic the scorer refused."""
+        finite_rows = int(np.isfinite(rows).all(axis=1).sum())
+        bad = rows.shape[0] - finite_rows
+        if not bad:
+            return
+        if metrics is not None:
+            metrics.inc("nan_rows_total", bad)
+        mon = obs_datastats.active()
+        if mon is not None:
+            mon.observe(model or "default", rows)
+        raise _BadRequest("rows contain NaN/Inf")
 
     @staticmethod
     def _score_response(scores: np.ndarray, loaded, rid: str | None,
@@ -415,6 +441,7 @@ class ScoringServer:
             return self._score_multi(raw, rid, model_name)
         model = self.store.current()
         rows = self._to_rows(raw, model.model.num_features)
+        self._reject_nonfinite(rows, self.metrics, None)
         self.metrics.inc("requests_total")
         if self._slo is not None:
             # "requests" counts every scoring ATTEMPT (a shed raises out
@@ -455,6 +482,7 @@ class ScoringServer:
                 raise ModelColdStart(tenant.name)
         loaded = store.current()
         rows = self._to_rows(raw, loaded.model.num_features)
+        self._reject_nonfinite(rows, tenant.metrics, tenant.name)
         tenant.metrics.inc("requests_total")
         if self._slo is not None:
             self._slo.count("requests")

@@ -928,12 +928,27 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
         ckpt_cls = NpzCheckpointer if use_flat else Checkpointer
         with ckpt_cls(args.checkpoint_dir) as ckpt:
             trainer.restore(ckpt)
+        # bundle-shipped drift baseline for the FLEET path: the data
+        # flowed through the workers' processes, not this submitter —
+        # their per-epoch journaled data_stats sketches merge into the
+        # feature_stats.json this export ships (obs/datastats.py)
+        feature_stats = None
+        obs_cfg = resolve_obs(args, conf)
+        if obs_cfg.enabled and obs_cfg.journal_path:
+            from shifu_tensorflow_tpu.obs import datastats as obs_datastats
+
+            feature_stats = obs_datastats.baseline_from_journal(
+                obs_cfg.journal_path)
+            if feature_stats is not None and \
+                    feature_stats.get("num_features") != schema.num_features:
+                feature_stats = None
         wrote = export_model(
             args.export_dir,
             trainer,
             feature_columns=schema.feature_columns,
             zscale_means=schema.means or None,
             zscale_stds=schema.stds or None,
+            feature_stats=feature_stats,
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
     print_summary()

@@ -23,7 +23,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from functools import partial
+from functools import partial, wraps
 from typing import Any, Callable, Iterable, Sequence
 
 import jax
@@ -750,6 +750,29 @@ def make_eval_step_body(apply_fn, loss_name: str = "mse"):
         return jnp.where(has_rows, loss, jnp.nan), pred
 
     return eval_step
+
+
+def _sketch_fit_scope(fn):
+    """Bracket a Trainer fit method with the train data sketch's
+    ``begin_fit``/``end_fit`` generation markers: concurrent fits
+    (thread-launcher fleet workers) share the sketch, while a fit
+    starting after every previous fit ended is a NEW training in the
+    same process and resets it — so a second same-width training can
+    never export a baseline blended with the first one's data
+    (obs/datastats.TrainDataSketch)."""
+    @wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        from shifu_tensorflow_tpu.obs import datastats as _obs_ds
+
+        sk = _obs_ds.train_active()
+        if sk is not None:
+            sk.begin_fit(id(self))
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            if sk is not None:
+                sk.end_fit(id(self))
+    return wrapper
 
 
 def make_eval_step(apply_fn, loss_name: str = "mse"):
@@ -1667,6 +1690,34 @@ class Trainer:
         if rec is not None:
             rec.tick()
         _obs_profile.poll()
+        # data leg (obs/datastats.py): journal the cumulative train-side
+        # feature sketch each epoch — the record `obs data` and the
+        # fleet export path (baseline_from_journal) read, and the
+        # in-bundle feature_stats.json baseline's provenance trail
+        from shifu_tensorflow_tpu.obs import datastats as _obs_datastats
+
+        sk = _obs_datastats.train_active()
+        if sk is not None and j is not None:
+            snap = sk.snapshot()
+            if snap is not None:
+                j.emit("data_stats", plane="train",
+                       worker=self.worker_index,
+                       epoch=stats.current_epoch, stats=snap)
+
+    def _note_train_dataset(self, dataset) -> None:
+        """Fold an in-memory dataset's training features into the
+        process-wide train data sketch (obs/datastats.py) — the
+        streaming paths feed it block-by-block at batch formation
+        instead (data/pipeline.blocks_to_batches).  One vectorized fold
+        per distinct array: epochs re-shuffle the same rows."""
+        from shifu_tensorflow_tpu.obs import datastats as _obs_datastats
+
+        sk = _obs_datastats.train_active()
+        if sk is not None:
+            try:
+                sk.add_dataset(dataset.train.features)
+            except Exception:  # observability must never fail the fit
+                pass
 
     def _warn_if_validation_empty(self, stats: EpochStats,
                                   early_stop) -> None:
@@ -1864,6 +1915,7 @@ class Trainer:
             "auc": M.auc(s, y, w),
         }
 
+    @_sketch_fit_scope
     def fit(
         self,
         dataset: InMemoryDataset,
@@ -1883,6 +1935,7 @@ class Trainer:
         batch_size = batch_size or self.model_config.batch_size
         history: list[EpochStats] = []
         self.stop_reason = None
+        self._note_train_dataset(dataset)
         for epoch in range(start_epoch, epochs):
             self._health_begin_epoch(epoch)
             t0 = time.time()
@@ -1921,6 +1974,7 @@ class Trainer:
                     break
         return history
 
+    @_sketch_fit_scope
     def fit_device_resident(
         self,
         dataset: InMemoryDataset,
@@ -1968,6 +2022,7 @@ class Trainer:
         epochs = epochs or self.model_config.num_train_epochs
         B = self.align_batch_size(batch_size or self.model_config.batch_size)
         self.stop_reason = None
+        self._note_train_dataset(dataset)
         if self.health_guard is not None:
             # one compiled dispatch IS the epoch here: there is no
             # per-step tick for the watchdog to measure against
@@ -2140,6 +2195,7 @@ class Trainer:
         cache[key] = obs_compile.observe(eval_fn, "train.resident_eval")
         return cache[key]
 
+    @_sketch_fit_scope
     def fit_stream(
         self,
         make_train_stream: Callable[[int], Iterable[Batch]],

@@ -734,6 +734,61 @@ def test_fleet_obs_keys_round_trip_xml_to_dataclass(tmp_path):
         ObsConfig(fleet_skew_threshold=1.0)
 
 
+def test_data_obs_keys_round_trip_xml_to_dataclass(tmp_path):
+    """The PR-12 data keys ride the same ObsConfig chain: the
+    drift-score watchdog target and the per-feature detect/clear
+    threshold — XML → Conf → ObsConfig → JSON bridge."""
+    import pytest
+
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "dataobs.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.SLO_DATA_DRIFT: "2.0",
+        K.DATA_DRIFT_THRESHOLD: "0.5",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.slo_data_drift == 2.0
+    assert cfg.data_drift_threshold == 0.5
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # the target reaches the watchdog signal (every plane)
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+
+    wd = slo_mod.from_config(cfg, plane="serve")
+    assert wd.state()["data_drift_score"]["target"] == 2.0
+    assert wd.state()["data_drift_score"]["stat"] == "max"
+    # install_obs builds the monitor from these knobs
+    from shifu_tensorflow_tpu.obs import datastats as ds_mod
+    from shifu_tensorflow_tpu.obs import install_obs
+
+    try:
+        install_obs(cfg, plane="serve")
+        mon = ds_mod.active()
+        assert mon is not None and mon.threshold == 0.5
+        assert ds_mod.train_active() is not None
+    finally:
+        install_obs(ObsConfig(enabled=False), plane="serve")
+    # defaults: no watchdog target, detection threshold 1.0
+    d = resolve_obs(_args(), _conf({}))
+    assert d.slo_data_drift == 0.0
+    assert d.data_drift_threshold == 1.0
+    # misconfiguration fails loudly
+    with pytest.raises(ValueError, match="slo-data-drift"):
+        ObsConfig(slo_data_drift=-1.0)
+    with pytest.raises(ValueError, match="data-drift-threshold"):
+        ObsConfig(data_drift_threshold=0.0)
+
+
 def test_obs_keys_reach_worker_config_bridge():
     """run_multi ships the resolved ObsConfig to subprocess workers via
     WorkerConfig.obs (JSON bridge) — and omits it entirely when obs is

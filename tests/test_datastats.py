@@ -1,0 +1,688 @@
+"""Data-plane observability (obs/datastats.py): sketch math pinned
+against numpy, windowed expiry, the drift score + hysteretic per-feature
+state machine, the bundle-shipped baseline chain (export → manifest →
+ModelStore → monitor), the serve batcher/ingress taps, and the
+ColumnConfig missing-stats satellite."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.obs import datastats as ds_mod
+from shifu_tensorflow_tpu.obs import journal as journal_mod
+from shifu_tensorflow_tpu.obs import slo as slo_mod
+from shifu_tensorflow_tpu.obs import trace as trace_mod
+from shifu_tensorflow_tpu.obs.datastats import (
+    DataDriftMonitor,
+    DataSketch,
+    SkewDetector,
+    TrainDataSketch,
+    WindowedDataSketch,
+    drift_components,
+    merge_snapshots,
+)
+from shifu_tensorflow_tpu.obs.journal import Journal, read_events
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_hooks():
+    yield
+    trace_mod.uninstall()
+    journal_mod.uninstall()
+    slo_mod.uninstall()
+    ds_mod.uninstall()
+    ds_mod.uninstall_train()
+
+
+# ---- DataSketch math ----
+
+def test_sketch_moments_match_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 2.0, size=(4000, 5)).astype(np.float32)
+    sk = DataSketch()
+    for i in range(0, len(x), 333):  # uneven batches exercise the merge
+        sk.add_batch(x[i:i + 333])
+    s = sk.snapshot()
+    assert s["rows"] == 4000
+    for j in range(5):
+        col = x[:, j].astype(np.float64)
+        assert s["count"][j] == 4000
+        assert s["mean"][j] == pytest.approx(col.mean(), abs=1e-3)
+        assert s["std"][j] == pytest.approx(col.std(), rel=1e-3)
+        assert s["min"][j] == pytest.approx(col.min(), abs=1e-4)
+        assert s["max"][j] == pytest.approx(col.max(), abs=1e-4)
+        assert s["missing_rate"][j] == 0.0
+
+
+def test_sketch_counts_nan_and_inf_separately():
+    x = np.array([[1.0, np.nan, np.inf],
+                  [2.0, np.nan, -np.inf],
+                  [3.0, 5.0, 1.0]], np.float32)
+    sk = DataSketch()
+    sk.add_batch(x)
+    s = sk.snapshot()
+    assert s["count"] == [3, 1, 1]
+    assert s["missing"] == [0, 2, 0]
+    assert s["inf"] == [0, 0, 2]
+    assert s["missing_rate"][1] == pytest.approx(2 / 3)
+    assert s["inf_rate"][2] == pytest.approx(2 / 3)
+    # the non-finite column's moments come from its finite values only
+    assert s["mean"][1] == pytest.approx(5.0)
+    assert s["mean"][2] == pytest.approx(1.0)
+
+
+def test_sketch_quantiles_track_numpy():
+    rng = np.random.default_rng(1)
+    x = rng.exponential(2.0, size=(6000, 2)).astype(np.float32)
+    # a high budget feeds every row → the P² estimate itself is on trial
+    sk = DataSketch(quantile_budget=1_000_000)
+    for i in range(0, len(x), 500):
+        sk.add_batch(x[i:i + 500])
+    s = sk.snapshot()
+    for q in (0.05, 0.5, 0.95):
+        want = np.quantile(x.astype(np.float64), q, axis=0)
+        got = s["quantiles"][str(q)]
+        for j in range(2):
+            assert got[j] == pytest.approx(want[j], rel=0.08, abs=0.05)
+
+
+def test_sketch_width_change_resets():
+    sk = DataSketch()
+    sk.add_batch(np.ones((10, 3), np.float32))
+    sk.add_batch(np.ones((10, 5), np.float32))
+    s = sk.snapshot()
+    assert s["num_features"] == 5 and s["rows"] == 10
+
+
+def test_merge_snapshots_equals_single_pass():
+    # stay under MOMENT_ROW_CAP so both sides fold identical row sets
+    rng = np.random.default_rng(2)
+    x = rng.normal(-1.0, 4.0, size=(2000, 3))
+    whole, a, b = DataSketch(), DataSketch(), DataSketch()
+    whole.add_batch(x)
+    a.add_batch(x[:1000])
+    b.add_batch(x[1000:])
+    m = merge_snapshots([a.snapshot(), b.snapshot()])
+    w = whole.snapshot()
+    assert m["rows"] == 2000
+    for j in range(3):
+        assert m["mean"][j] == pytest.approx(w["mean"][j], abs=1e-3)
+        assert m["std"][j] == pytest.approx(w["std"][j], rel=1e-3)
+        assert m["min"][j] == pytest.approx(w["min"][j])
+        assert m["max"][j] == pytest.approx(w["max"][j])
+
+
+def test_windowed_sketch_mixed_width_keeps_newest_schema():
+    """A reload that changed the model's feature width leaves old-width
+    cells in the preserved live window: the merged snapshot must carry
+    the NEWEST width (cells merge oldest-first), not whichever cell the
+    ring's index order happened to put last."""
+    w = WindowedDataSketch(window_s=8.0, buckets=4)  # bucket_s = 2
+    w.add(np.ones((40, 2), np.float32), now=1000.0)
+    w.add(np.ones((40, 3), np.float32), now=1002.5)  # newer cell, wider
+    snap = w.snapshot(now=1003.0)
+    assert snap["num_features"] == 3 and snap["rows"] == 40
+
+
+def test_windowed_sketch_expires_old_cells():
+    w = WindowedDataSketch(window_s=8.0, buckets=4)
+    w.add(np.ones((50, 2), np.float32), now=1000.0)
+    assert w.snapshot(now=1001.0)["rows"] == 50
+    # inside the window it still contributes; past it the cell is gone
+    assert w.snapshot(now=1007.0)["rows"] == 50
+    assert w.snapshot(now=1020.0) is None
+
+
+# ---- drift score ----
+
+def _baseline(rows=5000, f=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, 1.0, size=(rows, f))
+    sk = DataSketch()
+    sk.add_batch(x)
+    return x, sk.snapshot()
+
+
+def test_drift_components_mean_shift():
+    x, base = _baseline()
+    live_sk = DataSketch()
+    live_sk.add_batch(x[:500] + np.array([3.0, 0.0, 0.0]))
+    live = live_sk.snapshot()
+    c0 = drift_components(base, live, 0)
+    # a 3σ mean shift scores ~3 on the mean axis (quantiles move too)
+    assert c0["mean"] == pytest.approx(3.0, rel=0.15)
+    for j in (1, 2):
+        assert max(drift_components(base, live, j).values()) < 0.6
+
+
+def test_drift_components_scale_and_missing():
+    x, base = _baseline()
+    scaled = x[:500].copy()
+    scaled[:, 1] *= 4.0
+    holes = x[500:1000].copy()
+    holes[:250, 2] = np.nan
+    live_sk = DataSketch()
+    live_sk.add_batch(scaled)
+    live_sk.add_batch(holes)
+    live = live_sk.snapshot()
+    c1 = drift_components(base, live, 1)
+    assert max(c1, key=c1.get) in ("std", "quantile")
+    assert c1["std"] > 1.0
+    c2 = drift_components(base, live, 2)
+    # 25% of live rows are NaN vs ~0 at train: 0.25 * RATE_WEIGHT = 1.0
+    assert c2["missing_rate"] == pytest.approx(
+        ds_mod.RATE_WEIGHT * 0.25, rel=0.1)
+
+
+def test_drift_constant_feature_any_move_scores_large():
+    sk = DataSketch()
+    sk.add_batch(np.full((1000, 1), 7.0))
+    base = sk.snapshot()
+    live_sk = DataSketch()
+    live_sk.add_batch(np.full((100, 1), 7.7))
+    c = drift_components(base, live_sk.snapshot(), 0)
+    assert c["mean"] > 5.0  # 10% off a constant is a schema change
+
+
+# ---- detector state machine ----
+
+def test_skew_detector_hysteresis_and_clear():
+    x, base = _baseline()
+    det = SkewDetector("m", base, columns=[11, 12, 13], threshold=1.0,
+                       hysteresis=2, window_s=10.0, min_rows=32)
+    det.observe(x[:200] + np.array([5.0, 0.0, 0.0]), now=100.0)
+    assert det.evaluate(now=100.5) == []  # tick 1 of 2: hysteresis holds
+    evs = det.evaluate(now=101.0)
+    drifts = [e for e in evs if e["event"] == "data_drift"]
+    assert len(drifts) == 1 and drifts[0]["feature"] == 0
+    assert drifts[0]["column"] == 11
+    assert drifts[0]["stat"] in ("mean", "quantile")
+    assert drifts[0]["score"] >= 1.0
+    assert det.drifting() == 1
+    # no re-fire while it stays drifted
+    assert not det.evaluate(now=101.5)
+    # traffic returns to baseline; the shifted cells age out
+    det.observe(x[200:400], now=115.0)
+    det.evaluate(now=115.5)
+    evs = det.evaluate(now=116.0)
+    clears = [e for e in evs if e["event"] == "data_drift_clear"]
+    assert len(clears) == 1 and clears[0]["feature"] == 0
+    assert clears[0]["drift_s"] > 0
+    assert det.drifting() == 0
+
+
+def test_skew_detector_small_window_never_evaluates():
+    x, base = _baseline()
+    det = SkewDetector("m", base, threshold=1.0, hysteresis=1,
+                       window_s=10.0, min_rows=64)
+    det.observe(x[:16] + 100.0, now=10.0)  # wildly shifted but 16 rows
+    assert det.evaluate(now=10.5) == []
+    assert det.last_score == 0.0
+
+
+def test_skew_detector_empty_window_counts_clean():
+    """The slo.py empty-window rule: a tenant whose traffic stopped
+    entirely (window drained) must still clear an open drift."""
+    x, base = _baseline()
+    det = SkewDetector("m", base, threshold=1.0, hysteresis=1,
+                       window_s=5.0, min_rows=32)
+    det.observe(x[:100] + np.array([5.0, 0.0, 0.0]), now=10.0)
+    assert any(e["event"] == "data_drift" for e in det.evaluate(now=10.5))
+    # nothing observed since; the window is empty at now=30
+    evs = det.evaluate(now=30.0)
+    assert any(e["event"] == "data_drift_clear" for e in evs)
+
+
+def test_detector_without_baseline_collects_but_never_breaches():
+    det = SkewDetector("m", None, threshold=0.001, hysteresis=1)
+    det.observe(np.ones((100, 2), np.float32), now=5.0)
+    assert det.evaluate(now=5.5) == []
+    assert det.live.rows(now=5.5) == 100
+
+
+# ---- monitor (journal + gauges + watchdog feed) ----
+
+def test_monitor_journals_drift_and_renders_gauges(tmp_path):
+    jrn = journal_mod.install(Journal(str(tmp_path / "j.jsonl"),
+                                      plane="serve"))
+    wd = slo_mod.install(SloWatchdog_with_target())
+    x, base = _baseline()
+    mon = ds_mod.install(DataDriftMonitor(
+        threshold=1.0, hysteresis=1, window_s=10.0, plane="serve"))
+    mon.register("alpha", base, columns=[1, 2, 3])
+    mon.register("beta", base, columns=[1, 2, 3])
+    mon.observe("alpha", x[:200] + np.array([4.0, 0.0, 0.0]))
+    mon.observe("beta", x[200:400])
+    evs = mon.evaluate()
+    drifts = [e for e in evs if e["event"] == "data_drift"]
+    assert drifts and all(e["model"] == "alpha" for e in drifts)
+    events = read_events(str(tmp_path / "j.jsonl"))
+    kinds = {e["event"] for e in events}
+    assert "data_drift" in kinds and "data_stats" in kinds
+    stats_models = {e.get("model") for e in events
+                    if e["event"] == "data_stats"}
+    assert stats_models == {"alpha", "beta"}
+    text = mon.render_prometheus()
+    assert "stpu_data_drift_score_alpha" in text
+    assert "stpu_data_drifting_features_alpha" in text
+    assert "stpu_data_live_rows_beta" in text
+    # the fleet-wide max fed the watchdog's data_drift_score signal
+    assert wd.state()["data_drift_score"]["value"] >= 1.0
+    # unregister removes the gauges (eviction contract)
+    mon.unregister("alpha")
+    text = mon.render_prometheus()
+    assert "alpha" not in text and "beta" in text
+    jrn.close()
+
+
+def SloWatchdog_with_target():
+    from shifu_tensorflow_tpu.obs.slo import SloWatchdog
+
+    wd = SloWatchdog(window_s=30.0, plane="serve")
+    wd.track("data_drift_score", stat="max", target=2.0)
+    return wd
+
+
+def test_open_drift_clears_on_reload_and_evict(tmp_path):
+    """A detector discarded with an OPEN breach (hot reload replaces
+    the baseline; eviction drops the tenant) journals the clear — an
+    excursion left open forever would render STILL DRIFTING long after
+    the condition ended."""
+    jrn = journal_mod.install(Journal(str(tmp_path / "j.jsonl"),
+                                      plane="serve"))
+    x, base = _baseline()
+    mon = ds_mod.install(DataDriftMonitor(
+        threshold=1.0, hysteresis=1, window_s=30.0, plane="serve"))
+    for name in ("reloaded", "evicted"):
+        mon.register(name, base, columns=[1, 2, 3])
+        mon.observe(name, x[:100] + np.array([5.0, 0.0, 0.0]))
+    evs = mon.evaluate()
+    assert sum(1 for e in evs if e["event"] == "data_drift") == 2
+    mon.register("reloaded", base)   # hot reload: new contract
+    mon.unregister("evicted")        # eviction
+    jrn.close()
+    events = read_events(str(tmp_path / "j.jsonl"))
+    clears = {e["model"]: e for e in events
+              if e["event"] == "data_drift_clear"}
+    assert clears["reloaded"]["reason"] == "reload"
+    assert clears["evicted"]["reason"] == "evict"
+    assert all(e["feature"] == 0 for e in clears.values())
+
+
+def test_monitor_observe_never_raises():
+    mon = DataDriftMonitor()
+    mon.observe("m", "not an array")  # swallowed + warned once
+    mon.observe("m", None)
+    assert mon.evaluate() == []
+
+
+# ---- train sketch + taps ----
+
+def test_train_sketch_generation_reset_between_trainings():
+    """A fit starting after every previous fit ended is a NEW training
+    and resets the sketch (a second same-width training must not export
+    a baseline blended with the first one's data); CONCURRENT fits (a
+    thread-launcher fleet) share it."""
+    sk = TrainDataSketch()
+    sk.begin_fit(1)
+    sk.add_dataset(np.full((100, 2), 1.0, np.float32))
+    sk.end_fit(1)
+    # concurrent fleet: two overlapping fits accumulate together
+    sk2 = TrainDataSketch()
+    sk2.begin_fit(1)
+    sk2.begin_fit(2)
+    sk2.add_dataset(np.full((50, 2), 1.0, np.float32))
+    sk2.end_fit(1)
+    sk2.add_dataset(np.full((50, 2), 2.0, np.float32))
+    assert sk2.snapshot()["rows"] == 100
+    sk2.end_fit(2)
+    # sequential: the next generation starts clean
+    sk.begin_fit(7)
+    assert sk.snapshot() is None
+    sk.add_dataset(np.full((10, 2), 3.0, np.float32))
+    snap = sk.snapshot()
+    assert snap["rows"] == 10 and snap["mean"][0] == pytest.approx(3.0)
+
+
+def test_train_sketch_dataset_dedup_is_identity_safe():
+    """Dedup keys on the ARRAY OBJECT (weakref-guarded), not a bare
+    id() — CPython reuses ids after GC, and a later different array at
+    a recycled id must still fold."""
+    sk = TrainDataSketch()
+    a = np.full((10, 2), 1.0, np.float32)
+    sk.add_dataset(a)
+    sk.add_dataset(a)  # same object: folded once
+    assert sk.snapshot()["rows"] == 10
+    b = np.full((10, 2), 2.0, np.float32)
+    sk.add_dataset(b)
+    assert sk.snapshot()["rows"] == 20
+    # simulate id reuse: a dead entry pointing at a's id must not mask
+    # a NEW array (the weakref no longer resolves to the same object)
+    key = id(a)
+    del a
+    c = np.full((10, 2), 3.0, np.float32)
+    sk._datasets[id(c)] = sk._datasets.pop(key, None) or (lambda: None)
+    sk.add_dataset(c)
+    assert sk.snapshot()["rows"] == 30
+
+
+def test_trainer_fits_bracket_the_sketch(tmp_path):
+    """Two sequential in-memory fits in one process export DISTINCT
+    baselines — the second fit's sketch holds only its own data."""
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.data.dataset import (
+        InMemoryDataset,
+        ParsedBlock,
+    )
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    from shifu_tensorflow_tpu.train import make_trainer
+
+    ds_mod.install_train(TrainDataSketch())
+
+    def one_fit(mean):
+        x = np.full((64, 2), mean, np.float32)
+        y = np.zeros((64, 1), np.float32)
+        w = np.ones((64, 1), np.float32)
+        data = InMemoryDataset(
+            train=ParsedBlock(x, y, w), valid=ParsedBlock.empty(2),
+            schema=RecordSchema(feature_columns=(1, 2), target_column=0))
+        mc = ModelConfig.from_json({"train": {"params": {
+            "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+            "ActivationFunc": ["relu"], "LearningRate": 0.05}}})
+        t = make_trainer(mc, 2, feature_columns=(1, 2))
+        t.fit(data, epochs=1, batch_size=32)
+
+    one_fit(1.0)
+    first = ds_mod.train_active().snapshot()
+    assert first["rows"] == 64 and first["mean"][0] == pytest.approx(1.0)
+    one_fit(5.0)
+    second = ds_mod.train_active().snapshot()
+    assert second["rows"] == 64
+    assert second["mean"][0] == pytest.approx(5.0)  # not blended with 1.0
+
+
+def test_train_sketch_samples_blocks_and_folds_datasets():
+    sk = TrainDataSketch(sample_every=2)
+    x = np.ones((10, 2), np.float32)
+    for _ in range(4):
+        sk.add_block(x)  # every 2nd block folds
+    snap = sk.snapshot()
+    assert snap["rows"] == 20
+    y = np.zeros((30, 2), np.float32)
+    sk.add_dataset(y)
+    sk.add_dataset(y)  # same array: folded once
+    assert sk.snapshot()["rows"] == 50
+
+
+def test_blocks_to_batches_feeds_tap_prepadding():
+    from shifu_tensorflow_tpu.data.pipeline import blocks_to_batches
+    from shifu_tensorflow_tpu.data.reader import ParsedBlock
+
+    seen = []
+
+    class Tap:
+        def add_block(self, feats):
+            seen.append(np.asarray(feats).shape)
+
+    blocks = [ParsedBlock(np.ones((5, 2), np.float32),
+                          np.ones((5, 1), np.float32),
+                          np.ones((5, 1), np.float32))]
+    out = list(blocks_to_batches(iter(blocks), 4, 2, stats_tap=Tap()))
+    # tap saw the raw 5-row block; the emitted batches are padded to 4s
+    assert seen == [(5, 2)]
+    assert sum(b["x"].shape[0] for b in out) == 8  # 4 + padded tail
+
+
+def test_batcher_pack_tap_feeds_monitor():
+    from shifu_tensorflow_tpu.serve.batcher import MicroBatcher
+
+    mon = ds_mod.install(DataDriftMonitor(window_s=30.0))
+    mb = MicroBatcher(lambda rows: rows[:, :1], max_batch=16,
+                      max_delay_s=0.0, model="tenant-a")
+    try:
+        mb.submit(np.ones((4, 3), np.float32))
+    finally:
+        mb.close()
+    det = mon.detector("tenant-a")
+    assert det is not None and det.live.rows() == 4
+
+
+# ---- export → manifest → ModelStore chain ----
+
+def _tiny_bundle(tmp_path, feature_stats=None, name="bundle"):
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.export.saved_model import export_native_bundle
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mc = ModelConfig.from_json({"train": {"params": {
+        "NumHiddenLayers": 1, "NumHiddenNodes": [4],
+        "ActivationFunc": ["relu"], "LearningRate": 0.05}}})
+    t = Trainer(mc, 3)
+    d = str(tmp_path / name)
+    export_native_bundle(d, t.state.params, mc, 3,
+                         feature_columns=[1, 2, 3],
+                         feature_stats=feature_stats)
+    return d
+
+
+def test_feature_stats_rides_manifest_and_loads(tmp_path):
+    from shifu_tensorflow_tpu.export.saved_model import (
+        FEATURE_STATS,
+        NATIVE_MANIFEST,
+    )
+    from shifu_tensorflow_tpu.serve.model_store import ModelStore
+
+    _, base = _baseline(f=3)
+    d = _tiny_bundle(tmp_path, feature_stats=base)
+    man = json.loads((tmp_path / "bundle" / NATIVE_MANIFEST).read_text())
+    assert FEATURE_STATS in man["files"]
+    mon = ds_mod.install(DataDriftMonitor(window_s=30.0))
+    store = ModelStore(d, poll_interval_s=0, model_name="alpha")
+    try:
+        loaded = store.current()
+        assert loaded.feature_stats["stats"]["rows"] == base["rows"]
+        assert loaded.feature_stats["feature_columns"] == [1, 2, 3]
+        det = mon.detector("alpha")
+        assert det is not None and det.baseline is not None
+    finally:
+        store.close()
+    # close unregisters (the eviction path runs through here)
+    assert mon.detector("alpha") is None
+
+
+def test_bitflipped_feature_stats_refuses_admission(tmp_path):
+    from shifu_tensorflow_tpu.export.saved_model import FEATURE_STATS
+    from shifu_tensorflow_tpu.serve.model_store import (
+        ArtifactCorrupt,
+        ModelStore,
+    )
+
+    _, base = _baseline(f=3)
+    d = _tiny_bundle(tmp_path, feature_stats=base)
+    p = os.path.join(d, FEATURE_STATS)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ArtifactCorrupt, match="feature_stats"):
+        ModelStore(d, poll_interval_s=0)
+
+
+def test_bundle_without_stats_loads_and_registers_baselineless(tmp_path):
+    from shifu_tensorflow_tpu.serve.model_store import ModelStore
+
+    d = _tiny_bundle(tmp_path, feature_stats=None)
+    assert not os.path.exists(os.path.join(d, "feature_stats.json"))
+    mon = ds_mod.install(DataDriftMonitor(window_s=30.0))
+    store = ModelStore(d, poll_interval_s=0)
+    try:
+        assert store.current().feature_stats is None
+        det = mon.detector("default")
+        assert det is not None and det.baseline is None
+    finally:
+        store.close()
+
+
+def test_stale_orphan_stats_ignored_without_manifest_entry(tmp_path):
+    """A feature_stats.json the manifest does not cover belongs to some
+    other generation — nothing vouches for it, so it must not load."""
+    from shifu_tensorflow_tpu.export.saved_model import FEATURE_STATS
+    from shifu_tensorflow_tpu.serve.model_store import ModelStore
+
+    d = _tiny_bundle(tmp_path, feature_stats=None)
+    with open(os.path.join(d, FEATURE_STATS), "w") as f:
+        json.dump({"stats": {"rows": 9}}, f)
+    store = ModelStore(d, poll_interval_s=0)
+    try:
+        assert store.current().feature_stats is None
+    finally:
+        store.close()
+
+
+# ---- two-tenant drill (the acceptance shape, in-process) ----
+
+def test_two_tenant_drift_isolation(tmp_path):
+    """One tenant fed a shifted stream journals data_drift naming the
+    tenant/feature/statistic; the unshifted tenant stays quiet; the
+    restored stream journals data_drift_clear."""
+    jrn = journal_mod.install(Journal(str(tmp_path / "j.jsonl"),
+                                      plane="serve"))
+    x, base = _baseline(f=3)
+    mon = ds_mod.install(DataDriftMonitor(
+        threshold=1.0, hysteresis=1, window_s=6.0, plane="serve"))
+    mon.register("alpha", base, columns=[1, 2, 3])
+    mon.register("beta", base, columns=[1, 2, 3])
+    shifted = x[:300].copy()
+    shifted[:, 1] += 4.0
+    mon.detector("beta").observe(shifted, now=50.0)
+    mon.detector("alpha").observe(x[300:600], now=50.0)
+    evs = mon.evaluate(now=51.0)
+    drifts = [e for e in evs if e["event"] == "data_drift"]
+    assert drifts, evs
+    assert {e["model"] for e in drifts} == {"beta"}
+    assert drifts[0]["feature"] == 1 and drifts[0]["column"] == 2
+    # restore beta's stream; shifted cells age out of the 6s window
+    mon.detector("beta").observe(x[600:900], now=60.0)
+    evs = mon.evaluate(now=61.0)
+    clears = [e for e in evs if e["event"] == "data_drift_clear"]
+    assert clears and clears[0]["model"] == "beta"
+    jrn.close()
+    events = read_events(str(tmp_path / "j.jsonl"))
+    assert not any(e.get("model") == "alpha"
+                   for e in events if e["event"] == "data_drift")
+
+
+# ---- serve ingress NaN counting (satellite) ----
+
+def test_ingress_nan_rows_counted_and_rejected(tmp_path):
+    from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+    from shifu_tensorflow_tpu.serve.server import ScoringServer, _BadRequest
+
+    mon = ds_mod.install(DataDriftMonitor(window_s=30.0))
+    metrics = ServeMetrics()
+    rows = np.ones((4, 3), np.float32)
+    rows[1, 0] = np.nan
+    rows[2, 2] = np.inf
+    with pytest.raises(_BadRequest, match="NaN"):
+        ScoringServer._reject_nonfinite(rows, metrics, "alpha")
+    assert metrics.counters()["nan_rows_total"] == 2
+    assert "stpu_serve_nan_rows_total" in metrics.render_prometheus(
+        queue_rows=0, model_epoch=0, model_digest="", model_verified=True)
+    # the refused rows still fed the tenant's live sketch: their
+    # missing-rate is the drift signal the rejection would otherwise hide
+    det = mon.detector("alpha")
+    assert det is not None and det.live.rows() == 4
+    clean = np.ones((4, 3), np.float32)
+    ScoringServer._reject_nonfinite(clean, metrics, "alpha")  # no raise
+    assert metrics.counters()["nan_rows_total"] == 2
+
+
+# ---- journal reconstruction (fleet export path) ----
+
+def test_baseline_from_journal_merges_workers(tmp_path):
+    base = str(tmp_path / "fleet.jsonl")
+    for w in (0, 1):
+        j = Journal(f"{base}.w{w}", plane="train", worker=w)
+        sk = DataSketch()
+        sk.add_batch(np.full((100, 2), float(w)))
+        j.emit("data_stats", stats=sk.snapshot(), epoch=0)
+        # an older, smaller snapshot first would also be superseded
+        j.close()
+    merged = ds_mod.baseline_from_journal(base)
+    assert merged["rows"] == 200
+    assert merged["mean"][0] == pytest.approx(0.5)
+
+
+# ---- ColumnConfig missing-stats satellite ----
+
+def test_zscale_stats_warns_and_journals_missing_columns(tmp_path):
+    import logging
+
+    from shifu_tensorflow_tpu.config import model_config as mc_mod
+    from shifu_tensorflow_tpu.config.model_config import ColumnConfig
+    from shifu_tensorflow_tpu.utils import logs
+
+    mc_mod._warned_stats_missing.clear()
+    jrn = journal_mod.install(Journal(str(tmp_path / "cfg.jsonl"),
+                                      plane="train"))
+    cc = ColumnConfig.from_json([
+        {"columnNum": 0, "columnFlag": "Target"},
+        {"columnNum": 1, "columnStats": {"mean": 2.0, "stdDev": 3.0},
+         "finalSelect": True},
+        {"columnNum": 2, "finalSelect": True},            # no stats block
+        {"columnNum": 3, "columnStats": {"mean": 1.0},    # partial stats
+         "finalSelect": True},
+        {"columnNum": 4, "columnStats": {"mean": 7.0, "stdDev": 0.0},
+         "finalSelect": True},  # zero std: std=1 silently substituted
+    ])
+    # the config logger does not propagate to root (caplog can't see
+    # it); listen on the real logger directly
+    records: list[logging.LogRecord] = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logs.get("config")
+    logger.addHandler(handler)
+    try:
+        means, stds = cc.zscale_stats([1, 2, 3, 4, 9])
+        # columns 3/4 keep their means; 3's MISSING stdDev, 4's ZERO
+        # stdDev, and 2/9's full absence are what the warning names
+        assert means == [2.0, 0.0, 1.0, 7.0, 0.0]
+        assert stds == [3.0, 1.0, 1.0, 1.0, 1.0]
+        assert any("columnStats" in r.getMessage() for r in records)
+        records.clear()
+        cc.zscale_stats([1, 2, 3, 4, 9])  # same set: deduped
+        assert not records
+    finally:
+        logger.removeHandler(handler)
+    jrn.close()
+    events = read_events(str(tmp_path / "cfg.jsonl"))
+    ev = next(e for e in events if e["event"] == "config_stats_missing")
+    assert ev["columns"] == [2, 3, 4, 9] and ev["selected"] == 5
+
+
+def test_config_stats_missing_journals_even_when_detected_pre_install(
+        tmp_path):
+    """The real CLI order: config resolution (zscale_stats) runs BEFORE
+    install_obs — the journal record is deferred to journal install
+    instead of being eaten by the warn dedup (the event would otherwise
+    never reach a dead fleet's files)."""
+    from shifu_tensorflow_tpu.config import model_config as mc_mod
+    from shifu_tensorflow_tpu.config.model_config import ColumnConfig
+
+    mc_mod._warned_stats_missing.clear()
+    assert journal_mod.active() is None
+    cc = ColumnConfig.from_json([
+        {"columnNum": 0, "columnFlag": "Target"},
+        {"columnNum": 5, "finalSelect": True},
+    ])
+    cc.zscale_stats([5])  # detected with NO journal installed
+    jrn = journal_mod.install(Journal(str(tmp_path / "late.jsonl"),
+                                      plane="train"))
+    jrn.close()
+    events = read_events(str(tmp_path / "late.jsonl"))
+    ev = next(e for e in events if e["event"] == "config_stats_missing")
+    assert ev["columns"] == [5]

@@ -69,6 +69,88 @@ def test_p2_quantile_small_counts_nearest_rank():
     assert est.value() == 3.0  # median of {1, 3, 5}
 
 
+# ---- P² edge cases the data-plane taps now hit (obs/datastats.py
+# feeds one estimator per feature per quantile, including constant
+# columns, tiny live windows, and unbounded client payloads) ----
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_p2_quantile_under_five_samples_matches_nearest_rank(n):
+    import math
+
+    rng = random.Random(11)
+    for p in (0.05, 0.5, 0.95):
+        xs = [rng.uniform(-10, 10) for _ in range(n)]
+        est = P2Quantile(p)
+        for x in xs:
+            est.add(x)
+        ordered = sorted(xs)
+        rank = max(0, min(n - 1, int(math.ceil(p * n)) - 1))
+        assert est.value() == ordered[rank]
+
+
+def test_p2_quantile_constant_stream_is_exact():
+    for p in (0.05, 0.5, 0.99):
+        est = P2Quantile(p)
+        for _ in range(1000):
+            est.add(7.25)
+        assert est.value() == 7.25
+
+
+def test_p2_quantile_adversarial_extremes_stay_finite_and_bounded():
+    """Alternating ±1e30 spikes around a tiny signal: the estimate must
+    stay FINITE and inside [observed min, observed max] — no inf/NaN
+    out of the parabolic update's divisions.  (The marker heights DO
+    get dragged by such spikes — a documented P² property; the data
+    leg's drift score treats that consistently, because a baseline
+    carrying the same spikes has an equally dragged scale.)"""
+    import math
+
+    rng = random.Random(5)
+    est = P2Quantile(0.5)
+    lo, hi = float("inf"), float("-inf")
+    for i in range(5000):
+        if i % 97 == 0:
+            x = 1e30 if (i // 97) % 2 == 0 else -1e30
+        else:
+            x = rng.gauss(0.0, 1e-6)
+        lo, hi = min(lo, x), max(hi, x)
+        est.add(x)
+    v = est.value()
+    assert math.isfinite(v)
+    assert lo <= v <= hi
+    # a clean stream after the spikes pulls the markers back toward the
+    # bulk (monotone marker ordering survives the abuse)
+    for _ in range(50_000):
+        est.add(rng.gauss(0.0, 1e-6))
+    v2 = est.value()
+    assert math.isfinite(v2) and abs(v2) < abs(v)
+
+
+@pytest.mark.parametrize("dist,p,rel,abs_", [
+    ("normal", 0.05, None, 0.08),
+    ("normal", 0.5, None, 0.05),
+    ("normal", 0.95, None, 0.08),
+    ("uniform", 0.5, 0.05, None),
+    ("uniform", 0.95, 0.05, None),
+    ("exponential", 0.5, 0.08, None),
+    ("exponential", 0.95, 0.08, None),
+])
+def test_p2_quantile_pinned_against_numpy(dist, p, rel, abs_):
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    xs = {
+        "normal": lambda: rng.normal(0.0, 1.0, 8000),
+        "uniform": lambda: rng.uniform(1.0, 3.0, 8000),
+        "exponential": lambda: rng.exponential(2.0, 8000),
+    }[dist]()
+    est = P2Quantile(p)
+    for x in xs:
+        est.add(float(x))
+    want = float(np.quantile(xs, p))
+    assert est.value() == pytest.approx(want, rel=rel, abs=abs_)
+
+
 # ---- sliding window ----
 
 def test_windowed_digest_expires_old_cells():
@@ -241,8 +323,9 @@ def test_from_config_registers_plane_signals():
                     slo_serve_shed_rate=0.2, slo_step_time_ms=50.0,
                     slo_infeed_frac=0.3, slo_window_s=30.0,
                     slo_hysteresis=3)
-    # the device/compiler signals (PR 10) ride every plane
-    device = {"compile_s", "devmem_frac"}
+    # the device/compiler signals (PR 10) and the data-drift signal
+    # (PR 12) ride every plane
+    device = {"compile_s", "devmem_frac", "data_drift_score"}
     serve = slo_mod.from_config(cfg, plane="serve", worker=2)
     assert set(serve.state()) == {"serve_p99_s", "serve_shed_rate"} | device
     assert serve.state()["serve_p99_s"]["target"] == pytest.approx(0.25)
