@@ -705,6 +705,58 @@ def _attempt(env_overrides: dict, timeout_s: float,
     return result, "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
 
 
+def _append_bench_history(name: str, artifact: str | None = None,
+                          rc: int = 0, result: dict | None = None) -> None:
+    """Append one line per bench run to ``BENCH_HISTORY.jsonl`` so the
+    perf trajectory is a tracked series (`obs diff --bench` renders the
+    delta between the last two entries of a bench).  The record carries
+    a host fingerprint (numbers from different hosts must never be
+    compared silently), the artifact's scalar metrics, and a
+    caller-supplied timestamp (``BENCH_TS`` — the driver pins run
+    identity; wall clock otherwise).  Best-effort: history must never
+    fail the bench that feeds it."""
+    try:
+        import platform as _platform
+        import socket as _socket
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        doc = result
+        # a FAILED run must not re-read the artifact: the file on disk
+        # is the PREVIOUS successful run's, and logging its numbers
+        # under this run's timestamp would fake a clean data point —
+        # the failure is recorded (rc field), its metrics are not
+        if doc is None and artifact is not None and rc == 0:
+            try:
+                with open(os.path.join(root, artifact)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = None
+        metrics = {
+            k: v for k, v in (doc or {}).items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        ts = os.environ.get("BENCH_TS") or round(time.time(), 3)
+        rec = {
+            "ts": ts,
+            "name": name,
+            "rc": rc,
+            "artifact": artifact,
+            "host": {
+                "hostname": _socket.gethostname(),
+                "platform": _platform.platform(terse=True),
+                "machine": _platform.machine(),
+                "cpus": os.cpu_count(),
+            },
+            "metrics": metrics,
+        }
+        with open(os.path.join(root, "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(rec, separators=(",", ":"),
+                               default=str) + "\n")
+    except Exception as e:
+        print(f"bench history append failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def main() -> None:
     if "ingest" in sys.argv[1:]:
         # staged-ingest pipeline benchmark (python bench.py ingest):
@@ -717,7 +769,9 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_ingest_pipeline
 
-        sys.exit(bench_ingest_pipeline.main())
+        rc = bench_ingest_pipeline.main()
+        _append_bench_history('ingest', 'BENCH_INGEST_PIPELINE.json', rc=rc)
+        sys.exit(rc)
     if "obs" in sys.argv[1:]:
         # observability-overhead benchmark (python bench.py obs):
         # obs-enabled vs disabled step time on the per-step epoch path,
@@ -729,7 +783,9 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_obs
 
-        sys.exit(bench_obs.main())
+        rc = bench_obs.main()
+        _append_bench_history('obs', 'BENCH_OBS.json', rc=rc)
+        sys.exit(rc)
     if "serve-tenants" in sys.argv[1:]:
         # multi-tenant serve benchmark (python bench.py serve-tenants):
         # N-model consolidation rows/s vs N single-model fleets at equal
@@ -741,7 +797,9 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_serve_tenants
 
-        sys.exit(bench_serve_tenants.main())
+        rc = bench_serve_tenants.main()
+        _append_bench_history('serve-tenants', 'BENCH_SERVE_TENANTS.json', rc=rc)
+        sys.exit(rc)
     if "serve-scale" in sys.argv[1:]:
         # serve-plane scale benchmark (python bench.py serve-scale):
         # bucket-ladder warm-up latency cliffs (cold start + hot-reload
@@ -753,7 +811,9 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_serve_scale
 
-        sys.exit(bench_serve_scale.main())
+        rc = bench_serve_scale.main()
+        _append_bench_history('serve-scale', 'BENCH_SERVE_SCALE.json', rc=rc)
+        sys.exit(rc)
     if "serve" in sys.argv[1:]:
         # serving benchmark (python bench.py serve): micro-batched vs
         # one-row-per-request scoring over HTTP, artifact
@@ -764,7 +824,9 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_serve
 
-        sys.exit(bench_serve.main())
+        rc = bench_serve.main()
+        _append_bench_history('serve', 'BENCH_SERVE.json', rc=rc)
+        sys.exit(rc)
     if "--run" in sys.argv:
         _child_main()
         return
@@ -865,6 +927,7 @@ def main() -> None:
     result["diagnostics"] = diagnostics
     result["total_bench_s"] = round(time.monotonic() - t_start, 1)
     print(json.dumps(result), flush=True)
+    _append_bench_history("train", rc=0, result=result)
 
 
 if __name__ == "__main__":

@@ -278,8 +278,52 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
     for _ in range(m2):
         mon.evaluate()
     drift_evaluate_us = (time.perf_counter() - t0) / m2 * 1e6
+    # cost leg (PR 13): note_dispatch is per coalesced SERVE batch (off
+    # the train step path — reported for the serve plane's sake), and
+    # note_train_epoch is the train epoch path's one call, amortized
+    # like the journal write
+    from shifu_tensorflow_tpu.obs import cost as obs_cost
+
+    acct = obs_cost.CostAccountant(plane="serve")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        acct.note_dispatch("bench", dispatch_s=0.004, rows=256,
+                           bucket_rows=256, nbytes=30720)
+        acct.note_busy(0.004)
+    cost_note_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for _ in range(m2):
+        acct.note_train_epoch(0, dispatch_s=0.5, steps=64)
+    cost_epoch_us = (time.perf_counter() - t0) / m2 * 1e6
+    # rollup leg (PR 13): rollup_fold is the journal-tap dict fold every
+    # journaled EVENT now additionally pays (events are per-epoch /
+    # per-dispatch, never per-step), and rollup_flush is one window
+    # flush + sidecar write — which runs on the compactor's own daemon
+    # thread, off every hot path, reported as a thread cost
+    from shifu_tensorflow_tpu.obs.rollup import RollupCompactor
+
+    comp = RollupCompactor(
+        os.path.join(journal_dir, "micro.rollup.jsonl"),
+        window_s=3600.0, thread=False)
+    ev = {"ts": time.time(), "event": "serve_batch", "model": "bench",
+          "rows": 64, "requests": 8, "bucket": 64,
+          "dispatch_s": 0.004, "queue_delay_s": 0.001}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        comp.note_event(ev)
+    rollup_fold_us = (time.perf_counter() - t0) / n * 1e6
+    m4 = 200
+    t0 = time.perf_counter()
+    for _ in range(m4):
+        comp.note_event(ev)
+        comp.flush()
+    rollup_flush_us = (time.perf_counter() - t0) / m4 * 1e6
+    comp.close()
+    # per-epoch journal events each pay one tap fold (epoch +
+    # step_breakdown = 2 folds/epoch); note_train_epoch joins them
     per_epoch_total = (per_epoch_us + mem_snapshot_us + tick_us
-                       + fleet_observe_us + clock_update_us)
+                       + fleet_observe_us + clock_update_us
+                       + cost_epoch_us + 2.0 * rollup_fold_us)
     return {
         "span_us": per_step_us,
         "digest_us": digest_us,
@@ -294,6 +338,10 @@ def _micro_cost_us(steps_per_epoch: int, journal_dir: str) -> dict:
         "sketch_fold_us": sketch_add_us,
         "sketch_batches_per_block": batches_per_block,
         "drift_evaluate_us": drift_evaluate_us,
+        "cost_note_us": cost_note_us,
+        "cost_epoch_us": cost_epoch_us,
+        "rollup_fold_us": rollup_fold_us,
+        "rollup_flush_us": rollup_flush_us,
         # the train tap fires once per INGEST BLOCK, not per step: the
         # measured copy+enqueue amortizes over the batches the block
         # contains.  The fold runs on the folder thread and the serve
@@ -401,6 +449,17 @@ def main() -> int:
             "sketch_fold": round(micro["sketch_fold_us"], 1),
             "sketch_batches_per_block": micro["sketch_batches_per_block"],
             "drift_evaluate": round(micro["drift_evaluate_us"], 1),
+            # long-horizon leg (PR 13): cost_epoch (note_train_epoch)
+            # and rollup_fold (the journal-tap fold, 2 events/epoch)
+            # ride the per-epoch headline; cost_note is the SERVE
+            # dispatch thread's per-batch ledger write and rollup_flush
+            # the compactor daemon thread's window flush + sidecar
+            # write — both off the train step path, reported as
+            # off-path thread costs
+            "cost_note": round(micro["cost_note_us"], 3),
+            "cost_epoch": round(micro["cost_epoch_us"], 3),
+            "rollup_fold": round(micro["rollup_fold_us"], 3),
+            "rollup_flush": round(micro["rollup_flush_us"], 2),
         },
         "micro_pct_of_median_step": round(micro_pct, 3),
         "pair_ratio_p10_p50_p90": [

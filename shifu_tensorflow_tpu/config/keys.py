@@ -329,6 +329,32 @@ DEFAULT_OBS_COMPILE_ANALYSIS = "auto"
 # compiles never count — pre-warming is the cure, not the disease.
 OBS_COMPILE_STORM = TPU_PREFIX + "obs-compile-storm"
 DEFAULT_OBS_COMPILE_STORM = 8
+# ---- rollup archive (obs/rollup.py: the obs plane's time axis) ----
+# The journal is rotation-bounded (max-bytes x max-files per writer), so
+# a multi-day job loses its own history.  With a journal configured, a
+# per-writer compactor folds events + monotonic-counter deltas + digest
+# snapshots into one downsampled record per obs-rollup-window appended
+# to a <journal>.rollup.jsonl sidecar EXEMPT from rotation — hours of
+# history cost KBs, and `obs report` reconstructs a dead fleet's full
+# run from the sidecars alone.  obs-rollup=false turns the compactor off.
+OBS_ROLLUP = TPU_PREFIX + "obs-rollup"
+DEFAULT_OBS_ROLLUP = True
+OBS_ROLLUP_WINDOW_S = TPU_PREFIX + "obs-rollup-window"  # seconds
+DEFAULT_OBS_ROLLUP_WINDOW_S = 60.0
+# pinned baseline for cross-run regression detection: a rollup sidecar
+# (or journal base whose sidecars exist) from a known-good run.  The
+# regression watchdog compares live windowed digests against the
+# baseline's merged digests ("" = no baseline, watchdog off).
+OBS_BASELINE = TPU_PREFIX + "obs-baseline"
+DEFAULT_OBS_BASELINE = ""
+# regression threshold: live/baseline ratio at or above which the
+# watchdog journals perf_regression naming the metric and magnitude
+# (hysteretic, like every other slo state machine; clears below the
+# threshold via perf_regression_clear).  Must be > 1 when set — a run
+# always sits at ~1 against its own baseline; 0 = disabled even with a
+# baseline pinned.
+SLO_REGRESSION = TPU_PREFIX + "slo-regression"
+DEFAULT_SLO_REGRESSION = 0.0
 
 # ---- SLO watchdog (obs/slo.py: windowed quantile digests + breach
 # events) ----

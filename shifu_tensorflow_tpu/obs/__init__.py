@@ -53,6 +53,7 @@ def device_obs_text() -> str:
     always — the ``stpu_build_info`` identity gauge saying WHAT build
     answered the scrape."""
     from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import cost as cost_mod
     from shifu_tensorflow_tpu.obs import datastats as datastats_mod
     from shifu_tensorflow_tpu.obs import memory as memory_mod
     from shifu_tensorflow_tpu.obs.registry import build_info_text
@@ -68,6 +69,11 @@ def device_obs_text() -> str:
     if mon is not None:
         # stpu_data_* per-model drift gauges (the data leg)
         text += mon.render_prometheus()
+    acct = cost_mod.active()
+    if acct is not None:
+        # stpu_cost_* per-tenant device-time counters + the device
+        # lane's busy/idle headroom gauges (the cost leg)
+        text += acct.render_prometheus()
     return text + build_info_text()
 
 
@@ -87,12 +93,14 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     one merged journal can tell two jobs' events apart.
     """
     from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import cost as cost_mod
     from shifu_tensorflow_tpu.obs import datastats as datastats_mod
     from shifu_tensorflow_tpu.obs import fleet as fleet_mod
     from shifu_tensorflow_tpu.obs import journal as journal_mod
     from shifu_tensorflow_tpu.obs import memory as memory_mod
     from shifu_tensorflow_tpu.obs import profile as profile_mod
     from shifu_tensorflow_tpu.obs import registry as registry_mod
+    from shifu_tensorflow_tpu.obs import rollup as rollup_mod
     from shifu_tensorflow_tpu.obs import slo as slo_mod
     from shifu_tensorflow_tpu.obs import trace as trace_mod
 
@@ -103,6 +111,14 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
         fleet_mod.uninstall()
         datastats_mod.uninstall()
         datastats_mod.uninstall_train()
+        cost_mod.uninstall()
+        # drop the retired accountant's counter source too — the
+        # process-global _sources dict would otherwise pin its object
+        # graph for process lifetime (same leak the serve close path
+        # guards against)
+        rollup_mod.unregister_source("cost")
+        rollup_mod.uninstall()
+        rollup_mod.uninstall_regression()
         profile_mod.unconfigure()
         return None, None
     if cfg.hist_buckets:
@@ -179,6 +195,46 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     ))
     datastats_mod.install_train(datastats_mod.TrainDataSketch(
         sample_every=cfg.trace_sample))
+    # cost leg (PR 13): the device-time ledger every dispatch seam feeds
+    # (batcher _dispatch_one, Trainer._obs_epoch) — registered as a
+    # rollup counter source so per-tenant device-seconds survive journal
+    # rotation
+    acct = cost_mod.install(cost_mod.CostAccountant(
+        plane=plane, worker=worker_index))
+    rollup_mod.register_source("cost", acct.counters)
+    # rollup compactor (PR 13): one per journal WRITER, tapping its emit
+    # path and appending per-window aggregates to the rotation-exempt
+    # <journal>.rollup.jsonl sidecar; the journal's close hook does the
+    # final flush so a drained fleet's sidecar is complete
+    if jrn is not None and getattr(cfg, "rollup", True):
+        comp = rollup_mod.install(rollup_mod.RollupCompactor(
+            rollup_mod.rollup_path(jrn.path),
+            window_s=getattr(cfg, "rollup_window_s", 60.0),
+            plane=plane, worker=worker_index, job=job,
+        ))
+        jrn.set_tap(comp.note_event)
+        jrn.on_close(comp.close)
+    else:
+        rollup_mod.uninstall()
+    # cross-run regression watchdog: live windowed digests vs the pinned
+    # baseline rollup — both the target and the baseline must be set,
+    # and an unreadable baseline degrades to a logged warning, never a
+    # refused job (observability must not take down what it observes)
+    baseline_path = getattr(cfg, "baseline_path", "")
+    threshold = getattr(cfg, "slo_regression", 0.0)
+    rollup_mod.uninstall_regression()
+    if baseline_path and threshold > 1:
+        baseline = rollup_mod.load_baseline(baseline_path)
+        if baseline is None or not baseline.get("digests"):
+            rollup_mod.log.warning(
+                "obs-baseline %r has no readable rollup digests; "
+                "regression watchdog disabled", baseline_path)
+        else:
+            rollup_mod.install_regression(rollup_mod.RegressionWatchdog(
+                baseline, threshold=threshold,
+                hysteresis=cfg.slo_hysteresis,
+                plane=plane, worker=worker_index,
+            ))
     profile_mod.configure(cfg.journal_path or None, plane=plane,
                           worker=worker_index)
     return tracer, jrn

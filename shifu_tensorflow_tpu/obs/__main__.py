@@ -10,6 +10,9 @@ traces, and a live dashboard.
     python -m shifu_tensorflow_tpu.obs fleet   --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs compile --journal /tmp/job.jsonl
     python -m shifu_tensorflow_tpu.obs mem     --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs report  --journal /tmp/job.jsonl
+    python -m shifu_tensorflow_tpu.obs diff /tmp/runA.jsonl /tmp/runB.jsonl
+    python -m shifu_tensorflow_tpu.obs diff --bench
     python -m shifu_tensorflow_tpu.obs profile --journal ... --request \
         --dir /tmp/dump --seconds 5
 
@@ -46,12 +49,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import re
 import sys
 import time
 from collections import defaultdict
 
 from shifu_tensorflow_tpu.obs.journal import journal_files, read_events
+from shifu_tensorflow_tpu.obs.rollup import (
+    read_rollups,
+    reconstruct,
+    rollup_files,
+)
+
+#: stable top-level schema tags on every machine-readable document, so
+#: downstream tooling can detect format drift instead of guessing from
+#: key shapes (pinned by test)
+SUMMARY_SCHEMA = "stpu.obs.summary/1"
+REPORT_SCHEMA = "stpu.obs.report/1"
+DIFF_SCHEMA = "stpu.obs.diff/1"
 
 #: events that are high-signal fleet lifecycle (the timeline keeps every
 #: event, but these get rendered even under --compact aggregation)
@@ -136,6 +152,39 @@ def build_parser() -> argparse.ArgumentParser:
                            "score first (default 20; 0 = all)")
     data.add_argument("--json", action="store_true", dest="as_json",
                       help="machine-readable data document")
+
+    rep = sub.add_parser(
+        "report",
+        help="one-run operator report from the rotation-exempt rollup "
+             "sidecars: totals, per-tenant cost, utilization, "
+             "excursions — survives journal rotation",
+    )
+    rep.add_argument("--journal", required=True,
+                     help="journal base path (shifu.tpu.obs-journal) or "
+                          "one .rollup.jsonl sidecar")
+    rep.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable report document")
+
+    diff = sub.add_parser(
+        "diff",
+        help="compare two runs' rollup archives (noise-aware), or "
+             "--bench: the last two BENCH_HISTORY.jsonl entries",
+    )
+    diff.add_argument("runs", nargs="*",
+                      help="two journal bases (or .rollup.jsonl "
+                           "sidecars); with --bench, at most one bench "
+                           "name to filter the history by")
+    diff.add_argument("--bench", action="store_true",
+                      help="diff the last two BENCH_HISTORY.jsonl "
+                           "entries of one bench instead of rollups")
+    diff.add_argument("--history", default="BENCH_HISTORY.jsonl",
+                      help="--bench history file "
+                           "(default ./BENCH_HISTORY.jsonl)")
+    diff.add_argument("--threshold", type=float, default=0.02,
+                      help="relative-change floor below which a delta "
+                           "is noise (default 0.02 = 2%%)")
+    diff.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable diff document")
 
     comp = sub.add_parser(
         "compile",
@@ -598,6 +647,7 @@ def _build_summary(base: str, cache: dict | None = None) -> dict | None:
     for ev in events:
         counts[ev.get("event", "?")] += 1
     return {
+        "schema": SUMMARY_SCHEMA,
         "journal": base,
         "files": files,
         "events": len(events),
@@ -806,6 +856,391 @@ def cmd_trace(args) -> int:
                   f"{ev.get('queue_delay_s', 0.0):.4f}s, device "
                   f"{ev.get('dispatch_s', 0.0):.4f}s")
     return 0
+
+
+# ---- rollup report (data + renderer) ----
+
+def _report_doc(base: str) -> dict | None:
+    """One-run document reconstructed from the rotation-exempt rollup
+    sidecars alone — no journal read, so it survives rotation AND runs
+    against a single scp'd ``.rollup.jsonl``."""
+    records = read_rollups(base)
+    if not records:
+        return None
+    doc = reconstruct(records)
+    doc["schema"] = REPORT_SCHEMA
+    doc["journal"] = base
+    doc["files"] = ([base] if base.endswith(".rollup.jsonl")
+                    else rollup_files(base))
+    return doc
+
+
+def _tenant_cost_table(doc: dict) -> dict[str, dict]:
+    """Per-tenant cost rows: device-seconds / padded-row-seconds / rows
+    / bytes from the cost leg's counters (exact — counter deltas), with
+    the journal-fold serve volume as the fallback when the cost leg was
+    off, plus request/shed counts from the serve counters."""
+    counters = doc.get("counters") or {}
+    cost = counters.get("cost") or {}
+    serve_c = counters.get("serve") or {}
+    fold = doc.get("serve") or {}
+    models: set[str] = set(fold)
+    for k in cost:
+        if ":" in k and not k.startswith("train_"):
+            models.add(k.split(":", 1)[1])
+    for k in serve_c:
+        if ":" in k:
+            models.add(k.split(":", 1)[1])
+    out: dict[str, dict] = {}
+    for m in sorted(models):
+        f = fold.get(m) or {}
+        out[m] = {
+            "device_s": round(cost.get(f"device_seconds:{m}",
+                                       f.get("dispatch_s", 0.0)), 6),
+            "padded_row_s": round(
+                cost.get(f"padded_row_seconds:{m}", 0.0), 3),
+            "rows": int(cost.get(f"rows:{m}", f.get("rows", 0))),
+            "bytes": int(cost.get(f"bytes:{m}", 0)),
+            "requests": int(serve_c.get(f"requests_total:{m}",
+                                        f.get("requests", 0))),
+            "shed": int(serve_c.get(f"shed_total:{m}", 0)),
+        }
+    total_dev = sum(r["device_s"] for r in out.values())
+    for r in out.values():
+        r["share_pct"] = (round(100.0 * r["device_s"] / total_dev, 1)
+                          if total_dev else 0.0)
+    return out
+
+
+def _lane_utilization(doc: dict) -> dict | None:
+    """Device-lane busy wall vs the run's wall clock.  With several
+    serve workers each lane contributes its own busy seconds, so the
+    fraction is lane-seconds per wall-second (can exceed 1)."""
+    cost = (doc.get("counters") or {}).get("cost") or {}
+    busy = cost.get("device_busy_seconds")
+    if busy is None:
+        return None
+    span = (doc.get("t1") or 0.0) - (doc.get("t0") or 0.0)
+    out = {"busy_s": round(float(busy), 3)}
+    if span > 0:
+        out["wall_s"] = round(span, 3)
+        out["busy_frac"] = round(float(busy) / span, 4)
+        out["idle_frac"] = round(max(0.0, 1.0 - float(busy) / span), 4)
+    return out
+
+
+def _fmt_excursion(e: dict, t0: float) -> str:
+    start = e.get("start_ts")
+    start_s = "?" if start is None else f"+{start - t0:.1f}s"
+    end = e.get("end_ts")
+    if end is not None:
+        dur = "" if start is None else f" ({end - start:.1f}s)"
+        span = f"{start_s} .. +{end - t0:.1f}s{dur}"
+    else:
+        span = f"{start_s} .. STILL OPEN"
+    writer = f"  [{e['writer']}]" if e.get("writer") else ""
+    return (f"  {e.get('kind', '?'):<11} {e.get('name', '?'):<24} "
+            f"{span}{writer}")
+
+
+def _render_report(doc: dict) -> list[str]:
+    lines: list[str] = []
+    t0 = doc.get("t0") or 0.0
+    span = (doc.get("t1") or t0) - t0
+    lines.append(
+        f"run: {doc['windows']} rollup window(s) spanning {span:.1f}s, "
+        f"writer(s) {', '.join(doc['writers']) or '?'}"
+        + (f"  [job {', '.join(doc['jobs'])}]" if doc["jobs"] else ""))
+    serve_c = (doc.get("counters") or {}).get("serve") or {}
+    base_c = {k: v for k, v in serve_c.items() if ":" not in k}
+    if base_c:
+        order = ("requests_total", "rows_total", "batches_total",
+                 "shed_total", "errors_total", "nan_rows_total")
+        bits = [f"{k.removesuffix('_total')} {int(base_c[k])}"
+                for k in order if base_c.get(k)]
+        bits += [f"{k} {int(v)}" for k, v in sorted(base_c.items())
+                 if k not in order and v]
+        lines.append("totals (monotonic counters): " + ", ".join(bits))
+    util = _lane_utilization(doc)
+    if util is not None and "busy_frac" in util:
+        lines.append(
+            f"device lane: busy {util['busy_s']:.1f}s of "
+            f"{util['wall_s']:.1f}s wall — utilization "
+            f"{100 * util['busy_frac']:.1f}%, idle headroom "
+            f"{100 * util['idle_frac']:.1f}%")
+    tenants = _tenant_cost_table(doc)
+    if tenants:
+        lines.append("per-tenant cost (device attribution)")
+        lines.append(
+            "  model          device_s  share%  padded_row_s  rows"
+            "      requests  shed    bytes")
+        for m, r in tenants.items():
+            lines.append(
+                f"  {m:<14} {r['device_s']:<9.3f} {r['share_pct']:<7} "
+                f"{r['padded_row_s']:<13.1f} {r['rows']:<9} "
+                f"{r['requests']:<9} {r['shed']:<7} {_fmt_bytes(r['bytes'])}"
+            )
+    cost_c = (doc.get("counters") or {}).get("cost") or {}
+    train_rows = {k.split(":w", 1)[1]: v for k, v in cost_c.items()
+                  if k.startswith("train_device_seconds:w")}
+    train_fold = doc.get("train") or {}
+    if train_rows or train_fold:
+        lines.append("train device time")
+        lines.append("  worker  device_s   steps     epochs")
+        workers = sorted(set(train_rows) | set(train_fold),
+                         key=lambda w: (not w.isdigit(),
+                                        int(w) if w.isdigit() else w))
+        for w in workers:
+            f = train_fold.get(w) or {}
+            dev = train_rows.get(w, f.get("dispatch_s", 0.0))
+            steps = int(cost_c.get(f"train_steps:w{w}",
+                                   f.get("steps", 0)))
+            lines.append(f"  {w:<7} {dev:<10.3f} {steps:<9} "
+                         f"{int(f.get('epochs', 0))}")
+    digests = doc.get("digests") or {}
+    if digests:
+        lines.append("windowed digests (count-weight merged)")
+        lines.append("  signal                 stat   value      mean"
+                     "       max        count")
+        for sig in sorted(digests):
+            s = digests[sig]
+            stat = s.get("stat") or "mean"
+            val = s.get(stat)
+            lines.append(
+                f"  {sig:<22} {stat:<6} "
+                f"{'?' if val is None else f'{val:.4g}':<10} "
+                f"{s.get('mean', 0.0):<10.4g} {s.get('max', 0.0):<10.4g} "
+                f"{s['count']}")
+    comp = doc.get("compile") or {}
+    gauges = doc.get("gauges") or {}
+    if comp or gauges:
+        bits = []
+        if comp:
+            bits.append(f"{int(comp.get('compiles', 0))} compile(s), "
+                        f"{comp.get('compile_s', 0.0):.2f}s total, "
+                        f"max {comp.get('max_s', 0.0):.2f}s")
+        if gauges.get("total_bytes"):
+            bits.append(
+                f"devmem high-water {_fmt_bytes(gauges['total_bytes'])}"
+                + (f" ({100 * gauges['devmem_frac']:.1f}% of limit)"
+                   if gauges.get("devmem_frac") else ""))
+        lines.append("device/compiler: " + "; ".join(bits))
+    excs = (doc.get("excursions") or []) + (doc.get("open_excursions")
+                                            or [])
+    if excs:
+        lines.append("excursions")
+        for e in excs:
+            lines.append(_fmt_excursion(e, t0))
+    else:
+        lines.append("no excursions")
+    return lines
+
+
+def cmd_report(args) -> int:
+    doc = _report_doc(args.journal)
+    if doc is None:
+        print(f"no rollup records under {args.journal!r} "
+              f"(files: {rollup_files(args.journal) or 'none'}) — "
+              "rollups write beside the journal once obs is enabled "
+              "(shifu.tpu.obs-rollup, on by default with a journal)",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    print(f"rollup report — {args.journal}")
+    for line in _render_report(doc):
+        print(line)
+    return 0
+
+
+# ---- cross-run diff ----
+
+#: noise-discount scale for count-backed metrics (the datastats ~3/√n
+#: small-sample discipline): a delta must clear k/√min(n) on top of the
+#: relative floor before it can be called significant
+_DIFF_NOISE_K = 3.0
+
+
+def _delta_row(metric: str, va: float, vb: float, na: int, nb: int,
+               floor: float, lower_is_better: bool) -> dict:
+    rel = (vb - va) / abs(va)
+    n = min(na or 0, nb or 0)
+    noise = _DIFF_NOISE_K / math.sqrt(n) if n > 0 else 0.0
+    bar = max(floor, noise)
+    significant = abs(rel) >= bar
+    worse = (rel > 0) == lower_is_better
+    verdict = ("~same" if not significant
+               else ("REGRESSED" if worse else "improved"))
+    return {
+        "metric": metric,
+        "a": round(va, 6), "b": round(vb, 6),
+        "delta_pct": round(100.0 * rel, 2),
+        "count_a": na, "count_b": nb,
+        "noise_floor_pct": round(100.0 * bar, 2),
+        "significant": significant,
+        "verdict": verdict,
+    }
+
+
+def _diff_rows(a: dict, b: dict, floor: float) -> list[dict]:
+    rows: list[dict] = []
+    da, db = a.get("digests") or {}, b.get("digests") or {}
+    for sig in sorted(set(da) & set(db)):
+        sa, sb = da[sig], db[sig]
+        stat = sb.get("stat") or sa.get("stat") or "mean"
+        va, vb = sa.get(stat), sb.get(stat)
+        if va is None or vb is None or va <= 0:
+            continue
+        rows.append(_delta_row(
+            f"{sig}.{stat}", va, vb, int(sa.get("count", 0)),
+            int(sb.get("count", 0)), floor,
+            # every digest-backed signal here is a latency/time/ratio:
+            # smaller is better
+            lower_is_better=True))
+
+    def rate_of(doc, key):
+        c = (doc.get("counters") or {}).get("serve") or {}
+        span = (doc.get("t1") or 0.0) - (doc.get("t0") or 0.0)
+        v = c.get(key)
+        if not v or span <= 0:
+            return None, 0
+        return float(v) / span, int(v)
+
+    for key, label in (("requests_total", "serve_requests_per_s"),
+                       ("rows_total", "serve_rows_per_s")):
+        (ra, na), (rb, nb) = rate_of(a, key), rate_of(b, key)
+        if ra and rb:
+            rows.append(_delta_row(label, ra, rb, na, nb, floor,
+                                   lower_is_better=False))
+
+    def cost_per_krow(doc):
+        cost = (doc.get("counters") or {}).get("cost") or {}
+        dev = sum(v for k, v in cost.items()
+                  if k.startswith("device_seconds:"))
+        n = sum(v for k, v in cost.items() if k.startswith("rows:"))
+        return (dev / n * 1000.0, int(n)) if n else (None, 0)
+
+    (ca, na), (cb, nb) = cost_per_krow(a), cost_per_krow(b)
+    if ca and cb:
+        rows.append(_delta_row("device_s_per_krow", ca, cb, na, nb,
+                               floor, lower_is_better=True))
+    return rows
+
+
+def _diff_runs(args) -> int:
+    if len(args.runs) != 2:
+        print("obs diff needs exactly two runs (journal bases or "
+              ".rollup.jsonl sidecars), or --bench", file=sys.stderr)
+        return 2
+    docs = []
+    for run in args.runs:
+        records = read_rollups(run)
+        if not records:
+            print(f"no rollup records under {run!r}", file=sys.stderr)
+            return 1
+        docs.append(reconstruct(records))
+    a, b = docs
+    rows = _diff_rows(a, b, args.threshold)
+    doc = {
+        "schema": DIFF_SCHEMA,
+        "mode": "rollup",
+        "a": {"run": args.runs[0], "t0": a.get("t0"), "t1": a.get("t1"),
+              "windows": a.get("windows"), "jobs": a.get("jobs")},
+        "b": {"run": args.runs[1], "t0": b.get("t0"), "t1": b.get("t1"),
+              "windows": b.get("windows"), "jobs": b.get("jobs")},
+        "metrics": rows,
+        "regressions": [r["metric"] for r in rows
+                        if r["verdict"] == "REGRESSED"],
+    }
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    print(f"rollup diff — A: {args.runs[0]}  vs  B: {args.runs[1]}")
+    if not rows:
+        print("  no comparable metrics (both runs need rollup digests "
+              "or counters)")
+        return 1
+    print("  metric                     A          B          Δ%        "
+          "noise%   verdict")
+    for r in rows:
+        print(f"  {r['metric']:<26} {r['a']:<10.4g} {r['b']:<10.4g} "
+              f"{r['delta_pct']:<+10.2f} {r['noise_floor_pct']:<8.2f} "
+              f"{r['verdict']}")
+    if doc["regressions"]:
+        print(f"  REGRESSED: {', '.join(doc['regressions'])}")
+    return 0
+
+
+def _diff_bench(args) -> int:
+    entries: list[dict] = []
+    try:
+        with open(args.history) as f:
+            for raw in f:
+                try:
+                    e = json.loads(raw)
+                except ValueError:
+                    continue
+                if isinstance(e, dict) and e.get("name"):
+                    entries.append(e)
+    except OSError:
+        print(f"no bench history at {args.history!r} — `python bench.py "
+              "<name>` appends one line per run", file=sys.stderr)
+        return 1
+    # failed runs (rc != 0) carry no trustworthy metrics — they stay in
+    # the history as the record of the failure, but a diff must compare
+    # two runs that actually measured something
+    entries = [e for e in entries if not e.get("rc")]
+    name = args.runs[0] if args.runs else None
+    if name is None and entries:
+        name = entries[-1]["name"]
+    entries = [e for e in entries if e.get("name") == name]
+    if len(entries) < 2:
+        print(f"need at least two {name!r} entries in {args.history!r} "
+              f"to diff (have {len(entries)})", file=sys.stderr)
+        return 1
+    a, b = entries[-2], entries[-1]
+    rows = []
+    ma, mb = a.get("metrics") or {}, b.get("metrics") or {}
+    for k in sorted(set(ma) & set(mb)):
+        va, vb = ma[k], mb[k]
+        if (isinstance(va, (int, float)) and isinstance(vb, (int, float))
+                and not isinstance(va, bool) and va):
+            rel = (vb - va) / abs(va)
+            rows.append({
+                "metric": k, "a": va, "b": vb,
+                "delta_pct": round(100.0 * rel, 2),
+                "significant": abs(rel) >= args.threshold,
+            })
+    doc = {
+        "schema": DIFF_SCHEMA,
+        "mode": "bench",
+        "name": name,
+        "a": {k: a.get(k) for k in ("ts", "host", "artifact")},
+        "b": {k: b.get(k) for k in ("ts", "host", "artifact")},
+        "metrics": rows,
+    }
+    if args.as_json:
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    host_a = (a.get("host") or {}).get("hostname", "?")
+    host_b = (b.get("host") or {}).get("hostname", "?")
+    print(f"bench diff — {name}: {a.get('ts')} ({host_a}) -> "
+          f"{b.get('ts')} ({host_b})")
+    if not rows:
+        print("  no shared numeric metrics between the two entries")
+        return 1
+    for r in rows:
+        mark = "  *" if r["significant"] else ""
+        print(f"  {r['metric']:<34} {r['a']:<12.6g} -> {r['b']:<12.6g} "
+              f"({r['delta_pct']:+.2f}%){mark}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    if args.bench:
+        return _diff_bench(args)
+    return _diff_runs(args)
 
 
 # ---- fleet skew (data + renderer) ----
@@ -1588,6 +2023,10 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_top(args)
         if args.cmd == "fleet":
             return cmd_fleet(args)
+        if args.cmd == "report":
+            return cmd_report(args)
+        if args.cmd == "diff":
+            return cmd_diff(args)
         if args.cmd == "data":
             return cmd_data(args)
         if args.cmd == "compile":

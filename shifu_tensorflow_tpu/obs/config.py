@@ -51,6 +51,14 @@ class ObsConfig:
     # untargeted) and the per-feature detect/clear threshold
     slo_data_drift: float = K.DEFAULT_SLO_DATA_DRIFT
     data_drift_threshold: float = K.DEFAULT_DATA_DRIFT_THRESHOLD
+    # long-horizon leg (obs/rollup.py) — the rotation-exempt rollup
+    # sidecar compactor (active only with a journal path), the pinned
+    # baseline for cross-run comparison, and the regression watchdog
+    # target; flat fields for the same JSON-bridge reason as above
+    rollup: bool = K.DEFAULT_OBS_ROLLUP
+    rollup_window_s: float = K.DEFAULT_OBS_ROLLUP_WINDOW_S
+    baseline_path: str = K.DEFAULT_OBS_BASELINE
+    slo_regression: float = K.DEFAULT_SLO_REGRESSION
 
     def __post_init__(self):
         if self.journal_max_bytes < 4096:
@@ -110,6 +118,17 @@ class ObsConfig:
                 f"{K.DATA_DRIFT_THRESHOLD} must be > 0 (a 0 threshold "
                 f"would flag every feature on every tick), got "
                 f"{self.data_drift_threshold}")
+        if self.rollup_window_s <= 0:
+            raise ValueError(f"{K.OBS_ROLLUP_WINDOW_S} must be > 0, got "
+                             f"{self.rollup_window_s}")
+        if self.slo_regression < 0:
+            raise ValueError(f"{K.SLO_REGRESSION} must be >= 0 "
+                             f"(0 = disabled), got {self.slo_regression}")
+        if 0 < self.slo_regression <= 1:
+            raise ValueError(
+                f"{K.SLO_REGRESSION} must be > 1 when set (it is a "
+                f"live/baseline ratio; a run sits at ~1 against its own "
+                f"baseline), got {self.slo_regression}")
         if self.compile_analysis not in ("auto", "full", "cost", "off"):
             raise ValueError(
                 f"{K.OBS_COMPILE_ANALYSIS} must be auto|full|cost|off, "
@@ -202,4 +221,12 @@ def resolve_obs_config(args, conf) -> ObsConfig:
                                       K.DEFAULT_SLO_DATA_DRIFT),
         data_drift_threshold=conf.get_float(
             K.DATA_DRIFT_THRESHOLD, K.DEFAULT_DATA_DRIFT_THRESHOLD),
+        rollup=conf.get_bool(K.OBS_ROLLUP, K.DEFAULT_OBS_ROLLUP),
+        rollup_window_s=conf.get_float(K.OBS_ROLLUP_WINDOW_S,
+                                       K.DEFAULT_OBS_ROLLUP_WINDOW_S),
+        baseline_path=(flag("obs_baseline")
+                       or conf.get(K.OBS_BASELINE, K.DEFAULT_OBS_BASELINE)
+                       or ""),
+        slo_regression=conf.get_float(K.SLO_REGRESSION,
+                                      K.DEFAULT_SLO_REGRESSION),
     )

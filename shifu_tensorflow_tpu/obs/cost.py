@@ -1,0 +1,247 @@
+"""Device-time & cost attribution: who actually consumed the device.
+
+The tenancy ``DeviceScheduler`` shares one device by deficit round-robin
+and the serve batcher times every dispatch (``dispatch_s``) — but until
+now nobody ACCOUNTED that time: the weight-rebalancing policy ROADMAP
+item 3 wants ("victim tenant's p99 recovers without operator input")
+needs to know which tenant consumed how many device-seconds, not just
+who was queued.  This module is that ledger, in the obs plane's usual
+shape: one process-global accountant (``install``/``active``), seams
+that are a single is-None check when the plane is off, and a Prometheus
+text block every scrape surface appends (``obs.device_obs_text``).
+
+Per tenant, monotonic counters:
+
+- **device-seconds** — wall time inside ``score_fn`` (the batcher's
+  ``dispatch_s``), the raw device occupancy;
+- **padded-row-seconds** — ``dispatch_s × bucket`` rows, the DRR
+  currency: what the scheduler actually charges (padding cannot launder
+  cost), so tenant shares here compare directly against their
+  configured weights;
+- **rows** and **bytes** — payload volume (pre-padding), the
+  denominator for per-row cost.
+
+Plus the device lane itself: cumulative **busy seconds** (the whole
+dispatch envelope, scoring included) against the lane's wall clock
+since first dispatch — the busy/idle split is the headroom gauge an
+autoscaler reads before adding load, and the conservation bound the
+rollup drill checks per-tenant device-seconds against.
+
+The train plane attributes device-seconds per (job, worker): the
+``Trainer._obs_epoch`` step-phase drain already measures
+``dispatch_s`` per epoch, and the journal's ``job`` stamp scopes it —
+one merged scrape answers "what did job X's worker 3 cost".
+
+Everything exports as ``stpu_cost_*`` on every ``/metrics`` surface and
+flows into the rollup sidecar via the compactor's counter-source poll
+(:mod:`obs.rollup`), so a dead fleet's cost table reconstructs from
+files alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+__all__ = [
+    "CostAccountant",
+    "install",
+    "uninstall",
+    "active",
+]
+
+_mono = time.monotonic
+
+
+class _TenantCost:
+    __slots__ = ("device_s", "padded_row_s", "rows", "batches", "bytes")
+
+    def __init__(self):
+        self.device_s = 0.0
+        self.padded_row_s = 0.0
+        self.rows = 0
+        self.batches = 0
+        self.bytes = 0
+
+
+class CostAccountant:
+    """Monotonic device-time ledger.  All note_* calls are hot-path
+    cheap (one lock + float adds); rendering and counter export are
+    scrape-time work."""
+
+    def __init__(self, *, plane: str = "serve",
+                 worker: int | None = None):
+        self.plane = plane
+        self.worker = worker
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _TenantCost] = {}
+        # worker -> {"device_s": x, "steps": n} (train attribution; on
+        # the train plane each process accounts its own rank, on the
+        # thread launcher all ranks share this accountant)
+        self._train: dict[int, dict[str, float]] = {}
+        # the device lane: busy wall inside the dispatch envelope vs
+        # wall clock since the lane first dispatched.  Starting the
+        # clock at first use (not construction) keeps a server that
+        # sat idle before its first request from reading as headroom
+        # it never actually had.
+        self._busy_s = 0.0
+        self._lane_started: float | None = None
+
+    # ---- serve side ----
+    def note_dispatch(self, model: str | None, *, dispatch_s: float,
+                      rows: int, bucket_rows: int,
+                      nbytes: int = 0) -> None:
+        """Attribute one scored batch to its tenant (the batcher's
+        dispatch thread / the scheduler's device thread)."""
+        key = model or "default"
+        with self._lock:
+            t = self._tenants.get(key)
+            if t is None:
+                t = self._tenants[key] = _TenantCost()
+            t.device_s += dispatch_s
+            t.padded_row_s += dispatch_s * bucket_rows
+            t.rows += rows
+            t.batches += 1
+            t.bytes += nbytes
+
+    def note_busy(self, seconds: float) -> None:
+        """One dispatch ENVELOPE's wall time (scoring + handoffs) on
+        the device lane — the denominator-side measurement the
+        per-tenant device-seconds must conserve against."""
+        now = _mono()
+        with self._lock:
+            if self._lane_started is None:
+                self._lane_started = now - seconds
+            self._busy_s += seconds
+
+    # ---- train side ----
+    def note_train_epoch(self, worker: int | None, *, dispatch_s: float,
+                         steps: int) -> None:
+        """Attribute one epoch's device dispatch time to its rank (fed
+        from the same ``step_breakdown`` drain the journal records, so
+        the numbers agree by construction)."""
+        w = int(worker or 0)
+        with self._lock:
+            rec = self._train.get(w)
+            if rec is None:
+                rec = self._train[w] = {"device_s": 0.0, "steps": 0.0}
+            rec["device_s"] += dispatch_s
+            rec["steps"] += steps
+
+    # ---- reading ----
+    def utilization(self) -> dict[str, float] | None:
+        """Busy/idle split of the device lane since its first dispatch,
+        or None before any (the signal is absent, not 100% idle)."""
+        with self._lock:
+            if self._lane_started is None:
+                return None
+            wall = max(_mono() - self._lane_started, 1e-9)
+            busy = min(self._busy_s, wall)
+            return {
+                "busy_s": round(busy, 6),
+                "wall_s": round(wall, 6),
+                "busy_frac": round(busy / wall, 6),
+                "idle_frac": round(1.0 - busy / wall, 6),
+            }
+
+    def counters(self) -> dict[str, float]:
+        """Flat monotonic counters for the rollup compactor's source
+        poll: per-tenant series keyed ``<counter>:<model>``, train
+        series ``train_device_seconds:w<rank>``, plus the lane's busy
+        seconds.  Values are cumulative; the compactor writes per-window
+        deltas."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for name, t in self._tenants.items():
+                out[f"device_seconds:{name}"] = round(t.device_s, 6)
+                out[f"padded_row_seconds:{name}"] = round(t.padded_row_s, 6)
+                out[f"rows:{name}"] = t.rows
+                out[f"batches:{name}"] = t.batches
+                out[f"bytes:{name}"] = t.bytes
+            for w, rec in self._train.items():
+                out[f"train_device_seconds:w{w}"] = round(rec["device_s"], 6)
+                out[f"train_steps:w{w}"] = int(rec["steps"])
+            if self._lane_started is not None:
+                out["device_busy_seconds"] = round(self._busy_s, 6)
+        return out
+
+    def state(self) -> dict[str, Any]:
+        """Structured snapshot (tests, /healthz embedding)."""
+        with self._lock:
+            tenants = {
+                name: {"device_s": round(t.device_s, 6),
+                       "padded_row_s": round(t.padded_row_s, 6),
+                       "rows": t.rows, "batches": t.batches,
+                       "bytes": t.bytes}
+                for name, t in self._tenants.items()
+            }
+            train = {w: {"device_s": round(r["device_s"], 6),
+                         "steps": int(r["steps"])}
+                     for w, r in self._train.items()}
+        return {"tenants": tenants, "train": train,
+                "utilization": self.utilization()}
+
+    def render_prometheus(self, prefix: str = "stpu_") -> str:
+        """The ``stpu_cost_*`` scrape block: per-tenant counters share
+        one metric name across ``model=`` label values (hand-rendered,
+        like the coordinator's per-worker heartbeat gauges), so a
+        dashboard sums or ratios tenants without name surgery."""
+        with self._lock:
+            tenants = sorted(self._tenants.items())
+            train = sorted(self._train.items())
+        lines: list[str] = []
+        per_tenant = (
+            ("cost_device_seconds_total", "device_s", 6),
+            ("cost_padded_row_seconds_total", "padded_row_s", 6),
+            ("cost_rows_total", "rows", 0),
+            ("cost_bytes_total", "bytes", 0),
+        )
+        for metric, attr, nd in per_tenant:
+            if not tenants:
+                continue
+            lines.append(f"# TYPE {prefix}{metric} counter")
+            for name, t in tenants:
+                v = getattr(t, attr)
+                v = round(v, nd) if nd else int(v)
+                lines.append(f'{prefix}{metric}{{model="{name}"}} {v}')
+        if train:
+            lines.append(f"# TYPE {prefix}cost_train_device_seconds_total"
+                         " counter")
+            for w, rec in train:
+                lines.append(
+                    f'{prefix}cost_train_device_seconds_total'
+                    f'{{worker="{w}"}} {round(rec["device_s"], 6)}')
+        util = self.utilization()
+        if util is not None:
+            lines.append(f"# TYPE {prefix}cost_device_busy_frac gauge")
+            lines.append(f"{prefix}cost_device_busy_frac"
+                         f" {util['busy_frac']}")
+            lines.append(f"# TYPE {prefix}cost_device_idle_frac gauge")
+            lines.append(f"{prefix}cost_device_idle_frac"
+                         f" {util['idle_frac']}")
+            lines.append(f"# TYPE {prefix}cost_device_busy_seconds_total"
+                         " counter")
+            lines.append(f"{prefix}cost_device_busy_seconds_total"
+                         f" {util['busy_s']}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+# ---- process-global hook (mirrors obs.trace / obs.journal) ----
+
+_active: CostAccountant | None = None
+
+
+def install(accountant: CostAccountant) -> CostAccountant:
+    global _active
+    _active = accountant
+    return accountant
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> CostAccountant | None:
+    return _active

@@ -91,10 +91,28 @@ class Journal:
         # the raw wall clock.  Plain attribute write/read: a float slot
         # is atomic under the GIL and a torn update is impossible.
         self._offset: float | None = None
+        # in-process event tap (obs/rollup.RollupCompactor): sees every
+        # record dict at emit time, BEFORE rotation can drop it — the
+        # rollup sidecar's feed.  Exceptions are swallowed; reference
+        # assignment, so readers see a whole callable or None.
+        self._tap = None
+        # callables fired once when this writer closes (the compactor's
+        # final flush rides here so a drained fleet's sidecar is
+        # complete)
+        self._close_hooks: list = []
 
     def set_offset(self, offset: float | None) -> None:
         """Update the writer's clock-offset estimate (None clears it)."""
         self._offset = None if offset is None else float(offset)
+
+    def set_tap(self, fn) -> None:
+        """Install (or clear, with None) the in-process event tap."""
+        self._tap = fn
+
+    def on_close(self, fn) -> None:
+        """Run ``fn`` when this writer closes (at most once; errors are
+        swallowed — the journal contract)."""
+        self._close_hooks.append(fn)
 
     # ---- writing ----
     def emit(self, event: str, **fields: Any) -> None:
@@ -110,6 +128,12 @@ class Journal:
         if offset is not None:
             rec["offset"] = round(offset, 6)
         rec.update(fields)
+        tap = self._tap
+        if tap is not None:
+            try:
+                tap(rec)
+            except Exception:
+                pass
         try:
             line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         except (TypeError, ValueError) as e:
@@ -190,6 +214,12 @@ class Journal:
                 pass
 
     def close(self) -> None:
+        hooks, self._close_hooks = self._close_hooks, []
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                pass
         with self._lock:
             if self._file is not None:
                 try:

@@ -1,0 +1,879 @@
+"""Long-horizon rollup archive: the obs plane's time axis.
+
+Every other obs leg is within-run and rotation-bounded: journals cap at
+``max_bytes × max_files`` per writer (obs/journal.py), so a multi-day
+job silently loses its own history, and nothing compares run N against
+run N−1.  This module adds the missing axis in three parts, stdlib-only
+and off-path like every other leg:
+
+1. **Rollup compactor** (:class:`RollupCompactor`) — a per-writer tap
+   on the journal's emit path that folds events into one downsampled
+   aggregate record per window (default 60 s), appended to a
+   ``<journal>.rollup.jsonl`` sidecar that is EXEMPT from rotation.
+   Each record carries the window's event counts, per-model serve
+   volume, per-rank train phase seconds, gauge high-waters, compile
+   cost, the SLO watchdog's windowed digest snapshots, DataSketch
+   snapshots, excursion intervals (SLO/storm/drift/straggler/
+   regression), and — crucially — per-window DELTAS of every registered
+   MONOTONIC counter source (serve request/shed counters, the cost
+   accountant): rate-limited journal events (``shed``) can undercount,
+   counters cannot.  Hours of history cost KBs; a dead fleet's full run
+   reconstructs from the sidecars alone (:func:`reconstruct`) after its
+   journals rotated away.
+
+   Restart discipline: a compactor never re-reads its sidecar or its
+   journal — it only appends windows folded from events it saw and
+   counter deltas against baselines that start at the source's birth —
+   so a crash mid-window loses at most that window's in-memory fold and
+   a restart can never double-count (pinned by test).
+
+2. **Cross-run comparison** — :func:`reconstruct` merges a sidecar set
+   into one run document (counters summed, digests count-weight merged,
+   gauges maxed, excursions deduped); ``obs report`` renders it and
+   ``obs diff`` compares two runs with noise-aware significance: a
+   delta only counts when it clears both the relative floor and a
+   ``k/√n`` discount on the smaller side's sample count (the same
+   small-sample discipline the data-drift scorer uses).
+
+3. **Regression watchdog** (:class:`RegressionWatchdog`) — compares the
+   LIVE windowed digests (obs/slo.py) against a pinned baseline rollup
+   (``shifu.tpu.obs-baseline``) on the serve SLO tick / train epoch
+   tick, and journals hysteretic ``perf_regression`` /
+   ``perf_regression_clear`` events naming the metric and magnitude
+   when the live/baseline ratio holds past ``shifu.tpu.slo-regression``.
+
+Sidecar lines are plain JSON with a ``schema`` field; readers skip torn
+lines exactly like the journal's.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import threading
+import time
+from typing import Any, Callable
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("obs.rollup")
+
+__all__ = [
+    "ROLLUP_SUFFIX",
+    "ROLLUP_SCHEMA",
+    "RollupCompactor",
+    "RegressionWatchdog",
+    "rollup_path",
+    "rollup_files",
+    "read_rollups",
+    "reconstruct",
+    "merge_digest_snapshots",
+    "load_baseline",
+    "register_source",
+    "unregister_source",
+    "install",
+    "uninstall",
+    "active",
+    "install_regression",
+    "uninstall_regression",
+    "regression_active",
+    "tick",
+]
+
+ROLLUP_SUFFIX = ".rollup.jsonl"
+ROLLUP_SCHEMA = "stpu.obs.rollup/1"
+
+#: wall-clock seam (monkeypatchable by the frozen-clock drills)
+_time = time.time
+
+#: excursion-opening events → (kind, fn(rec) -> excursion name)
+_OPEN_KINDS: dict[str, tuple[str, Callable[[dict], str]]] = {
+    "slo_breach": ("slo", lambda r: str(r.get("signal", "?"))),
+    "recompile_storm": ("storm", lambda r: str(r.get("culprit", "?"))),
+    "data_drift": (
+        "drift",
+        lambda r: f"{r.get('model', '?')}/f{r.get('feature', '?')}",
+    ),
+    "straggler_detect": ("straggler",
+                         lambda r: f"worker {r.get('worker', '?')}"),
+    "perf_regression": ("regression",
+                        lambda r: str(r.get("metric", "?"))),
+}
+
+#: excursion-closing events → the kind they close
+_CLOSE_KINDS: dict[str, tuple[str, Callable[[dict], str]]] = {
+    "slo_recover": _OPEN_KINDS["slo_breach"],
+    "recompile_storm_clear": _OPEN_KINDS["recompile_storm"],
+    "data_drift_clear": _OPEN_KINDS["data_drift"],
+    "straggler_clear": _OPEN_KINDS["straggler_detect"],
+    "perf_regression_clear": _OPEN_KINDS["perf_regression"],
+}
+
+# ---- monotonic-counter sources -----------------------------------------------
+
+#: name -> zero-arg callable returning a flat {key: number} dict of
+#: CUMULATIVE counters.  The compactor polls every source at each window
+#: flush and records per-window deltas; baselines start at the source's
+#: birth (0 for a fresh registry), so the deltas sum back to the exact
+#: live totals — the conservation property the rotation drill pins.
+#: Process-global on purpose: sources (a serve server's metrics, the
+#: cost accountant) register whenever they come up, before or after the
+#: compactor installs.
+_sources: dict[str, Callable[[], dict]] = {}
+
+
+def register_source(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) a counter source.  Replacement resets the
+    delta baseline via the compactor's reset clamp — a counter that
+    moves BACKWARD (new registry) is treated as restarted from zero,
+    Prometheus ``rate()`` semantics."""
+    _sources[name] = fn
+
+
+def unregister_source(name: str) -> None:
+    _sources.pop(name, None)
+
+
+class _WindowFold:
+    """One in-progress rollup window's accumulation state."""
+
+    __slots__ = ("t0", "events", "serve", "train", "gauges", "compile",
+                 "data", "excursions")
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.events: dict[str, int] = {}
+        self.serve: dict[str, dict] = {}
+        self.train: dict[str, dict] = {}
+        self.gauges: dict[str, float] = {}
+        self.compile: dict[str, float] = {}
+        self.data: dict[str, dict] = {}
+        self.excursions: list[dict] = []
+
+
+class RollupCompactor:
+    """Fold journal events + counter deltas into per-window sidecar
+    records.  ``note_event`` is the journal tap (one dict fold, no IO
+    unless the window rolled); ``flush`` writes one JSON line; a daemon
+    thread flushes idle windows so counter deltas keep flowing even
+    when no events do."""
+
+    def __init__(self, path: str, *, window_s: float = 60.0,
+                 plane: str | None = None, worker: int | None = None,
+                 job: str | None = None, thread: bool = True):
+        self.path = os.fspath(path)
+        self.window_s = max(1.0, float(window_s))
+        self.plane = plane
+        self.worker = worker
+        self.job = job
+        self._lock = threading.Lock()
+        self._cur: _WindowFold | None = None
+        # (source, key) -> last absolute value polled (delta baseline).
+        # Starts EMPTY: the first poll's delta is the full counter value,
+        # so counts between source birth and first flush are never lost.
+        self._last: dict[tuple[str, str], float] = {}
+        # signal -> (count, sum) at the previous digest snapshot: the
+        # SLO digests are sliding windows that OVERLAP successive
+        # rollup windows, so recording raw counts would inflate them —
+        # each record instead carries new_count/new_sum (the growth
+        # since the last flush), which reconstruct sums back to the
+        # exact observation total (conservation, like the counters)
+        self._digest_last: dict[str, tuple[int, float]] = {}
+        self._open_exc: dict[tuple[str, str], dict] = {}
+        self._file: int | None = None
+        self._warned = False
+        self._closed = False
+        # wall time of the last flush: the daemon loop is a FALLBACK
+        # for idle/eventless windows — when the event-driven boundary
+        # roll already flushed this window, the daemon defers, so
+        # steady traffic yields ONE record per window, not two
+        self._flushed_at = _time()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if thread:
+            self._thread = threading.Thread(
+                target=self._flush_loop, name="obs-rollup", daemon=True)
+            self._thread.start()
+            # a short-lived worker (one fast fit) can exit BEFORE the
+            # first periodic tick and before anything closes its
+            # journal — the atexit flush is what makes its final
+            # windows (and final counter deltas) land; close()
+            # unregisters, and a SIGKILL still loses at most one window
+            import atexit
+
+            atexit.register(self.close)
+
+    # ---- folding (journal tap) ----
+    def note_event(self, rec: dict) -> None:
+        ev = rec.get("event")
+        if not isinstance(ev, str):
+            return
+        ts = float(rec.get("ts") or _time())
+        try:
+            with self._lock:
+                if self._closed:
+                    return
+                self._roll_locked(ts)
+                self._fold_locked(ev, rec, ts)
+        except Exception:
+            # the compactor must never take down the journal write that
+            # fed it — same contract as the journal itself
+            if not self._warned:
+                self._warned = True
+                log.warning("rollup fold failed; further fold errors "
+                            "are silent", exc_info=True)
+
+    def _roll_locked(self, ts: float) -> None:
+        start = (ts // self.window_s) * self.window_s
+        if self._cur is None:
+            self._cur = _WindowFold(start)
+        elif start > self._cur.t0:
+            self._flush_locked(self._cur.t0 + self.window_s)
+            self._cur = _WindowFold(start)
+
+    def _fold_locked(self, ev: str, rec: dict, ts: float) -> None:
+        w = self._cur
+        w.events[ev] = w.events.get(ev, 0) + 1
+        if ev == "serve_batch":
+            m = w.serve.setdefault(str(rec.get("model") or "default"), {
+                "rows": 0, "requests": 0, "batches": 0,
+                "padded_rows": 0, "dispatch_s": 0.0, "queue_delay_s": 0.0,
+            })
+            m["rows"] += int(rec.get("rows", 0) or 0)
+            m["requests"] += int(rec.get("requests", 0) or 0)
+            m["batches"] += 1
+            m["padded_rows"] += int(rec.get("bucket", 0) or 0)
+            m["dispatch_s"] += float(rec.get("dispatch_s", 0.0) or 0.0)
+            m["queue_delay_s"] += float(
+                rec.get("queue_delay_s", 0.0) or 0.0)
+        elif ev == "step_breakdown":
+            t = w.train.setdefault(str(rec.get("worker", 0) or 0), {
+                "epochs": 0, "steps": 0, "dispatch_s": 0.0,
+                "infeed_s": 0.0, "host_s": 0.0, "block_s": 0.0,
+                "train_time_s": 0.0,
+            })
+            t["epochs"] += 1
+            t["steps"] += int(rec.get("steps", 0) or 0)
+            for k in ("dispatch_s", "infeed_s", "host_s", "block_s"):
+                t[k] += float(rec.get(k, 0.0) or 0.0)
+        elif ev == "epoch":
+            # setdefault, not get: the trainer emits `epoch` BEFORE
+            # `step_breakdown`, so the window's first epoch event must
+            # be able to mint the worker's row or its train_time_s is
+            # silently dropped every window
+            t = w.train.setdefault(str(rec.get("worker", 0) or 0), {
+                "epochs": 0, "steps": 0, "dispatch_s": 0.0,
+                "infeed_s": 0.0, "host_s": 0.0, "block_s": 0.0,
+                "train_time_s": 0.0,
+            })
+            t["train_time_s"] += float(
+                rec.get("train_time_s", 0.0) or 0.0)
+        elif ev == "device_mem":
+            for key in ("total_bytes", "devmem_frac"):
+                v = rec.get(key)
+                if v is not None:
+                    w.gauges[key] = max(w.gauges.get(key, 0.0), float(v))
+        elif ev == "compile":
+            w.compile["compiles"] = w.compile.get("compiles", 0) + 1
+            s = float(rec.get("compile_s", 0.0) or 0.0)
+            w.compile["compile_s"] = w.compile.get("compile_s", 0.0) + s
+            w.compile["max_s"] = max(w.compile.get("max_s", 0.0), s)
+        elif ev == "data_stats":
+            stats = rec.get("stats")
+            if isinstance(stats, dict):
+                if rec.get("plane") == "train":
+                    key = f"train:w{rec.get('worker', 0) or 0}"
+                else:
+                    key = f"serve:{rec.get('model') or 'default'}"
+                # last-wins within the window: train sketches are
+                # CUMULATIVE per fit and serve sketches windowed, so
+                # summing them would double-count; reconstruct keeps
+                # the last across windows for the same reason
+                w.data[key] = stats
+        if ev in _OPEN_KINDS:
+            kind, name_of = _OPEN_KINDS[ev]
+            name = name_of(rec)
+            self._open_exc[(kind, name)] = {
+                "kind": kind, "name": name,
+                "start_ts": ts, "end_ts": None,
+            }
+        elif ev in _CLOSE_KINDS:
+            kind, name_of = _CLOSE_KINDS[ev]
+            name = name_of(rec)
+            exc = self._open_exc.pop((kind, name), None)
+            if exc is None:
+                # close without a seen open (the open predates this
+                # compactor): record the interval with an unknown start
+                exc = {"kind": kind, "name": name, "start_ts": None}
+            exc["end_ts"] = ts
+            w.excursions.append(exc)
+
+    # ---- flushing ----
+    def _poll_counters_locked(self) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for src, fn in list(_sources.items()):
+            try:
+                cur = fn()
+            except Exception:
+                continue
+            if not isinstance(cur, dict):
+                continue
+            deltas: dict[str, float] = {}
+            for key, val in cur.items():
+                try:
+                    val = float(val)
+                except (TypeError, ValueError):
+                    continue
+                last = self._last.get((src, key), 0.0)
+                if val < last:
+                    last = 0.0  # counter reset (replaced source/registry)
+                d = val - last
+                self._last[(src, key)] = val
+                if d:
+                    deltas[key] = round(d, 6)
+            if deltas:
+                out[src] = deltas
+        return out
+
+    def _digest_snapshots(self) -> dict[str, dict]:
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+
+        wd = obs_slo.active()
+        if wd is None:
+            return {}
+        try:
+            raw = wd.digest_snapshots()
+            totals = wd.digest_totals()
+        except Exception:
+            return {}
+        out: dict[str, dict] = {}
+        # iterate the TOTALS (a superset of the live snapshots): a
+        # signal whose window expired before this flush has no snapshot
+        # but its observations still happened — conservation demands
+        # their count/sum land in SOME record, values-unknown or not
+        for sig, (cur_n, cur_s) in totals.items():
+            snap = raw.get(sig)
+            if snap is None and cur_n == 0:
+                continue
+            # delta-ize against the digest's LIFETIME totals (monotonic
+            # — the windowed count shrinks as cells expire and cannot
+            # be delta-ized)
+            prev_n, prev_s = self._digest_last.get(sig, (0, 0.0))
+            if cur_n < prev_n:
+                prev_n, prev_s = 0, 0.0  # watchdog replaced: reset
+            self._digest_last[sig] = (cur_n, cur_s)
+            new_n = cur_n - prev_n
+            if new_n <= 0:
+                continue  # nothing new since the last flush
+            rec = ({k: v for k, v in snap.items()
+                    if k not in ("total_count", "total_sum")}
+                   if snap is not None else {})
+            rec["new_count"] = new_n
+            rec["new_sum"] = round(cur_s - prev_s, 6)
+            out[sig] = rec
+        return out
+
+    def flush(self, now: float | None = None) -> None:
+        """Flush the current window (plus counter deltas) to the
+        sidecar.  Public for tests and the journal-close hook; the
+        daemon thread calls it once per window so an idle journal still
+        records counter movement."""
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked(now)
+            self._cur = None
+
+    def _flush_locked(self, now: float | None = None) -> None:
+        now = _time() if now is None else now
+        self._flushed_at = now
+        w = self._cur
+        counters = self._poll_counters_locked()
+        digests = self._digest_snapshots()
+        rec: dict[str, Any] = {
+            "schema": ROLLUP_SCHEMA,
+            "t0": round(w.t0 if w is not None else now, 6),
+            "t1": round(now, 6),
+        }
+        if self.plane is not None:
+            rec["plane"] = self.plane
+        if self.worker is not None:
+            rec["worker"] = self.worker
+        if self.job is not None:
+            rec["job"] = self.job
+        empty = True
+        if w is not None and w.events:
+            rec["events"] = w.events
+            empty = False
+        if w is not None:
+            for field in ("serve", "train", "gauges", "compile", "data"):
+                val = getattr(w, field)
+                if val:
+                    rec[field] = val
+                    empty = False
+            if w.excursions:
+                rec["excursions"] = w.excursions
+                empty = False
+        if counters:
+            rec["counters"] = counters
+            empty = False
+        if digests:
+            rec["digests"] = digests
+            empty = False
+        if self._open_exc:
+            rec["open_excursions"] = [
+                dict(e) for e in self._open_exc.values()]
+        if empty:
+            return  # an idle window costs zero bytes
+        self._write_locked(rec)
+
+    def _write_locked(self, rec: dict) -> None:
+        try:
+            line = (json.dumps(rec, separators=(",", ":"), default=str)
+                    + "\n").encode("utf-8")
+        except (TypeError, ValueError):
+            return
+        try:
+            if self._file is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._file = os.open(
+                    self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                    0o644)
+            os.write(self._file, line)
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                log.warning("rollup write to %s failed (%s); further "
+                            "records will be dropped", self.path, e)
+
+    def _flush_loop(self) -> None:
+        period = min(self.window_s, 5.0)
+        while not self._stop.wait(period):
+            now = _time()
+            # defer to the event-driven boundary flush: only step in
+            # when a full window has passed with nothing flushing
+            if now - self._flushed_at >= self.window_s:
+                try:
+                    self.flush(now)
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        """Final flush (captures the partial window + final counter
+        deltas) and stop.  Installed as the journal's close hook AND an
+        atexit handler, so a SIGTERM-drained fleet's (or a fast-exiting
+        worker's) sidecar is complete; a SIGKILL loses at most the
+        current window."""
+        self._stop.set()
+        if self._thread is not None:
+            import atexit
+
+            try:
+                atexit.unregister(self.close)
+            except Exception:
+                pass
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._flush_locked()
+            except Exception:
+                pass
+            self._closed = True
+            if self._file is not None:
+                try:
+                    os.close(self._file)
+                except OSError:
+                    pass
+                self._file = None
+
+
+# ---- reading -----------------------------------------------------------------
+
+def rollup_path(journal_path: str) -> str:
+    """The sidecar path for one WRITER's journal path (siblings keep
+    their ``.w<k>``/``.s<k>`` suffix: one writer per sidecar, same as
+    the journal's crash-safety contract)."""
+    return os.fspath(journal_path) + ROLLUP_SUFFIX
+
+
+def rollup_files(base: str) -> list[str]:
+    """Every sidecar belonging to the journal at ``base`` (the base
+    writer's plus fleet siblings')."""
+    base = os.fspath(base)
+    pat = re.compile(
+        re.escape(os.path.basename(base)) + r"(\.[ws]\d+)?"
+        + re.escape(ROLLUP_SUFFIX) + "$"
+    )
+    found = [
+        p for p in glob.glob(glob.escape(base) + "*")
+        if pat.fullmatch(os.path.basename(p))
+    ]
+    return sorted(found)
+
+
+def read_rollups(base: str) -> list[dict]:
+    """All intact rollup records for the journal at ``base`` (or, when
+    ``base`` IS a sidecar file, that one file), ordered by window
+    start.  Torn lines are skipped, like the journal's readers."""
+    base = os.fspath(base)
+    paths = ([base] if base.endswith(ROLLUP_SUFFIX)
+             and os.path.isfile(base) else rollup_files(base))
+    records: list[dict] = []
+    for path in paths:
+        try:
+            f = open(path, "rb")
+        except OSError:
+            continue
+        with f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("schema"):
+                    records.append(rec)
+    records.sort(key=lambda r: (r.get("t0", 0.0), r.get("t1", 0.0)))
+    return records
+
+
+def merge_digest_snapshots(snaps: list[dict]) -> dict | None:
+    """Count-weighted combine of WindowedDigest snapshots (the same
+    estimate ``obs/slo.WindowedDigest.snapshot`` makes across its own
+    cells): counts and sums add, max maxes, quantiles average
+    count-weighted.  Rollup records carry ``new_count``/``new_sum`` —
+    the growth since the previous flush — because the sliding SLO
+    window overlaps successive rollup windows; merging those deltas
+    makes the run-level count and sum EXACT, while the quantiles stay
+    the usual load-homogeneous estimate."""
+
+    def weight(s: dict) -> int:
+        return int(s.get("new_count", s.get("count", 0)) or 0)
+
+    def part_sum(s: dict) -> float:
+        if "new_sum" in s:
+            return float(s["new_sum"])
+        return float(s.get("sum", 0.0) or 0.0)
+
+    snaps = [s for s in snaps if s and weight(s) > 0]
+    if not snaps:
+        return None
+    total = sum(weight(s) for s in snaps)
+    out: dict[str, Any] = {
+        "count": total,
+        "sum": round(sum(part_sum(s) for s in snaps), 6),
+        "max": max(float(s.get("max", 0.0)) for s in snaps),
+    }
+    out["mean"] = round(out["sum"] / total, 6)
+    qkeys = sorted({k for s in snaps for k in s
+                    if re.fullmatch(r"p\d+", k)})
+    for q in qkeys:
+        num = sum(float(s[q]) * weight(s) for s in snaps if q in s)
+        den = sum(weight(s) for s in snaps if q in s)
+        if den:
+            out[q] = round(num / den, 6)
+    stat = next((s["stat"] for s in reversed(snaps) if s.get("stat")),
+                None)
+    if stat is not None:
+        out["stat"] = stat
+    return out
+
+
+def reconstruct(records: list[dict]) -> dict:
+    """One run document out of a sidecar set: counters summed (exact —
+    they were written as per-window deltas of monotonic counters),
+    event counts and serve/train volume summed, gauges maxed, digests
+    count-weight merged, data sketches last-wins (they are cumulative/
+    windowed, not deltas), excursion intervals concatenated with the
+    final record's still-open set."""
+    doc: dict[str, Any] = {
+        "schema": "stpu.obs.report/1",
+        "windows": len(records),
+        "t0": None, "t1": None,
+        "writers": [],
+        "jobs": [],
+        "events": {},
+        "counters": {},
+        "serve": {},
+        "train": {},
+        "gauges": {},
+        "compile": {},
+        "data": {},
+        "digests": {},
+        "excursions": [],
+        "open_excursions": [],
+    }
+    writers: set = set()
+    jobs: set = set()
+    digest_parts: dict[str, list[dict]] = {}
+    open_by_writer: dict[tuple, list[dict]] = {}
+    for rec in records:
+        t0, t1 = rec.get("t0"), rec.get("t1")
+        if t0 is not None:
+            doc["t0"] = t0 if doc["t0"] is None else min(doc["t0"], t0)
+        if t1 is not None:
+            doc["t1"] = t1 if doc["t1"] is None else max(doc["t1"], t1)
+        wkey = (rec.get("plane"), rec.get("worker"))
+        writers.add(wkey)
+        if rec.get("job"):
+            jobs.add(rec["job"])
+        for ev, n in (rec.get("events") or {}).items():
+            doc["events"][ev] = doc["events"].get(ev, 0) + int(n)
+        for src, deltas in (rec.get("counters") or {}).items():
+            acc = doc["counters"].setdefault(src, {})
+            for k, d in deltas.items():
+                acc[k] = round(acc.get(k, 0.0) + float(d), 6)
+        for model, m in (rec.get("serve") or {}).items():
+            acc = doc["serve"].setdefault(model, {})
+            for k, v in m.items():
+                acc[k] = round(acc.get(k, 0) + v, 6)
+        for wk, t in (rec.get("train") or {}).items():
+            acc = doc["train"].setdefault(wk, {})
+            for k, v in t.items():
+                acc[k] = round(acc.get(k, 0) + v, 6)
+        for k, v in (rec.get("gauges") or {}).items():
+            doc["gauges"][k] = max(doc["gauges"].get(k, 0.0), float(v))
+        for k, v in (rec.get("compile") or {}).items():
+            if k == "max_s":
+                doc["compile"][k] = max(doc["compile"].get(k, 0.0), v)
+            else:
+                doc["compile"][k] = round(
+                    doc["compile"].get(k, 0) + v, 6)
+        for k, v in (rec.get("data") or {}).items():
+            doc["data"][k] = v  # last wins (records are time-ordered)
+        for sig, snap in (rec.get("digests") or {}).items():
+            digest_parts.setdefault(sig, []).append(snap)
+        # excursions are per-WRITER state (each compactor tracked its
+        # own journal): tag them, or worker A's recovery would hide
+        # worker B's still-open excursion of the same signal
+        wtag = (f"{rec.get('plane') or '?'}"
+                + (f"/w{rec['worker']}" if rec.get("worker") is not None
+                   else ""))
+        doc["excursions"].extend(
+            {**e, "writer": wtag} for e in rec.get("excursions") or [])
+        # still-open excursions: each writer's LAST record's view wins
+        open_by_writer[wkey] = [
+            {**e, "writer": wtag}
+            for e in rec.get("open_excursions") or []]
+    for sig, parts in digest_parts.items():
+        merged = merge_digest_snapshots(parts)
+        if merged is not None:
+            doc["digests"][sig] = merged
+    still_open = [e for lst in open_by_writer.values() for e in lst]
+
+    def _still_open(e: dict) -> bool:
+        # a snapshot that a LATER window's completed interval covers is
+        # not open anymore — matched per WRITER: another worker's
+        # recovery says nothing about this one's excursion
+        s = e.get("start_ts") or 0
+        key = (e.get("writer"), e.get("kind"), e.get("name"))
+        return not any(
+            (c.get("writer"), c.get("kind"), c.get("name")) == key
+            and (c.get("end_ts") or 0) >= s
+            for c in doc["excursions"]
+        )
+
+    doc["open_excursions"] = [e for e in still_open if _still_open(e)]
+    doc["writers"] = sorted(
+        f"{p or '?'}" + (f"/w{w}" if w is not None else "")
+        for p, w in writers)
+    doc["jobs"] = sorted(jobs)
+    return doc
+
+
+def load_baseline(path: str) -> dict | None:
+    """A pinned baseline run document: ``path`` is either one sidecar
+    file or a journal base whose sidecars exist.  None when nothing is
+    readable (the caller logs and runs without a baseline rather than
+    failing the job)."""
+    records = read_rollups(path)
+    if not records:
+        return None
+    return reconstruct(records)
+
+
+# ---- cross-run regression watchdog -------------------------------------------
+
+#: digest-backed signals compared across runs, with the stat that
+#: matters for each (falls back to the snapshot's recorded stat)
+_REGRESSION_STATS = {"serve_p99_s": "p99", "train_step_ms": "mean"}
+
+#: noise-discount scale: a live/baseline delta must clear
+#: NOISE_K/sqrt(min(n_live, n_base)) above 1 before it can count —
+#: the small-sample discipline the data-drift scorer uses (≈3/√n)
+_NOISE_K = 3.0
+
+
+class _RegState:
+    __slots__ = ("breached", "bad", "good", "since")
+
+    def __init__(self):
+        self.breached = False
+        self.bad = 0
+        self.good = 0
+        self.since: float | None = None
+
+
+class RegressionWatchdog:
+    """Live-vs-pinned-baseline comparison, evaluated on the serve SLO
+    tick / train epoch tick.  Hysteretic like every other obs state
+    machine; an absent live window (no traffic) counts as a clean tick
+    so a drained fleet recovers."""
+
+    def __init__(self, baseline: dict, *, threshold: float,
+                 hysteresis: int = 2, min_count: int = 16,
+                 plane: str = "serve", worker: int | None = None):
+        if threshold <= 1:
+            raise ValueError(
+                f"regression threshold must be > 1, got {threshold}")
+        self.baseline = baseline.get("digests") or {}
+        self.threshold = float(threshold)
+        self.hysteresis = max(1, int(hysteresis))
+        self.min_count = max(1, int(min_count))
+        self.plane = plane
+        self.worker = worker
+        self._states: dict[str, _RegState] = {}
+        self._lock = threading.Lock()
+
+    def _live_snapshots(self) -> dict[str, dict]:
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+
+        wd = obs_slo.active()
+        if wd is None:
+            return {}
+        try:
+            return wd.digest_snapshots()
+        except Exception:
+            return {}
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """One tick; returns (and journals) the events it emitted."""
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+        now = time.monotonic() if now is None else now
+        live = self._live_snapshots()
+        events: list[dict] = []
+        with self._lock:
+            for metric, base in self.baseline.items():
+                stat = _REGRESSION_STATS.get(
+                    metric, base.get("stat") or "mean")
+                base_v = base.get(stat)
+                base_n = int(base.get("count", 0) or 0)
+                if base_v is None or base_v <= 0 or not base_n:
+                    continue
+                st = self._states.setdefault(metric, _RegState())
+                snap = live.get(metric)
+                live_v = snap.get(stat) if snap else None
+                live_n = int(snap.get("count", 0) or 0) if snap else 0
+                if live_v is not None and 0 < live_n < self.min_count:
+                    # too few samples to judge either way: a NEUTRAL
+                    # tick — neither opens nor closes an excursion.
+                    # (Counting it clean once cleared a live 28×
+                    # regression whose window just happened to be thin
+                    # because the slowdown itself throttled traffic.)
+                    continue
+                regressing = False
+                ratio = None
+                if live_v is not None:
+                    ratio = live_v / base_v
+                    # noise-aware: the excess over 1 must clear the
+                    # small-sample discount on top of the threshold
+                    floor = (self.threshold - 1.0
+                             + _NOISE_K / math.sqrt(min(live_n, base_n)))
+                    regressing = ratio - 1.0 >= floor
+                if regressing:
+                    st.bad += 1
+                    st.good = 0
+                    if not st.breached and st.bad >= self.hysteresis:
+                        st.breached = True
+                        st.since = now
+                        events.append({
+                            "event": "perf_regression",
+                            "metric": metric, "stat": stat,
+                            "value": round(live_v, 6),
+                            "baseline": round(base_v, 6),
+                            "ratio": round(ratio, 4),
+                            "threshold": self.threshold,
+                        })
+                else:
+                    st.good += 1
+                    st.bad = 0
+                    if st.breached and st.good >= self.hysteresis:
+                        st.breached = False
+                        events.append({
+                            "event": "perf_regression_clear",
+                            "metric": metric, "stat": stat,
+                            "value": (round(live_v, 6)
+                                      if live_v is not None else None),
+                            "baseline": round(base_v, 6),
+                            "regression_s": round(
+                                now - (st.since or now), 3),
+                        })
+                        st.since = None
+        for ev in events:
+            fields = {k: v for k, v in ev.items() if k != "event"}
+            obs_journal.emit(ev["event"], plane=self.plane,
+                             worker=self.worker, **fields)
+        return events
+
+    def state(self) -> dict[str, dict]:
+        with self._lock:
+            return {m: {"breached": st.breached}
+                    for m, st in self._states.items()}
+
+
+# ---- process-global hooks ----------------------------------------------------
+
+_active: RollupCompactor | None = None
+_regression: RegressionWatchdog | None = None
+
+
+def install(compactor: RollupCompactor) -> RollupCompactor:
+    global _active
+    if _active is not None and _active is not compactor:
+        _active.close()
+    _active = compactor
+    return compactor
+
+
+def uninstall() -> None:
+    global _active
+    if _active is not None:
+        _active.close()
+    _active = None
+
+
+def active() -> RollupCompactor | None:
+    return _active
+
+
+def install_regression(watchdog: RegressionWatchdog) -> RegressionWatchdog:
+    global _regression
+    _regression = watchdog
+    return watchdog
+
+
+def uninstall_regression() -> None:
+    global _regression
+    _regression = None
+
+
+def regression_active() -> RegressionWatchdog | None:
+    return _regression
+
+
+def tick(now: float | None = None) -> None:
+    """The slow-path hook the serve SLO loop and the trainer's epoch
+    call: evaluate the regression watchdog (a no-op without a pinned
+    baseline) — the compactor flushes on its own thread."""
+    rw = _regression
+    if rw is not None:
+        try:
+            rw.evaluate(now)
+        except Exception:
+            log.warning("regression evaluation failed", exc_info=True)

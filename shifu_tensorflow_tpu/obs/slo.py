@@ -189,6 +189,13 @@ class WindowedDigest:
         self.quantiles = tuple(quantiles)
         self._cells: list[_Cell | None] = [None] * self.buckets
         self._lock = threading.Lock()
+        # lifetime totals, MONOTONIC (the windowed count shrinks as
+        # cells expire): the rollup compactor delta-izes these to get
+        # exact per-window observation counts — a sliding count cannot
+        # be delta-ized (shrinkage would read as a reset and re-count
+        # the survivors)
+        self._total = 0
+        self._total_sum = 0.0
 
     def _cell(self, now: float) -> _Cell:
         start = (now // self.bucket_s) * self.bucket_s
@@ -209,6 +216,15 @@ class WindowedDigest:
                 cell.max = x
             for p2 in cell.p2.values():
                 p2.add(x)
+            self._total += 1
+            self._total_sum += x
+
+    def totals(self) -> tuple[int, float]:
+        """Lifetime (count, sum) — monotonic even when the window is
+        empty, so the rollup compactor's conservation bookkeeping can
+        account observations whose window expired before a flush."""
+        with self._lock:
+            return self._total, self._total_sum
 
     def snapshot(self, now: float | None = None) -> dict | None:
         """Merged window statistics, or None when the window holds no
@@ -225,6 +241,10 @@ class WindowedDigest:
                 "count": total,
                 "sum": sum(c.sum for c in live),
                 "max": max(c.max for c in live),
+                # lifetime totals (monotonic — see __init__); consumed
+                # by the rollup compactor, stripped from its records
+                "total_count": self._total,
+                "total_sum": self._total_sum,
             }
             out["mean"] = out["sum"] / total
             for q in self.quantiles:
@@ -544,6 +564,39 @@ class SloWatchdog:
         return events
 
     # ---- reading ----
+    def digest_snapshots(self, now: float | None = None) -> dict[str, dict]:
+        """Current-window snapshot of every digest-backed signal that
+        holds observations, each stamped with the signal's evaluation
+        stat.  The rollup compactor records these per window and the
+        regression watchdog compares them against a pinned baseline —
+        a JSON-able read, no state mutated."""
+        now = _mono() if now is None else now
+        with self._lock:
+            digests = list(self._digests.items())
+            stats = {name: sig.stat for name, sig in self._signals.items()}
+        out: dict[str, dict] = {}
+        for name, d in digests:
+            snap = d.snapshot(now)
+            if snap is None:
+                continue
+            snap = _round_snap(snap)
+            stat = stats.get(name)
+            if stat is not None:
+                snap["stat"] = stat
+            out[name] = snap
+        return out
+
+    def digest_totals(self) -> dict[str, tuple[int, float]]:
+        """Lifetime (count, sum) per digest-backed signal — monotonic
+        and present even when a signal's window is EMPTY, unlike
+        :meth:`digest_snapshots`.  The rollup compactor reads both: the
+        snapshot for window statistics, the totals for conservation (an
+        observation whose window expired before the flush still
+        counts)."""
+        with self._lock:
+            digests = list(self._digests.items())
+        return {name: d.totals() for name, d in digests}
+
     def state(self) -> dict[str, dict]:
         """Per-signal state snapshot (tests, /healthz embedding)."""
         with self._lock:

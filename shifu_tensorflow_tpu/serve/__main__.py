@@ -102,6 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(internal) fleet-wide job correlation id stamped "
                         "on journal events; set by the --serve-workers "
                         "supervisor so every worker journals the same id")
+    p.add_argument("--obs-baseline", default=None, dest="obs_baseline",
+                   help="pinned baseline rollup (a .rollup.jsonl sidecar "
+                        "or a journal base) for the cross-run regression "
+                        "watchdog (shifu.tpu.obs-baseline); fires "
+                        "perf_regression when live windows exceed it by "
+                        "the shifu.tpu.slo-regression ratio")
     return p
 
 

@@ -57,10 +57,11 @@ from typing import Callable
 import numpy as np
 
 from shifu_tensorflow_tpu.export.bucketing import bucket_size, pad_rows
+from shifu_tensorflow_tpu.obs import cost as obs_cost
 from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import trace as obs_trace
-from shifu_tensorflow_tpu.utils import logs
+from shifu_tensorflow_tpu.utils import faults, logs
 
 log = logs.get("serve.batcher")
 
@@ -199,6 +200,11 @@ class MicroBatcher:
         self._dispatching: _Work | None = None
         self._scheduler = scheduler
         self._sched_handle = None
+        # chaos seam serve.dispatch (slow/error kinds): decided at
+        # construction, like the trainer's per-step seam — a plan comes
+        # from the environment at process start, and the steady-state
+        # dispatch path must not pay the plan lookup's lock per batch
+        self._fault_seam = faults.active() is not None
         tag = f"-{model}" if model else ""
         self._threads = [
             threading.Thread(target=self._pack_loop,
@@ -405,13 +411,26 @@ class MicroBatcher:
         thread (which calls it under its weighted-fair arbitration).
         Must be entered by one thread at a time per scorer — both
         callers are single device threads by construction."""
+        acct = obs_cost.active()
+        t_env = time.monotonic()
         if work.error is None:
-            t0 = time.monotonic()
+            t0 = t_env
             work.queue_delay_s = t0 - min(
                 p.t_enqueue for p in work.batch)
             self._dispatching = work
+            # payload bytes (pre-padding): the volume denominator of the
+            # per-tenant cost ledger — captured before the pad copy is
+            # dropped below
+            nbytes = (work.padded.itemsize * work.n * work.padded.shape[1]
+                      if work.padded is not None and work.padded.ndim == 2
+                      else 0)
             with obs_trace.span("serve.dispatch"):
                 try:
+                    if self._fault_seam:
+                        # slow/error kinds land INSIDE the dispatch
+                        # timing so an injected lag shows up exactly
+                        # where a slow device would
+                        faults.check("serve.dispatch")
                     work.scores = np.asarray(self._score(work.padded))
                 except BaseException as e:
                     work.error = e
@@ -419,7 +438,19 @@ class MicroBatcher:
                     self._dispatching = None
             work.dispatch_s = time.monotonic() - t0
             work.padded = None  # the pad copy is dead weight now
+            if acct is not None:
+                # cost leg (obs/cost.py): device-seconds + the DRR
+                # currency (padded-row-seconds) attributed to this
+                # tenant — the scheduler charged bucket rows, so the
+                # ledger does too
+                acct.note_dispatch(self.model, dispatch_s=work.dispatch_s,
+                                   rows=work.n, bucket_rows=work.bucket,
+                                   nbytes=nbytes)
         self._scatter_q.put(work)
+        if acct is not None:
+            # the device lane's busy envelope (scoring + handoff):
+            # per-tenant device-seconds must conserve against this
+            acct.note_busy(time.monotonic() - t_env)
 
     def _dispatch_loop(self) -> None:
         while True:

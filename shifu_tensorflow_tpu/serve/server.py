@@ -42,6 +42,7 @@ from shifu_tensorflow_tpu.serve.batcher import (
 from shifu_tensorflow_tpu.export.bucketing import ladder
 from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.obs import rollup as obs_rollup
 from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.serve.config import ServeConfig
 from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
@@ -191,6 +192,24 @@ class ScoringServer:
         self._slo = obs_slo.active()
         self._slo_stop = threading.Event()
         self._slo_thread: threading.Thread | None = None
+        # rollup counter source (obs/rollup.py): the compactor polls the
+        # serve plane's MONOTONIC counters each window and records
+        # deltas in the rotation-exempt sidecar — rate-limited journal
+        # events (shed) can undercount, these cannot.  Registering is a
+        # module-dict write; without a compactor it is never polled.
+        obs_rollup.register_source("serve", self._rollup_counters)
+
+    def _rollup_counters(self) -> dict:
+        """Flat monotonic counters for the rollup compactor: the
+        process-wide surface (single-model totals / the multi-tenant
+        unrouted surface), plus every tenant's counters keyed
+        ``<counter>:<model>``."""
+        out: dict[str, float] = dict(self.metrics.counters())
+        if self.multi is not None:
+            for name, counters in self.multi.per_tenant_counters().items():
+                for k, v in counters.items():
+                    out[f"{k}:{name}"] = v
+        return out
 
     def max_body_bytes(self) -> int:
         """Reject-before-read bound on a /score body: the admission queue
@@ -285,6 +304,10 @@ class ScoringServer:
                 mon = obs_datastats.active()
                 if mon is not None:
                     mon.evaluate()
+                # long-horizon leg: the cross-run regression watchdog
+                # compares the live windowed digests against the pinned
+                # baseline rollup on this same tick (no-op unpinned)
+                obs_rollup.tick()
                 obs_profile.poll()
             except Exception as e:  # the watchdog must never kill serving
                 log.error("slo evaluation failed: %s: %s",
@@ -294,6 +317,15 @@ class ScoringServer:
         if self._closed:
             return
         self._closed = True
+        # flush the compactor BEFORE unregistering: the final counter
+        # deltas since the last window must land in the sidecar (the
+        # conservation gate), and after this server is gone its source
+        # must stop pinning the whole object graph (metrics, store,
+        # model arrays) for process lifetime
+        comp = obs_rollup.active()
+        if comp is not None:
+            comp.flush()
+        obs_rollup.unregister_source("serve")
         if self._serving:
             # shutdown() blocks on an event only serve_forever sets on
             # exit — calling it on a never-started server hangs forever

@@ -60,7 +60,7 @@ class _TenantQueue:
 
     __slots__ = ("name", "weight", "batcher", "work", "deficit",
                  "in_flight", "registered", "dispatched_rows",
-                 "dispatched_batches")
+                 "dispatched_batches", "dispatched_device_s")
 
     def __init__(self, name: str, batcher, weight: float):
         self.name = name
@@ -73,6 +73,10 @@ class _TenantQueue:
         self.registered = True
         self.dispatched_rows = 0
         self.dispatched_batches = 0
+        # device-seconds this tenant's dispatches consumed (the
+        # batcher's dispatch_s, accumulated here so the scheduler's own
+        # totals answer "who got the device" without the obs plane)
+        self.dispatched_device_s = 0.0
 
 
 class DeviceScheduler:
@@ -186,9 +190,11 @@ class DeviceScheduler:
             return {
                 tq.name: {"rows": tq.dispatched_rows,
                           "batches": tq.dispatched_batches,
+                          "device_s": round(tq.dispatched_device_s, 6),
                           "weight": tq.weight}
                 for tq in self._order
             }
+
 
     # ---- device thread ----
     def _pick_locked(self) -> _TenantQueue | None:
@@ -243,7 +249,10 @@ class DeviceScheduler:
                 tq.in_flight = True
             try:
                 # outside the lock: scoring must not serialize the
-                # tenants' pack/scatter threads or submissions
+                # tenants' pack/scatter threads or submissions.  The
+                # lane's busy/idle split is the COST ACCOUNTANT's job
+                # (obs/cost.py note_busy, fed inside _dispatch_one) —
+                # one ledger, not two that can drift.
                 tq.batcher._dispatch_one(work)
             except BaseException as e:  # the device thread must survive
                 log.error("dispatch for tenant %s failed outside the "
@@ -254,6 +263,7 @@ class DeviceScheduler:
                     tq.in_flight = False
                     tq.dispatched_rows += work.n
                     tq.dispatched_batches += 1
+                    tq.dispatched_device_s += work.dispatch_s
                     self._cond.notify_all()
 
     def close(self, timeout_s: float = 60.0) -> None:

@@ -744,6 +744,17 @@ class MultiModelStore:
                 totals[k] = totals.get(k, 0) + v
         return totals
 
+    def per_tenant_counters(self) -> dict[str, dict[str, int]]:
+        """Every tenant's monotonic counters, per tenant — the rollup
+        compactor's counter source (obs/rollup.py): rate-limited shed
+        EVENTS can undercount in the journal, these cannot.  Tenant
+        metrics are created once and survive eviction (the PR-9
+        monotonicity rule), so the series never reset mid-run."""
+        with self._lock:
+            tenants = list(self._tenants.items())
+        return {name: t.metrics.counters() for name, t in tenants
+                if t.metrics is not None}
+
     #: how stale the feature-width high-water mark may run before the
     #: next request re-reads the live stores (a hot reload that widened
     #: a model becomes visible to the body bound within this window)

@@ -1678,8 +1678,10 @@ class Trainer:
         # recorder's storm tick (a storm whose compiles stopped clears
         # here), and the on-demand profiler trigger poll — each an
         # is-None check when the leg is off
+        from shifu_tensorflow_tpu.obs import cost as _obs_cost
         from shifu_tensorflow_tpu.obs import memory as _obs_memory
         from shifu_tensorflow_tpu.obs import profile as _obs_profile
+        from shifu_tensorflow_tpu.obs import rollup as _obs_rollup
 
         mem = _obs_memory.active()
         if mem is not None:
@@ -1689,6 +1691,18 @@ class Trainer:
         rec = obs_compile.active()
         if rec is not None:
             rec.tick()
+        # cost leg (obs/cost.py): attribute this epoch's device dispatch
+        # seconds to (job, worker) from the SAME step-phase drain the
+        # journal records — the train side of the fleet's cost ledger
+        acct = _obs_cost.active()
+        if acct is not None and fields is not None:
+            acct.note_train_epoch(
+                self.worker_index,
+                dispatch_s=float(fields.get("dispatch_s", 0.0) or 0.0),
+                steps=int(fields.get("steps", 0) or 0))
+        # long-horizon leg: the train plane's regression-watchdog tick
+        # (the epoch IS the train tick, like the storm detector's)
+        _obs_rollup.tick()
         _obs_profile.poll()
         # data leg (obs/datastats.py): journal the cumulative train-side
         # feature sketch each epoch — the record `obs data` and the

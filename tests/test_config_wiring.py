@@ -789,6 +789,74 @@ def test_data_obs_keys_round_trip_xml_to_dataclass(tmp_path):
         ObsConfig(data_drift_threshold=0.0)
 
 
+def test_rollup_keys_round_trip_xml_to_dataclass(tmp_path):
+    """The PR-13 long-horizon keys ride the same ObsConfig chain: the
+    rollup compactor knobs, the pinned baseline, and the regression
+    watchdog target — XML → Conf → ObsConfig → JSON bridge."""
+    import pytest
+
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "rollup.xml"
+    values = {
+        K.OBS_ENABLED: "true",
+        K.OBS_ROLLUP: "false",
+        K.OBS_ROLLUP_WINDOW_S: "30",
+        K.OBS_BASELINE: "/tmp/base.rollup.jsonl",
+        K.SLO_REGRESSION: "1.5",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.rollup is False
+    assert cfg.rollup_window_s == 30.0
+    assert cfg.baseline_path == "/tmp/base.rollup.jsonl"
+    assert cfg.slo_regression == 1.5
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    # rollup=false: install_obs must NOT start a compactor even with a
+    # journal configured
+    from shifu_tensorflow_tpu.obs import install_obs
+    from shifu_tensorflow_tpu.obs import rollup as rollup_mod
+
+    off = ObsConfig(enabled=True,
+                    journal_path=str(tmp_path / "j.jsonl"),
+                    rollup=False)
+    try:
+        install_obs(off, plane="train")
+        assert rollup_mod.active() is None
+        on = ObsConfig(enabled=True,
+                       journal_path=str(tmp_path / "j2.jsonl"))
+        install_obs(on, plane="train")
+        assert rollup_mod.active() is not None
+    finally:
+        install_obs(ObsConfig(enabled=False), plane="train")
+        from shifu_tensorflow_tpu.obs import journal as journal_mod
+        from shifu_tensorflow_tpu.obs import trace as trace_mod
+
+        journal_mod.uninstall()
+        trace_mod.uninstall()
+    # defaults: rollup on (with a journal), no baseline, watchdog off
+    d = resolve_obs(_args(), _conf({}))
+    assert d.rollup is True
+    assert d.rollup_window_s == 60.0
+    assert d.baseline_path == ""
+    assert d.slo_regression == 0.0
+    # misconfiguration fails loudly
+    with pytest.raises(ValueError, match="obs-rollup-window"):
+        ObsConfig(rollup_window_s=0.0)
+    with pytest.raises(ValueError, match="slo-regression"):
+        ObsConfig(slo_regression=-1.0)
+    with pytest.raises(ValueError, match="slo-regression"):
+        ObsConfig(slo_regression=0.8)
+
+
 def test_obs_keys_reach_worker_config_bridge():
     """run_multi ships the resolved ObsConfig to subprocess workers via
     WorkerConfig.obs (JSON bridge) — and omits it entirely when obs is
