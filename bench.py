@@ -800,6 +800,22 @@ def main() -> None:
         rc = bench_serve_tenants.main()
         _append_bench_history('serve-tenants', 'BENCH_SERVE_TENANTS.json', rc=rc)
         sys.exit(rc)
+    if "serve-aot" in sys.argv[1:]:
+        # AOT executable shipping benchmark (python bench.py serve-aot):
+        # 10-tenant fleet-restart admission, deserialize (shipped
+        # executables) vs the PR-5 compile-warm baseline, plus the
+        # fingerprint-mismatch fallback drill, artifact
+        # BENCH_SERVE_AOT.json — implemented in
+        # scripts/bench_serve_aot.py.  In-process on the CPU backend
+        # (admission cost is the quantity under test), so the parent's
+        # no-jax rule does not apply.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve_aot
+
+        rc = bench_serve_aot.main()
+        _append_bench_history('serve-aot', 'BENCH_SERVE_AOT.json', rc=rc)
+        sys.exit(rc)
     if "serve-scale" in sys.argv[1:]:
         # serve-plane scale benchmark (python bench.py serve-scale):
         # bucket-ladder warm-up latency cliffs (cold start + hot-reload

@@ -1,0 +1,283 @@
+"""AOT executable shipping benchmark: admission latency at 10+ tenants.
+
+The question ROADMAP item 4 poses: when a fleet restarts, does shipping
+serialized executables in the bundle (export/aot.py) actually turn the
+tenants x ladder-buckets compile bill into a deserialize bill?  Three
+arms, all through the REAL admission path (ModelStore verify -> warm
+ladder, one store per tenant — exactly what MultiModelStore._admit
+runs per tenant, and what every SO_REUSEPORT worker re-pays today):
+
+- **aot**: bundles ship serialized executables; admission deserializes.
+  Deterministic criteria: ZERO new traces across every tenant
+  (``native_trace_count``), every ladder bucket journals
+  ``kind=aot_load`` with ``compile_s == 0``, no ``kind=warm`` events at
+  all, and the recompile-storm detector stays quiet.
+- **baseline**: the same weights without AOT — the PR-5 compile-warm
+  admission this PR exists to beat.
+- **mismatch drill**: bundles exported under a FAKED compile
+  environment; every bucket falls back to a live compile (journaled
+  ``kind=aot_fallback``) and the scores must be bit-identical to the
+  baseline arm's — the fallback ladder serves correctly, just slower.
+
+Headline metrics: total fleet admission seconds (all tenants, the
+restart bill), per-tenant time-to-first-score p50 (admission + first
+request — what a rebooted worker's first caller feels), and their
+aot/baseline ratios.  Gates: aot admission beats baseline, aot
+time-to-first-score beats baseline, the deterministic aot-hit criteria
+hold, and the mismatch drill is bit-identical.
+
+Output contract matches bench.py: every stdout line is a JSON object,
+the last the most complete; artifact lands in ``BENCH_SERVE_AOT.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+ARTIFACT = os.path.join(REPO_ROOT, "BENCH_SERVE_AOT.json")
+N_TENANTS = int(os.environ.get("BENCH_AOT_TENANTS", 10))
+MAX_ROWS = int(os.environ.get("BENCH_AOT_ROWS", 256))
+NUM_FEATURES = 12
+HIDDEN = [64, 32]
+
+
+def _emit(result: dict, partial: bool = True) -> None:
+    out = dict(result)
+    if partial:
+        out["partial"] = True
+    print(json.dumps(out), flush=True)
+
+
+def _export(export_dir: str, aot_buckets) -> None:
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+    from shifu_tensorflow_tpu.export.saved_model import export_native_bundle
+    from shifu_tensorflow_tpu.train.trainer import Trainer
+
+    mc = ModelConfig.from_json(
+        {"train": {"params": {
+            "NumHiddenLayers": len(HIDDEN), "NumHiddenNodes": HIDDEN,
+            "ActivationFunc": ["relu"] * len(HIDDEN),
+            "LearningRate": 0.05, "Optimizer": "adam"}}}
+    )
+    trainer = Trainer(mc, NUM_FEATURES, seed=7)
+    export_native_bundle(export_dir, trainer.state.params, mc,
+                         NUM_FEATURES, aot_buckets=aot_buckets)
+
+
+def _tenant_dirs(root: str, bundle: str, arm: str,
+                 n: int = N_TENANTS) -> list[str]:
+    # tenant names carry the arm prefix: the journal's model= dimension
+    # must tell the arms apart when the gates count per-arm events
+    dirs = []
+    for i in range(n):
+        d = os.path.join(root, arm, f"{arm}{i}")
+        shutil.copytree(bundle, d)
+        dirs.append(d)
+    return dirs
+
+
+def _admit_fleet(dirs: list[str], buckets, rows: np.ndarray):
+    """Admit every tenant through the real ModelStore path; returns
+    (admission seconds per tenant, time-to-first-score seconds per
+    tenant, stores, score of tenant 0)."""
+    from shifu_tensorflow_tpu.serve.model_store import ModelStore
+
+    admit_s, first_s, stores = [], [], []
+    score0 = None
+    for d in dirs:
+        t0 = time.monotonic()
+        store = ModelStore(d, poll_interval_s=0, warm_buckets=buckets,
+                           model_name=os.path.basename(d))
+        t1 = time.monotonic()
+        s = store.current().model.compute_batch(rows)
+        t2 = time.monotonic()
+        admit_s.append(t1 - t0)
+        first_s.append(t2 - t0)
+        stores.append(store)
+        if score0 is None:
+            score0 = np.asarray(s).copy()
+    return admit_s, first_s, stores, score0
+
+
+def _p50(xs: list[float]) -> float:
+    return float(sorted(xs)[len(xs) // 2]) if xs else 0.0
+
+
+def _drain(path: str):
+    # the journal writes one os.write per line — nothing to flush
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    return read_events(path)
+
+
+def main() -> int:
+    # this bench measures admission compile-vs-deserialize cost: pin the
+    # CPU backend so a present-but-unusable TPU plugin can't stall it
+    from shifu_tensorflow_tpu.utils.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+    from shifu_tensorflow_tpu.export import aot as aot_mod
+    from shifu_tensorflow_tpu.export.bucketing import ladder
+    from shifu_tensorflow_tpu.obs import compile as compile_mod
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs.journal import Journal
+
+    buckets = ladder(MAX_ROWS)
+    rows = np.random.default_rng(0).random(
+        (5, NUM_FEATURES)).astype(np.float32)
+    result: dict = {
+        "bench": "serve-aot",
+        "tenants": N_TENANTS,
+        "ladder": list(buckets),
+    }
+    root = tempfile.mkdtemp(prefix="stpu-bench-aot-")
+    try:
+        # ---- export the three bundle generations (identical weights)
+        aot_bundle = os.path.join(root, "bundle-aot")
+        plain_bundle = os.path.join(root, "bundle-plain")
+        mm_bundle = os.path.join(root, "bundle-mismatch")
+        _export(aot_bundle, buckets)
+        _export(plain_bundle, None)
+        real_fp = aot_mod.compile_env_fingerprint
+        fake = dict(real_fp(), jaxlib="0.0.0-elsewhere")
+        aot_mod.compile_env_fingerprint = lambda: fake
+        try:
+            _export(mm_bundle, buckets)
+        finally:
+            aot_mod.compile_env_fingerprint = real_fp
+        aot_bytes = sum(
+            os.path.getsize(os.path.join(aot_bundle, aot_mod.AOT_DIR, f))
+            for f in os.listdir(os.path.join(aot_bundle, aot_mod.AOT_DIR)))
+        result["aot_artifact_bytes"] = aot_bytes
+        _emit(result)
+
+        journal_path = os.path.join(root, "journal.jsonl")
+        journal_mod.install(Journal(journal_path, plane="serve"))
+        compile_mod.install(
+            compile_mod.CompileRecorder(plane="serve", analysis="cost"))
+
+        # ---- baseline arm: the PR-5 compile-warm admission
+        base_admit, base_first, base_stores, base_score = _admit_fleet(
+            _tenant_dirs(root, plain_bundle, "baseline"), buckets, rows)
+        base_traces = sum(s.current().model.native_trace_count
+                          for s in base_stores)
+        for s in base_stores:
+            s.close()
+        result.update({
+            "baseline_admission_total_s": round(sum(base_admit), 4),
+            "baseline_admission_p50_s": round(_p50(base_admit), 4),
+            "baseline_first_score_p50_s": round(_p50(base_first), 4),
+            "baseline_traces": base_traces,
+        })
+        _emit(result)
+
+        # ---- aot arm: admission is a deserialize
+        aot_admit, aot_first, aot_stores, aot_score = _admit_fleet(
+            _tenant_dirs(root, aot_bundle, "aot"), buckets, rows)
+        aot_traces = sum(s.current().model.native_trace_count
+                         for s in aot_stores)
+        aot_loads = sum(s.current().model.aot_stats["loads"]
+                        for s in aot_stores)
+        for s in aot_stores:
+            s.close()
+        result.update({
+            "aot_admission_total_s": round(sum(aot_admit), 4),
+            "aot_admission_p50_s": round(_p50(aot_admit), 4),
+            "aot_first_score_p50_s": round(_p50(aot_first), 4),
+            "aot_traces": aot_traces,
+            "aot_loads": aot_loads,
+        })
+        _emit(result)
+
+        # ---- mismatch drill: fallback ladder must serve bit-identically
+        mm_admit, _mm_first, mm_stores, mm_score = _admit_fleet(
+            _tenant_dirs(root, mm_bundle, "mismatch", n=2), buckets, rows)
+        mm_fallbacks = sum(s.current().model.aot_stats["fallbacks"]
+                           for s in mm_stores)
+        for s in mm_stores:
+            s.close()
+
+        # ---- journal-backed deterministic criteria
+        evs = _drain(journal_path)
+        compiles = [e for e in evs if e.get("event") == "compile"]
+        aot_load_evs = [e for e in compiles
+                        if e.get("kind") == "aot_load"]
+        warm_evs = [e for e in compiles if e.get("kind") == "warm"]
+        fb_evs = [e for e in compiles if e.get("kind") == "aot_fallback"]
+        storms = [e for e in evs if e.get("event") == "recompile_storm"]
+        aot_hit_compile_s = sum(e.get("compile_s", 0.0)
+                                for e in aot_load_evs)
+        result.update({
+            "aot_load_events": len(aot_load_evs),
+            "aot_hit_compile_s": round(aot_hit_compile_s, 6),
+            "warm_events_in_aot_arm": sum(
+                1 for e in warm_evs
+                if (e.get("model") or "").startswith("aot")),
+            "aot_fallback_events": len(fb_evs),
+            "mismatch_fallbacks": mm_fallbacks,
+            "mismatch_admission_p50_s": round(_p50(mm_admit), 4),
+            "storms": len(storms),
+        })
+
+        admission_ratio = (sum(aot_admit) / sum(base_admit)
+                           if sum(base_admit) else 0.0)
+        first_ratio = (_p50(aot_first) / _p50(base_first)
+                       if _p50(base_first) else 0.0)
+        bit_identical = (np.array_equal(aot_score, base_score)
+                         and np.array_equal(mm_score, base_score))
+        gates = {
+            # the restart bill: deserialize must beat compile fleet-wide
+            "admission_beats_baseline": admission_ratio < 0.8,
+            # what a rebooted worker's first caller feels
+            "first_score_beats_baseline": first_ratio < 0.8,
+            # deterministic aot-hit criteria (host-noise-proof)
+            "zero_traces": aot_traces == 0,
+            "zero_warms": result["warm_events_in_aot_arm"] == 0,
+            "all_buckets_loaded": (
+                aot_loads == N_TENANTS * len(buckets)
+                and len(aot_load_evs) == N_TENANTS * len(buckets)),
+            "aot_compile_s_zero": aot_hit_compile_s == 0.0,
+            "storm_quiet": len(storms) == 0,
+            # the fallback ladder serves CORRECTLY, just slower
+            "mismatch_bit_identical": bit_identical,
+            "mismatch_fell_back": mm_fallbacks == 2 * len(buckets),
+        }
+        result.update({
+            "admission_ratio": round(admission_ratio, 4),
+            "first_score_ratio": round(first_ratio, 4),
+            "admission_speedup": round(
+                1.0 / admission_ratio if admission_ratio else 0.0, 2),
+            "bit_identical": bit_identical,
+            "gates": gates,
+            "acceptance_ok": all(gates.values()),
+        })
+    finally:
+        # uninstall the process-global hooks BEFORE the tmp root goes
+        # away: on an arm failure the journal would otherwise keep a
+        # deleted directory's fd and the recorder would stay installed
+        # through interpreter teardown, burying the real error
+        journal_mod.uninstall()
+        compile_mod.uninstall()
+        shutil.rmtree(root, ignore_errors=True)
+
+    _emit(result, partial=False)
+    with open(ARTIFACT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps({"artifact": ARTIFACT,
+                      "acceptance_ok": result["acceptance_ok"]}),
+          flush=True)
+    return 0 if result["acceptance_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

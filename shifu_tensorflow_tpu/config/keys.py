@@ -253,6 +253,31 @@ DEFAULT_SERVE_RELOAD_POLL_MS = 2000
 SERVE_WORKERS = TPU_PREFIX + "serve-workers"
 DEFAULT_SERVE_WORKERS = 1
 
+# ---- AOT executable shipping (export/aot.py: compile once at export,
+# serve everywhere) ----
+# Serialize the bucket ladder's compiled executables into the export
+# bundle (aot/ subdir, digested into the manifest like any artifact) so
+# serve admission DESERIALIZES instead of compiling: a fleet restart
+# cold-starts in deserialize time instead of tenants x buckets compile
+# time, and every SO_REUSEPORT worker loads the same shipped programs.
+# Loadable only on a matching compile environment (jax/jaxlib/backend/
+# device-kind fingerprint stamped in the bundle); any mismatch falls
+# back PER BUCKET to a live compile — AOT never refuses a bundle that
+# can still compile live.
+EXPORT_AOT = TPU_PREFIX + "export-aot"
+DEFAULT_EXPORT_AOT = False
+# the ladder to pre-compile covers every bucket reachable under this
+# many rows (export/bucketing.ladder); default matches the serve
+# plane's warm set, ladder(serve-queue-rows)
+EXPORT_AOT_ROWS = TPU_PREFIX + "export-aot-rows"
+DEFAULT_EXPORT_AOT_ROWS = DEFAULT_SERVE_QUEUE_ROWS
+# jax persistent compilation cache dir — the middle tier of the AOT
+# fallback ladder (shipped executable -> this cache -> live compile): a
+# fingerprint-mismatched bucket that live-compiles populates it, so the
+# NEXT worker/restart on this host still skips XLA.  Empty = off.
+COMPILE_CACHE_DIR = TPU_PREFIX + "compile-cache-dir"
+DEFAULT_COMPILE_CACHE_DIR = ""
+
 # ---- multi-tenant serving (serve/tenancy/: one endpoint, many models) ----
 # A models DIR turns the server multi-tenant: every immediate
 # subdirectory holding an exported bundle is a tenant named by the

@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -53,7 +54,9 @@ from shifu_tensorflow_tpu.export.saved_model import (
     OUTPUT_NAME,
     _unflatten_params,
 )
-from shifu_tensorflow_tpu.utils import fs
+from shifu_tensorflow_tpu.utils import fs, logs
+
+log = logs.get("export.eval")
 
 
 class ModelReleasedError(RuntimeError):
@@ -130,11 +133,35 @@ class EvalModel:
         # no recorder installed the wrap is one is-None check per call
         from shifu_tensorflow_tpu.obs import compile as obs_compile
 
+        self._model_name = (os.path.basename(self.model_dir.rstrip("/"))
+                            or None)
         self._apply = obs_compile.observe(
             jax.jit(fwd), "eval.native_score",
-            model=os.path.basename(self.model_dir.rstrip("/")) or None,
+            model=self._model_name,
             bucket_from=lambda params, x: x.shape[0],
         )
+        # AOT executable shipping (export/aot.py): when the bundle ships
+        # serialized ladder executables, dispatch DESERIALIZES them
+        # instead of compiling — per-bucket, falling back to the jitted
+        # path (live compile) on any fingerprint/payload mismatch.  A
+        # bundle without aot/ behaves byte-identically to before.
+        from shifu_tensorflow_tpu.export import aot as aot_mod
+
+        try:
+            self._aot = aot_mod.AotIndex.load(self.model_dir)
+        except Exception as e:  # the index must never fail the load
+            self._aot = aot_mod.AotIndex(
+                self.model_dir, None,
+                unusable=f"{type(e).__name__}: {e}")
+        self._aot_execs: dict[int, object] = {}
+        self._aot_failed: dict[int, str] = {}
+        self._aot_loads = 0
+        self._aot_fallbacks = 0
+        if self._aot is not None and self._aot.unusable:
+            log.warning(
+                "AOT executables at %s unusable (%s): every shipped "
+                "bucket will live-compile instead",
+                self.model_dir, self._aot.unusable)
 
     def _init_cpp(self) -> None:
         from shifu_tensorflow_tpu.export.native_scorer import NativeScorer
@@ -190,13 +217,113 @@ class EvalModel:
                 n = rows.shape[0]
                 # pad to the bucket ladder: compile once per bucket, not
                 # once per distinct batch length (padded rows sliced off)
-                padded = pad_rows(rows, bucket_size(n))
-                out = self._apply(self._params, self._jnp.asarray(padded))
+                bucket = bucket_size(n)
+                padded = pad_rows(rows, bucket)
+                out = self._dispatch(self._jnp.asarray(padded), bucket)
                 return np.asarray(out)[:n]
             if self.backend == "cpp":
                 return self._cpp.score(rows)
             result = self._infer(**{INPUT_NAME: self._tf.constant(rows)})
             return result[OUTPUT_NAME].numpy()
+
+    def _dispatch(self, x, bucket: int):
+        """Route one padded batch (caller holds the compute lock): the
+        bundle-shipped AOT executable when one deserializes for this
+        bucket, else the jitted scorer (whose first call per bucket
+        live-compiles).  When AOT *promised* the bucket and could not
+        deliver, the live compile journals ``kind=aot_fallback`` with
+        the reason — never ``warm``/unmarked — so admission journals
+        say what actually happened."""
+        fn = None
+        reason = None
+        if self._aot is not None:
+            fn, reason = self._aot_acquire(bucket)
+        if fn is not None:
+            return fn(self._params, x)
+        if reason is not None:
+            from shifu_tensorflow_tpu.obs import compile as obs_compile
+
+            with obs_compile.kind_section("aot_fallback",
+                                          aot_error=reason):
+                return self._apply(self._params, x)
+        return self._apply(self._params, x)
+
+    def _aot_acquire(self, bucket: int):
+        """(executable, None) on an AOT hit for ``bucket``; (None,
+        reason) when the bundle promised the bucket and cannot deliver
+        (the caller live-compiles under ``kind=aot_fallback``); (None,
+        None) for buckets the bundle never shipped (plain live path).
+        A successful deserialization journals a ``compile`` event with
+        ``kind=aot_load`` and ``compile_s`` ~ 0 — admission cost
+        becomes visible as what it is: a load, not a compile."""
+        fn = self._aot_execs.get(bucket)
+        if fn is not None:
+            return fn, None
+        failed = self._aot_failed.get(bucket)
+        if failed is not None:
+            return None, failed
+        if not self._aot.covers(bucket):
+            return None, None
+        from shifu_tensorflow_tpu.export.aot import AotLoadError
+        from shifu_tensorflow_tpu.obs import compile as obs_compile
+
+        t0 = time.perf_counter()
+        try:
+            fn = self._aot.load_bucket(bucket)
+        except AotLoadError as e:
+            reason = str(e)
+            self._aot_failed[bucket] = reason
+            self._aot_fallbacks += 1
+            if not self._aot.unusable:
+                # per-bucket warnings only for genuinely per-bucket
+                # failures (corrupt payload, CRC): an index-wide
+                # mismatch already logged ONE summary warning at init —
+                # restating it per bucket x tenant x worker would bury
+                # a fleet restart's logs
+                log.warning("AOT bucket %d at %s refused (%s); falling "
+                            "back to live compile", bucket,
+                            self.model_dir, reason)
+            return None, reason
+        wall = time.perf_counter() - t0
+        self._aot_execs[bucket] = fn
+        self._aot_loads += 1
+        rec = obs_compile.active()
+        if rec is not None:
+            try:
+                import jax
+
+                # ShapeDtypeStruct: the signature needs shape+dtype
+                # only — no reason to allocate a (bucket, f) device
+                # array just to journal what was loaded
+                sig = obs_compile.signature_of(
+                    (self._params,
+                     jax.ShapeDtypeStruct(
+                         (bucket, self.num_features),
+                         self._jnp.float32)), {})
+            except Exception:
+                sig = "?"
+            rec.record(name="eval.native_score", signature=sig,
+                       compile_s=0.0, parts=0, wall_s=wall,
+                       bucket=bucket, model=self._model_name,
+                       kind="aot_load")
+        return fn, None
+
+    @property
+    def aot_stats(self) -> dict:
+        """What AOT did for this instance: whether the bundle shipped
+        executables, how many buckets deserialized vs fell back to a
+        live compile, and why the whole index was unusable (fingerprint
+        or generation mismatch) if it was.  Read by the serve admission
+        path for its logs/journal."""
+        if self.backend != "native" or getattr(self, "_aot", None) is None:
+            return {"shipped": False, "loads": 0, "fallbacks": 0,
+                    "unusable": None}
+        return {
+            "shipped": True,
+            "loads": self._aot_loads,
+            "fallbacks": self._aot_fallbacks,
+            "unusable": self._aot.unusable,
+        }
 
     def warm(self, buckets) -> int:
         """Pre-compile the jitted native scorer for every ladder bucket
@@ -231,10 +358,14 @@ class EvalModel:
                     # the model be swapped in while its warm-up programs
                     # still occupy the device — the first real request
                     # would queue behind them, re-creating (a smaller)
-                    # latency cliff.
+                    # latency cliff.  _dispatch prefers the
+                    # bundle-shipped AOT executable: a hit deserializes
+                    # (~ms, journaled kind=aot_load) instead of
+                    # compiling, which is the whole point of shipping
+                    # them — warming then costs no new traces at all.
                     x = self._jnp.zeros((b, self.num_features),
                                         self._jnp.float32)
-                    np.asarray(self._apply(self._params, x))
+                    np.asarray(self._dispatch(x, b))
             return self._trace_count - before
 
     def device_bytes(self) -> int:
@@ -267,7 +398,7 @@ class EvalModel:
             if hasattr(self, "_cpp"):
                 self._cpp.close()
             for attr in ("_model", "_params", "_infer", "_tf", "_jnp",
-                         "_cpp", "_apply"):
+                         "_cpp", "_apply", "_aot", "_aot_execs"):
                 if hasattr(self, attr):
                     delattr(self, attr)
 

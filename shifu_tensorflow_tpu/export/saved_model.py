@@ -119,6 +119,7 @@ def export_native_bundle(
     zscale_means=None,
     zscale_stds=None,
     feature_stats=None,
+    aot_buckets=None,
 ) -> None:
     """Write the TF-free artifact: architecture JSON + weights npz, plus
     the sidecar manifest (size+CRC32+SHA-256 per file) that the serving
@@ -129,7 +130,13 @@ def export_native_bundle(
     (obs/datastats.DataSketch.snapshot) — written as
     ``feature_stats.json`` and digested into the manifest, so the serve
     admission that verifies the weights verifies the drift baseline with
-    them."""
+    them.
+
+    ``aot_buckets`` (a bucket-ladder tuple — export/aot.py) additionally
+    compiles the scorer for each bucket on THIS environment and ships
+    the serialized executables under ``aot/``, digested into the
+    manifest like every artifact: serve admission then deserializes
+    instead of compiling, falling back per bucket on any mismatch."""
     fs.mkdirs(export_dir)
     arch = {
         "format_version": 1,
@@ -192,6 +199,22 @@ def export_native_bundle(
         NATIVE_WEIGHTS: weights_entry,
         GENERIC_CONFIG: _digest_entry(generic_bytes),
     }
+    aot_files: dict[str, bytes] = {}
+    if aot_buckets:
+        # compile + serialize the ladder FROM the bundle's own
+        # representation (arch dict + flat arrays — the exact tree the
+        # serve side rebuilds), then digest the files into the manifest
+        # so the admission that verifies the weights verifies the
+        # executables with them
+        from shifu_tensorflow_tpu.export import aot as aot_mod
+
+        aot_files = aot_mod.build_aot_files(
+            arch, flat, aot_buckets,
+            model_name=(os.path.basename(export_dir.rstrip("/"))
+                        or None),
+            weights_sha256=weights_entry["sha256"])
+        for name, payload in aot_files.items():
+            files[name] = _digest_entry(payload)
     stats_bytes = None
     if feature_stats is not None:
         stats_bytes = json.dumps({
@@ -214,6 +237,36 @@ def export_native_bundle(
     _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes)
     _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes)
     _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes)
+    if aot_files:
+        from shifu_tensorflow_tpu.export.aot import AOT_DIR as _AOT_DIR
+
+        fs.mkdirs(os.path.join(export_dir, _AOT_DIR))
+        for name, payload in aot_files.items():
+            _commit_bytes(os.path.join(export_dir, name), payload)
+        # prune bucket files a previous generation wrote that this one
+        # did not (a narrower ladder): nothing vouches for them anymore
+        # and the weights-generation stamp inside the meta no longer
+        # names them
+        try:
+            for leftover in os.listdir(os.path.join(export_dir, _AOT_DIR)):
+                rel = f"{_AOT_DIR}/{leftover}"
+                if rel not in aot_files and not leftover.startswith("."):
+                    os.remove(os.path.join(export_dir, _AOT_DIR, leftover))
+        except OSError:
+            pass
+    else:
+        # a re-export WITHOUT AOT must not leave a previous generation's
+        # executables beside weights they were not compiled for: the
+        # stamped weights digest would refuse them anyway (EvalModel
+        # checks it), but stale artifacts beside a manifest that no
+        # longer covers them are exactly the chimera the manifest chain
+        # exists to prevent
+        import shutil
+
+        from shifu_tensorflow_tpu.export.aot import AOT_DIR as _AOT_DIR
+
+        shutil.rmtree(os.path.join(export_dir, _AOT_DIR),
+                      ignore_errors=True)
     if stats_bytes is not None:
         _commit_bytes(os.path.join(export_dir, FEATURE_STATS), stats_bytes)
     else:
@@ -310,6 +363,7 @@ def export_model(
     zscale_means=None,
     zscale_stds=None,
     feature_stats=None,
+    aot_buckets=None,
 ) -> dict[str, bool]:
     """One-call export of both artifacts from a Trainer.
 
@@ -372,6 +426,7 @@ def export_model(
         zscale_means=zscale_means,
         zscale_stds=zscale_stds,
         feature_stats=feature_stats,
+        aot_buckets=aot_buckets,
     )
     # deep-copy: ModelConfig.from_json keeps a reference to the nested
     # dicts, so mutating a shallow copy would rewrite the live trainer's

@@ -104,6 +104,12 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     from shifu_tensorflow_tpu.obs import slo as slo_mod
     from shifu_tensorflow_tpu.obs import trace as trace_mod
 
+    # persistent compilation cache (shifu.tpu.compile-cache-dir): a
+    # compile-plane knob riding this config for the key-resolve + JSON
+    # bridge, applied regardless of whether observability itself is on
+    # (best-effort, no-op on jax-free hosts)
+    if getattr(cfg, "compile_cache_dir", ""):
+        compile_mod.apply_persistent_cache(cfg.compile_cache_dir)
     if not cfg.enabled:
         slo_mod.uninstall()
         compile_mod.uninstall()

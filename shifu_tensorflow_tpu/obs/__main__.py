@@ -1019,6 +1019,13 @@ def _render_report(doc: dict) -> list[str]:
             bits.append(f"{int(comp.get('compiles', 0))} compile(s), "
                         f"{comp.get('compile_s', 0.0):.2f}s total, "
                         f"max {comp.get('max_s', 0.0):.2f}s")
+            if comp.get("aot_loads"):
+                # the dead-fleet report says what admission actually
+                # did: deserialized shipped executables vs fallbacks
+                bits.append(
+                    f"{int(comp['aot_loads'])} AOT load(s)"
+                    + (f", {int(comp['aot_fallbacks'])} fallback(s)"
+                       if comp.get("aot_fallbacks") else ""))
         if gauges.get("total_bytes"):
             bits.append(
                 f"devmem high-water {_fmt_bytes(gauges['total_bytes'])}"
@@ -1632,7 +1639,8 @@ def _compile_data(events: list[dict]) -> dict:
     journal files (a dead fleet's included)."""
     per: dict = defaultdict(lambda: {
         "compiles": 0, "compile_s": 0.0, "max_s": 0.0, "wall_s": 0.0,
-        "signatures": set(), "warm": 0, "workers": set(),
+        "signatures": set(), "warm": 0, "aot_loads": 0,
+        "aot_fallbacks": 0, "workers": set(),
         "flops_max": None, "code_bytes": 0,
     })
     storms: list[dict] = []
@@ -1641,16 +1649,25 @@ def _compile_data(events: list[dict]) -> dict:
         kind = ev.get("event")
         if kind == "compile":
             a = per[ev.get("name", "?")]
+            a["signatures"].add(ev.get("signature", "?"))
+            if ev.get("worker") is not None:
+                a["workers"].add(ev["worker"])
+            if ev.get("kind") == "aot_load":
+                # a deserialized shipped executable — admission did a
+                # LOAD, not a compile; counted in its own column so the
+                # table says what admission actually did
+                a["aot_loads"] += 1
+                a["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
+                continue
             a["compiles"] += 1
             s = float(ev.get("compile_s", 0.0) or 0.0)
             a["compile_s"] += s
             a["max_s"] = max(a["max_s"], s)
             a["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
-            a["signatures"].add(ev.get("signature", "?"))
             if ev.get("kind") == "warm":
                 a["warm"] += 1
-            if ev.get("worker") is not None:
-                a["workers"].add(ev["worker"])
+            elif ev.get("kind") == "aot_fallback":
+                a["aot_fallbacks"] += 1
             if ev.get("flops") is not None:
                 a["flops_max"] = max(a["flops_max"] or 0.0,
                                      float(ev["flops"]))
@@ -1680,6 +1697,8 @@ def _compile_data(events: list[dict]) -> dict:
         name: {
             "compiles": a["compiles"],
             "warm": a["warm"],
+            "aot_loads": a["aot_loads"],
+            "aot_fallbacks": a["aot_fallbacks"],
             "signatures": len(a["signatures"]),
             "compile_s": round(a["compile_s"], 4),
             "max_s": round(a["max_s"], 4),
@@ -1712,12 +1731,16 @@ def cmd_compile(args) -> int:
         return 1
     total_s = sum(a["compile_s"] for a in data["callables"].values())
     total_n = sum(a["compiles"] for a in data["callables"].values())
+    total_aot = sum(a["aot_loads"] for a in data["callables"].values())
+    aot_note = (f", {total_aot} AOT executable load(s)"
+                if total_aot else "")
     print(f"compile flight recorder — {total_n} compilation(s), "
-          f"{total_s:.2f}s total compile time")
-    print("  callable                 compiles  warm  signatures  "
-          "compile_s  max_s")
+          f"{total_s:.2f}s total compile time{aot_note}")
+    print("  callable                 compiles  warm  aot   fb    "
+          "signatures  compile_s  max_s")
     for name, a in data["callables"].items():
         print(f"  {name:<24} {a['compiles']:<9} {a['warm']:<5} "
+              f"{a['aot_loads']:<5} {a['aot_fallbacks']:<5} "
               f"{a['signatures']:<11} {a['compile_s']:<10.3f} "
               f"{a['max_s']:.3f}")
     if data["storms"]:

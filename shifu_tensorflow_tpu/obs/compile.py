@@ -74,10 +74,22 @@ __all__ = [
     "observe",
     "attribute",
     "warm_section",
+    "kind_section",
+    "apply_persistent_cache",
     "install",
     "uninstall",
     "active",
 ]
+
+#: compile kinds that are deliberate admission/export work, never
+#: request-path churn: the warm ladder, an AOT executable deserialized
+#: instead of compiled (kind=aot_load, ~0 compile_s), the per-bucket
+#: live-compile fallback when AOT couldn't deliver (kind=aot_fallback),
+#: and export-time AOT pre-compilation.  None of these count toward a
+#: recompile storm — a 10-tenant fleet restart deserializing (or even
+#: re-compiling) its ladders is the cure, not the disease.
+ADMISSION_KINDS = frozenset({"warm", "aot_load", "aot_fallback",
+                             "export"})
 
 _perf = time.perf_counter
 _mono = time.monotonic
@@ -91,7 +103,7 @@ _COMPILE_EVENT_SUFFIX = "backend_compile_duration"
 class _Tls(threading.local):
     def __init__(self):
         self.stack: list[list] = []  # frames: [compile_s, n_compiles]
-        self.warm = 0                # warm_section() nesting depth
+        self.kinds: list[tuple] = []  # kind_section() stack: (kind, fields)
         self.suppress = 0            # self-inflicted compiles (analysis)
 
 
@@ -222,6 +234,7 @@ class CompileRecorder:
         self._executables: dict[tuple[str, str], list] = {}
         self.compiles_total = 0
         self.compile_seconds_total = 0.0
+        self.aot_loads_total = 0
         self.unattributed_compiles = 0
         self.unattributed_seconds = 0.0
         self.registry = MetricsRegistry()
@@ -285,10 +298,10 @@ class CompileRecorder:
             except Exception:
                 bucket = None
         fields = self._analyze(fn, args, kw)
+        section_kind, extra = _section(kind)
         self.record(name=name, signature=sig, compile_s=frame[0],
                     parts=frame[1], wall_s=wall_s, bucket=bucket,
-                    model=model,
-                    kind=("warm" if _tls.warm else kind), **fields)
+                    model=model, kind=section_kind, **extra, **fields)
 
     def _analyze(self, fn, args, kw) -> dict:
         """Cost/memory analysis fields, degrading to {} wherever the
@@ -344,11 +357,18 @@ class CompileRecorder:
             entry[1] += compile_s
             if "code_bytes" in fields:
                 entry[2] = int(fields["code_bytes"])
-            # counts BACKEND compiles (one jit call can compile several
-            # sub-programs — `parts`), matching what _note_unattributed
-            # counts for compiles nobody claimed
-            self.compiles_total += max(1, parts)
-            self.compile_seconds_total += compile_s
+            if kind == "aot_load":
+                # a deserialized shipped executable: live in the
+                # registry (it occupies the device like any program)
+                # but NOT a compilation — compiles_total must keep
+                # meaning "times XLA ran"
+                self.aot_loads_total += 1
+            else:
+                # counts BACKEND compiles (one jit call can compile
+                # several sub-programs — `parts`), matching what
+                # _note_unattributed counts for compiles nobody claimed
+                self.compiles_total += max(1, parts)
+                self.compile_seconds_total += compile_s
         ev: dict[str, Any] = {
             "name": name, "signature": signature,
             "compile_s": round(compile_s, 6), "parts": parts,
@@ -369,12 +389,14 @@ class CompileRecorder:
         obs_journal.emit("compile", plane=self.plane, worker=self.worker,
                          **ev)
         wd = obs_slo.active()
-        if wd is not None:
+        if wd is not None and kind != "aot_load":
             # the shifu.tpu.slo-compile-s target judges the window MAX
             # of this signal (from_config); one slow compile is the
-            # breach, not the average of many fast ones
+            # breach, not the average of many fast ones.  A deserialized
+            # AOT executable never ran XLA — its ~0 is not a compile
+            # sample.
             wd.observe("compile_s", compile_s)
-        if kind != "warm":
+        if kind not in ADMISSION_KINDS:
             self._storm_note(name, signature, now)
         else:
             # even expected churn must let an open storm close
@@ -475,6 +497,7 @@ class CompileRecorder:
             return {
                 "live_executables": len(self._executables),
                 "compiles_total": self.compiles_total,
+                "aot_loads_total": self.aot_loads_total,
                 "compile_seconds_total": round(
                     self.compile_seconds_total, 6),
                 "executable_bytes": sum(
@@ -493,6 +516,7 @@ class CompileRecorder:
         r.set_gauge("live_executables", s["live_executables"])
         r.set_gauge("seconds_total", round(s["compile_seconds_total"], 6))
         r.set_gauge("total", s["compiles_total"])
+        r.set_gauge("aot_loads_total", s["aot_loads_total"])
         if self.analysis == "full":
             # code bytes come only from memory_analysis: under
             # cost/off the signal is ABSENT, not a measured zero (the
@@ -603,22 +627,99 @@ def attribute(name: str, *, kind: str | None = None,
         rec._pop(frame)
         if frame[1]:
             try:
+                section_kind, extra = _section(kind)
                 rec.record(name=name, compile_s=frame[0], parts=frame[1],
-                           wall_s=wall, model=model,
-                           kind=("warm" if _tls.warm else kind))
+                           wall_s=wall, model=model, kind=section_kind,
+                           **extra)
             except Exception as e:
                 log.warning("compile event for %s dropped (%s: %s)",
                             name, type(e).__name__, e)
 
 
+def _section(default: str | None) -> tuple[str | None, dict]:
+    """The innermost :func:`kind_section`'s (kind, extra fields), or
+    ``(default, {})`` when no section is open on this thread."""
+    if _tls.kinds:
+        return _tls.kinds[-1]
+    return default, {}
+
+
 @contextlib.contextmanager
+def kind_section(kind: str, **fields):
+    """Mark the dynamic extent where compile events journal with
+    ``kind=`` (plus any extra fields — e.g. the AOT fallback's
+    ``aot_error`` reason) instead of the seam's default.  Innermost
+    section wins; kinds in :data:`ADMISSION_KINDS` are excluded from
+    recompile-storm detection."""
+    _tls.kinds.append((kind, fields))
+    try:
+        yield
+    finally:
+        _tls.kinds.pop()
+
+
 def warm_section():
     """Mark the dynamic extent of deliberate pre-warming (the serve
     bucket ladder): compiles inside journal with ``kind="warm"`` and are
     EXCLUDED from recompile-storm detection — expected churn, and the
     cure for the storm the detector exists to catch."""
-    _tls.warm += 1
+    return kind_section("warm")
+
+
+def apply_persistent_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (``shifu.tpu.compile-cache-dir``) — the middle tier of the AOT
+    fallback ladder: a bucket that live-compiles (AOT mismatch, or no
+    AOT shipped) writes its program here, so the NEXT worker/restart on
+    this host deserializes from the cache instead of re-running XLA.
+    The min-compile-time floor drops to 0 because serve-plane scorer
+    programs compile in well under jax's 1s default — exactly the
+    programs whose re-compilation scales as tenants x buckets.
+
+    Best-effort by contract: returns False (logged) on a host without
+    jax or a jax without the config knobs — the caller's plane must
+    come up regardless.
+
+    In a process that has NOT imported jax yet (the serve supervisor,
+    the coordinator — planes that deliberately stay jax-free), the
+    settings land as environment variables instead: jax reads them at
+    import, and child processes (SO_REUSEPORT workers, subprocess
+    fleets) inherit them for free — install time stays jax-free, per
+    this module's contract."""
+    import os
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = str(cache_dir)
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+        os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+        return True
     try:
-        yield
-    finally:
-        _tls.warm -= 1
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              0)
+        except Exception:
+            pass  # knob absent on older jax: the default (0) matches
+        try:
+            # the cache object initializes lazily at the FIRST compile
+            # and then sticks: a process that compiled anything before
+            # this call (an earlier model load, a probe) would silently
+            # keep the old (usually disabled) cache — reset so the new
+            # dir takes effect regardless of call order
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+        return True
+    except Exception as e:
+        log.warning("persistent compile cache at %s not applied (%s: %s)",
+                    cache_dir, type(e).__name__, e)
+        return False

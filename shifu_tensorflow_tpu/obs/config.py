@@ -40,6 +40,11 @@ class ObsConfig:
     # for the same JSON-bridge reason as the slo block above
     compile_analysis: str = K.DEFAULT_OBS_COMPILE_ANALYSIS
     compile_storm: int = K.DEFAULT_OBS_COMPILE_STORM
+    # jax persistent compilation cache (shifu.tpu.compile-cache-dir) —
+    # the middle tier of the AOT fallback ladder (export/aot.py):
+    # applied by install_obs on every jax plane, bridged to subprocess
+    # workers like every other field.  Empty = off.
+    compile_cache_dir: str = K.DEFAULT_COMPILE_CACHE_DIR
     slo_compile_s: float = K.DEFAULT_SLO_COMPILE_S
     slo_devmem_frac: float = K.DEFAULT_SLO_DEVMEM_FRAC
     # fleet leg (obs/fleet.py) — straggler skew watchdog target (0 =
@@ -209,6 +214,10 @@ def resolve_obs_config(args, conf) -> ObsConfig:
                           or K.DEFAULT_OBS_COMPILE_ANALYSIS).strip(),
         compile_storm=conf.get_int(K.OBS_COMPILE_STORM,
                                    K.DEFAULT_OBS_COMPILE_STORM),
+        compile_cache_dir=(flag("compile_cache_dir")
+                           or conf.get(K.COMPILE_CACHE_DIR,
+                                       K.DEFAULT_COMPILE_CACHE_DIR)
+                           or ""),
         slo_compile_s=conf.get_float(K.SLO_COMPILE_S,
                                      K.DEFAULT_SLO_COMPILE_S),
         slo_devmem_frac=conf.get_float(K.SLO_DEVMEM_FRAC,

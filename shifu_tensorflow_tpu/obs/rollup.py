@@ -277,10 +277,19 @@ class RollupCompactor:
                 if v is not None:
                     w.gauges[key] = max(w.gauges.get(key, 0.0), float(v))
         elif ev == "compile":
+            if rec.get("kind") == "aot_load":
+                # a deserialized shipped executable (export/aot.py) is
+                # an admission LOAD, not a compile — its ~0 compile_s
+                # must not dilute the window's compile-cost fold
+                w.compile["aot_loads"] = w.compile.get("aot_loads", 0) + 1
+                return
             w.compile["compiles"] = w.compile.get("compiles", 0) + 1
             s = float(rec.get("compile_s", 0.0) or 0.0)
             w.compile["compile_s"] = w.compile.get("compile_s", 0.0) + s
             w.compile["max_s"] = max(w.compile.get("max_s", 0.0), s)
+            if rec.get("kind") == "aot_fallback":
+                w.compile["aot_fallbacks"] = (
+                    w.compile.get("aot_fallbacks", 0) + 1)
         elif ev == "data_stats":
             stats = rec.get("stats")
             if isinstance(stats, dict):

@@ -102,6 +102,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(internal) fleet-wide job correlation id stamped "
                         "on journal events; set by the --serve-workers "
                         "supervisor so every worker journals the same id")
+    p.add_argument("--compile-cache-dir", default=None,
+                   dest="compile_cache_dir",
+                   help="jax persistent compilation cache dir "
+                        "(shifu.tpu.compile-cache-dir) — the middle "
+                        "tier of the AOT fallback ladder: a bucket "
+                        "that live-compiles (AOT mismatch, no AOT "
+                        "shipped) persists its program here, so the "
+                        "next worker/restart skips XLA")
     p.add_argument("--obs-baseline", default=None, dest="obs_baseline",
                    help="pinned baseline rollup (a .rollup.jsonl sidecar "
                         "or a journal base) for the cross-run regression "

@@ -123,6 +123,17 @@ def _verify_manifest(model_dir: str) -> dict | None:
     return manifest
 
 
+def _aot_fields(model) -> dict:
+    """``aot_loads``/``aot_fallbacks`` journal fields for an admission
+    event — present only when the bundle shipped AOT executables, so
+    pre-AOT event schemas stay byte-identical."""
+    st = getattr(model, "aot_stats", None)
+    if not isinstance(st, dict) or not st.get("shipped"):
+        return {}
+    return {"aot_loads": int(st.get("loads", 0)),
+            "aot_fallbacks": int(st.get("fallbacks", 0))}
+
+
 class ModelStore:
     """Atomic current-model reference + the background reload poller."""
 
@@ -298,11 +309,27 @@ class ModelStore:
             raise ArtifactCorrupt(
                 f"artifact failed warm-up scoring: {type(e).__name__}: {e}"
             ) from e
-        log.info(
-            "warmed bucket ladder %s in %.0f ms (%d new traces)",
-            list(self.warm_buckets), (time.monotonic() - t0) * 1000.0,
-            traced,
-        )
+        aot = getattr(model, "aot_stats", None)
+        aot = aot if isinstance(aot, dict) else {}
+        if aot.get("shipped"):
+            # admission became a deserialize, not a compile (or says
+            # exactly why it didn't): the operator-facing counterpart
+            # of the journal's kind=aot_load / aot_fallback events
+            log.info(
+                "warmed bucket ladder %s in %.0f ms (%d AOT "
+                "executable(s) loaded, %d live-compile fallback(s)%s)",
+                list(self.warm_buckets),
+                (time.monotonic() - t0) * 1000.0,
+                aot.get("loads", 0), aot.get("fallbacks", 0),
+                f"; aot unusable: {aot['unusable']}"
+                if aot.get("unusable") else "",
+            )
+        else:
+            log.info(
+                "warmed bucket ladder %s in %.0f ms (%d new traces)",
+                list(self.warm_buckets),
+                (time.monotonic() - t0) * 1000.0, traced,
+            )
 
     def _fingerprint(self) -> str | None:
         """Cheap change detector: the manifest's bundle digest PLUS its
@@ -451,6 +478,7 @@ class ModelStore:
         obs_journal.emit("reload", plane="serve", epoch=loaded.epoch,
                          digest=loaded.digest[:12],
                          verified=loaded.verified,
+                         **_aot_fields(loaded.model),
                          **self._model_field())
         if old is not None:
             # release AFTER the swap; EvalModel.release takes the compute

@@ -60,7 +60,7 @@ from shifu_tensorflow_tpu.obs import slo as obs_slo
 from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
 from shifu_tensorflow_tpu.serve.batcher import MicroBatcher
 from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
-from shifu_tensorflow_tpu.serve.model_store import ModelStore
+from shifu_tensorflow_tpu.serve.model_store import ModelStore, _aot_fields
 from shifu_tensorflow_tpu.serve.tenancy.scheduler import DeviceScheduler
 from shifu_tensorflow_tpu.utils import logs
 
@@ -578,6 +578,10 @@ class MultiModelStore:
                 device_bytes=device_bytes.get(name, 0),
                 digest=store.current().digest[:12],
                 verified=store.current().verified,
+                # bundles shipping AOT executables admit by deserialize:
+                # the warm ladder's per-bucket aot_load/aot_fallback
+                # split, absent for pre-AOT bundles (schema parity)
+                **_aot_fields(store.current().model),
             )
             log.info("admitted model %s (%d bytes, %.0f ms)",
                      name, cost, (now - t0) * 1000.0)

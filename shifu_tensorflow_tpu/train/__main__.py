@@ -137,6 +137,25 @@ def build_parser() -> argparse.ArgumentParser:
     # artifacts
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--export-dir", default=None)
+    p.add_argument("--export-aot", action="store_true", default=None,
+                   dest="export_aot",
+                   help="compile the serve bucket ladder at export and "
+                        "ship serialized XLA executables in the bundle "
+                        "(shifu.tpu.export-aot): serve admission then "
+                        "DESERIALIZES instead of compiling, falling "
+                        "back per bucket on environment mismatch")
+    p.add_argument("--export-aot-rows", type=int, default=None,
+                   dest="export_aot_rows",
+                   help="pre-compile the ladder covering batches up to "
+                        "this many rows (shifu.tpu.export-aot-rows; "
+                        "default matches the serve plane's warm set, "
+                        "ladder(serve-queue-rows))")
+    p.add_argument("--compile-cache-dir", default=None,
+                   dest="compile_cache_dir",
+                   help="jax persistent compilation cache dir "
+                        "(shifu.tpu.compile-cache-dir): programs that "
+                        "do compile persist here, so the next "
+                        "process/restart on this host skips XLA")
     p.add_argument("--board-path", default=None,
                    help="metrics board file (reference console-board parity)")
     p.add_argument("--profile-dir", default=None,
@@ -709,12 +728,15 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     wall = time.time() - t0
 
     if args.export_dir:
+        from shifu_tensorflow_tpu.export.aot import resolve_aot_buckets
+
         wrote = export_model(
             args.export_dir,
             trainer,
             feature_columns=schema.feature_columns,
             zscale_means=schema.means or None,
             zscale_stds=schema.stds or None,
+            aot_buckets=resolve_aot_buckets(args, conf),
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
     import jax as _jax
@@ -942,6 +964,8 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             if feature_stats is not None and \
                     feature_stats.get("num_features") != schema.num_features:
                 feature_stats = None
+        from shifu_tensorflow_tpu.export.aot import resolve_aot_buckets
+
         wrote = export_model(
             args.export_dir,
             trainer,
@@ -949,6 +973,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             zscale_means=schema.means or None,
             zscale_stds=schema.stds or None,
             feature_stats=feature_stats,
+            aot_buckets=resolve_aot_buckets(args, conf),
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
     print_summary()

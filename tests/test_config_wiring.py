@@ -962,3 +962,43 @@ def test_data_keys_defaults_autotune_on_and_auto_widths():
     # --no-data-autotune freezes everything (no tuner object at all)
     ing = resolve_ingest(_args(["--no-data-autotune"]), _conf({}))
     assert ing["autotune"] is False
+
+
+def test_aot_keys_round_trip_xml_cli_and_json_bridge(tmp_path):
+    """The AOT shipping keys (PR 14): shifu.tpu.export-aot /
+    export-aot-rows resolve the export ladder (CLI wins), and
+    shifu.tpu.compile-cache-dir rides ObsConfig through the same
+    XML → Conf → CLI → JSON-bridge chain as every obs key."""
+    from shifu_tensorflow_tpu.export.aot import resolve_aot_buckets
+    from shifu_tensorflow_tpu.export.bucketing import ladder
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+    from shifu_tensorflow_tpu.train.__main__ import resolve_obs
+
+    xml = tmp_path / "aot.xml"
+    values = {
+        K.EXPORT_AOT: "true",
+        K.EXPORT_AOT_ROWS: "128",
+        K.COMPILE_CACHE_DIR: "/cache/xla",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    assert resolve_aot_buckets(_args(), conf) == ladder(128)
+    # CLI wins over the conf ladder size; the flag alone enables
+    assert resolve_aot_buckets(
+        _args(["--export-aot-rows", "64"]), conf) == ladder(64)
+    assert resolve_aot_buckets(_args(["--export-aot"]), _conf({})) \
+        == ladder(K.DEFAULT_SERVE_QUEUE_ROWS)
+    # defaults: AOT export off, cache off
+    assert resolve_aot_buckets(_args(), _conf({})) is None
+    cfg = resolve_obs(_args(), conf)
+    assert cfg.compile_cache_dir == "/cache/xla"
+    assert ObsConfig.from_json(cfg.to_json()) == cfg
+    cfg = resolve_obs(_args(["--compile-cache-dir", "/cache/cli"]), conf)
+    assert cfg.compile_cache_dir == "/cache/cli"
+    assert resolve_obs(_args(), _conf({})).compile_cache_dir == ""
