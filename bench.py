@@ -800,6 +800,23 @@ def main() -> None:
         rc = bench_serve_tenants.main()
         _append_bench_history('serve-tenants', 'BENCH_SERVE_TENANTS.json', rc=rc)
         sys.exit(rc)
+    if "elastic" in sys.argv[1:]:
+        # elastic-fleet drill (python bench.py elastic [--quick]):
+        # hot-standby takeover vs checkpoint restart on a real process
+        # fleet — kill-a-worker mid-epoch, gate zero rollback on the
+        # survivors (epoch monotonicity + bit-identical chief params vs
+        # an unkilled control arm) and takeover-beats-relaunch latency,
+        # artifact BENCH_ELASTIC.json — implemented in
+        # scripts/bench_elastic.py.  Workers are subprocesses; the
+        # submitter side is jax-light, so the parent's no-jax rule does
+        # not apply to this mode.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_elastic
+
+        rc = bench_elastic.main()
+        _append_bench_history('elastic', 'BENCH_ELASTIC.json', rc=rc)
+        sys.exit(rc)
     if "serve-aot" in sys.argv[1:]:
         # AOT executable shipping benchmark (python bench.py serve-aot):
         # 10-tenant fleet-restart admission, deserialize (shipped

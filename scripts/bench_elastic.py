@@ -1,0 +1,305 @@
+"""Elastic-fleet drill benchmark: hot-standby takeover vs checkpoint
+restart (ISSUE 15 / ROADMAP item 3, train side).
+
+Three arms, all REAL process fleets (launcher="process": every worker is
+an OS process, the kill is a SIGKILL, detection is heartbeat expiry —
+nothing cooperative):
+
+- **control**: 2 workers, no kill — the clean run whose chief params and
+  epoch sequence are the ground truth.
+- **standby**: 2 workers + 1 hot standby, ZERO restart budget,
+  worker-1 SIGKILLed mid-epoch.  Gates: the job FINISHES with exactly
+  one promotion and zero budgeted restarts; the surviving chief's epoch
+  counter never regresses (journal ``epoch`` events, strictly
+  increasing); the chief's final params are BIT-IDENTICAL to the
+  control arm (sha256 over the checkpoint arrays) — the takeover never
+  touched the survivors; and the takeover latency (``standby_claim``)
+  is recorded.
+- **restart**: 2 workers, budget for one relaunch, same SIGKILL, no
+  standby — the PR-2 checkpoint-restart path this PR exists to beat.
+  Recovery latency = ``worker_failed`` -> the relaunched worker's next
+  ``register`` (journal timestamps).
+
+Headline: ``takeover_latency_s`` vs ``relaunch_latency_s`` (the standby
+is already registered, pre-built, and compile-warm; the relaunch pays
+process spawn + jax import + build before it can even register).  Gate:
+takeover strictly faster.  Wall clocks for all three arms are recorded
+for context but not gated — on a 2-core CI host total wall is dominated
+by epoch compute, not recovery.
+
+Output contract matches bench.py: every stdout line is a JSON object,
+the last one complete; artifact lands in ``BENCH_ELASTIC.json``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+ARTIFACT = os.path.join(REPO_ROOT, "BENCH_ELASTIC.json")
+N_FEATURES = 8
+QUICK = "--quick" in sys.argv[1:]
+EPOCHS = 4 if QUICK else 6
+# epochs must be LONG enough for the submitter's 0.2s kill poll to land
+# mid-job (the whole point is a mid-epoch SIGKILL): small batches keep
+# each epoch in the ~1s range on a CPU host
+ROWS_PER_SHARD = 1500 if QUICK else 3000
+N_SHARDS = 4
+BATCH = 16
+
+
+def _emit(result: dict, partial: bool = True) -> None:
+    out = dict(result)
+    if partial:
+        out["partial"] = True
+    print(json.dumps(out), flush=True)
+
+
+def _gen_dataset(root: str) -> None:
+    rng = np.random.default_rng(11)
+    w_true = rng.normal(size=N_FEATURES)
+    for i in range(N_SHARDS):
+        with gzip.open(os.path.join(root, f"part-{i:05d}.gz"), "wt") as f:
+            for _ in range(ROWS_PER_SHARD):
+                x = rng.normal(size=N_FEATURES)
+                logit = float(x @ w_true)
+                y = 1 if rng.random() < 1.0 / (1.0 + np.exp(-logit)) else 0
+                cols = [str(y)] + [f"{v:.5f}" for v in x] + ["1.0"]
+                f.write("|".join(cols) + "\n")
+
+
+def _model_config():
+    from shifu_tensorflow_tpu.config.model_config import ModelConfig
+
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": EPOCHS, "validSetRate": 0.2,
+                   "params": {"NumHiddenLayers": 1,
+                              "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05,
+                              "Optimizer": "adam"}}})
+
+
+def _chief_params_digest(ckpt_dir: str) -> str | None:
+    """sha256 over the latest checkpoint's arrays, iterated in sorted
+    key order — npz byte layout may differ run-to-run, array VALUES are
+    the bit-identity that matters."""
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+    with NpzCheckpointer(ckpt_dir) as ckpt:
+        epoch = ckpt.latest_verified_epoch()
+        if epoch is None:
+            epoch = ckpt.latest_epoch()
+        if epoch is None:
+            return None
+        path = None
+        for name in sorted(os.listdir(ckpt_dir)):
+            if name.endswith(f"-{epoch}.npz") or name == f"epoch-{epoch}.npz":
+                path = os.path.join(ckpt_dir, name)
+        if path is None:
+            cand = [n for n in os.listdir(ckpt_dir) if n.endswith(".npz")
+                    and "keep-best" not in n]
+            if not cand:
+                return None
+            path = os.path.join(ckpt_dir, sorted(cand)[-1])
+    h = hashlib.sha256()
+    with np.load(path) as z:
+        for k in sorted(z.files):
+            arr = np.asarray(z[k])
+            h.update(k.encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _run_arm(name: str, data_root: str, work: str, *,
+             standby_workers: int = 0, spare_restarts: int = 0,
+             kill: bool = False, timeout_s: float = 420.0) -> dict:
+    from shifu_tensorflow_tpu.coordinator.submitter import (
+        JobSubmitter,
+        make_job_spec,
+    )
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    from shifu_tensorflow_tpu.obs import (
+        ObsConfig,
+        install_obs,
+    )
+    from shifu_tensorflow_tpu.obs import journal as obs_journal
+
+    arm_dir = os.path.join(work, name)
+    os.makedirs(arm_dir, exist_ok=True)
+    journal = os.path.join(arm_dir, "journal.jsonl")
+    ckpt_dir = os.path.join(arm_dir, "ckpt")
+    obs_cfg = ObsConfig(enabled=True, journal_path=journal)
+    # fresh journal per arm in THIS process (coordinator/submitter
+    # events); workers journal .w<i> siblings via the JSON bridge
+    obs_journal.uninstall()
+    install_obs(obs_cfg, plane="coordinator", job=name)
+
+    spec = make_job_spec(
+        data_root, 2, epochs=EPOCHS,
+        registration_timeout_s=120.0,
+        sync_epochs=True, epoch_barrier_timeout_s=300.0,
+        standby_workers=standby_workers,
+        spare_restarts=spare_restarts,
+        heartbeat_interval_ms=100, max_missed_heartbeats=10,
+    )
+    schema = RecordSchema(
+        feature_columns=tuple(range(1, N_FEATURES + 1)),
+        target_column=0, weight_column=N_FEATURES + 1,
+    )
+    mc = _model_config()
+
+    def make_cfg(worker_id, addr):
+        return WorkerConfig(
+            worker_id=worker_id,
+            coordinator_host=addr[0], coordinator_port=addr[1],
+            model_config=mc, schema=schema, batch_size=BATCH,
+            checkpoint_dir=ckpt_dir, flat_checkpoint=True,
+            heartbeat_interval_s=0.1, seed=7,
+            obs=obs_cfg.to_json(),
+        )
+
+    sub = JobSubmitter(
+        spec, make_cfg, launcher="process",
+        kill_injections={"worker-1": 0} if kill else None,
+    )
+    t0 = time.monotonic()
+    result = sub.run(timeout_s=timeout_s)
+    wall = time.monotonic() - t0
+
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    events = read_events(journal)
+    return {
+        "state": result.state.value,
+        "failure_reason": result.failure_reason,
+        "wall_s": round(wall, 2),
+        "epochs": len(result.epoch_summaries),
+        "restarts_used": result.restarts_used,
+        "promotions_used": result.promotions_used,
+        "journal": journal,
+        "events": events,
+        "chief_digest": _chief_params_digest(ckpt_dir),
+    }
+
+
+def _chief_epoch_sequence(events: list[dict]) -> list[int]:
+    return [int(ev.get("epoch"))
+            for ev in events
+            if ev.get("event") == "epoch" and ev.get("plane") == "train"
+            and ev.get("worker") == 0 and ev.get("epoch") is not None]
+
+
+def _takeover_latency(events: list[dict]) -> float | None:
+    for ev in events:
+        if ev.get("event") == "standby_claim":
+            return float(ev.get("latency_s"))
+    return None
+
+
+def _relaunch_latency(events: list[dict]) -> float | None:
+    """worker_failed ts -> the SAME identity's next register ts."""
+    failed_ts = None
+    failed_worker = None
+    for ev in events:
+        if ev.get("event") == "worker_failed" and failed_ts is None:
+            failed_ts = ev.get("ts")
+            failed_worker = ev.get("worker")
+        elif (failed_ts is not None and ev.get("event") == "register"
+                and ev.get("worker") == failed_worker
+                and ev.get("ts", 0) > failed_ts):
+            return round(ev["ts"] - failed_ts, 3)
+    return None
+
+
+def main() -> int:
+    result: dict = {
+        "bench": "elastic",
+        "epochs": EPOCHS,
+        "quick": QUICK,
+        "n_shards": N_SHARDS,
+        "rows_per_shard": ROWS_PER_SHARD,
+    }
+    with tempfile.TemporaryDirectory(prefix="bench-elastic-") as work:
+        data_root = os.path.join(work, "data")
+        os.makedirs(data_root)
+        _gen_dataset(data_root)
+
+        control = _run_arm("control", data_root, work)
+        result["control"] = {k: v for k, v in control.items()
+                             if k not in ("events",)}
+        _emit(result)
+
+        standby = _run_arm("standby", data_root, work,
+                           standby_workers=1, spare_restarts=0,
+                           kill=True)
+        chief_seq = _chief_epoch_sequence(standby["events"])
+        takeover = _takeover_latency(standby["events"])
+        result["standby"] = {
+            **{k: v for k, v in standby.items() if k not in ("events",)},
+            "chief_epoch_sequence": chief_seq,
+            "takeover_latency_s": takeover,
+        }
+        _emit(result)
+
+        restart = _run_arm("restart", data_root, work,
+                           spare_restarts=1, kill=True)
+        relaunch = _relaunch_latency(restart["events"])
+        result["restart"] = {
+            **{k: v for k, v in restart.items() if k not in ("events",)},
+            "relaunch_latency_s": relaunch,
+        }
+
+    # ---- gates ----
+    gates = {
+        # the kill is fatal without elasticity (budget 0) — the standby
+        # arm finishing at all proves the takeover, and it must have
+        # cost a standby, not budget
+        "standby_finished": standby["state"] == "finished",
+        "standby_one_promotion_zero_restarts": (
+            standby["promotions_used"] == 1
+            and standby["restarts_used"] == 0),
+        # zero rollback on survivors: the chief's epoch counter is
+        # strictly increasing through the takeover
+        "chief_epochs_never_regress": (
+            len(chief_seq) > 0
+            and all(b > a for a, b in zip(chief_seq, chief_seq[1:]))),
+        # and its final params are bit-identical to the unkilled run
+        "chief_params_bit_identical_to_control": (
+            control["chief_digest"] is not None
+            and standby["chief_digest"] == control["chief_digest"]),
+        "restart_arm_finished_within_budget": (
+            restart["state"] == "finished"
+            and restart["restarts_used"] == 1),
+        # the headline: warm takeover beats cold relaunch
+        "takeover_faster_than_relaunch": (
+            takeover is not None and relaunch is not None
+            and takeover < relaunch),
+    }
+    result["takeover_latency_s"] = takeover
+    result["relaunch_latency_s"] = relaunch
+    if takeover and relaunch:
+        result["takeover_speedup"] = round(relaunch / takeover, 2)
+    result["gates"] = gates
+    result["acceptance_ok"] = all(gates.values())
+    _emit(result, partial=False)
+    with open(ARTIFACT, "w") as f:
+        json.dump({k: v for k, v in result.items()}, f, indent=2,
+                  default=str)
+        f.write("\n")
+    return 0 if result["acceptance_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

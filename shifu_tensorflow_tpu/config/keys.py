@@ -176,6 +176,28 @@ DEFAULT_DATA_AUTOTUNE = True
 DATA_SHUFFLE_ROWS = TPU_PREFIX + "data-shuffle-rows"
 DEFAULT_DATA_SHUFFLE_ROWS = 0
 
+# ---- elastic fleet (coordinator standby promotion + membership
+# re-split; docs/resilience.md) ----
+# Hot-standby workers launched BESIDE the fleet (the reference's backup
+# instances, weakupBackup/TensorflowSession.java:748-781, made real):
+# each registers with role=standby, pre-builds its model/optimizer
+# (compile warm, no data shard), and heartbeats like any worker.  When a
+# rank dies, the coordinator PROMOTES the freshest-heartbeat standby
+# into the dead rank — same index, same shard, current generation —
+# instead of restarting the fleet from checkpoint, so surviving ranks
+# never roll back and promotion costs no restart budget.
+STANDBY_WORKERS = TPU_PREFIX + "standby-workers"
+DEFAULT_STANDBY_WORKERS = 0
+# Elastic membership: when a rank fails with no standby left AND the
+# restart budget exhausted, re-split the training data deterministically
+# over the surviving ranks (data/splitter is a pure function of
+# paths x n_workers) and continue rather than failing the job.  Also
+# unlocks the coordinator's explicit resize op (grow/shrink).  Off by
+# default: shrinking changes shard->rank assignment mid-job, which an
+# operator must opt into.
+ELASTIC = TPU_PREFIX + "elastic"
+DEFAULT_ELASTIC = False
+
 # flat-file (npz) checkpointing with sidecar-manifest verification for
 # NON-SPMD workers too (SPMD always uses it — orbax's collective
 # barriers deadlock under chief-writes/everyone-reads)
@@ -252,6 +274,42 @@ DEFAULT_SERVE_RELOAD_POLL_MS = 2000
 # workers.  1 = the single-process server (no supervisor).
 SERVE_WORKERS = TPU_PREFIX + "serve-workers"
 DEFAULT_SERVE_WORKERS = 1
+
+# ---- SLO-driven serve autoscaling (serve/autoscale.py, run by the
+# --serve-workers supervisor; docs/serving.md) ----
+# Ceiling for the autoscaler: with serve-workers-max > serve-workers the
+# supervisor runs a policy loop over the fleet's journaled SLO/shed
+# events — sustained serve_p99/shed-rate breach adds an SO_REUSEPORT
+# worker (up to this many), sustained recovery shrinks back toward
+# serve-workers, and a single-tenant overload REBALANCES that tenant's
+# DRR weight down before any scaling.  0 (default) disables the loop;
+# it also needs an obs journal (the signals live there).
+SERVE_WORKERS_MAX = TPU_PREFIX + "serve-workers-max"
+DEFAULT_SERVE_WORKERS_MAX = 0
+# seconds after any scale/rebalance decision during which the policy
+# holds still (anti-flap; breach/recover hysteresis applies on top)
+SERVE_AUTOSCALE_COOLDOWN_S = TPU_PREFIX + "serve-autoscale-cooldown"
+DEFAULT_SERVE_AUTOSCALE_COOLDOWN_S = 60.0
+# consecutive breached policy ticks before acting (the slo_breach events
+# feeding the loop are already hysteretic; this is the policy's own
+# debounce on top)
+SERVE_AUTOSCALE_TICKS = TPU_PREFIX + "serve-autoscale-ticks"
+DEFAULT_SERVE_AUTOSCALE_TICKS = 2
+# consecutive CLEAN (recovered, non-empty) ticks before scaling back
+# down — shrink must be much lazier than grow
+SERVE_AUTOSCALE_RECOVERY_TICKS = TPU_PREFIX + "serve-autoscale-recovery-ticks"
+DEFAULT_SERVE_AUTOSCALE_RECOVERY_TICKS = 6
+# policy tick cadence in seconds
+SERVE_AUTOSCALE_POLL_S = TPU_PREFIX + "serve-autoscale-poll"
+DEFAULT_SERVE_AUTOSCALE_POLL_S = 5.0
+# supervisor scrape surface: a /metrics-only HTTP listener on the parent
+# supervisor process exposing the stpu_serve_scale_* gauges (worker
+# count, ceiling, scale/rebalance totals, restart-budget remaining and
+# per-window burn — the PR-5 sliding-window budget was invisible until
+# it exhausted at rc 4).  0 (default) = off; the same numbers always
+# ride the journal events either way.
+SERVE_SUPERVISOR_PORT = TPU_PREFIX + "serve-supervisor-port"
+DEFAULT_SERVE_SUPERVISOR_PORT = 0
 
 # ---- AOT executable shipping (export/aot.py: compile once at export,
 # serve everywhere) ----

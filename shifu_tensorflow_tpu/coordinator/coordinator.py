@@ -71,6 +71,9 @@ class WorkerRecord:
     completed: bool = False
     exit_code: int | None = None
     restarts: int = 0
+    # "worker" | "standby": standbys hold no rank (worker_index -1) and
+    # live in Coordinator.standbys until promoted into a dead rank
+    role: str = "worker"
     # cross-process SPMD bring-up: the worker's host and, for the chief, the
     # TCP port it reserved for the jax coordination service
     host: str = ""
@@ -89,6 +92,10 @@ class WorkerRecord:
 #: cooperative exit code for a worker leaving because the fleet is
 #: restarting (not a failure; does not consume restart budget)
 RESTART_EXIT_CODE = 44
+
+#: sliding window for the restart-budget burn gauge (matches the serve
+#: supervisor's crash-restart window, serve/__main__.py)
+RESTART_BURN_WINDOW_S = 600.0
 
 #: cooperative exit code for a worker leaving after its health guard
 #: tripped and the coordinator granted a rollback: the BUDGET was already
@@ -154,6 +161,22 @@ class JobSpec:
     # before it is skipped on the replay (trailing steps are covered by
     # the report itself — the guard lists every non-finite step)
     health_skip_window: int = 1
+    # elastic fleet (shifu.tpu.standby-workers / shifu.tpu.elastic).
+    # standby_workers: hot standbys launched beside the fleet; they
+    # register with role=standby, pre-build their model (compile warm, no
+    # shard), heartbeat like any worker, and on a rank failure the
+    # coordinator PROMOTES the freshest-heartbeat standby into the dead
+    # rank — same index, same shard, current generation — instead of
+    # charging the restart budget (non-SPMD: the surviving ranks never
+    # roll back; SPMD: the standby substitutes into an UNCHARGED fleet
+    # restart, resuming from the latest verified epoch).
+    standby_workers: int = 0
+    # elastic=True: a rank failure with no standby left AND the restart
+    # budget exhausted SHRINKS the fleet — the training data re-splits
+    # deterministically over the survivors (data/splitter is a pure
+    # function of paths x n_workers) and the job continues instead of
+    # failing.  Also unlocks the explicit resize (grow/shrink) op.
+    elastic: bool = False
 
 
 class Coordinator:
@@ -195,7 +218,8 @@ class Coordinator:
         for name in ("registrations_total", "epochs_published_total",
                      "fleet_restarts_total", "health_trips_total",
                      "rollbacks_total", "worker_expiries_total",
-                     "worker_failures_total", "op_replays_total"):
+                     "worker_failures_total", "op_replays_total",
+                     "standby_promotions_total", "resplits_total"):
             self.registry.counter(name)
         self.aggregator = EpochAggregator(
             spec.n_workers, board_path=spec.board_path,
@@ -226,6 +250,17 @@ class Coordinator:
                 target_ks=spec.early_stop_ks,
                 patience=spec.early_stop_patience,
             )
+        if spec.elastic and not spec.sync_epochs:
+            # validated, not silently mutated (the early-stop rule): the
+            # shrink/release and re-split directives are delivered ONLY
+            # through the per-epoch barrier — without sync_epochs the
+            # survivors would keep training their old shards and a
+            # released rank would never learn it left the membership
+            raise ValueError(
+                "JobSpec.elastic requires sync_epochs=True: the elastic "
+                "re-split/release directives are delivered through the "
+                "per-epoch barrier (elastic_spec_kwargs sets it)"
+            )
         self.liveness = LivenessMonitor(
             interval_ms=spec.heartbeat_interval_ms,
             max_missed=spec.max_missed_heartbeats,
@@ -233,6 +268,63 @@ class Coordinator:
             on_recovered=self._on_worker_recovered,
         )
         self._failed_restarts = 0
+        # restart-budget burn times (monotonic): the budget itself stays
+        # lifetime-scoped (parity with the reference's fault envelope),
+        # but the metrics op exports the burn inside a sliding window so
+        # an operator can see the budget draining BEFORE it exhausts —
+        # the PR-5 serve supervisor learned this the hard way (rc 4 was
+        # the first visible symptom)
+        self._restart_times: list[float] = []
+        # ---- elastic fleet (JobSpec.standby_workers / .elastic) ----
+        # hot standbys: registered with role=standby, no rank, waiting on
+        # the standby_wait long-poll for a promotion
+        self.standbys: dict[str, WorkerRecord] = {}
+        self._standby_cond = threading.Condition(self._lock)
+        #: promotion history (diagnostics + `obs fleet` render): one dict
+        #: per promotion with rank, ids, epoch, why, and — once the
+        #: standby's wait poll claims it — the takeover latency
+        self.promotions: list[dict] = []
+        # active membership: the rank indices the fleet currently expects
+        # at every barrier/quorum.  Starts as range(n_workers); an
+        # elastic shrink (or resize) changes it and re-splits the data
+        # over the survivors (split_generation bumps so workers learn
+        # their new shard through the epoch barrier)
+        self._active_indices: set[int] = set(range(spec.n_workers))
+        self._split_generation = 0
+        # rank -> shard assignment under the CURRENT split: seeded from
+        # the spec, rewritten wholesale by _resplit_over.  register()
+        # reads THIS (never spec.shards directly) so a rank grown past
+        # the original width — or re-split before its worker registered
+        # — is handed the current split's shard, not a stale or
+        # out-of-range one
+        # (tolerates placeholder shards without .paths — in-memory test
+        # fleets construct JobSpec(shards=[None]))
+        self._rank_shards: dict[int, tuple[str, ...]] = {
+            i: tuple(getattr(s, "paths", None) or ())
+            for i, s in enumerate(spec.shards)
+        }
+        # per-path byte sizes, stat'ed ONCE here (construction runs
+        # before the server loop, so no RPC blocks behind it) and fed to
+        # every elastic re-split — which runs under self._lock, where a
+        # live stat sweep would stall heartbeats (training data is
+        # immutable for the life of a job, so the sizes never go stale).
+        # Only re-splits consume the sizes, and those are elastic-only:
+        # the default path must not re-pay the stat sweep make_job_spec
+        # just ran
+        self._path_sizes: dict[str, int] = {}
+        if spec.elastic:
+            from shifu_tensorflow_tpu.data.splitter import _size_safe
+
+            self._path_sizes = {
+                p: _size_safe(p)
+                for paths in self._rank_shards.values() for p in paths
+            }
+        # workers released by a resize shrink: they learn it at their
+        # next epoch barrier and exit cooperatively.  Membership-derived
+        # and NEVER consumed on delivery — a lost reply must redeliver
+        # at the released worker's next barrier (the same
+        # compare-don't-store discipline the resplit directive follows)
+        self._released_ids: set[str] = set()
         # health-rollback state: count, the accumulated LR back-off, the
         # skip directive for the offending batch window, and the last
         # unhealthy report's diagnostics (bundled into failures)
@@ -295,6 +387,11 @@ class Coordinator:
         with self._lock:
             return self._generation
 
+    def _expected(self) -> int:
+        """Ranks the current membership expects (caller holds the lock).
+        Equals spec.n_workers until an elastic shrink/resize."""
+        return len(self._active_indices)
+
     # ---- worker lifecycle (all called under the TCP handlers) ----
     def register(
         self,
@@ -302,6 +399,7 @@ class Coordinator:
         worker_index: int | None = None,
         host: str | None = None,
         jax_port: int | None = None,
+        role: str = "worker",
     ) -> dict[str, Any]:
         """``worker_index`` pins the caller to a specific slot (the submitter
         launches worker i with index i, so chief identity is deterministic,
@@ -312,20 +410,25 @@ class Coordinator:
         with self._lock:
             if self.state == JobState.FAILED:
                 return {"ok": False, "error": self.failure_reason}
+            if role == "standby":
+                return self._register_standby(worker_id, host)
             rec = self.workers.get(worker_id)
             if rec is None:
-                if len(self.workers) >= self.spec.n_workers:
+                if len(self.workers) >= self._expected():
                     return {"ok": False, "error": "cluster full"}
                 if worker_index is None:
                     worker_index = min(
                         i
-                        for i in range(self.spec.n_workers)
+                        for i in sorted(self._active_indices)
                         if i not in self._by_index
                     )
-                elif not 0 <= worker_index < self.spec.n_workers:
+                elif worker_index not in self._active_indices:
                     return {
                         "ok": False,
-                        "error": f"worker_index {worker_index} out of range",
+                        "error": (
+                            f"worker_index {worker_index} not in the active "
+                            f"membership {sorted(self._active_indices)}"
+                        ),
                     }
                 elif worker_index in self._by_index:
                     return {
@@ -335,10 +438,17 @@ class Coordinator:
                             f"{self._by_index[worker_index]!r}"
                         ),
                     }
+                # a rank shrunk away and later grown back relaunches
+                # under its original id: the stale release directive
+                # must not tell the NEW process to exit at its first
+                # barrier (the old process learned it and exited; a
+                # fresh registration into the active membership is the
+                # submitter deliberately refilling the rank)
+                self._released_ids.discard(worker_id)
                 rec = WorkerRecord(
                     worker_id=worker_id,
                     worker_index=worker_index,
-                    shard_paths=tuple(self.spec.shards[worker_index].paths),
+                    shard_paths=self._rank_shards.get(worker_index, ()),
                     registered_at=time.monotonic(),
                 )
                 self.workers[worker_id] = rec
@@ -355,14 +465,14 @@ class Coordinator:
             if jax_port is not None:
                 rec.jax_port = int(jax_port)
             self.liveness.register(worker_id)
-            if len(self.workers) == self.spec.n_workers and all(
+            if len(self.workers) == self._expected() and all(
                 r.generation == self._generation
                 for r in self.workers.values()
             ):
                 if self.state == JobState.REGISTERING:
                     self.state = JobState.TRAINING
                     log.info("all %d workers registered (generation %d): "
-                             "TRAINING", self.spec.n_workers,
+                             "TRAINING", self._expected(),
                              self._generation)
                     self.liveness.start()
                 self._start_barrier.set()
@@ -372,13 +482,13 @@ class Coordinator:
                 worker=rec.worker_index, worker_id=worker_id,
                 generation=self._generation,
                 registered=len(self.workers),
-                n_workers=self.spec.n_workers,
+                n_workers=self._expected(),
             )
             return {
                 "ok": True,
                 "worker_index": rec.worker_index,
                 "shard": list(rec.shard_paths),
-                "n_workers": self.spec.n_workers,
+                "n_workers": self._expected(),
                 "total_rows": self.spec.total_rows,
                 "epochs": self.spec.epochs,
                 "state": self.state.value,
@@ -408,6 +518,366 @@ class Coordinator:
                     }
                 ),
             }
+
+    # ---- elastic fleet: standby pool + promotion + membership ----
+    def _register_standby(self, worker_id: str,
+                          host: str | None) -> dict[str, Any]:
+        """Admit (or sticky-refresh) a hot standby.  Caller holds the
+        lock.  Standbys hold no rank and never gate the start barrier —
+        they heartbeat, pre-build their model, and long-poll
+        ``standby_wait`` until a rank failure promotes one of them."""
+        rec = self.standbys.get(worker_id)
+        promoted = self.workers.get(worker_id)
+        if promoted is not None:
+            # a promoted standby re-registering (e.g. after an SPMD
+            # generation bump) is a WORKER now — route it sticky
+            return {"ok": False, "error": (
+                f"{worker_id!r} was promoted to rank "
+                f"{promoted.worker_index}; re-register as a worker")}
+        if rec is None:
+            rec = WorkerRecord(
+                worker_id=worker_id, worker_index=-1, role="standby",
+                registered_at=time.monotonic(),
+            )
+            self.standbys[worker_id] = rec
+        if host is not None:
+            rec.host = host
+        rec.generation = self._generation
+        self.liveness.register(worker_id)
+        self.registry.inc("registrations_total")
+        obs_journal.emit(
+            "standby_register", plane="coordinator", worker_id=worker_id,
+            standbys=len(self.standbys), generation=self._generation,
+        )
+        return {
+            "ok": True,
+            "role": "standby",
+            "worker_index": -1,
+            "state": self.state.value,
+            "spmd": self.spec.spmd,
+            "generation": self._generation,
+            "job": self.job_id,
+            "epochs": self.spec.epochs,
+        }
+
+    def standby_wait(self, worker_id: str,
+                     timeout_s: float = 10.0) -> dict[str, Any]:
+        """Standby long-poll: block until this standby is promoted into a
+        rank, the job reaches a terminal state, or ``timeout_s`` passes
+        (the standby then re-polls — each poll doubles as liveness
+        evidence beside its heartbeat thread).  The promotion reply is a
+        superset of the worker register reply, so the caller can enter
+        the normal training path with it."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        self.liveness.beat(worker_id)
+        with self._standby_cond:
+            while True:
+                if self.state in (JobState.FINISHED, JobState.FAILED):
+                    return {"ok": False, "abort": True,
+                            "state": self.state.value,
+                            "error": self.failure_reason}
+                rec = self.workers.get(worker_id)
+                if rec is not None and rec.worker_index >= 0:
+                    # promoted: stamp the takeover claim (latency from
+                    # the promote decision to this poll returning)
+                    for p in reversed(self.promotions):
+                        if (p["standby_id"] == worker_id
+                                and p.get("claim_latency_s") is None):
+                            p["claim_latency_s"] = round(
+                                time.monotonic() - p["_promoted_mono"], 4)
+                            obs_journal.emit(
+                                "standby_claim", plane="coordinator",
+                                worker=rec.worker_index,
+                                worker_id=worker_id,
+                                latency_s=p["claim_latency_s"],
+                            )
+                            break
+                    reply = {
+                        "ok": True,
+                        "promoted": True,
+                        "worker_index": rec.worker_index,
+                        "shard": list(rec.shard_paths),
+                        "n_workers": self._expected(),
+                        "total_rows": self.spec.total_rows,
+                        "epochs": self.spec.epochs,
+                        "state": self.state.value,
+                        "sync_epochs": self.spec.sync_epochs,
+                        "spmd": self.spec.spmd,
+                        "generation": self._generation,
+                        "job": self.job_id,
+                        "shard_lines": self._shard_lines.get(
+                            rec.worker_index),
+                        "health": {
+                            "lr_scale": (self._lr_scale if self.spec.spmd
+                                         else rec.lr_scale),
+                            "skip": (self._skip_directive if self.spec.spmd
+                                     else rec.skip_directive),
+                            "rollbacks": self._rollbacks,
+                        },
+                    }
+                    return reply
+                if worker_id not in self.standbys and rec is None:
+                    return {"ok": False,
+                            "error": f"unknown standby {worker_id}"}
+                if time.monotonic() >= deadline:
+                    return {"ok": True, "promoted": False,
+                            "state": self.state.value}
+                self._standby_cond.wait(timeout=0.2)
+
+    def _eligible_standbys(self) -> list[WorkerRecord]:
+        """Standbys eligible for promotion, freshest heartbeat first.
+        Caller holds the lock.  A standby currently EXPIRED by the
+        liveness monitor is skipped even if it later flaps back — a
+        promotion must land on a rank that is provably alive right now,
+        not one the monitor has written off."""
+        expired = self.liveness.expired()
+        ages = self.liveness.ages()
+        out = [s for s in self.standbys.values()
+               if s.worker_id not in expired]
+        out.sort(key=lambda s: ages.get(s.worker_id, float("inf")))
+        return out
+
+    def _promote_standby(self, rec: WorkerRecord, why: str) -> bool:
+        """Promote the freshest-heartbeat live standby into ``rec``'s
+        rank.  Caller holds the lock.  Returns False when no eligible
+        standby exists (caller falls back to the restart/relaunch
+        policy).  Promotion is FREE — it consumes a standby, not restart
+        budget — and non-SPMD survivors never see it: their barriers
+        simply hold until the promoted rank catches up."""
+        eligible = self._eligible_standbys()
+        if not eligible:
+            return False
+        standby = eligible[0]
+        skipped = [s.worker_id for s in self.standbys.values()
+                   if s.worker_id in self.liveness.expired()]
+        del self.standbys[standby.worker_id]
+        idx = rec.worker_index
+        # the standby inherits the dead rank's identity wholesale: index,
+        # shard, rollback state (non-SPMD scoping), restart accounting
+        standby.role = "worker"
+        standby.worker_index = idx
+        standby.shard_paths = rec.shard_paths
+        standby.generation = self._generation
+        standby.lr_scale = rec.lr_scale
+        standby.skip_directive = rec.skip_directive
+        standby.restarts = rec.restarts
+        standby.completed = False
+        standby.exit_code = None
+        self.workers.pop(rec.worker_id, None)
+        self.liveness.unregister(rec.worker_id)
+        # the "dead" process may only be FLAPPED (GC pause, partition):
+        # if it wakes after the takeover, its next epoch barrier must
+        # hand it the cooperative-exit directive the resize shrink uses
+        # — otherwise two live processes train rank ``idx``'s shard.
+        # Never discarded for this id: the submitter relaunches by
+        # active_worker_ids(), which maps the rank to the standby.
+        self._released_ids.add(rec.worker_id)
+        self.workers[standby.worker_id] = standby
+        self._by_index[idx] = standby.worker_id
+        ages = self.liveness.ages()
+        promo = {
+            "worker_index": idx,
+            "old_id": rec.worker_id,
+            "standby_id": standby.worker_id,
+            "why": why,
+            "epoch": self._last_epoch.get(idx, -1),
+            "hb_age_s": round(ages.get(standby.worker_id, 0.0), 3),
+            "ts": time.time(),
+            "_promoted_mono": time.monotonic(),
+            "claim_latency_s": None,
+        }
+        self.promotions.append(promo)
+        self.registry.inc("standby_promotions_total")
+        log.warning(
+            "promoting standby %s into rank %d (%s); heartbeat age "
+            "%.3fs, %d standby(s) left",
+            standby.worker_id, idx, why, promo["hb_age_s"],
+            len(self.standbys),
+        )
+        obs_journal.emit(
+            "standby_promote", plane="coordinator",
+            worker=idx, worker_id=standby.worker_id,
+            old_worker_id=rec.worker_id, why=why,
+            epoch=promo["epoch"], hb_age_s=promo["hb_age_s"],
+            standbys_left=len(self.standbys),
+            skipped_expired=skipped,
+            generation=self._generation,
+        )
+        self._standby_cond.notify_all()
+        return True
+
+    def _all_data_paths(self) -> list[str]:
+        """Union of every active rank's shard paths (deterministic
+        order) — the re-split input.  Caller holds the lock."""
+        paths: set[str] = set()
+        for rec in self.workers.values():
+            paths.update(rec.shard_paths)
+        for shard_paths in self._rank_shards.values():
+            paths.update(shard_paths)
+        for shard in self.spec.shards:
+            paths.update(getattr(shard, "paths", None) or ())
+        return sorted(paths)
+
+    def _resplit_over(self, indices: list[int], why: str) -> None:
+        """Deterministically re-split the training data over ``indices``
+        and update membership.  Caller holds the lock.  Workers learn
+        their new shard through the epoch barrier (``resplit`` directive,
+        keyed by split_generation) — the streaming paths apply it at
+        their next epoch boundary; in-memory workers pick it up on
+        relaunch (their coordinator record already carries it)."""
+        from shifu_tensorflow_tpu.data.splitter import split_size_aware
+
+        indices = sorted(indices)
+        paths = self._all_data_paths()
+        # sizes were stat'ed once at construction (outside the serving
+        # lock): re-splitting holds self._lock, and a live stat sweep
+        # over a slow filesystem here would stall heartbeats long
+        # enough to expire healthy workers mid-recovery
+        shards = split_size_aware(paths, len(indices),
+                                  sizes=self._path_sizes)
+        # the rank->shard map is rewritten WHOLESALE: ranks whose worker
+        # has not registered yet (a grown rank) get their shard from
+        # here at registration time
+        self._rank_shards = {
+            idx: tuple(shard.paths)
+            for shard, idx in zip(shards, indices)
+        }
+        for idx in indices:
+            wid = self._by_index.get(idx)
+            rec = self.workers.get(wid) if wid else None
+            if rec is not None:
+                rec.shard_paths = self._rank_shards[idx]
+        self._active_indices = set(indices)
+        self._split_generation += 1
+        # cached per-rank line counts describe the OLD split; workers
+        # recount their new shard once and re-report through sync_plan
+        self._shard_lines.clear()
+        self.registry.inc("resplits_total")
+        log.warning("re-split %d data file(s) over ranks %s "
+                    "(split generation %d): %s", len(paths), indices,
+                    self._split_generation, why)
+        obs_journal.emit(
+            "resplit", plane="coordinator",
+            split_generation=self._split_generation,
+            ranks=indices, n_files=len(paths), why=why,
+        )
+        # barriers re-evaluate against the new membership: a quorum the
+        # dead rank was holding open may be complete now
+        self._epoch_cond.notify_all()
+        self._plan_cond.notify_all()
+        self._standby_cond.notify_all()
+
+    def _shrink_membership(self, rec: WorkerRecord, why: str) -> bool:
+        """Elastic fallback: drop ``rec``'s rank from the membership and
+        re-split its data over the survivors instead of failing the job.
+        Caller holds the lock.  Refused (False) for the chief (rank 0
+        owns the exported model — nothing to shrink onto) and when no
+        survivor would remain."""
+        survivors = sorted(self._active_indices - {rec.worker_index})
+        if not self.spec.elastic or rec.worker_index == 0 or not survivors:
+            return False
+        if len(self._all_data_paths()) < len(survivors):
+            # placeholder/in-memory shards (no data paths) or fewer
+            # files than survivors: split_size_aware would raise AFTER
+            # the membership mutation below, wedging the job half-shrunk
+            # inside the liveness callback — refuse up front and let the
+            # caller's restart/failure policy decide instead
+            return False
+        self.workers.pop(rec.worker_id, None)
+        self._by_index.pop(rec.worker_index, None)
+        self.liveness.unregister(rec.worker_id)
+        # same flap hazard as promotion: a shrunk-away process that
+        # wakes up must learn at its next barrier that the re-split
+        # handed its rows to the survivors, and exit instead of
+        # training them in duplicate
+        self._released_ids.add(rec.worker_id)
+        self._last_epoch.pop(rec.worker_index, None)
+        self._plans.pop(rec.worker_index, None)
+        self._resplit_over(survivors, f"shrink after {why}")
+        self.aggregator.set_expected(len(survivors))
+        return True
+
+    def resize(self, n_workers: int) -> dict[str, Any]:
+        """Explicit elastic grow/shrink to ``n_workers`` ranks (admin op;
+        non-SPMD, requires JobSpec.elastic).  Grow adds vacant ranks
+        (the submitter launches workers for them — poll
+        ``pending_indices``); shrink releases the highest ranks at their
+        next epoch barrier.  Either way the data re-splits
+        deterministically over the new membership."""
+        with self._lock:
+            if not self.spec.elastic:
+                return {"ok": False,
+                        "error": "resize needs JobSpec.elastic=True "
+                                 f"({K.ELASTIC})"}
+            if self.spec.spmd:
+                return {"ok": False, "error": (
+                    "resize is non-SPMD only: SPMD membership is pinned "
+                    "by the jax.distributed process count for the job's "
+                    "lifetime")}
+            n = int(n_workers)
+            if n < 1:
+                return {"ok": False, "error": "n_workers must be >= 1"}
+            current = sorted(self._active_indices)
+            if n == len(current):
+                return {"ok": True, "ranks": current, "changed": False}
+            if n < len(current):
+                keep, drop = current[:n], current[n:]
+                if 0 in drop:
+                    return {"ok": False,
+                            "error": "cannot shrink away the chief"}
+                if len(self._all_data_paths()) < n:
+                    # validate BEFORE the drop loop mutates membership:
+                    # split_size_aware raising mid-mutation would leave
+                    # released workers still in the barrier quorum
+                    return {"ok": False, "error": (
+                        f"cannot shrink to {n} ranks: only "
+                        f"{len(self._all_data_paths())} data file(s) to "
+                        "re-split (need at least one per rank)")}
+                for idx in drop:
+                    wid = self._by_index.pop(idx, None)
+                    rec = self.workers.pop(wid, None) if wid else None
+                    if rec is not None:
+                        self.liveness.unregister(rec.worker_id)
+                        self._released_ids.add(rec.worker_id)
+                    self._last_epoch.pop(idx, None)
+                    self._plans.pop(idx, None)
+                self._resplit_over(keep, f"resize to {n}")
+            else:
+                if len(self._all_data_paths()) < n:
+                    return {"ok": False, "error": (
+                        f"cannot grow to {n} ranks: only "
+                        f"{len(self._all_data_paths())} data file(s) to "
+                        "split (need at least one per rank)")}
+                grown = current + [i for i in range(
+                    max(current) + 1 + n - len(current))
+                    if i not in current][:n - len(current)]
+                self._resplit_over(grown, f"resize to {n}")
+            self.aggregator.set_expected(n)
+            return {"ok": True, "ranks": sorted(self._active_indices),
+                    "changed": True,
+                    "split_generation": self._split_generation}
+
+    def pending_indices(self) -> list[int]:
+        """Active ranks with no registered worker — after a grow, the
+        submitter launches one worker per pending index."""
+        with self._lock:
+            return sorted(i for i in self._active_indices
+                          if i not in self._by_index)
+
+    def active_worker_ids(self) -> dict[int, str]:
+        """index -> worker_id for the CURRENT membership (a promoted
+        standby occupies its rank under its own id) — the submitter's
+        relaunch identity map; relaunching by the original launch names
+        would collide with promoted standbys."""
+        with self._lock:
+            return {i: wid for i, wid in sorted(self._by_index.items())
+                    if i in self._active_indices}
+
+    def standby_ids(self) -> list[str]:
+        """Unpromoted standbys (submitter: skip these on fleet-restart
+        kills — they hold no collective state and stay warm)."""
+        with self._lock:
+            return sorted(self.standbys)
 
     _LOOPBACK = LOOPBACK_HOSTS
 
@@ -447,7 +917,7 @@ class Coordinator:
         return {
             "chief_host": chief_host,
             "jax_port": chief.jax_port if chief else 0,
-            "n_workers": self.spec.n_workers,
+            "n_workers": self._expected(),
             "generation": self._generation,
         }
 
@@ -546,8 +1016,10 @@ class Coordinator:
                     }
                 if self._generation != gen:
                     return {"ok": False, "restart": True}
-                if len(self._plans) == self.spec.n_workers:
-                    plans = list(self._plans.values())
+                if len(self._plans) >= self._expected() and all(
+                        i in self._plans for i in self._active_indices):
+                    plans = [self._plans[i]
+                             for i in sorted(self._active_indices)]
                     return {
                         "ok": True,
                         "train_steps": max(
@@ -563,7 +1035,7 @@ class Coordinator:
                 if time.monotonic() >= deadline:
                     missing = [
                         i
-                        for i in range(self.spec.n_workers)
+                        for i in sorted(self._active_indices)
                         if i not in self._plans
                     ]
                     return {
@@ -578,11 +1050,19 @@ class Coordinator:
     def heartbeat(self, worker_id: str) -> dict[str, Any]:
         self.liveness.beat(worker_id)
         with self._lock:
-            return {
+            out = {
                 "ok": True,
                 "abort": self.state == JobState.FAILED,
                 "generation": self._generation,
             }
+            if worker_id in self._released_ids:
+                # a promoted-over or shrunk-away flapper may never call
+                # epoch_barrier (sync_epochs can be off outside the
+                # elastic path): the heartbeat is the one channel EVERY
+                # worker polls, so the cooperative-exit directive rides
+                # it too
+                out["released"] = True
+            return out
 
     def report_epoch(self, stats_dict: dict[str, Any]) -> dict[str, Any]:
         stats = EpochStats(**stats_dict)
@@ -667,12 +1147,19 @@ class Coordinator:
         return {"ok": True, "abort": self.state == JobState.FAILED}
 
     def epoch_barrier(
-        self, worker_id: str, epoch: int, timeout_s: float | None = None
+        self, worker_id: str, epoch: int, timeout_s: float | None = None,
+        split_generation: int | None = None,
     ) -> dict[str, Any]:
-        """Block until every worker index has reported ``epoch`` (or the job
-        reaches a terminal state).  A failed worker holds the barrier; its
-        relaunch re-reports the epoch and releases everyone — sync-SGD
-        semantics at epoch granularity."""
+        """Block until every ACTIVE worker index has reported ``epoch``
+        (or the job reaches a terminal state).  A failed worker holds the
+        barrier; its relaunch — or its promoted standby — re-reports the
+        epoch and releases everyone; an elastic shrink removes it from
+        the quorum instead.  Sync-SGD semantics at epoch granularity.
+
+        ``split_generation`` is the caller's last-applied re-split: when
+        it trails the coordinator's, the success reply carries the
+        worker's NEW shard (``resplit`` directive) — echoed per request
+        so a lost reply just means redelivery at the next barrier."""
         deadline = time.monotonic() + (
             timeout_s
             if timeout_s is not None
@@ -680,6 +1167,15 @@ class Coordinator:
         )
         def _ok() -> dict[str, Any]:
             out = {"ok": True, "state": self.state.value}
+            if (split_generation is not None
+                    and split_generation < self._split_generation):
+                rec = self.workers.get(worker_id)
+                if rec is not None:
+                    out["resplit"] = {
+                        "shard": list(rec.shard_paths),
+                        "split_generation": self._split_generation,
+                        "n_workers": self._expected(),
+                    }
             if self._stop_after_epoch is not None:
                 # same value for every worker — the whole fleet stops
                 # after the same epoch.  Attached to EVERY success
@@ -695,17 +1191,28 @@ class Coordinator:
             while True:
                 if self.state == JobState.FAILED:
                     return {"ok": False, "abort": True, "error": self.failure_reason}
+                if worker_id in self._released_ids:
+                    # resize shrink released this rank: the worker exits
+                    # cooperatively instead of training a shard the
+                    # re-split just handed to the survivors.  NOT
+                    # consumed on delivery — a lost reply (the exact
+                    # fault the rpc.recv seam models, and this op
+                    # carries no dedup token) must redeliver at the
+                    # retry, or the released worker trains duplicated
+                    # rows for the rest of the job
+                    return {"ok": True, "released": True,
+                            "state": self.state.value}
                 if self.state == JobState.FINISHED:
                     return _ok()
                 if all(
                     self._last_epoch.get(i, -1) >= epoch
-                    for i in range(self.spec.n_workers)
+                    for i in self._active_indices
                 ):
                     return _ok()
                 if time.monotonic() >= deadline:
                     missing = [
                         i
-                        for i in range(self.spec.n_workers)
+                        for i in sorted(self._active_indices)
                         if self._last_epoch.get(i, -1) < epoch
                     ]
                     return {
@@ -719,6 +1226,17 @@ class Coordinator:
 
     def complete(self, worker_id: str, exit_code: int) -> dict[str, Any]:
         with self._lock:
+            standby = self.standbys.pop(worker_id, None)
+            if standby is not None:
+                # a standby leaving (job over, or its own crash) just
+                # shrinks the pool — no rank failed, no budget charged
+                self.liveness.unregister(worker_id)
+                obs_journal.emit(
+                    "standby_exit", plane="coordinator",
+                    worker_id=worker_id, exit_code=exit_code,
+                    standbys=len(self.standbys),
+                )
+                return {"ok": True, "state": self.state.value}
             rec = self.workers.get(worker_id)
             if rec is None:
                 return {"ok": False, "error": f"unknown worker {worker_id}"}
@@ -865,6 +1383,7 @@ class Coordinator:
             # budget here; the worker exits UNHEALTHY_EXIT_CODE (which
             # complete() treats as already-charged) and is relaunched
             self._failed_restarts += 1
+            self._restart_times.append(time.monotonic())
             if self._failed_restarts > self.max_restarts:
                 self._fail(
                     f"worker {rec.worker_index} unhealthy at epoch {epoch} "
@@ -909,7 +1428,11 @@ class Coordinator:
     # ---- failure handling ----
     def _on_worker_expired(self, worker_id: str) -> None:
         with self._lock:
-            rec = self.workers.get(worker_id)
+            # standbys live in their own pool: without this lookup an
+            # expired standby never reaches _on_worker_failed's standby
+            # branch (no warning, and the pool silently overcounts)
+            rec = (self.workers.get(worker_id)
+                   or self.standbys.get(worker_id))
             if rec is not None and not rec.completed:
                 self._on_worker_failed(rec, "missed heartbeats")
 
@@ -928,6 +1451,14 @@ class Coordinator:
         )
 
     def _on_worker_failed(self, rec: WorkerRecord, why: str) -> None:
+        if rec.role == "standby" or rec.worker_index < 0:
+            # a standby dying never fails a rank: it just leaves the pool
+            # (its record stays so a flap can recover it — expiry is
+            # already the eligibility gate for promotion)
+            log.warning("standby %s failed (%s); %d standby(s) remain "
+                        "eligible", rec.worker_id, why,
+                        len(self._eligible_standbys()))
+            return
         self.registry.inc("worker_failures_total")
         obs_journal.emit("worker_failed", plane="coordinator",
                          worker=rec.worker_index, why=why,
@@ -946,14 +1477,39 @@ class Coordinator:
             # checkpoint.  This consciously widens the reference's
             # chief-short-circuit (TensorflowSession.java:434-452): under
             # SPMD a chief failure is as recoverable as any other.
+            #
+            # With a live standby the restart is UNCHARGED: the prebuilt
+            # standby substitutes into the dead rank (sticky index +
+            # shard) and the fleet resumes from the latest VERIFIED epoch
+            # (sync_plan agreement) — the standby was the budget.
+            if self._promote_standby(rec, why):
+                self._fleet_restart(
+                    f"worker {rec.worker_index} failed ({why}); standby "
+                    f"promoted into rank {rec.worker_index}",
+                    charge=False,
+                )
+                return
             self._fleet_restart(f"worker {rec.worker_index} failed ({why})")
             return
+        # non-SPMD: a live standby takes the rank over with ZERO rollback
+        # anywhere — survivors' barriers simply hold until the promoted
+        # rank restores the latest verified checkpoint and catches up
+        if self._promote_standby(rec, why):
+            return
         if rec.worker_index == 0:
-            # chief short-circuit (TensorflowSession.java:434-452)
+            # chief short-circuit (TensorflowSession.java:434-452): only
+            # a standby promotion (above) can save a dead chief
             self._fail(f"chief worker failed: {why}")
             return
         self._failed_restarts += 1
+        self._restart_times.append(time.monotonic())
         if self._failed_restarts > self.max_restarts:
+            # elastic fleets SHRINK here instead of failing: drop the
+            # rank, re-split its data over the survivors, continue
+            if self._shrink_membership(
+                    rec, f"worker {rec.worker_index} failed ({why}); "
+                         f"restart budget {self.max_restarts} exhausted"):
+                return
             self._fail(
                 f"worker {rec.worker_index} failed ({why}); restart budget "
                 f"{self.max_restarts} exhausted"
@@ -986,19 +1542,25 @@ class Coordinator:
             )
             return {"ok": True, "fleet": True}
 
-    def _fleet_restart(self, why: str) -> None:
+    def _fleet_restart(self, why: str, charge: bool = True) -> None:
         """Bump the fleet generation: the submitter kills every live worker
         process and relaunches the whole fleet; workers re-register sticky
-        (same index, same shard) and resume from the agreed checkpoint."""
+        (same index, same shard) and resume from the agreed checkpoint.
+
+        ``charge=False`` is the standby-promotion path: the restart
+        consumed a prebuilt standby instead of restart budget."""
         with self._lock:
             if self.state in (JobState.FINISHED, JobState.FAILED):
                 return
-            self._failed_restarts += 1
-            if self._failed_restarts > self.max_restarts:
-                self._fail(
-                    f"{why}; restart budget {self.max_restarts} exhausted"
-                )
-                return
+            if charge:
+                self._failed_restarts += 1
+                self._restart_times.append(time.monotonic())
+                if self._failed_restarts > self.max_restarts:
+                    self._fail(
+                        f"{why}; restart budget {self.max_restarts} "
+                        f"exhausted"
+                    )
+                    return
             self._generation += 1
             log.warning("fleet restart -> generation %d (%s); budget %d/%d "
                         "used", self._generation, why,
@@ -1009,6 +1571,7 @@ class Coordinator:
                 generation=self._generation, why=why,
                 restarts_used=self._failed_restarts,
                 restart_budget=self.max_restarts,
+                charged=charge,
             )
             self._gen_started_at = time.monotonic()
             self._start_barrier = threading.Event()
@@ -1074,6 +1637,11 @@ class Coordinator:
                 # ran twice
                 "rollbacks": self._rollbacks,
                 "lr_scale": self._lr_scale,
+                # elastic fleet visibility
+                "standbys": len(self.standbys),
+                "promotions": len(self.promotions),
+                "active_workers": sorted(self._active_indices),
+                "split_generation": self._split_generation,
             }
 
     def diagnostics(self) -> dict[str, Any]:
@@ -1096,6 +1664,7 @@ class Coordinator:
                     liveness = "unregistered"
                 workers[wid] = {
                     "worker_index": rec.worker_index,
+                    "role": rec.role,
                     "liveness": liveness,
                     "last_heartbeat_age_s": (
                         round(ages[wid], 3) if wid in ages else None
@@ -1107,6 +1676,16 @@ class Coordinator:
                     "exit_code": rec.exit_code,
                     "lr_scale": rec.lr_scale,
                 }
+            standbys = {}
+            for wid, rec in self.standbys.items():
+                standbys[wid] = {
+                    "liveness": ("expired" if wid in expired
+                                 else "alive" if wid in ages
+                                 else "unregistered"),
+                    "last_heartbeat_age_s": (
+                        round(ages[wid], 3) if wid in ages else None
+                    ),
+                }
             return {
                 "workers": workers,
                 "restarts_used": self._failed_restarts,
@@ -1116,6 +1695,17 @@ class Coordinator:
                 "liveness_flaps": self.liveness.flaps,
                 "generation": self._generation,
                 "last_unhealthy": self._last_unhealthy,
+                # elastic fleet: the standby pool and every promotion —
+                # rank, ids, epoch, heartbeat age at choice, takeover
+                # latency once claimed (internal monotonic stamp elided)
+                "standbys": standbys,
+                "promotions": [
+                    {k: v for k, v in p.items()
+                     if not k.startswith("_")}
+                    for p in self.promotions
+                ],
+                "active_workers": sorted(self._active_indices),
+                "split_generation": self._split_generation,
             }
 
     def metrics_text(self) -> str:
@@ -1125,10 +1715,31 @@ class Coordinator:
         same convention ServeMetrics follows."""
         with self._lock:
             self.registry.set_gauge("workers_registered", len(self.workers))
-            self.registry.set_gauge("workers_expected", self.spec.n_workers)
+            self.registry.set_gauge("workers_expected", self._expected())
             self.registry.set_gauge("generation", self._generation)
             self.registry.set_gauge("restarts_used", self._failed_restarts)
             self.registry.set_gauge("restart_budget", self.max_restarts)
+            # the budget draining must be visible BEFORE it exhausts:
+            # remaining headroom plus the burn inside a sliding window
+            # (the same 600s window the serve supervisor budgets over) —
+            # a burst here is the page; a slow lifetime trickle is not
+            self.registry.set_gauge(
+                "restart_budget_remaining",
+                max(0, self.max_restarts - self._failed_restarts))
+            now = time.monotonic()
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t < RESTART_BURN_WINDOW_S]
+            self.registry.set_gauge(
+                "restart_budget_burn_window", len(self._restart_times))
+            # elastic fleet: pool size, currently-promotable count, and
+            # membership width
+            self.registry.set_gauge("standby_registered",
+                                    len(self.standbys))
+            self.registry.set_gauge("standby_available",
+                                    len(self._eligible_standbys()))
+            self.registry.set_gauge("split_generation",
+                                    self._split_generation)
             self.registry.set_gauge("lr_scale", self._lr_scale)
             self.registry.set_gauge(
                 "state_info", 1, labels='{state="%s"}' % self.state.value
@@ -1246,7 +1857,14 @@ class Coordinator:
                 msg.get("worker_index"),
                 msg.get("host"),
                 msg.get("jax_port"),
+                msg.get("role") or "worker",
             )
+        if op == "standby_wait":
+            return self.standby_wait(
+                msg["worker_id"], float(msg.get("timeout_s") or 10.0)
+            )
+        if op == "resize":
+            return self.resize(int(msg["n_workers"]))
         if op == "await_start":
             return self.await_start(msg.get("timeout_s"))
         if op == "sync_plan":
@@ -1259,7 +1877,8 @@ class Coordinator:
             return self.report_epoch(msg["stats"])
         if op == "epoch_barrier":
             return self.epoch_barrier(
-                msg["worker_id"], int(msg["epoch"]), msg.get("timeout_s")
+                msg["worker_id"], int(msg["epoch"]), msg.get("timeout_s"),
+                split_generation=msg.get("split_generation"),
             )
         if op == "complete":
             return self.complete(msg["worker_id"], int(msg.get("exit_code", 0)))
@@ -1380,6 +1999,7 @@ class CoordinatorClient:
         worker_index: int | None = None,
         host: str | None = None,
         jax_port: int | None = None,
+        role: str = "worker",
     ) -> dict[str, Any]:
         return self.call(
             {
@@ -1388,9 +2008,25 @@ class CoordinatorClient:
                 "worker_index": worker_index,
                 "host": host,
                 "jax_port": jax_port,
+                "role": role,
                 "token": uuid.uuid4().hex,
             }
         )
+
+    def standby_wait(self, worker_id: str,
+                     timeout_s: float = 10.0) -> dict[str, Any]:
+        """Standby long-poll for a promotion; no socket timeout — the
+        server bounds the wait by ``timeout_s`` itself."""
+        return self.call(
+            {"op": "standby_wait", "worker_id": worker_id,
+             "timeout_s": timeout_s},
+            timeout_s=None,
+        )
+
+    def resize(self, n_workers: int) -> dict[str, Any]:
+        """Explicit elastic grow/shrink (admin surface; needs
+        JobSpec.elastic)."""
+        return self.call({"op": "resize", "n_workers": n_workers})
 
     def await_start(self, timeout_s: float | None = None) -> dict[str, Any]:
         # no socket timeout: the server responds by its own registration
@@ -1413,12 +2049,16 @@ class CoordinatorClient:
         return self.call({"op": "epoch", "stats": stats.__dict__,
                           "token": uuid.uuid4().hex})
 
-    def epoch_barrier(self, worker_id: str, epoch: int) -> dict[str, Any]:
+    def epoch_barrier(self, worker_id: str, epoch: int,
+                      split_generation: int | None = None) -> dict[str, Any]:
         # no socket timeout: the server enforces its own barrier deadline
-        return self.call(
-            {"op": "epoch_barrier", "worker_id": worker_id, "epoch": epoch},
-            timeout_s=None,
-        )
+        msg = {"op": "epoch_barrier", "worker_id": worker_id,
+               "epoch": epoch}
+        if split_generation is not None:
+            # echoed per request so a lost resplit reply self-heals at
+            # the next barrier (the server compares, never stores)
+            msg["split_generation"] = split_generation
+        return self.call(msg, timeout_s=None)
 
     def complete(self, worker_id: str, exit_code: int = 0) -> dict[str, Any]:
         return self.call(

@@ -69,6 +69,14 @@ class EpochAggregator:
         self._lock = threading.Lock()
         self.summaries: list[EpochSummary] = []
 
+    def set_expected(self, n_workers: int) -> None:
+        """Elastic membership change (coordinator shrink/resize): later
+        epochs reach quorum at the NEW width.  Epochs already holding
+        more reports than the new width flush on the next completing
+        report via the partial-quorum path."""
+        with self._lock:
+            self.n_workers = int(n_workers)
+
     def report(self, stats: EpochStats) -> EpochSummary | None:
         """Record one worker's epoch stats; returns the summary if this
         report completes the epoch's quorum.  When an epoch completes, any

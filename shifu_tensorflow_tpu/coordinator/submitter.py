@@ -70,6 +70,9 @@ class JobResult:
     # health rollbacks performed (visible in metrics: a rollback is an
     # operational event, not just epochs silently running twice)
     rollbacks_used: int = 0
+    # standby promotions performed (elastic fleet): takeovers that cost a
+    # standby instead of restart budget
+    promotions_used: int = 0
     # failure-time diagnostic bundle (per-worker last-heartbeat ages +
     # liveness state, last epochs, restart/rollback accounting, last
     # unhealthy report) — populated on EVERY failure path, including the
@@ -177,10 +180,16 @@ class JobSubmitter:
         return host
 
     def _launch(
-        self, worker_id: str, addr: tuple[str, int], index: int | None = None
+        self, worker_id: str, addr: tuple[str, int],
+        index: int | None = None, role: str = "worker",
     ) -> None:
         cfg = self.make_worker_config(worker_id, addr)
-        if cfg.worker_index is None:
+        if role == "standby":
+            # standbys hold no rank until promoted; the coordinator
+            # assigns one at promotion time (sticky thereafter)
+            cfg.role = "standby"
+            cfg.worker_index = None
+        elif cfg.worker_index is None:
             cfg.worker_index = index
         if self.spec.spmd:
             cfg.spmd = True
@@ -198,7 +207,7 @@ class JobSubmitter:
         obs_journal.emit(
             "worker_launch", plane="coordinator", worker_id=worker_id,
             worker=cfg.worker_index, attempt=self._launch_counts[worker_id],
-            launcher=self.launcher,
+            launcher=self.launcher, role=role,
         )
         if self.launcher == "process":
             self._launch_process(worker_id, cfg, fail_at)
@@ -326,10 +335,19 @@ class JobSubmitter:
                              worker_id=worker_id)
         return was_alive or remote_killed
 
-    def _kill_fleet(self) -> None:
+    def _kill_fleet(self, skip: set | None = None) -> None:
+        """SIGKILL the fleet.  ``skip`` (fleet restart) spares unpromoted
+        standbys: they hold no collective state, and killing a warm
+        standby would throw away exactly the capacity the restart is
+        about to need."""
+        skip = skip or set()
         for wid in list(self._procs):
+            if wid in skip:
+                continue
             self.kill_worker(wid)
-        for proc in self._procs.values():
+        for wid, proc in self._procs.items():
+            if wid in skip:
+                continue
             try:
                 proc.wait(timeout=10.0)
             except subprocess.TimeoutExpired:
@@ -355,8 +373,15 @@ class JobSubmitter:
         worker_ids = [f"worker-{i}" for i in range(self.spec.n_workers)]
         for i, wid in enumerate(worker_ids):
             self._launch(wid, addr, index=i)
+        # hot standbys launch BESIDE the fleet: rankless, prebuilt, warm
+        # (JobSpec.standby_workers / shifu.tpu.standby-workers)
+        standby_ids = [f"standby-{i}"
+                       for i in range(self.spec.standby_workers)]
+        for sid in standby_ids:
+            self._launch(sid, addr, role="standby")
 
         relaunched: set = set()
+        grown: set = set()
         seen_generation = 0
         try:
             while time.monotonic() - t0 < timeout_s:
@@ -382,16 +407,28 @@ class JobSubmitter:
                 gen = self.coordinator.generation
                 if gen != seen_generation:
                     # SPMD fleet restart: kill survivors (they are wedged in
-                    # a broken collective), relaunch everyone
+                    # a broken collective), relaunch everyone.  Relaunch by
+                    # the coordinator's CURRENT identity map — a promoted
+                    # standby occupies its rank under its own id, and
+                    # relaunching the original launch name would collide
+                    # with it.  Unpromoted standbys are spared the kill:
+                    # they hold no collective state and stay warm.
                     seen_generation = gen
                     log.warning("fleet restart: generation %d — killing and "
                                 "relaunching all workers", gen)
-                    self._kill_fleet()
+                    self._kill_fleet(
+                        skip=set(self.coordinator.standby_ids()))
                     if self.coordinator.state not in (
                         JobState.FINISHED, JobState.FAILED
                     ):
+                        identity = self.coordinator.active_worker_ids()
+                        # a rank that never managed to register has no
+                        # identity yet — relaunch it under its original
+                        # launch name
                         for i, wid in enumerate(worker_ids):
-                            self._launch(wid, addr, index=i)
+                            identity.setdefault(i, wid)
+                        for i in sorted(identity):
+                            self._launch(identity[i], addr, index=i)
                     continue
                 # per-worker checkpoint-restart recovery (non-SPMD):
                 # relaunch failed workers that are within budget
@@ -403,6 +440,48 @@ class JobSubmitter:
                                     "(restart %d)", rec.worker_id,
                                     rec.restarts)
                         self._launch(rec.worker_id, addr)
+                # elastic grow (coordinator resize): active ranks with no
+                # registered worker get one launched here — the
+                # submitter's half of the grow actuator.  Gated on
+                # TRAINING: during initial registration EVERY rank is
+                # "pending" and already has its launch in flight; a
+                # resize can only happen once the fleet is up.  This
+                # covers refilled holes (a rank shrunk away earlier has
+                # no record left, so the relaunch path above cannot
+                # resurrect it) as well as ranks beyond the original
+                # width.
+                if state == JobState.TRAINING:
+                    pending = self.coordinator.pending_indices()
+                    # once a rank registers it leaves `grown`, so a rank
+                    # shrunk away and grown AGAIN later re-launches
+                    grown.intersection_update(pending)
+                    for idx in pending:
+                        if idx not in grown:
+                            wid = f"worker-{idx}"
+                            # a rank shrunk away earlier may still have
+                            # its released incarnation running (release
+                            # is delivered at its next barrier): two
+                            # live workers must never share one id — the
+                            # replacement would erase the old one's
+                            # release directive at registration and both
+                            # would train rank `idx`.  Kill + reap the
+                            # old process first; a thread cannot be
+                            # killed, so defer the launch until it exits
+                            # cooperatively (retried next poll).
+                            old_t = self._threads.get(wid)
+                            if old_t is not None and old_t.is_alive():
+                                continue
+                            old_p = self._procs.get(wid)
+                            if old_p is not None and old_p.poll() is None:
+                                self.kill_worker(wid)
+                                try:
+                                    old_p.wait(timeout=10.0)
+                                except subprocess.TimeoutExpired:
+                                    continue
+                            grown.add(idx)
+                            log.warning("elastic grow: launching %s for "
+                                        "rank %d", wid, idx)
+                            self._launch(wid, addr, index=idx)
                 time.sleep(self.poll_interval_s)
             else:
                 # job timeout: the bare message says nothing about WHICH
@@ -450,6 +529,7 @@ class JobSubmitter:
                 wall_time_s=wall,
                 stop_reason=self.coordinator.stop_reason,
                 rollbacks_used=self.coordinator._rollbacks,
+                promotions_used=len(self.coordinator.promotions),
                 # diagnostics snapshot BEFORE the fleet teardown below, so
                 # heartbeat ages / liveness still describe the failure,
                 # not the cleanup

@@ -61,6 +61,11 @@ class WorkerConfig:
     schema: RecordSchema
     # pins this worker to a cluster slot; None lets the coordinator pick
     worker_index: int | None = None
+    # "worker" | "standby": a standby registers rankless, pre-builds its
+    # model/optimizer (compile warm, no data shard), heartbeats, and
+    # long-polls the coordinator until a rank failure promotes it — then
+    # runs the normal worker lifecycle as that rank (docs/resilience.md)
+    role: str = "worker"
     batch_size: int = 100
     checkpoint_dir: str | None = None
     checkpoint_every_epochs: int = 1
@@ -130,7 +135,7 @@ class WorkerConfig:
             k: getattr(self, k)
             for k in (
                 "worker_id", "coordinator_host", "coordinator_port",
-                "worker_index", "batch_size", "checkpoint_dir",
+                "worker_index", "role", "batch_size", "checkpoint_dir",
                 "checkpoint_every_epochs", "valid_rate",
                 "heartbeat_interval_s", "mesh_spec", "seed", "dtype",
                 "spmd", "host", "stream", "n_readers", "decode_workers",
@@ -165,12 +170,17 @@ class WorkerConfig:
 
 
 class _HeartbeatThread(threading.Thread):
+    """``generation=None`` disables the fleet-restart watch: standbys
+    keep heartbeating across generation bumps — they are not collective
+    participants, and their promotion reply carries whatever generation
+    is current."""
+
     def __init__(
         self,
         client: CoordinatorClient,
         worker_id: str,
         interval_s: float,
-        generation: int = 0,
+        generation: int | None = 0,
     ):
         super().__init__(daemon=True)
         self.client = client
@@ -179,6 +189,11 @@ class _HeartbeatThread(threading.Thread):
         self.generation = generation
         self.abort = threading.Event()
         self.restart = threading.Event()
+        # the coordinator wrote this rank off (standby promoted into it,
+        # or an elastic shrink re-split its rows away) while this
+        # process was merely FLAPPED: exit cooperatively at the next
+        # epoch boundary instead of training a shard someone else owns
+        self.released = threading.Event()
         self._stop = threading.Event()
 
     def run(self) -> None:
@@ -188,6 +203,11 @@ class _HeartbeatThread(threading.Thread):
                 if resp.get("abort"):
                     self.abort.set()
                     return
+                if resp.get("released"):
+                    self.released.set()
+                    return
+                if self.generation is None:
+                    continue
                 if int(resp.get("generation", self.generation)) != self.generation:
                     # fleet restarted without us (we may be about to be
                     # killed by the submitter; exit cooperatively first)
@@ -219,6 +239,125 @@ def _stream_step_estimate(
     return max(1, int(math.ceil(min(bound, total_lines) / batch_size)))
 
 
+def _health_from_cfg(cfg: WorkerConfig, lr_scale: float = 1.0,
+                     skip: dict | None = None) -> HealthConfig:
+    """HealthConfig from the worker's knobs plus the coordinator's
+    rollback directive — one resolver shared by the normal lifecycle and
+    the standby pre-build, so the two cannot drift."""
+    skip = skip or {}
+    return HealthConfig(
+        check_finite=cfg.health_check_finite,
+        spike_factor=cfg.health_spike_factor,
+        spike_min_epochs=cfg.health_spike_min_epochs,
+        hang_timeout_s=cfg.health_hang_timeout_s,
+        lr_scale=lr_scale,
+        skip_epoch=(int(skip["epoch"]) if skip.get("epoch") is not None
+                    else None),
+        skip_steps=tuple(int(s) for s in (skip.get("steps") or ())),
+    )
+
+
+def _build_trainer(cfg: WorkerConfig, model_config, health, *,
+                   worker_index: int, mesh=None, topology=None):
+    """The one trainer-construction site (normal lifecycle AND standby
+    pre-build build through here)."""
+    extra = {}
+    if cfg.dtype:
+        import jax.numpy as jnp
+
+        extra["dtype"] = {"float32": jnp.float32,
+                          "bfloat16": jnp.bfloat16}[cfg.dtype]
+    # feature_columns must match what the export trainer will use, or
+    # wide/embedding column positions (and so the param tree) diverge
+    # between the trained checkpoint and the restored export model
+    return make_trainer(
+        model_config,
+        cfg.schema.num_features,
+        feature_columns=cfg.schema.feature_columns,
+        mesh=mesh,
+        worker_index=worker_index,
+        seed=cfg.seed,
+        topology=topology,
+        prefetch_depth=cfg.prefetch_depth,
+        scan_steps=cfg.scan_steps,
+        accum_steps=cfg.accum_steps,
+        keep_best=cfg.keep_best,
+        health=health,
+        **extra,
+    )
+
+
+def _standby_phase(cfg: WorkerConfig, client: CoordinatorClient):
+    """Hot-standby lifecycle until promotion: register rankless, pre-build
+    the model/optimizer and compile-warm the step functions (no data
+    shard touched), heartbeat, and long-poll ``standby_wait``.
+
+    Returns ``(promotion_reply, prebuilt_trainer_or_None)``; ``(None,
+    None)`` when the job ends without this standby being needed.  The
+    prebuild is best-effort — any failure just means the promoted rank
+    builds cold, exactly like a relaunched worker.
+    """
+    reg = client.register(cfg.worker_id, host=cfg.host, role="standby")
+    if not reg.get("ok"):
+        log.error("standby registration rejected: %s", reg.get("error"))
+        return None, None
+    hb = _HeartbeatThread(
+        client, cfg.worker_id, cfg.heartbeat_interval_s, generation=None
+    )
+    hb.start()
+    trainer = None
+    try:
+        if not bool(reg.get("spmd", cfg.spmd)):
+            # SPMD standbys stay un-built: the mesh spans processes that
+            # only exist once the (restarted) fleet forms; their compile
+            # warmth comes from the persistent compile cache instead
+            try:
+                mesh = None
+                if cfg.mesh_spec:
+                    from shifu_tensorflow_tpu.parallel.mesh import make_mesh
+
+                    mesh = make_mesh(cfg.mesh_spec)
+                trainer = _build_trainer(
+                    cfg, cfg.model_config, _health_from_cfg(cfg),
+                    worker_index=-1, mesh=mesh,
+                )
+                warmed = trainer.warm_step(
+                    cfg.batch_size,
+                    x_dtype=_np_feature_dtype(cfg) if cfg.stream else None,
+                )
+                log.info("standby %s pre-built and warmed %s",
+                         cfg.worker_id, warmed)
+            except Exception:
+                log.exception(
+                    "standby pre-build failed (%s); promotion will build "
+                    "cold", cfg.worker_id)
+                trainer = None
+        while True:
+            if hb.abort.is_set():
+                return None, None
+            try:
+                resp = client.standby_wait(cfg.worker_id, timeout_s=10.0)
+            except Exception:
+                # coordinator unreachable past the retry envelope: the
+                # job is gone — a standby exits quietly, it was never a
+                # rank anyone is waiting on
+                log.exception("standby %s lost the coordinator; exiting",
+                              cfg.worker_id)
+                return None, None
+            if resp.get("promoted"):
+                log.warning(
+                    "standby %s promoted into rank %s (generation %s)",
+                    cfg.worker_id, resp.get("worker_index"),
+                    resp.get("generation"),
+                )
+                return resp, trainer
+            if not resp.get("ok"):
+                # terminal job state (or we were never admitted)
+                return None, None
+    finally:
+        hb.stop()
+
+
 def run_worker(cfg: WorkerConfig, *,
                fail_at_epoch: int | None = None) -> int:
     """Full worker lifecycle; returns the exit code it reported.
@@ -226,6 +365,13 @@ def run_worker(cfg: WorkerConfig, *,
     ``fail_at_epoch`` is the built-in fault-injection hook (the reference
     only had a commented-out kill-PS-after-80s hack,
     CommonUtils.java:265-273): the worker aborts mid-job at that epoch.
+
+    ``cfg.role == "standby"`` prepends the hot-standby phase: register
+    rankless, pre-build + compile-warm, wait for a promotion — then run
+    this very lifecycle as the promoted rank (the re-registration is
+    sticky: the coordinator moved the standby's record into the dead
+    rank's slot, so the register below returns that rank's shard, epoch
+    state, and health directive).
     """
     from shifu_tensorflow_tpu.parallel import distributed as dist
 
@@ -239,6 +385,25 @@ def run_worker(cfg: WorkerConfig, *,
             retry_util.RetryPolicy.from_dict(cfg.retry)
         )
     client = CoordinatorClient(cfg.coordinator_host, cfg.coordinator_port)
+    prebuilt = None
+    promoted_from_standby = False
+    if cfg.role == "standby":
+        promoted_from_standby = True
+        promo, prebuilt = _standby_phase(cfg, client)
+        if promo is None:
+            # never promoted: the job ended (or refused us) — a clean,
+            # budget-free exit the coordinator logs as standby_exit
+            try:
+                client.complete(cfg.worker_id, 0)
+            except Exception:
+                pass
+            return 0
+        import dataclasses as _dc
+
+        # fall into the normal lifecycle AS the promoted rank: the
+        # sticky re-registration below returns the rank's shard/state
+        cfg = _dc.replace(cfg, role="worker",
+                          worker_index=int(promo["worker_index"]))
     # reserve a port for the jax coordination service up front: only the
     # chief's is used, but index assignment happens at registration.  The
     # reservation is HELD (socket open) until just before initialize binds
@@ -288,7 +453,14 @@ def run_worker(cfg: WorkerConfig, *,
         _obs_journal.emit("worker_start", plane="train",
                           worker=worker_index,
                           worker_id=cfg.worker_id,
-                          generation=int(reg.get("generation", 0)))
+                          generation=int(reg.get("generation", 0)),
+                          promoted=promoted_from_standby)
+        if promoted_from_standby:
+            _obs_journal.emit("standby_takeover", plane="train",
+                              worker=worker_index,
+                              worker_id=cfg.worker_id,
+                              prebuilt=prebuilt is not None,
+                              generation=int(reg.get("generation", 0)))
     shard_paths = reg["shard"]
     epochs = reg.get("epochs") or cfg.model_config.num_train_epochs
     sync_epochs = bool(reg.get("sync_epochs", False))
@@ -314,16 +486,7 @@ def run_worker(cfg: WorkerConfig, *,
             "(rollback %s)", lr_scale,
             model_config.params.learning_rate, directive.get("rollbacks"),
         )
-    health = HealthConfig(
-        check_finite=cfg.health_check_finite,
-        spike_factor=cfg.health_spike_factor,
-        spike_min_epochs=cfg.health_spike_min_epochs,
-        hang_timeout_s=cfg.health_hang_timeout_s,
-        lr_scale=lr_scale,
-        skip_epoch=(int(skip["epoch"]) if skip.get("epoch") is not None
-                    else None),
-        skip_steps=tuple(int(s) for s in (skip.get("steps") or ())),
-    )
+    health = _health_from_cfg(cfg, lr_scale=lr_scale, skip=skip)
 
     hb = _HeartbeatThread(
         client, cfg.worker_id, cfg.heartbeat_interval_s, generation
@@ -377,30 +540,31 @@ def run_worker(cfg: WorkerConfig, *,
 
             mesh = make_mesh(cfg.mesh_spec)
 
-        extra = {}
-        if cfg.dtype:
-            import jax.numpy as jnp
+        if (prebuilt is not None and not spmd and lr_scale == 1.0
+                and not skip):
+            # promoted standby, clean directive: the pre-built trainer's
+            # construction arguments are identical to what _build_trainer
+            # would produce here (same cfg, same health resolver), so the
+            # warm executables carry straight into the takeover.  A
+            # rollback directive (scaled LR / skip window) changes the
+            # construction inputs — build fresh then.
+            trainer = prebuilt
+            trainer.worker_index = worker_index
+            if trainer.health_guard is not None:
+                trainer.health_guard.worker_index = worker_index
+            # the standby built before install_obs ran: pick the plane up
+            # now, exactly like construction would have
+            from shifu_tensorflow_tpu.obs import trace as _obs_trace
 
-            extra["dtype"] = {"float32": jnp.float32,
-                              "bfloat16": jnp.bfloat16}[cfg.dtype]
-        # feature_columns must match what the export trainer will use, or
-        # wide/embedding column positions (and so the param tree) diverge
-        # between the trained checkpoint and the restored export model
-        trainer = make_trainer(
-            model_config,
-            cfg.schema.num_features,
-            feature_columns=cfg.schema.feature_columns,
-            mesh=mesh,
-            worker_index=worker_index,
-            seed=cfg.seed,
-            topology=topology,
-            prefetch_depth=cfg.prefetch_depth,
-            scan_steps=cfg.scan_steps,
-            accum_steps=cfg.accum_steps,
-            keep_best=cfg.keep_best,
-            health=health,
-            **extra,
-        )
+            trainer.tracer = _obs_trace.active()
+            from shifu_tensorflow_tpu.obs import slo as _obs_slo
+
+            trainer.slo = _obs_slo.active()
+        else:
+            trainer = _build_trainer(
+                cfg, model_config, health,
+                worker_index=worker_index, mesh=mesh, topology=topology,
+            )
         if private_tracer is not None:
             trainer.tracer = private_tracer
         if trainer.health_guard is not None:
@@ -487,6 +651,11 @@ def run_worker(cfg: WorkerConfig, *,
     except _FleetRestart:
         log.info("exiting for fleet restart (worker_index=%s)", worker_index)
         exit_code = RESTART_EXIT_CODE
+    except _Released:
+        # elastic resize released this rank: a clean exit, not a failure
+        log.info("released by elastic resize (worker_index=%s)",
+                 worker_index)
+        exit_code = 0
     except _JobAborted:
         log.warning("job aborted by coordinator (worker_index=%s)",
                     worker_index)
@@ -545,6 +714,23 @@ class _FleetStopSignal:
         return None
 
 
+class _ShardState:
+    """Mutable view of this worker's shard assignment: the streaming
+    epoch factories read ``paths`` per epoch, so an elastic re-split
+    delivered at the epoch barrier takes effect at the very next epoch
+    without restarting the worker.  ``split_generation`` is echoed on
+    every barrier call — the coordinator compares (never stores), so a
+    lost resplit reply just redelivers at the next barrier."""
+
+    def __init__(self, paths):
+        self.paths = list(paths)
+        self.split_generation = 0
+
+    def apply(self, directive: dict) -> None:
+        self.paths[:] = list(directive.get("shard") or self.paths)
+        self.split_generation = int(directive.get("split_generation", 0))
+
+
 def _epoch_callback(
     cfg: WorkerConfig,
     client: CoordinatorClient,
@@ -553,21 +739,51 @@ def _epoch_callback(
     sync_epochs: bool,
     fail_at_epoch: int | None,
     fleet_stop: "_FleetStopSignal | None" = None,
+    shard_state: "_ShardState | None" = None,
 ) -> Callable:
     def on_epoch(stats) -> None:
         if hb.abort.is_set():
             raise _JobAborted()
         if hb.restart.is_set():
             raise _FleetRestart()
+        if hb.released.is_set():
+            # heartbeat-borne release (the barrier-borne one below only
+            # reaches sync_epochs fleets)
+            raise _Released()
         if fail_at_epoch is not None and stats.current_epoch >= fail_at_epoch:
             raise _InjectedFault()
         client.report_epoch(stats)
         if sync_epochs:
-            resp = client.epoch_barrier(cfg.worker_id, stats.current_epoch)
+            resp = client.epoch_barrier(
+                cfg.worker_id, stats.current_epoch,
+                split_generation=(shard_state.split_generation
+                                  if shard_state is not None else None),
+            )
             if resp.get("abort"):
                 raise _JobAborted()
+            if resp.get("released"):
+                # resize shrink: this rank left the membership — stop
+                # cleanly instead of training a shard someone else owns
+                raise _Released()
             if not resp.get("ok"):
                 raise RuntimeError(resp.get("error", "epoch barrier failed"))
+            directive = resp.get("resplit")
+            if directive and shard_state is not None:
+                shard_state.apply(directive)
+                log.warning(
+                    "elastic re-split applied (split generation %d): "
+                    "%d path(s); takes effect next epoch",
+                    shard_state.split_generation, len(shard_state.paths),
+                )
+                from shifu_tensorflow_tpu.obs import journal as _obs_journal
+
+                _obs_journal.emit(
+                    "resplit_applied", plane="train",
+                    worker=stats.worker_index,
+                    split_generation=shard_state.split_generation,
+                    n_paths=len(shard_state.paths),
+                    n_workers=directive.get("n_workers"),
+                )
             if fleet_stop is not None and "stop_after_epoch" in resp:
                 fleet_stop.stop_after = int(resp["stop_after_epoch"])
                 fleet_stop.reason = resp.get("stop_reason")
@@ -581,11 +797,19 @@ def _run_local_training(
     fail_at_epoch,
 ) -> int:
     """Independent-model path (non-SPMD): each worker trains on its shard;
-    only the chief's checkpoint is exported."""
+    only the chief's checkpoint is exported.
+
+    The shard lives in a mutable _ShardState: an elastic re-split
+    delivered at the epoch barrier re-points the STREAMING epoch
+    factories at the new shard from the next epoch on (the in-memory
+    path loaded its data up front — it picks a re-split up on relaunch,
+    its coordinator record already carries the new shard)."""
+    shard_state = _ShardState(shard_paths)
     fleet_stop = _FleetStopSignal() if sync_epochs else None
     on_epoch = _epoch_callback(
         cfg, client, hb, sync_epochs=sync_epochs,
         fail_at_epoch=fail_at_epoch, fleet_stop=fleet_stop,
+        shard_state=shard_state,
     )
     start_epoch = 0
     if checkpointer is not None:
@@ -597,7 +821,7 @@ def _run_local_training(
         widths, stats_sink = _ingest_setup(cfg, trainer)
         trainer.fit_stream(
             lambda epoch: ShardStream(
-                shard_paths, cfg.schema, batch_size,
+                list(shard_state.paths), cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="train", salt=cfg.seed,
                 cache_dir=cfg.cache_dir,
                 feature_dtype=_feature_dtype_for(cfg),
@@ -606,7 +830,7 @@ def _run_local_training(
                 stats_sink=stats_sink, **widths(),
             ),
             (lambda: ShardStream(
-                shard_paths, cfg.schema, batch_size,
+                list(shard_state.paths), cfg.schema, batch_size,
                 valid_rate=valid_rate, emit="valid", salt=cfg.seed,
                 cache_dir=cfg.cache_dir,
                 feature_dtype=_feature_dtype_for(cfg),
@@ -839,3 +1063,7 @@ class _JobAborted(RuntimeError):
 
 class _FleetRestart(RuntimeError):
     pass
+
+
+class _Released(RuntimeError):
+    """Elastic resize removed this rank from the membership."""

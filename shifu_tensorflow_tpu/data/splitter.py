@@ -58,11 +58,25 @@ def split_round_robin(paths: list[str], num_workers: int) -> list[Shard]:
     ]
 
 
-def split_size_aware(paths: list[str], num_workers: int) -> list[Shard]:
+def split_size_aware(
+    paths: list[str], num_workers: int,
+    sizes: dict[str, int] | None = None,
+) -> list[Shard]:
     """Greedy LPT: largest file first onto the lightest worker — the upgrade
-    the reference's TODO requests (TrainingDataSet.java:32-34)."""
+    the reference's TODO requests (TrainingDataSet.java:32-34).
+
+    ``sizes`` lets a caller supply pre-gathered byte sizes (falling back
+    to a live stat per missing path) — the coordinator's elastic
+    re-split runs under its serving lock, and one stat per data file on
+    a slow filesystem there would stall heartbeats long enough to expire
+    healthy workers mid-recovery."""
     _check(paths, num_workers)
-    sized = sorted(((_size_safe(p), p) for p in paths), reverse=True)
+    if sizes is None:
+        sizes = {}
+    sized = sorted(
+        ((sizes[p] if p in sizes else _size_safe(p), p) for p in paths),
+        reverse=True,
+    )
     heap: list[tuple[int, int]] = [(0, w) for w in range(num_workers)]
     heapq.heapify(heap)
     buckets: list[list[str]] = [[] for _ in range(num_workers)]

@@ -452,6 +452,10 @@ def _serve_data(events: list[dict]) -> dict:
         "_shed_max": {},
     })
     fleet = {"workers": None, "restarts": 0}
+    # autoscaler decisions (scale_up / scale_down / rebalance), in
+    # journal order with their evidence — the dead-fleet reconstruction
+    # of the supervisor's control loop
+    autoscale: list = []
     # per-MODEL aggregation (multi-tenant serve: events carry a `model`
     # dimension) — rows/batches from serve_batch, tenancy lifecycle
     # from model_admit/model_evict/model_admit_failed.  Rows
@@ -512,8 +516,19 @@ def _serve_data(events: list[dict]) -> dict:
                 mm["evicts"] += 1
         elif kind == "serve_fleet_start":
             fleet["workers"] = ev.get("workers")
+            fleet["workers_max"] = ev.get("workers_max")
+            fleet["autoscale"] = ev.get("autoscale")
         elif kind in ("serve_worker_restart",):
             fleet["restarts"] += 1
+        elif kind in ("scale_up", "scale_down", "rebalance"):
+            autoscale.append({
+                "action": kind,
+                "ts": ev.get("ts"),
+                "to_workers": ev.get("to_workers"),
+                "model": ev.get("model"),
+                "weight": ev.get("weight"),
+                "reason": ev.get("reason"),
+            })
     rows = {}
     for w, a in per.items():
         if (a["start_ts"] is None and a["requests"] is None
@@ -534,7 +549,8 @@ def _serve_data(events: list[dict]) -> dict:
                       if k not in ("start_ts", "stop_ts", "_shed_max")},
                    "req_per_s": rate}
     return {"fleet": fleet, "workers": rows,
-            "models": {m: dict(v) for m, v in sorted(models.items())}}
+            "models": {m: dict(v) for m, v in sorted(models.items())},
+            "autoscale": autoscale}
 
 
 def _render_serve(data: dict) -> list[str]:
@@ -544,9 +560,20 @@ def _render_serve(data: dict) -> list[str]:
     models = data.get("models") or {}
     lines = []
     if fleet["workers"]:
-        lines.append(f"  fleet: {fleet['workers']} workers"
-                     + (f", {fleet['restarts']} restart(s)"
-                        if fleet["restarts"] else ""))
+        line = f"  fleet: {fleet['workers']} workers"
+        if fleet.get("autoscale") and fleet.get("workers_max"):
+            line += f" (autoscaling up to {fleet['workers_max']})"
+        if fleet["restarts"]:
+            line += f", {fleet['restarts']} restart(s)"
+        lines.append(line)
+    for d in data.get("autoscale") or []:
+        if d["action"] == "rebalance":
+            what = (f"tenant {d['model']} weight -> {d['weight']:g}"
+                    if d.get("weight") is not None else "weights")
+        else:
+            what = f"-> {d['to_workers']} workers"
+        lines.append(f"  autoscale: {d['action']} {what}"
+                     + (f"  ({d['reason']})" if d.get("reason") else ""))
     if not rows:
         # a fleet whose workers all died before serve_start (crash
         # loop: bad artifact, stolen port) has no per-worker rows, but
@@ -1255,12 +1282,17 @@ def cmd_diff(args) -> int:
 def _fleet_data(events: list[dict]) -> dict:
     """Per-rank skew state + straggler excursions from the coordinator's
     ``fleet_skew`` / ``straggler_detect`` / ``straggler_clear`` events,
-    plus the per-epoch ``comm`` drains — entirely from journal files, so
-    a dead fleet's straggler story reconstructs on a jax-free laptop."""
+    plus the per-epoch ``comm`` drains, standby-promotion takeovers
+    (``standby_promote`` / ``standby_claim``) and elastic re-splits —
+    entirely from journal files, so a dead fleet's straggler AND
+    takeover story reconstructs on a jax-free laptop."""
     ranks: dict = {}
     excursions: list[dict] = []
     open_exc: dict = {}
     comm: dict = defaultdict(lambda: {"calls": 0, "bytes": 0})
+    promotions: list[dict] = []
+    resplits: list[dict] = []
+    standbys: set = set()
     epochs = 0
     straggler = None
     max_skew = None
@@ -1297,7 +1329,35 @@ def _fleet_data(events: list[dict]) -> dict:
             for k, v in (ev.get("kinds") or {}).items():
                 comm[k]["calls"] += int(v.get("calls", 0) or 0)
                 comm[k]["bytes"] += int(v.get("bytes", 0) or 0)
-    if not ranks and not excursions and not comm:
+        elif kind == "standby_register":
+            standbys.add(ev.get("worker_id"))
+        elif kind == "standby_promote":
+            promotions.append({
+                "worker": ev.get("worker"),
+                "standby_id": ev.get("worker_id"),
+                "old_id": ev.get("old_worker_id"),
+                "epoch": ev.get("epoch"),
+                "why": ev.get("why"),
+                "hb_age_s": ev.get("hb_age_s"),
+                "promote_ts": ev.get("ts"),
+                "latency_s": None,
+            })
+        elif kind == "standby_claim":
+            for p in reversed(promotions):
+                if (p["standby_id"] == ev.get("worker_id")
+                        and p["latency_s"] is None):
+                    p["latency_s"] = ev.get("latency_s")
+                    break
+        elif kind == "resplit":
+            resplits.append({
+                "split_generation": ev.get("split_generation"),
+                "ranks": ev.get("ranks"),
+                "n_files": ev.get("n_files"),
+                "why": ev.get("why"),
+                "ts": ev.get("ts"),
+            })
+    if (not ranks and not excursions and not comm and not promotions
+            and not resplits and not standbys):
         return {}
     def rank_key(kv):
         # ranks are JSON string keys: numeric order, not "0,1,10,11,2"
@@ -1313,6 +1373,9 @@ def _fleet_data(events: list[dict]) -> dict:
         "straggler": straggler,
         "max_skew": max_skew,
         "comm": {k: dict(v) for k, v in sorted(comm.items())},
+        "standbys": sorted(s for s in standbys if s),
+        "promotions": promotions,
+        "resplits": resplits,
     }
 
 
@@ -1350,6 +1413,24 @@ def _render_fleet(data: dict, t0: float) -> list[str]:
             f"{e.get('phase', '?')}")
     if not data["excursions"] and data["ranks"]:
         lines.append("  no straggler excursions")
+    # elastic fleet: standby promotions render beside the straggler
+    # excursions — rank, epoch, takeover latency, and why
+    if data.get("standbys"):
+        lines.append(f"  standbys registered: "
+                     f"{', '.join(data['standbys'])}")
+    for p in data.get("promotions") or []:
+        when = ""
+        if p.get("promote_ts") is not None:
+            when = f"+{p['promote_ts'] - t0:.1f}s  "
+        lat = ("takeover pending" if p.get("latency_s") is None
+               else f"takeover {p['latency_s']:.2f}s")
+        lines.append(
+            f"  promotion: rank {p['worker']} <- {p['standby_id']}  "
+            f"{when}@epoch {p.get('epoch')}  {lat}  ({p.get('why')})")
+    for r in data.get("resplits") or []:
+        lines.append(
+            f"  resplit: generation {r['split_generation']} over ranks "
+            f"{r['ranks']} ({r['n_files']} file(s); {r.get('why')})")
     if data["comm"]:
         lines.append("  collective      calls     bytes")
         for k, v in data["comm"].items():

@@ -336,6 +336,93 @@ def iter_events(path: str) -> Iterator[dict]:
                 yield ev
 
 
+def read_keyed_events(
+    base: str, cache: dict | None = None,
+    after: dict | None = None,
+) -> list[tuple[float, tuple, int, dict]]:
+    """``read_events`` plus each event's merge key: ``(ts, writer, seq,
+    event)`` tuples in merged order.  ``writer`` is the file-derived
+    identity (``(-1, -1)`` for the base file, ``(0, k)`` for ``.w<k>``,
+    ``(1, k)`` for ``.s<k>``) and ``(ts, seq)`` is monotonic WITHIN a
+    writer — the contract an incremental poller needs to keep a
+    per-writer high-water mark that survives late file flushes and
+    rotation dropping old files (a global list index does neither: a
+    slow writer's events can merge BEFORE an already-seen tail, and
+    rotation can shrink the list below the index).
+
+    ``after`` (writer -> (ts, seq) watermark) makes the RETURN
+    incremental too: only events past each writer's mark are keyed,
+    sorted, and returned, and an unchanged file whose whole key span
+    sits at or below the mark is skipped without iterating its parsed
+    events — so a steady-state poller (the serve autoscaler) pays per
+    tick for the new tail, not an O(total-events) rebuild of history."""
+    base = os.fspath(base)
+    pat = re.compile(
+        re.escape(os.path.basename(base)) + r"(\.([ws])(\d+))?(\.\d+)?$"
+    )
+    keyed: list[tuple[float, tuple, int, dict]] = []
+    positions: dict[tuple, int] = {}
+    for path in journal_files(base):
+        m = pat.fullmatch(os.path.basename(path))
+        writer = ((-1, -1) if not m or not m.group(2)
+                  else ({"w": 0, "s": 1}[m.group(2)], int(m.group(3))))
+        mark = after.get(writer) if after is not None else None
+        if cache is not None:
+            try:
+                st = os.stat(path)
+                # st_ino travels WITH the content across a rotation
+                # rename (path -> path.1 keeps the inode): on a
+                # coarse-mtime filesystem two successive rotations can
+                # leave path.1 with the same (size, mtime) as its
+                # previous occupant, and without the inode the cache
+                # would serve the older file's parsed events as the new
+                # one's
+                sig = (st.st_size, st.st_mtime_ns, st.st_ino)
+            except OSError:
+                continue
+            if mark is not None:
+                # key-span sidecar entry (tuple key — invisible to the
+                # plain-path lookups above): an unchanged file fully at
+                # or below the watermark contributes nothing; only its
+                # event count matters (the pos fallback for any later
+                # file of the same writer)
+                span = cache.get(("span", path))
+                if (span is not None and span[0] == sig
+                        and span[2] <= mark):
+                    positions[writer] = (
+                        positions.get(writer, 0) + span[1])
+                    continue
+            hit = cache.get(path)
+            if hit is not None and hit[0] == sig:
+                parsed = hit[1]
+            else:
+                parsed = list(iter_events(path))
+                cache[path] = (sig, parsed)
+        else:
+            parsed = iter_events(path)
+        pos = positions.get(writer, 0)
+        all_seq = True
+        max_key = (-1.0, -1)
+        for ev in parsed:
+            seq = ev.get("seq")
+            if not isinstance(seq, int):
+                # pos-keyed legacy event: its key depends on preceding
+                # files' counts, so this file never earns a span entry
+                all_seq = False
+                seq = pos
+            key = (ev.get("ts", 0.0), seq)
+            if key > max_key:
+                max_key = key
+            if mark is None or key > mark:
+                keyed.append((key[0], writer, seq, ev))
+            pos += 1
+        positions[writer] = pos
+        if cache is not None and all_seq:
+            cache[("span", path)] = (sig, len(parsed), max_key)
+    keyed.sort(key=lambda t: t[:3])
+    return keyed
+
+
 def read_events(base: str, cache: dict | None = None) -> list[dict]:
     """All intact events of the journal (every writer, every rotation),
     merged oldest-first by ``(ts, writer, seq)``.
@@ -354,45 +441,4 @@ def read_events(base: str, cache: dict | None = None) -> list[dict]:
     rotated files are immutable, so a poller like ``obs top`` pays only
     for the growing active file per refresh, not the whole rotation
     set."""
-    base = os.fspath(base)
-    pat = re.compile(
-        re.escape(os.path.basename(base)) + r"(\.([ws])(\d+))?(\.\d+)?$"
-    )
-    keyed: list[tuple[float, tuple, int, dict]] = []
-    positions: dict[tuple, int] = {}
-    for path in journal_files(base):
-        m = pat.fullmatch(os.path.basename(path))
-        writer = ((-1, -1) if not m or not m.group(2)
-                  else ({"w": 0, "s": 1}[m.group(2)], int(m.group(3))))
-        if cache is not None:
-            try:
-                st = os.stat(path)
-                # st_ino travels WITH the content across a rotation
-                # rename (path -> path.1 keeps the inode): on a
-                # coarse-mtime filesystem two successive rotations can
-                # leave path.1 with the same (size, mtime) as its
-                # previous occupant, and without the inode the cache
-                # would serve the older file's parsed events as the new
-                # one's
-                sig = (st.st_size, st.st_mtime_ns, st.st_ino)
-            except OSError:
-                continue
-            hit = cache.get(path)
-            if hit is not None and hit[0] == sig:
-                parsed = hit[1]
-            else:
-                parsed = list(iter_events(path))
-                cache[path] = (sig, parsed)
-        else:
-            parsed = iter_events(path)
-        pos = positions.get(writer, 0)
-        for ev in parsed:
-            seq = ev.get("seq")
-            keyed.append((
-                ev.get("ts", 0.0), writer,
-                seq if isinstance(seq, int) else pos, ev,
-            ))
-            pos += 1
-        positions[writer] = pos
-    keyed.sort(key=lambda t: t[:3])
-    return [t[3] for t in keyed]
+    return [t[3] for t in read_keyed_events(base, cache=cache)]

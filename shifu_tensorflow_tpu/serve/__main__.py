@@ -85,6 +85,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "SO_REUSEPORT (shifu.tpu.serve-workers); a parent "
                         "supervisor drains them on SIGTERM and restarts "
                         "crashes.  1 = single process (default)")
+    p.add_argument("--serve-workers-max", type=int, default=None,
+                   dest="serve_workers_max",
+                   help="autoscaler ceiling (shifu.tpu.serve-workers-max):"
+                        " with a value above --serve-workers, the "
+                        "supervisor scales SO_REUSEPORT workers between "
+                        "the two from the journaled SLO/shed signals "
+                        "(sustained breach grows, sustained recovery "
+                        "shrinks, single-tenant overload rebalances that "
+                        "tenant's weight first).  Needs --obs-journal.  "
+                        "0 = off (default)")
+    p.add_argument("--autoscale-cooldown", type=float, default=None,
+                   dest="autoscale_cooldown",
+                   help="seconds the autoscaler holds still after any "
+                        "decision (shifu.tpu.serve-autoscale-cooldown)")
+    p.add_argument("--autoscale-poll", type=float, default=None,
+                   dest="autoscale_poll",
+                   help="autoscaler tick cadence in seconds "
+                        "(shifu.tpu.serve-autoscale-poll)")
+    p.add_argument("--supervisor-port", type=int, default=None,
+                   dest="supervisor_port",
+                   help="supervisor /metrics listener port "
+                        "(shifu.tpu.serve-supervisor-port): scrapes "
+                        "stpu_serve_scale_* gauges — live worker count, "
+                        "ceiling, scale/rebalance totals, restart-budget "
+                        "remaining and per-window burn.  0 = off")
     p.add_argument("--no-warm", action="store_true", dest="no_warm",
                    help="skip the bucket-ladder pre-warm at startup and "
                         "on reload admits (diagnostic/benchmark arm: "
@@ -145,7 +170,14 @@ def main(argv: list[str] | None = None) -> int:
         import uuid as _uuid
 
         job_id = args.obs_job or _uuid.uuid4().hex[:8]
-        if config.workers > 1 and args.serve_worker_index is None:
+        needs_supervisor = (
+            config.workers > 1
+            # an autoscale ceiling needs the supervisor even at one
+            # worker: the policy loop and the spawn/drain actuators
+            # live there
+            or (config.workers_max or 0) > config.workers
+        )
+        if needs_supervisor and args.serve_worker_index is None:
             # multi-process scale-out: this invocation becomes the
             # supervisor, each scoring process is a re-exec of this CLI
             # with --worker-index set (and the SAME argv otherwise, so
@@ -305,11 +337,55 @@ def _probe_port(host: str):
     return s, int(s.getsockname()[1])
 
 
+def _start_supervisor_metrics(host: str, port: int, render):
+    """Tiny /metrics-only HTTP listener on the supervisor process: the
+    fleet's control-loop state (worker count, scale totals, restart-
+    budget remaining + per-window burn) as stpu_serve_scale_* gauges —
+    the sliding-window restart budget was previously invisible until it
+    exhausted at rc 4.  Returns (server, bound_port) or (None, 0)."""
+    import http.server
+    import socketserver
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_error(404)
+                return
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # stdout carries the JSON contract
+            pass
+
+    class Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Srv((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, int(srv.server_address[1])
+
+
 def _supervise(argv: list[str], config, obs_cfg,
                job_id: str | None = None) -> int:
     """Parent of ``--serve-workers N``: spawn N scoring processes
     sharing one SO_REUSEPORT port, restart crashes (bounded), propagate
-    SIGTERM as a fleet-wide drain, and aggregate the final summary."""
+    SIGTERM as a fleet-wide drain, and aggregate the final summary.
+
+    With ``serve-workers-max > serve-workers`` (and an obs journal) it
+    ALSO runs the elastic control loop (serve/autoscale.py): the policy
+    reads the fleet's own journaled SLO/shed signals and the supervisor
+    applies its decisions — spawn another SO_REUSEPORT worker
+    (``scale_up``), SIGTERM-drain one back (``scale_down``), or roll the
+    fleet onto new ``--tenant-weight`` overrides (``rebalance``) —
+    journaling every decision with its triggering evidence, so a dead
+    fleet's scaling story reconstructs from the files alone."""
     import signal
     import threading
     import time as _time
@@ -332,10 +408,61 @@ def _supervise(argv: list[str], config, obs_cfg,
     # the fleet's lifetime: sporadic single-worker deaths spaced hours
     # apart are transients a long-lived fleet must absorb, while a
     # crashing artifact burns through the window's budget in seconds
-    restart_budget = max(5, 2 * n)
+    restart_budget = max(5, 2 * max(n, config.workers_max or n))
     restart_window_s = 600.0
     recent_restarts: list[float] = []  # monotonic ts, pruned to window
     restarts = 0  # lifetime total, for the journal + summary only
+
+    def budget_remaining() -> int:
+        # read-only on purpose: /metrics scrapes call this from HTTP
+        # threads, and a prune-by-assignment here could race the main
+        # loop's append and erase a just-burned restart.  Only the main
+        # loop (the sole appender) prunes.
+        now = _time.monotonic()
+        live = sum(1 for t in recent_restarts
+                   if now - t < restart_window_s)
+        return max(0, restart_budget - live)
+
+    # ---- elastic control loop ----
+    autoscale = bool(config.workers_max and config.workers_max > n)
+    policy = None
+    signals = None
+    if autoscale:
+        if not obs_cfg.journal_path:
+            print(f"autoscale disabled: serve-workers-max="
+                  f"{config.workers_max} needs an obs journal "
+                  f"(--obs-journal) — the SLO/shed signals live there",
+                  file=sys.stderr)
+            autoscale = False
+        else:
+            from shifu_tensorflow_tpu.serve.autoscale import (
+                AutoscaleConfig,
+                AutoscalePolicy,
+                JournalSignals,
+            )
+
+            policy = AutoscalePolicy(AutoscaleConfig(
+                workers_min=n,
+                workers_max=config.workers_max,
+                ticks=config.autoscale_ticks,
+                recovery_ticks=config.autoscale_recovery_ticks,
+                cooldown_s=config.autoscale_cooldown_s,
+            ))
+            signals = JournalSignals(obs_cfg.journal_path)
+    scale_totals = {"scale_up": 0, "scale_down": 0, "rebalance": 0}
+
+    def worker_argv() -> list[str]:
+        # the policy OWNS the weight-override state (observe() applies
+        # the backoff/floor there); every spawn — scale_up, crash
+        # restart, rolling rebalance — reads the one copy, so the
+        # policy's view and the workers' flags cannot drift
+        extra: list[str] = []
+        if policy is not None:
+            for m, w in sorted(policy.weight_overrides.items()):
+                # appended LAST so argparse's append-and-last-wins merge
+                # lets the override beat any operator-passed weight
+                extra += ["--tenant-weight", f"{m}={w:g}"]
+        return [*argv, *extra]
 
     stop = threading.Event()
     stopping: list[int] = []
@@ -355,13 +482,95 @@ def _supervise(argv: list[str], config, obs_cfg,
     # try matters: if worker k's fork fails, workers 0..k-1 are already
     # listening on the shared port and must not be orphaned.
     workers: list[_Worker] = []
+    expected_exits: set = set()  # _Worker objects we terminated on purpose
+    # rebalance rolling restart, advanced ONE step per monitor tick (a
+    # blocking roll would stall crash detection for minutes: an
+    # unrelated worker dying at the start of the roll must still be
+    # restarted within one poll)
+    roll_queue: list[int] = []   # worker indices still to roll
+    roll_in_flight: "_Worker | None" = None  # replacement warming up
+    roll_old: "_Worker | None" = None        # retiring copy, still serving
+    roll_deadline = 0.0
     rc: int | None = None
     drain_rc = 0
+    metrics_srv = None
+
+    def render_metrics() -> str:
+        from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.set_gauge("scale_workers", len(workers))
+        reg.set_gauge("scale_workers_min", n)
+        reg.set_gauge("scale_workers_max", config.workers_max or n)
+        reg.set_gauge("scale_autoscale_enabled", int(autoscale))
+        reg.set_gauge("scale_ups_total", scale_totals["scale_up"])
+        reg.set_gauge("scale_downs_total", scale_totals["scale_down"])
+        reg.set_gauge("scale_rebalances_total",
+                      scale_totals["rebalance"])
+        if policy is not None:
+            reg.set_gauge("scale_cooldown_remaining_s",
+                          round(policy.cooldown_remaining_s(), 3))
+        reg.set_gauge("restart_budget", restart_budget)
+        reg.set_gauge("restart_budget_remaining", budget_remaining())
+        reg.set_gauge("restart_budget_burn_window",
+                      restart_budget - budget_remaining())
+        reg.set_gauge("restarts_total", restarts)
+        return reg.render_prometheus("stpu_serve_")
+
+    def apply_decision(decision) -> None:
+        nonlocal workers
+        ev = {
+            "reason": decision.reason,
+            "workers": len(workers),
+            "budget_remaining": budget_remaining(),
+            **{f"evidence_{k}": v
+               for k, v in decision.evidence.items()},
+        }
+        if decision.action == "scale_up":
+            idx = min(i for i in range(len(workers) + 1)
+                      if i not in {w.index for w in workers})
+            w = _Worker(idx, worker_argv(), port, job_id)
+            workers.append(w)
+            scale_totals["scale_up"] += 1
+            obs_journal.emit("scale_up", plane="serve", index=idx,
+                             to_workers=len(workers), **ev)
+            print(f"autoscale: scale_up -> {len(workers)} workers "
+                  f"(worker {idx}; {decision.reason})", file=sys.stderr)
+        elif decision.action == "scale_down":
+            victim = max(workers, key=lambda w: w.index)
+            workers = [w for w in workers if w is not victim]
+            expected_exits.add(victim)
+            if victim.proc.poll() is None:
+                victim.proc.terminate()
+            scale_totals["scale_down"] += 1
+            obs_journal.emit("scale_down", plane="serve",
+                             index=victim.index,
+                             to_workers=len(workers), **ev)
+            print(f"autoscale: scale_down -> {len(workers)} workers "
+                  f"(drained worker {victim.index}; {decision.reason})",
+                  file=sys.stderr)
+        elif decision.action == "rebalance":
+            # the policy already recorded the new weight in its
+            # weight_overrides (the single owner worker_argv reads)
+            scale_totals["rebalance"] += 1
+            obs_journal.emit("rebalance", plane="serve",
+                             model=decision.model,
+                             weight=decision.weight, **ev)
+            print(f"autoscale: rebalance tenant {decision.model} "
+                  f"weight -> {decision.weight:g} (rolling restart; "
+                  f"{decision.reason})", file=sys.stderr)
+            # rolling restart onto the new weights: enqueued, not run
+            # inline — the monitor loop advances it one worker per tick
+            # (waiting for each replacement to listen before the next),
+            # so crash detection keeps its 0.2s poll during the roll
+            roll_queue[:] = sorted(w.index for w in workers)
+
     try:
         for i in range(n):
-            workers.append(_Worker(i, argv, port, job_id))
+            workers.append(_Worker(i, worker_argv(), port, job_id))
         obs_journal.emit("serve_fleet_start", plane="serve", port=port,
-                         workers=n)
+                         workers=n, workers_max=config.workers_max or n,
+                         autoscale=autoscale)
         # listening barrier: every worker up (or one dead = fail fast —
         # a fleet that can only half-listen mis-advertises its capacity)
         deadline = _time.monotonic() + 180.0
@@ -389,22 +598,29 @@ def _supervise(argv: list[str], config, obs_cfg,
             probe.close()
             probe = None
         if ready:
+            if config.supervisor_port:
+                metrics_srv, mport = _start_supervisor_metrics(
+                    config.host, config.supervisor_port, render_metrics)
+                print(f"[supervisor] /metrics on port {mport}",
+                      file=sys.stderr)
             print(json.dumps({
                 "state": "listening", "host": config.host, "port": port,
                 "workers": n,
+                "workers_max": config.workers_max or n,
+                "autoscale": autoscale,
             }), flush=True)
+            next_tick = _time.monotonic() + (
+                config.autoscale_poll_s if autoscale else 0.0)
             while not stop.wait(0.2):
-                for i, w in enumerate(workers):
+                for i, w in enumerate(list(workers)):
                     if w.proc.poll() is None:
                         continue
                     # unprompted exit = crash (clean or not, a scoring
                     # process has no business leaving on its own)
                     obs_journal.emit("serve_worker_exit", plane="serve",
-                                     index=w.index, rc=w.proc.returncode)
-                    now = _time.monotonic()
-                    recent_restarts = [t for t in recent_restarts
-                                       if now - t < restart_window_s]
-                    if len(recent_restarts) >= restart_budget:
+                                     index=w.index, rc=w.proc.returncode,
+                                     budget_remaining=budget_remaining())
+                    if budget_remaining() <= 0:
                         print(f"serve worker {w.index} died (rc="
                               f"{w.proc.returncode}) with the restart "
                               f"budget ({restart_budget} per "
@@ -414,22 +630,87 @@ def _supervise(argv: list[str], config, obs_cfg,
                         stop.set()
                         break
                     restarts += 1
-                    recent_restarts.append(now)
+                    now = _time.monotonic()
+                    # prune HERE, the sole appender (budget_remaining
+                    # is read-only so /metrics threads can't race this)
+                    recent_restarts[:] = [
+                        t for t in recent_restarts
+                        if now - t < restart_window_s
+                    ] + [now]
                     _time.sleep(0.5)  # a crashing artifact busy-loops
-                    workers[i] = _Worker(w.index, argv, port, job_id)
+                    workers[workers.index(w)] = _Worker(
+                        w.index, worker_argv(), port, job_id)
                     obs_journal.emit("serve_worker_restart", plane="serve",
-                                     index=w.index, restarts=restarts)
+                                     index=w.index, restarts=restarts,
+                                     budget_remaining=budget_remaining())
                     print(f"restarted serve worker {w.index} "
                           f"({restarts}/{restart_budget})", file=sys.stderr)
+                # reap expected (scaled-down / rolled) workers quietly
+                for w in list(expected_exits):
+                    if w.proc.poll() is not None:
+                        w._reader.join(timeout=5.0)
+                        expected_exits.discard(w)
+                # advance the rolling rebalance, one index at a time:
+                # make-before-break over SO_REUSEPORT — spawn the
+                # replacement on the new weights, wait for it to
+                # listen, only then drain the old copy, so capacity
+                # never dips mid-roll
+                if roll_in_flight is not None:
+                    if roll_in_flight.listening.is_set():
+                        if roll_old.proc.poll() is None:
+                            roll_old.proc.terminate()
+                        roll_in_flight = roll_old = None
+                    elif (roll_in_flight.proc.poll() is not None
+                          or _time.monotonic() > roll_deadline):
+                        # replacement crashed or wedged before
+                        # listening: the crash path above owns its
+                        # respawn (worker_argv already carries the new
+                        # weights) — drain the old copy and abandon
+                        # the rest of the roll rather than churn the
+                        # fleet behind a broken spawn
+                        print(f"rebalance roll aborted: replacement "
+                              f"for worker {roll_old.index} never "
+                              "listened", file=sys.stderr)
+                        if roll_old.proc.poll() is None:
+                            roll_old.proc.terminate()
+                        roll_queue.clear()
+                        roll_in_flight = roll_old = None
+                if (roll_in_flight is None and roll_queue
+                        and not stop.is_set()):
+                    idx = roll_queue.pop(0)
+                    old = next((w for w in workers if w.index == idx),
+                               None)
+                    if old is not None:
+                        repl = _Worker(idx, worker_argv(), port, job_id)
+                        workers[workers.index(old)] = repl
+                        # retired but STILL SERVING until the
+                        # replacement listens; the finally drain and
+                        # the reap loop both know expected_exits
+                        expected_exits.add(old)
+                        roll_old = old
+                        roll_in_flight = repl
+                        roll_deadline = _time.monotonic() + 120.0
+                if (autoscale and not stop.is_set()
+                        and _time.monotonic() >= next_tick):
+                    next_tick = (_time.monotonic()
+                                 + config.autoscale_poll_s)
+                    decision = policy.observe(signals.poll(),
+                                              len(workers))
+                    if decision is not None:
+                        apply_decision(decision)
     finally:
         if probe is not None:
             probe.close()
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
         # fleet-wide drain: SIGTERM each live worker (it stops
-        # admitting, finishes queued dispatches, prints its summary)
-        for w in workers:
+        # admitting, finishes queued dispatches, prints its summary);
+        # expected exits (scale_down victims, rolled workers) drain too
+        drainees = [*workers, *expected_exits]
+        for w in drainees:
             if w.proc.poll() is None:
                 w.proc.terminate()
-        for w in workers:
+        for w in drainees:
             try:
                 wrc = w.proc.wait(timeout=60.0)
             except Exception:
@@ -438,15 +719,18 @@ def _supervise(argv: list[str], config, obs_cfg,
             # wrc == -SIGTERM is OUR drain signal landing before the
             # worker installed its graceful handler (e.g. a just-
             # restarted worker still importing jax) — an expected drain
-            # outcome, not a failure
-            if wrc not in (0, -signal.SIGTERM):
+            # outcome, not a failure (and never for expected exits)
+            if wrc not in (0, -signal.SIGTERM) and w not in expected_exits:
                 drain_rc = drain_rc or wrc
             # the worker's final "stopped" JSON line may still be in
             # the pipe when wait() returns — let the reader drain it
             # before the aggregate summary reads last_json
             w._reader.join(timeout=10.0)
         obs_journal.emit("serve_fleet_stop", plane="serve",
-                         restarts=restarts)
+                         restarts=restarts,
+                         scale_ups=scale_totals["scale_up"],
+                         scale_downs=scale_totals["scale_down"],
+                         rebalances=scale_totals["rebalance"])
         totals: dict[str, int] = {}
         per_worker = []
         for w in workers:
@@ -457,14 +741,20 @@ def _supervise(argv: list[str], config, obs_cfg,
             for k, v in summary.items():
                 if isinstance(v, (int, float)) and k != "signal":
                     totals[k] = totals.get(k, 0) + v
-        print(json.dumps({
+        stopped = {
             "state": "stopped",
             "signal": stopping[0] if stopping else None,
-            "workers": n,
+            "workers": len(workers) or n,
             "restarts": restarts,
             **{k: v for k, v in sorted(totals.items())},
             "per_worker": per_worker,
-        }), flush=True)
+        }
+        if any(scale_totals.values()):
+            # NOTE: totals above sum the FINAL workers' counters; rolled
+            # or drained workers' requests live in the journal/rollup
+            # (exact monotonic counters, PR-13), not this line
+            stopped["autoscale"] = dict(scale_totals)
+        print(json.dumps(stopped), flush=True)
     return rc if rc is not None else (drain_rc or 0)
 
 

@@ -1,0 +1,345 @@
+"""SLO-driven serve autoscaling — the control loop that closes the
+signals the obs plane already journals onto the actuators the supervisor
+already owns (ROADMAP item 3, serve side).
+
+Signals (all journal-borne, so a policy decision is reconstructable from
+a dead fleet's files): the PR-7 watchdog's hysteretic ``slo_breach`` /
+``slo_recover`` transitions on ``serve_p99_s`` / ``serve_shed_rate``
+(fleet-wide and per-tenant ``:model`` variants), plus the rate-limited
+``shed`` events' per-tenant monotonic counters.  Actuators (applied by
+``serve/__main__._supervise``): add an SO_REUSEPORT scoring worker up to
+``shifu.tpu.serve-workers-max``; SIGTERM-drain one back on sustained
+recovery; and — BEFORE scaling — rebalance a single overloading tenant's
+DRR weight down (``--tenant-weight`` override on a rolling restart),
+because one hot tenant starving its peers is a fairness problem capacity
+cannot fix.
+
+The policy here is PURE (observations in, at most one Decision out, a
+injectable clock) so the hysteresis/cooldown/ordering semantics are unit
+-testable without processes; the supervisor owns all side effects.
+
+Anti-flap discipline, layered:
+- the slo_breach events feeding the loop are already hysteretic
+  (obs/slo.py holds a state for ``slo-hysteresis`` evaluations);
+- the policy requires ``ticks`` consecutive breached polls before acting
+  and ``recovery_ticks`` consecutive CLEAN polls before shrinking;
+- every decision opens a ``cooldown_s`` window during which the policy
+  holds still;
+- empty-window discipline (the PR-7/PR-13 lesson, adapted): a tick with
+  NO new journal events is NEUTRAL while a breach is latched — a
+  latched breach whose writer went quiet is a dead worker, not fresh
+  overload evidence, so it must never drive a scale_up; and before the
+  journal has produced ANY event the policy stays inert (nothing
+  proves the fleet is even wired to it).  A quiet, UN-breached fleet
+  does accrue recovery credit — traffic going away entirely is the
+  purest recovery there is, and the slo watchdog already journals
+  ``slo_recover`` on a drained window for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("autoscale")
+
+#: serve signals the policy treats as overload evidence (bare and
+#: per-tenant ``:model`` forms)
+_BREACH_SIGNALS = ("serve_p99_s", "serve_shed_rate")
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    workers_min: int
+    workers_max: int
+    ticks: int = K.DEFAULT_SERVE_AUTOSCALE_TICKS
+    recovery_ticks: int = K.DEFAULT_SERVE_AUTOSCALE_RECOVERY_TICKS
+    cooldown_s: float = K.DEFAULT_SERVE_AUTOSCALE_COOLDOWN_S
+    # one tenant owning at least this fraction of the window's NEW sheds
+    # (with >1 tenant serving) reads as single-tenant overload:
+    # rebalance its weight down before adding capacity
+    dominance: float = 0.8
+    # weight multiplier applied per rebalance, floored so a tenant can
+    # be tamed but never starved into un-serveability
+    rebalance_backoff: float = 0.5
+    weight_floor: float = 0.25
+
+
+@dataclass(frozen=True)
+class Decision:
+    action: str  # "scale_up" | "scale_down" | "rebalance"
+    reason: str
+    evidence: dict
+    # rebalance only: the tenant and its NEW weight
+    model: str | None = None
+    weight: float | None = None
+
+
+@dataclass
+class TickObservation:
+    """One policy poll's view of the journal (built by JournalSignals or
+    a test)."""
+
+    #: new journal events since the last poll (0 + nothing breached =
+    #: neutral tick)
+    new_events: int = 0
+    #: serve signals currently in breach (last transition was
+    #: slo_breach), e.g. {"serve_p99_s", "serve_shed_rate:alpha"}
+    breached: set = field(default_factory=set)
+    #: cumulative shed counts per tenant (None key = single-model); the
+    #: policy diffs these between polls itself
+    sheds_by_model: dict = field(default_factory=dict)
+    #: distinct tenants observed serving (rebalance needs > 1)
+    tenants_seen: int = 0
+    #: the journal could not be read this tick: the policy must treat
+    #: it as fully NEUTRAL (no breach debounce reset, no recovery
+    #: credit) — an unreadable journal is evidence of nothing
+    read_error: bool = False
+
+
+class AutoscalePolicy:
+    """Hysteretic scale/rebalance policy.  Call ``observe`` once per
+    tick; it returns at most one Decision (the supervisor applies it and
+    reports the applied worker count back on the next tick)."""
+
+    def __init__(self, cfg: AutoscaleConfig, clock=time.monotonic):
+        self.cfg = cfg
+        self._clock = clock
+        self._breach_ticks = 0
+        self._clean_ticks = 0
+        self._seen_any = False
+        self._last_action_ts: float | None = None
+        #: tenant -> current weight override (starts unset = 1.0); the
+        #: supervisor reads this to build --tenant-weight args
+        self.weight_overrides: dict[str, float] = {}
+        # shed totals at the LAST action (dominance judges the burst
+        # since then, not all history)
+        self._shed_base: dict = {}
+
+    def in_cooldown(self) -> bool:
+        return (self._last_action_ts is not None
+                and self._clock() - self._last_action_ts
+                < self.cfg.cooldown_s)
+
+    def cooldown_remaining_s(self) -> float:
+        if self._last_action_ts is None:
+            return 0.0
+        return max(0.0, self.cfg.cooldown_s
+                   - (self._clock() - self._last_action_ts))
+
+    def _new_sheds(self, obs: TickObservation) -> dict:
+        out = {}
+        for m, total in obs.sheds_by_model.items():
+            delta = int(total) - int(self._shed_base.get(m, 0))
+            if delta > 0:
+                out[m] = delta
+        return out
+
+    def _decide(self, decision: Decision, obs: TickObservation) -> Decision:
+        self._last_action_ts = self._clock()
+        self._breach_ticks = 0
+        self._clean_ticks = 0
+        self._shed_base = dict(obs.sheds_by_model)
+        return decision
+
+    def observe(self, obs: TickObservation,
+                workers: int) -> Decision | None:
+        if obs.read_error:
+            # a failed journal read proves nothing: hold every counter
+            # still — six blips in a row must not shrink a breached
+            # fleet, and one must not reset the scale_up debounce
+            return None
+        breached = bool(obs.breached)
+        if obs.new_events == 0:
+            if breached or not self._seen_any:
+                # neutral: a latched breach with no fresh events is a
+                # dead writer, not overload evidence; and before any
+                # event at all, nothing proves the journal is wired
+                return None
+            # quiet AND un-breached = recovered/idle: recovery credit
+            self._breach_ticks = 0
+            self._clean_ticks += 1
+        elif breached:
+            self._seen_any = True
+            self._clean_ticks = 0
+            self._breach_ticks += 1
+        else:
+            self._seen_any = True
+            self._breach_ticks = 0
+            self._clean_ticks += 1
+        if self.in_cooldown():
+            return None
+        cfg = self.cfg
+        if breached and self._breach_ticks >= cfg.ticks:
+            evidence = {
+                "breached": sorted(obs.breached),
+                "breach_ticks": self._breach_ticks,
+                "new_sheds": self._new_sheds(obs),
+                "workers": workers,
+            }
+            hot = self._dominant_tenant(obs)
+            if hot is not None:
+                current = self.weight_overrides.get(hot, 1.0)
+                new = max(cfg.weight_floor,
+                          current * cfg.rebalance_backoff)
+                if new < current or hot not in self.weight_overrides:
+                    self.weight_overrides[hot] = new
+                    return self._decide(Decision(
+                        action="rebalance",
+                        reason=(f"tenant {hot} owns the overload "
+                                f"(>= {cfg.dominance:.0%} of window "
+                                f"sheds): weight -> {new:g} before "
+                                f"scaling"),
+                        evidence=evidence, model=hot, weight=new,
+                    ), obs)
+                # already floored: fall through to capacity
+            if workers < cfg.workers_max:
+                return self._decide(Decision(
+                    action="scale_up",
+                    reason=(f"{sorted(obs.breached)} breached for "
+                            f"{self._breach_ticks} tick(s)"),
+                    evidence=evidence,
+                ), obs)
+            return None  # at ceiling; keep the breach counters running
+        if (not breached and self._clean_ticks >= cfg.recovery_ticks
+                and workers > cfg.workers_min):
+            return self._decide(Decision(
+                action="scale_down",
+                reason=(f"recovered for {self._clean_ticks} "
+                        f"clean tick(s)"),
+                evidence={"clean_ticks": self._clean_ticks,
+                          "workers": workers},
+            ), obs)
+        return None
+
+    def _dominant_tenant(self, obs: TickObservation) -> str | None:
+        """The single tenant owning the overload, if any: >1 tenants
+        serving AND one named tenant holds >= ``dominance`` of the NEW
+        sheds since the last action."""
+        if obs.tenants_seen < 2:
+            return None
+        new = self._new_sheds(obs)
+        named = {m: n for m, n in new.items() if m}
+        total = sum(new.values())
+        if not named or total <= 0:
+            return None
+        hot, n = max(named.items(), key=lambda kv: kv[1])
+        if n / total >= self.cfg.dominance:
+            return hot
+        return None
+
+
+class JournalSignals:
+    """Incremental journal reader feeding the policy: breach state per
+    signal, per-tenant shed counters, and the new-event count per poll —
+    all from the serve fleet's journal base + ``.s<i>`` siblings, the
+    same files ``obs summary`` reads off a dead fleet.
+
+    State folds INCREMENTALLY: each poll processes only the events past
+    the last watermark (read_events' parse cache already makes the file
+    reads incremental; without this fold a long-lived fleet would pay an
+    O(total-events) Python scan per tick).  A writer's latched breach is
+    cleared when that writer restarts (``serve_start`` — a fresh
+    process's watchdog starts un-breached) or leaves
+    (``serve_worker_exit``/``scale_down``): a dead writer cannot emit
+    its own ``slo_recover``, and without this the rebalance rolling
+    restart would latch a breach forever and drive scale_ups to the
+    ceiling."""
+
+    def __init__(self, journal_base: str):
+        from shifu_tensorflow_tpu.obs.journal import read_keyed_events
+
+        self._read_keyed = read_keyed_events
+        self.base = journal_base
+        self._cache: dict = {}
+        # per-WRITER-file high-water mark over the (ts, seq) merge key —
+        # NOT a global list index: a slow writer's flush can merge its
+        # events BEFORE an already-seen faster writer's tail, and
+        # rotation dropping the oldest file can shrink the merged list;
+        # a per-writer watermark survives both (ts, seq is monotonic
+        # within a writer, and rotation only drops events <= the mark)
+        self._marks: dict = {}      # writer-file id -> (ts, seq)
+        # folded state (survives across polls)
+        self._breached: dict = {}   # (worker, signal) -> bool
+        self._sheds: dict = {}      # (worker, model) -> monotonic max
+        # shed counts already credited to dead processes of a writer
+        # index: a restarted worker's shed_total restarts near 0, and
+        # max() alone would mask its fresh sheds until they beat the
+        # dead process's high-water — blinding dominance detection
+        self._retired_sheds: dict = {}
+        self._tenants: set = set()
+
+    def _clear_writer(self, worker) -> None:
+        for key in [k for k in self._breached if k[0] == worker]:
+            self._breached[key] = False
+        # retire the dead process's shed high-water so the fresh
+        # process's counters are visible from 0 (totals stay monotonic)
+        for key in [k for k in self._sheds if k[0] == worker]:
+            self._retired_sheds[key] = (
+                self._retired_sheds.get(key, 0) + self._sheds.pop(key))
+
+    def poll(self) -> TickObservation:
+        try:
+            # after= pushes the watermarks down into the reader: events
+            # at or below a writer's mark are neither keyed nor sorted,
+            # and unchanged files wholly below it are skipped outright —
+            # a steady-state tick pays for the new tail only
+            keyed = self._read_keyed(self.base, cache=self._cache,
+                                     after=self._marks)
+        except Exception:
+            log.exception("autoscale journal read failed (%s)", self.base)
+            return TickObservation(read_error=True)
+        new = []
+        marks = self._marks
+        for ts, writer, seq, ev in keyed:
+            if (ts, seq) <= marks.get(writer, (-1.0, -1)):
+                continue
+            marks[writer] = (ts, seq)
+            new.append(ev)
+        for ev in new:
+            if ev.get("plane") != "serve":
+                continue
+            kind = ev.get("event")
+            if kind == "slo_breach":
+                sig = str(ev.get("signal") or "")
+                if sig.split(":", 1)[0] in _BREACH_SIGNALS:
+                    # per (writer, signal): worker 1 recovering must not
+                    # mask worker 0's still-open breach of the same name
+                    self._breached[(ev.get("worker"), sig)] = True
+            elif kind == "slo_recover":
+                self._breached[(ev.get("worker"),
+                                str(ev.get("signal") or ""))] = False
+            elif kind == "serve_start":
+                # this writer index restarted: its previous process's
+                # latched breaches died with it
+                self._clear_writer(ev.get("worker"))
+            elif kind in ("serve_worker_exit", "scale_down"):
+                self._clear_writer(ev.get("index"))
+            elif kind == "shed":
+                m = ev.get("model")
+                # shed_total is a per-WRITER per-model monotonic counter:
+                # take each writer's max, sum across writers below
+                key = (ev.get("worker"), m)
+                self._sheds[key] = max(
+                    int(self._sheds.get(key, 0)),
+                    int(ev.get("shed_total", 0) or 0))
+                if m:
+                    self._tenants.add(m)
+            elif kind == "serve_batch":
+                m = ev.get("model")
+                if m:
+                    self._tenants.add(m)
+        by_model: dict = {}
+        for src in (self._sheds, self._retired_sheds):
+            for (w, m), n in src.items():
+                by_model[m] = by_model.get(m, 0) + n
+        return TickObservation(
+            new_events=len(new),
+            breached={sig for (_, sig), b in self._breached.items()
+                      if b},
+            sheds_by_model=by_model,
+            tenants_seen=len(self._tenants),
+        )

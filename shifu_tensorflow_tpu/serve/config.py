@@ -35,6 +35,18 @@ class ServeConfig:
     retry_after_s: int = K.DEFAULT_SERVE_RETRY_AFTER_S
     reload_poll_ms: int = K.DEFAULT_SERVE_RELOAD_POLL_MS
     workers: int = K.DEFAULT_SERVE_WORKERS
+    # SLO-driven autoscaling (serve/autoscale.py, run by the supervisor):
+    # workers_max > workers turns the policy loop on — workers is then
+    # the FLOOR, workers_max the ceiling.  0 (default) = off.
+    workers_max: int = K.DEFAULT_SERVE_WORKERS_MAX
+    autoscale_cooldown_s: float = K.DEFAULT_SERVE_AUTOSCALE_COOLDOWN_S
+    autoscale_ticks: int = K.DEFAULT_SERVE_AUTOSCALE_TICKS
+    autoscale_recovery_ticks: int = (
+        K.DEFAULT_SERVE_AUTOSCALE_RECOVERY_TICKS)
+    autoscale_poll_s: float = K.DEFAULT_SERVE_AUTOSCALE_POLL_S
+    # supervisor /metrics listener port (stpu_serve_scale_* gauges +
+    # restart-budget burn); 0 = off
+    supervisor_port: int = K.DEFAULT_SERVE_SUPERVISOR_PORT
     # multi-tenant (serve/tenancy/) — shifu.tpu.serve-model-* keys
     models_dir: str | None = None
     model_budget_mb: float = K.DEFAULT_SERVE_MODEL_BUDGET_MB
@@ -61,6 +73,22 @@ class ServeConfig:
                 )
         if self.workers < 1:
             raise ValueError(f"{K.SERVE_WORKERS} must be >= 1")
+        if self.workers_max and self.workers_max < self.workers:
+            raise ValueError(
+                f"{K.SERVE_WORKERS_MAX} ({self.workers_max}) must be 0 "
+                f"(autoscale off) or >= {K.SERVE_WORKERS} "
+                f"({self.workers}): serve-workers is the autoscaler's "
+                "floor"
+            )
+        if self.autoscale_cooldown_s < 0:
+            raise ValueError(f"{K.SERVE_AUTOSCALE_COOLDOWN_S} must be >= 0")
+        if self.autoscale_ticks < 1 or self.autoscale_recovery_ticks < 1:
+            raise ValueError(
+                f"{K.SERVE_AUTOSCALE_TICKS} and "
+                f"{K.SERVE_AUTOSCALE_RECOVERY_TICKS} must be >= 1"
+            )
+        if self.autoscale_poll_s <= 0:
+            raise ValueError(f"{K.SERVE_AUTOSCALE_POLL_S} must be > 0")
         if self.backend not in ("native", "cpp", "saved_model"):
             raise ValueError(
                 f"unknown {K.SERVE_BACKEND} value {self.backend!r} "
@@ -159,4 +187,21 @@ def resolve_serve_config(args, conf) -> ServeConfig:
                             K.DEFAULT_SERVE_RELOAD_POLL_MS, conf.get_int),
         workers=pick("serve_workers", K.SERVE_WORKERS,
                      K.DEFAULT_SERVE_WORKERS, conf.get_int),
+        workers_max=pick("serve_workers_max", K.SERVE_WORKERS_MAX,
+                         K.DEFAULT_SERVE_WORKERS_MAX, conf.get_int),
+        autoscale_cooldown_s=pick(
+            "autoscale_cooldown", K.SERVE_AUTOSCALE_COOLDOWN_S,
+            K.DEFAULT_SERVE_AUTOSCALE_COOLDOWN_S, conf.get_float),
+        autoscale_ticks=pick(
+            "autoscale_ticks", K.SERVE_AUTOSCALE_TICKS,
+            K.DEFAULT_SERVE_AUTOSCALE_TICKS, conf.get_int),
+        autoscale_recovery_ticks=pick(
+            "autoscale_recovery_ticks", K.SERVE_AUTOSCALE_RECOVERY_TICKS,
+            K.DEFAULT_SERVE_AUTOSCALE_RECOVERY_TICKS, conf.get_int),
+        autoscale_poll_s=pick(
+            "autoscale_poll", K.SERVE_AUTOSCALE_POLL_S,
+            K.DEFAULT_SERVE_AUTOSCALE_POLL_S, conf.get_float),
+        supervisor_port=pick(
+            "supervisor_port", K.SERVE_SUPERVISOR_PORT,
+            K.DEFAULT_SERVE_SUPERVISOR_PORT, conf.get_int),
     )

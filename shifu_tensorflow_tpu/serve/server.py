@@ -156,8 +156,15 @@ class ScoringServer:
             )
         handler = _make_handler(self)
         # workers > 1 means this process is ONE of several sharing the
-        # port — every one of them must bind with SO_REUSEPORT
-        server_cls = (_ReuseportHTTPServer if config.workers > 1
+        # port — every one of them must bind with SO_REUSEPORT.  An
+        # autoscale ceiling (workers_max > workers) means siblings may
+        # JOIN later even when the floor is a single worker: the first
+        # worker must bind shareable too, or every scale_up would
+        # EADDRINUSE against it (and against the supervisor's held
+        # port-0 probe).
+        elastic = (getattr(config, "workers_max", 0) or 0) > config.workers
+        server_cls = (_ReuseportHTTPServer
+                      if config.workers > 1 or elastic
                       else ThreadingHTTPServer)
         try:
             self.httpd = server_cls(

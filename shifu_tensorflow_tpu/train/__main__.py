@@ -61,6 +61,25 @@ def build_parser() -> argparse.ArgumentParser:
     spmd.add_argument("--no-spmd", dest="spmd", action="store_false",
                       help="independent per-worker models; only the chief's "
                            "checkpoint is exported")
+    p.add_argument("--standby-workers", type=int, default=None,
+                   dest="standby_workers",
+                   help="hot standbys launched beside the fleet "
+                        "(shifu.tpu.standby-workers): each pre-builds "
+                        "its model (compile warm, no shard) and takes "
+                        "over a dead rank on promotion instead of the "
+                        "fleet restarting from checkpoint.  Default 0")
+    elastic = p.add_mutually_exclusive_group()
+    elastic.add_argument("--elastic", dest="elastic", action="store_true",
+                         default=None,
+                         help="shrink instead of failing when a rank "
+                              "dies with no standby and no restart "
+                              "budget left: the data re-splits "
+                              "deterministically over the survivors "
+                              "(shifu.tpu.elastic; non-SPMD fleets)")
+    elastic.add_argument("--no-elastic", dest="elastic",
+                         action="store_false",
+                         help="fail the job on budget exhaustion (the "
+                              "default)")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--valid-rate", type=float, default=None)
@@ -491,6 +510,26 @@ def job_spec_kwargs(conf: Conf) -> dict:
     }
 
 
+def elastic_spec_kwargs(args, conf: Conf) -> dict:
+    """JobSpec fields for the elastic fleet (hot standbys + shrink-on-
+    exhaustion re-split), CLI-wins over the shifu.tpu.standby-workers /
+    shifu.tpu.elastic keys."""
+    standby = (args.standby_workers
+               if getattr(args, "standby_workers", None) is not None
+               else conf.get_int(K.STANDBY_WORKERS,
+                                 K.DEFAULT_STANDBY_WORKERS))
+    el = (args.elastic if getattr(args, "elastic", None) is not None
+          else conf.get_bool(K.ELASTIC, K.DEFAULT_ELASTIC))
+    out = {"standby_workers": max(0, int(standby)), "elastic": bool(el)}
+    if out["elastic"]:
+        # shrink/release and re-split directives are delivered through
+        # the per-epoch barrier: elastic forces it on over whatever the
+        # conf key says (same rule as early stopping — the invariant
+        # lives where the spec is built)
+        out["sync_epochs"] = True
+    return out
+
+
 def early_stop_spec_kwargs(args, conf: Conf) -> dict:
     """JobSpec fields for fleet-coordinated early stopping (the
     coordinator evaluates quorum aggregates; the barrier delivers the
@@ -827,7 +866,8 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     use_spmd = args.spmd if args.spmd is not None else args.launcher == "process"
     # merged dict (not two ** expansions): early-stop forces sync_epochs
     # True over whatever the conf key says — a keyword collision otherwise
-    spec_kw = {**job_spec_kwargs(conf), **early_stop_spec_kwargs(args, conf)}
+    spec_kw = {**job_spec_kwargs(conf), **elastic_spec_kwargs(args, conf),
+               **early_stop_spec_kwargs(args, conf)}
     # one job correlation id for the whole fleet: the coordinator stamps
     # it on its journal events and hands it to every worker at
     # registration (the workers' .w<i> journal siblings carry the same id)
@@ -904,6 +944,10 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             # a health rollback is an operational event the run record
             # must show — not just epochs silently running twice
             summary["rollbacks_used"] = result.rollbacks_used
+        if result.promotions_used:
+            # ditto for standby takeovers: an elastic recovery is part
+            # of the run record, not an invisible non-event
+            summary["promotions_used"] = result.promotions_used
         if result.diagnostics is not None:
             summary["diagnostics"] = result.diagnostics
         if result.stop_reason:

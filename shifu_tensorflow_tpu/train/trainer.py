@@ -1162,6 +1162,72 @@ class Trainer:
         a = self._local_data_divisor
         return -(-batch_size // a) * a
 
+    def warm_step(self, batch_size: int, x_dtype=None) -> list[str]:
+        """Compile-warm the step functions a fit at ``batch_size`` would
+        dispatch, WITHOUT touching training state — the hot-standby
+        pre-build (coordinator/worker.py): a promoted standby's first
+        real step then hits the executable cache instead of paying XLA
+        mid-takeover.
+
+        Uses the code's own padding invariant instead of AOT tricks: an
+        all-zero-WEIGHT batch is a proven no-op on every step variant
+        (the ``has_rows`` gate skips ``apply_gradients``, so params and
+        optimizer moments pass through bit-identical — the same contract
+        the fixed-step SPMD padding batches rely on), while the dispatch
+        itself compiles and caches exactly like a real one.  The
+        returned state is reassigned so donated buffers stay valid.
+
+        Returns the names of the warmed callables.  Not supported under
+        cross-process SPMD (the mesh spans processes that don't exist
+        until the fleet forms) — returns [] there.
+        """
+        if self._cross_process:
+            return []
+        b = self.align_batch_size(batch_size)
+        xd = np.dtype(x_dtype if x_dtype is not None else np.float32)
+
+        def zeros(rows: int) -> Batch:
+            return {
+                "x": np.zeros((rows, self.num_features), xd),
+                "y": np.zeros((rows, 1), np.float32),
+                "w": np.zeros((rows, 1), np.float32),
+            }
+
+        warmed: list[str] = []
+        if self.scan_steps > 1:
+            stacked = self._put_stacked({
+                k: np.stack([v] * self.scan_steps)
+                for k, v in zeros(b).items()
+            })
+            self.state, _ = self._scan_epoch(self.state, stacked)
+            warmed.append("train.scan_epoch")
+        elif self.accum_steps > 1:
+            stacked = self._put_stacked({
+                k: np.stack([v] * self.accum_steps)
+                for k, v in zeros(b).items()
+            })
+            self.state, _ = self._accum_step(self.state, stacked)
+            warmed.append("train.accum_step")
+        elif self._host_emb_step is not None:
+            batch = self._put(zeros(b))  # _put augments host embeddings
+            self.state, _, _ = self._host_emb_step(self.state, batch)
+            warmed.append("train.host_emb_step")
+        elif self._health_step is not None:
+            batch = self._put(zeros(b))
+            self.state, _ = self._health_step(self.state, batch)
+            warmed.append("train.step")
+        else:
+            batch = self._put(zeros(b))
+            self.state, _ = self._train_step(self.state, batch)
+            warmed.append("train.step")
+        # the eval/validation step shares the batch shape
+        batch = self._put(zeros(b))
+        loss, _ = self._eval_step(self.state.params, batch)
+        jax.block_until_ready(loss)
+        jax.block_until_ready(self.state.step)
+        warmed.append("train.eval_step")
+        return warmed
+
     # ---- core loops ----
     def train_epoch(self, batches: Iterable[Batch]) -> tuple[float, int]:
         """Run one epoch; returns (mean loss over batches, batch count).

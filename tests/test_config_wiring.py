@@ -1002,3 +1002,107 @@ def test_aot_keys_round_trip_xml_cli_and_json_bridge(tmp_path):
     cfg = resolve_obs(_args(["--compile-cache-dir", "/cache/cli"]), conf)
     assert cfg.compile_cache_dir == "/cache/cli"
     assert resolve_obs(_args(), _conf({})).compile_cache_dir == ""
+
+
+def test_elastic_keys_round_trip_xml_cli_and_spec(tmp_path):
+    """shifu.tpu.standby-workers / shifu.tpu.elastic: XML → Conf → CLI
+    override → JobSpec kwargs (the elastic-fleet switchboard)."""
+    from shifu_tensorflow_tpu.train.__main__ import elastic_spec_kwargs
+
+    xml = tmp_path / "elastic.xml"
+    xml.write_text(
+        "<configuration>"
+        f"<property><name>{K.STANDBY_WORKERS}</name><value>2</value>"
+        "</property>"
+        f"<property><name>{K.ELASTIC}</name><value>true</value>"
+        "</property>"
+        "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    kw = elastic_spec_kwargs(_args(), conf)
+    # elastic forces sync_epochs: the shrink/release/re-split directives
+    # are delivered through the per-epoch barrier
+    assert kw == {"standby_workers": 2, "elastic": True,
+                  "sync_epochs": True}
+    # CLI wins over the XML layer
+    kw = elastic_spec_kwargs(
+        _args(["--standby-workers", "1", "--no-elastic"]), conf)
+    assert kw == {"standby_workers": 1, "elastic": False}
+    # defaults: no standbys, elastic off (budget exhaustion still fails)
+    kw = elastic_spec_kwargs(_args(), _conf({}))
+    assert kw == {"standby_workers": K.DEFAULT_STANDBY_WORKERS,
+                  "elastic": K.DEFAULT_ELASTIC}
+    # the JobSpec accepts them and the worker JSON bridge carries role
+    from shifu_tensorflow_tpu.coordinator.coordinator import JobSpec
+    from shifu_tensorflow_tpu.coordinator.worker import WorkerConfig
+    from shifu_tensorflow_tpu.data.reader import RecordSchema
+    from shifu_tensorflow_tpu.data.splitter import Shard
+
+    spec = JobSpec(n_workers=1, shards=[Shard(0, ("/d/p0",), 1)],
+                   standby_workers=2, elastic=True)
+    assert spec.standby_workers == 2 and spec.elastic is True
+    wc = WorkerConfig(
+        worker_id="sb-0", coordinator_host="127.0.0.1",
+        coordinator_port=1,
+        model_config=ModelConfig.from_json({}),
+        schema=RecordSchema(feature_columns=(1,), target_column=0),
+        role="standby",
+    )
+    assert WorkerConfig.from_json(wc.to_json()).role == "standby"
+
+
+def test_autoscale_keys_round_trip_xml_to_serve_config(tmp_path):
+    """shifu.tpu.serve-workers-max / serve-autoscale-* /
+    serve-supervisor-port: XML → Conf → CLI override → ServeConfig →
+    JSON bridge."""
+    from shifu_tensorflow_tpu.serve import resolve_serve_config
+    from shifu_tensorflow_tpu.serve.__main__ import (
+        build_parser as serve_parser,
+    )
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+
+    xml = tmp_path / "autoscale.xml"
+    values = {
+        K.SERVE_WORKERS: "2",
+        K.SERVE_WORKERS_MAX: "6",
+        K.SERVE_AUTOSCALE_COOLDOWN_S: "45",
+        K.SERVE_AUTOSCALE_TICKS: "3",
+        K.SERVE_AUTOSCALE_RECOVERY_TICKS: "9",
+        K.SERVE_AUTOSCALE_POLL_S: "2.5",
+        K.SERVE_SUPERVISOR_PORT: "9301",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), conf)
+    assert (cfg.workers, cfg.workers_max) == (2, 6)
+    assert cfg.autoscale_cooldown_s == 45.0
+    assert cfg.autoscale_ticks == 3
+    assert cfg.autoscale_recovery_ticks == 9
+    assert cfg.autoscale_poll_s == 2.5
+    assert cfg.supervisor_port == 9301
+    # CLI wins
+    cfg = resolve_serve_config(serve_parser().parse_args(
+        ["--model-dir", "/m", "--serve-workers-max", "4",
+         "--autoscale-cooldown", "5", "--autoscale-poll", "1",
+         "--supervisor-port", "0"]), conf)
+    assert cfg.workers_max == 4 and cfg.autoscale_cooldown_s == 5.0
+    assert cfg.autoscale_poll_s == 1.0 and cfg.supervisor_port == 0
+    # JSON bridge round-trips the new fields
+    assert ServeConfig.from_json(cfg.to_json()) == cfg
+    # defaults: autoscale off
+    d = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), Conf())
+    assert d.workers_max == K.DEFAULT_SERVE_WORKERS_MAX == 0
+    # validation: a ceiling below the floor is a config error
+    import pytest
+
+    with pytest.raises(ValueError, match="serve-workers-max"):
+        ServeConfig(model_dir="/m", workers=4, workers_max=2)
