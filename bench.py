@@ -817,6 +817,24 @@ def main() -> None:
         rc = bench_elastic.main()
         _append_bench_history('elastic', 'BENCH_ELASTIC.json', rc=rc)
         sys.exit(rc)
+    if "score" in sys.argv[1:]:
+        # bulk scoring benchmark (python bench.py score [--quick]):
+        # the batch plane vs HTTP /score on the same rows + bundle,
+        # 1-vs-2 worker scaling (host_capped fallback on narrow hosts),
+        # and the exactly-once kill drill — SIGKILL a scorer process
+        # mid-lease under a torn-write plan, gate zero missing rows,
+        # zero duplicate commit tokens, and bit-identical output vs the
+        # unkilled arm; artifact BENCH_SCORE.json — implemented in
+        # scripts/bench_score.py.  The driver side is jax-light and the
+        # scorer fleet is subprocesses, so the parent's no-jax rule does
+        # not apply to this mode.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_score
+
+        rc = bench_score.main()
+        _append_bench_history('score', 'BENCH_SCORE.json', rc=rc)
+        sys.exit(rc)
     if "serve-aot" in sys.argv[1:]:
         # AOT executable shipping benchmark (python bench.py serve-aot):
         # 10-tenant fleet-restart admission, deserialize (shipped

@@ -526,6 +526,33 @@ DEFAULT_RETRY_MAX_DELAY_MS = 2000
 RETRY_DEADLINE_MS = TPU_PREFIX + "retry-deadline"
 DEFAULT_RETRY_DEADLINE_MS = 60_000
 
+# ---- bulk scoring plane (score/; docs/scoring.md) ----
+# score-workers: scan fleet size the driver spawns (each worker is an
+# admission-free AOT-admitted scorer process; elastic — a killed worker's
+# leases expire and peers finish the job).
+SCORE_WORKERS = TPU_PREFIX + "score-workers"
+DEFAULT_SCORE_WORKERS = 2
+# score-lease-ttl: seconds a shard lease lives without renewal (workers
+# renew at ttl/3).  The recovery latency for a SIGKILLed scorer's shard
+# is bounded by this plus one driver reclaim tick (ttl/4).
+SCORE_LEASE_TTL_S = TPU_PREFIX + "score-lease-ttl"
+DEFAULT_SCORE_LEASE_TTL_S = 10.0
+# score-speculate-factor: when no shard is PENDING, an idle worker may
+# steal (early-reclaim) the longest-running lease once it has outlived
+# factor x the median committed-shard duration — straggler speculation
+# on the reclaim path; first-commit-wins keeps it exactly-once.
+# 0 disables.
+SCORE_SPECULATE_FACTOR = TPU_PREFIX + "score-speculate-factor"
+DEFAULT_SCORE_SPECULATE_FACTOR = 4.0
+# score-max-shards: cap on the shard plan; 0 = one shard per input file,
+# else size-aware grouping (splitter LPT) down to at most this many.
+SCORE_MAX_SHARDS = TPU_PREFIX + "score-max-shards"
+DEFAULT_SCORE_MAX_SHARDS = 0
+# score-batch-rows: rows per decoded block = rows per compute_batch
+# dispatch in the scan loop (bucket-ladder padding applies per call).
+SCORE_BATCH_ROWS = TPU_PREFIX + "score-batch-rows"
+DEFAULT_SCORE_BATCH_ROWS = 4096
+
 # ---- fault-tolerance envelope (reference: Constants.java:87-89; the ps
 # threshold has no analogue — there is no PS role) ----
 WORKER_FAULT_TOLERANCE_THRESHOLD = 0.1

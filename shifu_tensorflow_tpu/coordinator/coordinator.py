@@ -344,6 +344,20 @@ class Coordinator:
         # not re-count a worker
         self._op_cache: OrderedDict[str, dict] = OrderedDict()
         self.op_replays = 0
+        # bulk scoring plane (score/job.ScoreJob): attached by a score
+        # driver, routed by the four score_* / lease_* / shard_commit
+        # ops — the lease table lives in the job object, not here, so
+        # the coordinator stays a router and the table stays unit-
+        # testable without a socket in sight
+        self._score_job = None
+
+    def attach_score_job(self, job) -> None:
+        """Install the active bulk-score job (score/job.ScoreJob); its
+        lease/commit ops dispatch through this coordinator's RPC plane
+        and ride the same token replay cache as every other
+        non-idempotent op."""
+        with self._lock:
+            self._score_job = job
 
     # ---- policy ----
     @property
@@ -1802,7 +1816,12 @@ class Coordinator:
                     self.wfile.flush()
 
         self._server = _Server((host, port), Handler)
-        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        # 50ms poll: serve_forever's default 0.5s poll makes shutdown()
+        # block half a second on average, which dominates short-lived
+        # coordinators (one bulk score job runs its own)
+        t = threading.Thread(
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            daemon=True)
         t.start()
         return self._server.server_address[:2]
 
@@ -1899,6 +1918,21 @@ class Coordinator:
             return self.status()
         if op == "metrics":
             return {"ok": True, "text": self.metrics_text()}
+        if op in ("score_plan", "lease_acquire", "lease_renew",
+                  "shard_commit"):
+            job = self._score_job
+            if job is None:
+                return {"ok": False, "error": "no score job attached"}
+            if op == "score_plan":
+                return job.plan_msg()
+            if op == "lease_acquire":
+                return job.rpc_acquire(msg["worker_id"])
+            if op == "lease_renew":
+                return job.rpc_renew(int(msg["shard"]), msg["lease"])
+            return job.rpc_commit(
+                int(msg["shard"]), msg["lease"], msg.get("manifest") or {},
+                msg.get("worker_id"),
+            )
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def shutdown(self) -> None:
@@ -2094,6 +2128,37 @@ class CoordinatorClient:
                 "token": uuid.uuid4().hex,
             }
         )
+
+    # ---- bulk scoring plane (score/) ----
+
+    def score_plan(self) -> dict[str, Any]:
+        """The attached score job's description (shards, tenants,
+        output) — idempotent read."""
+        return self.call({"op": "score_plan"})
+
+    def lease_acquire(self, worker_id: str) -> dict[str, Any]:
+        # non-idempotent (grants a lease, mints its token server-side):
+        # the dedup token makes a redelivered acquire replay the SAME
+        # grant instead of leasing a second shard to a worker that will
+        # only work one
+        return self.call({"op": "lease_acquire", "worker_id": worker_id,
+                          "token": uuid.uuid4().hex})
+
+    def lease_renew(self, shard: int, lease: str) -> dict[str, Any]:
+        # idempotent: renewing twice extends to (about) the same
+        # deadline; a refused renewal stays refused
+        return self.call({"op": "lease_renew", "shard": shard,
+                          "lease": lease})
+
+    def shard_commit(self, shard: int, lease: str,
+                     manifest: dict) -> dict[str, Any]:
+        # non-idempotent in its counters (a redelivered winning commit
+        # must not journal shard_discarded_duplicate against itself):
+        # the dedup token replays the original verdict
+        return self.call({"op": "shard_commit", "shard": shard,
+                          "lease": lease, "manifest": manifest,
+                          "worker_id": manifest.get("worker"),
+                          "token": uuid.uuid4().hex})
 
     def status(self) -> dict[str, Any]:
         return self.call({"op": "status"})

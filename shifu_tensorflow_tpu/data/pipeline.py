@@ -199,6 +199,8 @@ class ShardPipeline:
         stats: StageStats | None = None,
         close_timeout_s: float = 10.0,
         tracer=None,
+        fault_site_prefix: str = "ingest",
+        shard_offset: int = 0,
     ):
         self.paths = list(paths)
         self.schema = schema
@@ -217,6 +219,12 @@ class ShardPipeline:
         self.stats.decode_workers = self.decode_workers
 
         self.close_timeout_s = close_timeout_s
+        # chaos-seam identity: the bulk scorer runs one pipeline PER
+        # LEASED SHARD and keys faults to the job-global shard id
+        # ("score.read.s<shard>"), not this pipeline's local index —
+        # prefix + offset let it do that without a parallel seam scheme
+        self.fault_site_prefix = fault_site_prefix
+        self.shard_offset = int(shard_offset)
         # EXPLICIT span sink only (no fallback to the process-global
         # install): the validation stream runs untraced on purpose —
         # its ingest work must not inflate the train epoch's journaled
@@ -365,7 +373,8 @@ class ShardPipeline:
         def attempt() -> None:
             nonlocal submitted
             emitted = 0
-            site = f"ingest.read.s{shard_idx}"
+            site = (f"{self.fault_site_prefix}.read."
+                    f"s{self.shard_offset + shard_idx}")
             t0 = _perf()
             for payload in self._shard_chunks(path, cache_reader,
                                               want_hashes):
@@ -398,7 +407,8 @@ class ShardPipeline:
             return ok
 
         retry_util.call(attempt, policy=self.retry_policy,
-                        site="ingest.read", classify=on_retry_classify)
+                        site=f"{self.fault_site_prefix}.read",
+                        classify=on_retry_classify)
         self._put(q, (_SHARD_END, shard_idx, None))
 
     def _shard_chunks(self, path, cache_reader, want_hashes):

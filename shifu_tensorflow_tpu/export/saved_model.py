@@ -234,9 +234,15 @@ def export_native_bundle(
     # so the manifest records what SHOULD land on disk — the serving
     # reload verification must catch the divergence
     weights_bytes = faults.mutate("export.at-rest", weights_bytes)
-    _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes)
-    _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes)
-    _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes)
+    # torn-write seam on every publish: a firing export.commit term
+    # leaves a truncated tmp beside the previous intact generation —
+    # the admission verifier must keep serving the old one
+    _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes,
+                  site="export.commit")
+    _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes,
+                  site="export.commit")
+    _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes,
+                  site="export.commit")
     if aot_files:
         from shifu_tensorflow_tpu.export.aot import AOT_DIR as _AOT_DIR
 
@@ -280,7 +286,8 @@ def export_native_bundle(
             pass
     # manifest LAST: its presence implies every covered file committed
     _commit_bytes(
-        os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8")
+        os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8"),
+        site="export.commit",
     )
 
 

@@ -227,6 +227,18 @@ def build_parser() -> argparse.ArgumentParser:
     prof.add_argument("--json", action="store_true", dest="as_json",
                       help="capture events, one JSON object per line")
 
+    score = sub.add_parser(
+        "score",
+        help="reconstruct a bulk scoring job from its journal: shard "
+             "commit state, per-worker commits, lease reclaims/"
+             "duplicates, row totals",
+    )
+    score.add_argument("--journal", required=True,
+                       help="journal base path the score driver wrote "
+                            "(`score run --journal ...`)")
+    score.add_argument("--json", action="store_true", dest="as_json",
+                       help="machine-readable score-job document")
+
     top = sub.add_parser(
         "top",
         help="live dashboard: tail the journals (+ optionally scrape "
@@ -2092,6 +2104,123 @@ def _render_top(base: str, urls: list[str],
     return lines
 
 
+# ---- bulk scoring job reconstruction ----
+
+SCORE_SCHEMA = "stpu.obs.score/1"
+
+
+def _score_data(events: list[dict]) -> dict:
+    """One score job's story out of the journal: the driver emits
+    ``score_job_start``/``score_job_finished`` and the lease table
+    emits every ``lease_*`` / ``shard_commit`` / duplicate transition —
+    enough to reconstruct shard ownership history, per-worker commit
+    counts, and the exactly-once audit (committed vs duplicate tokens)
+    from a dead fleet's files alone."""
+    jobs: dict = {}
+    # the lease table emits its events without a job field (it predates
+    # nothing — it simply doesn't know the id); attribute them to the
+    # most recently STARTED job, which is correct because one driver
+    # runs one job at a time and events are merged time-ordered
+    current: list = [None]
+
+    def job(ev) -> dict:
+        key = ev.get("job") or current[0] or "?"
+        return jobs.setdefault(key, {
+            "job": key, "start_ts": None, "finish_ts": None,
+            "shards": None, "noop": False, "rows": None,
+            "committed": {}, "duplicates": [], "grants": 0,
+            "expiries": [], "reclaims": [], "workers": {},
+            "timeline": [],
+        })
+
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "score_job_start":
+            current[0] = ev.get("job") or current[0]
+            j = job(ev)
+            j["start_ts"] = ev.get("ts")
+            j["shards"] = ev.get("shards")
+            j["noop"] = bool(ev.get("noop"))
+            j["timeline"].append(ev)
+        elif kind == "score_job_finished":
+            j = job(ev)
+            j["finish_ts"] = ev.get("ts")
+            j["rows"] = ev.get("rows")
+            j["noop"] = bool(ev.get("noop")) or j["noop"]
+            j["timeline"].append(ev)
+        elif kind in ("lease_grant", "lease_expire", "lease_reclaim",
+                      "shard_commit", "shard_discarded_duplicate"):
+            j = job(ev)
+            j["timeline"].append(ev)
+            if kind == "lease_grant":
+                j["grants"] += 1
+            elif kind == "lease_expire":
+                j["expiries"].append(ev)
+            elif kind == "lease_reclaim":
+                j["reclaims"].append(ev)
+            elif kind == "shard_commit":
+                j["committed"][ev.get("shard")] = ev
+                w = ev.get("worker") or "?"
+                j["workers"][w] = j["workers"].get(w, 0) + 1
+            else:
+                j["duplicates"].append(ev)
+    out = [j for j in jobs.values() if j["timeline"]]
+    if not out:
+        return {}
+    for j in out:
+        j["committed_rows"] = sum(
+            int(e.get("rows") or 0) for e in j["committed"].values())
+        tokens = [e.get("lease") for e in j["committed"].values()]
+        j["duplicate_committed_tokens"] = len(tokens) - len(set(tokens))
+    return {"schema": SCORE_SCHEMA, "jobs": out}
+
+
+def _render_score(data: dict, t0: float) -> list[str]:
+    lines: list[str] = []
+    for j in data["jobs"]:
+        n_committed = len(j["committed"])
+        total = j["shards"] if j["shards"] is not None else "?"
+        state = ("no-op (already sealed)" if j["noop"]
+                 else "finished" if j["finish_ts"] is not None
+                 else "RUNNING/DEAD")
+        lines.append(f"score job {j['job']} — {state}: "
+                     f"{n_committed}/{total} shard(s) committed, "
+                     f"{j['committed_rows']} row(s)")
+        lines.append(f"  grants {j['grants']}  expiries "
+                     f"{len(j['expiries'])}  reclaims "
+                     f"{len(j['reclaims'])}  duplicates discarded "
+                     f"{len(j['duplicates'])}  duplicate committed "
+                     f"tokens {j['duplicate_committed_tokens']}")
+        if j["workers"]:
+            per = "  ".join(f"{w}={n}" for w, n in
+                            sorted(j["workers"].items()))
+            lines.append(f"  commits by worker: {per}")
+        for ev in j["timeline"]:
+            lines.append(" " + _fmt_event(ev, t0))
+    return lines
+
+
+def cmd_score(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _score_data(events)
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0 if data else 1
+    if not data:
+        print("no score-plane events — run the job with "
+              "`python -m shifu_tensorflow_tpu.score run --journal ...`")
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    for line in _render_score(data, t0):
+        print(line)
+    return 0
+
+
 def cmd_top(args) -> int:
     # per-file parse cache: rotated journal files are immutable, so each
     # refresh re-reads only the growing active files, not the whole
@@ -2139,6 +2268,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_mem(args)
         if args.cmd == "profile":
             return cmd_profile(args)
+        if args.cmd == "score":
+            return cmd_score(args)
         return cmd_summary(args)
     except KeyboardInterrupt:
         return 0

@@ -1,0 +1,36 @@
+"""Bulk offline scoring plane: exactly-once batch scoring over the fleet.
+
+Reference parity: shifu-tensorflow-eval is a *batch* scorer plugged into
+Shifu's ``Computable`` eval interface — whole datasets scored offline,
+not one HTTP micro-batch at a time.  This package is that job plane,
+grown around the machinery previous PRs built:
+
+- a deterministic **shard plan** (:mod:`~shifu_tensorflow_tpu.score.plan`)
+  over the input directory's data files (splitter conventions: dot/
+  underscore-prefixed names are invisible);
+- **lease-based shard ownership**
+  (:mod:`~shifu_tensorflow_tpu.score.lease`): a worker holds a
+  heartbeat-renewed lease per input shard; the coordinator reclaims
+  expired leases and re-dispatches them, so a SIGKILLed or wedged scorer
+  never strands a shard — speculative re-execution for stragglers rides
+  the same reclaim path;
+- an **exactly-once output commit protocol**
+  (:mod:`~shifu_tensorflow_tpu.score.committer`): tmp-side writes under
+  reader-invisible names, coordinator-arbitrated first-commit-wins by
+  dedup token, rename-commit publish sealed by a digest sidecar, and a
+  job-level ``_SUCCESS`` manifest written last — duplicate attempts are
+  discarded by token, torn tmp files are invisible to readers, and a
+  re-run resumes from the committed set;
+- the **driver + worker** (:mod:`~shifu_tensorflow_tpu.score.job`,
+  :mod:`~shifu_tensorflow_tpu.score.worker`) composing ShardPipeline
+  readers (PR-6) with batch-admitted MultiModelStore tenants (PR-9/14)
+  so N models score one input scan in a single pass.
+
+CLI: ``python -m shifu_tensorflow_tpu.score run ...`` (driver + fleet),
+``... worker`` (one scorer process).  See docs/scoring.md.
+"""
+
+from shifu_tensorflow_tpu.score.lease import LeaseTable
+from shifu_tensorflow_tpu.score.plan import ShardSpec, build_plan
+
+__all__ = ["LeaseTable", "ShardSpec", "build_plan"]

@@ -1,0 +1,217 @@
+"""Output commit protocol: exactly-once publication of scored shards.
+
+The disk layout of a score job's output directory::
+
+    part-00003.psv                    committed data (one per input shard)
+    part-00003.psv.manifest.json      digest sidecar sealing it
+    .part-00003.<lease>.tmp           a staged (or torn) attempt — the
+                                      dot prefix makes it invisible to
+                                      splitter.list_data_files readers
+    _PLAN.json                        the plan this job ran (score/plan.py)
+    _SUCCESS                          job manifest, written LAST
+
+Protocol (the exactly-once argument, spelled out in docs/scoring.md):
+
+1. **Stage**: the worker writes the shard's scored rows under a tmp name
+   that encodes its lease token.  ``score.commit`` is the torn-write
+   chaos seam here — a firing term persists a prefix and aborts, exactly
+   what a SIGKILL mid-write leaves behind.  Torn or abandoned tmps are
+   never visible to readers and are swept at finalize.
+2. **Arbitrate**: the worker asks the coordinator to commit
+   ``(shard, lease, manifest)``.  The lease table accepts the FIRST
+   commit per shard and answers every later one ``duplicate``
+   (score/lease.py) — this is the only serialization point.
+3. **Publish**: only an accepted committer renames tmp → final
+   (fs.commit_rename: at-most-once effect, verification-based recovery)
+   and then seals it with the digest sidecar (rows / size / CRC32 /
+   SHA-256 + input shard id + lease token).  Sidecar AFTER data: a
+   sidecar's presence implies intact covered data, same ordering
+   discipline as the export manifest.  A rejected committer deletes its
+   tmp and moves on.
+4. **Audit + seal**: the driver re-verifies every committed shard on
+   disk (an accepted committer may have died between arbitration and
+   rename — such shards are REOPENED and re-dispatched), then writes
+   ``_SUCCESS`` last, enumerating every shard's token and digests plus
+   job row totals.  A re-run finding ``_SUCCESS`` is a journaled no-op;
+   a re-run finding partial output resumes from the verified committed
+   set (scan_committed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from shifu_tensorflow_tpu.utils import faults, fs, integrity, logs
+
+log = logs.get("score.committer")
+
+SHARD_SCHEMA = "stpu.score.shard/1"
+JOB_SCHEMA = "stpu.score.job/1"
+SUCCESS_FILE = "_SUCCESS"
+
+
+def shard_file(out_dir: str, shard: int) -> str:
+    return os.path.join(out_dir, f"part-{shard:05d}.psv")
+
+
+def sidecar_file(out_dir: str, shard: int) -> str:
+    return shard_file(out_dir, shard) + ".manifest.json"
+
+
+def tmp_file(out_dir: str, shard: int, lease: str) -> str:
+    # dot prefix: invisible to splitter.list_data_files; lease token in
+    # the name: two attempts at one shard never collide tmp-side
+    return os.path.join(out_dir, f".part-{shard:05d}.{lease}.tmp")
+
+
+def shard_manifest(shard: int, lease: str, worker: str, payload: bytes,
+                   rows: int, tenants: list[str],
+                   input_paths: list[str]) -> dict:
+    return {
+        "schema": SHARD_SCHEMA,
+        "shard": shard,
+        "token": lease,
+        "worker": worker,
+        "rows": rows,
+        "tenants": list(tenants),
+        "input_paths": list(input_paths),
+        "data": integrity.digest_entry(payload),
+    }
+
+
+def stage(out_dir: str, shard: int, lease: str, payload: bytes) -> str:
+    """Write the staged tmp file (torn-write seam inside).  Returns the
+    tmp path.  On a firing ``score.commit`` torn-write term the prefix
+    IS persisted (the torn file must genuinely exist on disk for the
+    drill to prove readers never see it) and InjectedTornWrite raises."""
+    tmp = tmp_file(out_dir, shard, lease)
+    cut = faults.torn_cut("score.commit", len(payload))
+    with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
+        f.write(payload if cut is None else payload[:cut])
+    if cut is not None:
+        raise faults.InjectedTornWrite("score.commit", cut, len(payload))
+    return tmp
+
+
+def publish(out_dir: str, shard: int, lease: str, manifest: dict) -> None:
+    """Rename-commit the staged data, then seal with the sidecar."""
+    fs.commit_rename(tmp_file(out_dir, shard, lease),
+                     shard_file(out_dir, shard))
+    integrity.commit_bytes(
+        sidecar_file(out_dir, shard),
+        json.dumps(manifest, indent=2).encode("utf-8"),
+        site="score.commit",
+    )
+
+
+def discard(out_dir: str, shard: int, lease: str) -> None:
+    """Drop a staged attempt that lost the commit arbitration."""
+    try:
+        os.remove(tmp_file(out_dir, shard, lease))
+    except OSError:
+        pass
+
+
+def verify_shard(out_dir: str, shard: int) -> dict | None:
+    """The shard's sidecar manifest iff data + sidecar are both present
+    and the data bytes match the recorded digests; else None (torn,
+    missing, or tampered — the shard does not count as committed)."""
+    side = sidecar_file(out_dir, shard)
+    final = shard_file(out_dir, shard)
+    if not (os.path.exists(side) and os.path.exists(final)):
+        return None
+    try:
+        manifest = json.loads(fs.read_bytes(side))
+    except (ValueError, OSError):
+        return None
+    if manifest.get("schema") != SHARD_SCHEMA:
+        return None
+    mismatch = integrity.check_entry(fs.read_bytes(final),
+                                     manifest.get("data") or {})
+    if mismatch is not None:
+        log.warning("shard %d output fails its sidecar digest (%s) — "
+                    "not counting it committed", shard, mismatch)
+        return None
+    return manifest
+
+
+def scan_committed(out_dir: str, n_shards: int) -> dict[int, dict]:
+    """Resume scan: every shard whose on-disk output verifies against
+    its sidecar.  Pure disk read — this is how a fresh driver learns
+    what a crashed predecessor already finished."""
+    out: dict[int, dict] = {}
+    for shard in range(n_shards):
+        manifest = verify_shard(out_dir, shard)
+        if manifest is not None:
+            out[shard] = manifest
+    return out
+
+
+def sweep_tmp(out_dir: str) -> int:
+    """Delete staged/torn tmp attempts (finalize housekeeping).  Returns
+    the count removed — the kill drills assert their torn file was both
+    present (the fault landed) and swept (readers never cared)."""
+    n = 0
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith(".part-") and name.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(out_dir, name))
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
+def write_success(out_dir: str, doc: dict) -> None:
+    """Seal the job: ``_SUCCESS`` written last via the same atomic
+    publish; its presence implies every enumerated shard committed."""
+    doc = dict(doc)
+    doc["schema"] = JOB_SCHEMA
+    integrity.commit_bytes(
+        os.path.join(out_dir, SUCCESS_FILE),
+        json.dumps(doc, indent=2).encode("utf-8"),
+        site="score.commit",
+    )
+
+
+def read_success(out_dir: str) -> dict | None:
+    path = os.path.join(out_dir, SUCCESS_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        doc = json.loads(fs.read_bytes(path))
+    except (ValueError, OSError):
+        return None
+    if doc.get("schema") != JOB_SCHEMA:
+        return None
+    return doc
+
+
+def job_doc(plan_doc: dict, committed: dict[int, dict]) -> dict:
+    """The ``_SUCCESS`` document: every shard's token + digests + the
+    job row total — the token/row-count audit surface for drills and
+    for ``obs score``."""
+    shards = []
+    total_rows = 0
+    for shard in sorted(committed):
+        m = committed[shard]
+        total_rows += int(m.get("rows", 0))
+        shards.append({
+            "shard": shard,
+            "token": m.get("token"),
+            "worker": m.get("worker"),
+            "rows": m.get("rows"),
+            "data": m.get("data"),
+        })
+    return {
+        "input_dir": plan_doc.get("input_dir"),
+        "tenants": plan_doc.get("tenants"),
+        "n_shards": len(plan_doc.get("shards", [])),
+        "total_rows": total_rows,
+        "shards": shards,
+    }

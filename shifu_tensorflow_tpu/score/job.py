@@ -1,0 +1,342 @@
+"""Score job driver: plan → lease → fleet → audit → seal.
+
+The driver owns the job's durable truth.  It plans (or resumes) the
+shard set, attaches a :class:`ScoreJob` to a coordinator so workers can
+lease/commit over the existing RPC plane, runs the scan fleet, ticks
+lease reclamation, and finalizes: audit every accepted commit against
+the bytes actually on disk, reopen any that never published, sweep tmp
+debris, and write ``_SUCCESS`` last.  Every decision is journaled
+(``score_job_start`` / lease and commit events from the table /
+``score_job_finished``) so ``obs score`` can reconstruct the job from a
+dead fleet's files.
+
+Crash matrix the finalize audit closes (the one window the ask-first
+commit protocol leaves): a worker may die AFTER the coordinator accepted
+its commit but BEFORE the rename published the bytes.  The audit waits
+up to one lease ttl for the in-flight publish (the publisher either
+finishes or is dead by then), then reopens the shard in the lease table
+and lets the fleet re-score it — re-entering the normal loop until
+every shard's on-disk bytes verify against their sidecar.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.score import committer, plan as plan_mod
+from shifu_tensorflow_tpu.score.lease import LeaseTable
+from shifu_tensorflow_tpu.utils import fs, logs
+
+log = logs.get("score.job")
+
+
+class ScoreJob:
+    """Coordinator-attached score-job state: the lease table plus the
+    job description workers need (shards, models, output).  The RPC
+    handlers below are what `coordinator._dispatch` routes the four
+    score ops to; everything they mutate is the lease table, which owns
+    its own lock."""
+
+    def __init__(self, doc: dict, out_dir: str, table: LeaseTable, *,
+                 models_dir: str, batch_rows: int, job_id: str):
+        self.doc = doc
+        self.out_dir = out_dir
+        self.table = table
+        self.models_dir = models_dir
+        self.batch_rows = int(batch_rows)
+        self.job_id = job_id
+
+    # ---- RPC handlers (coordinator handler threads) ----
+
+    def plan_msg(self) -> dict:
+        return {"ok": True, "job": {
+            "job_id": self.job_id,
+            "out_dir": self.out_dir,
+            "models_dir": self.models_dir,
+            "tenants": list(self.doc.get("tenants") or []),
+            "delimiter": "|",
+            "batch_rows": self.batch_rows,
+            "shards": self.doc.get("shards") or [],
+        }}
+
+    def rpc_acquire(self, worker: str) -> dict:
+        grant = self.table.acquire(worker, uuid.uuid4().hex)
+        return {"ok": True, "grant": grant, "done": self.table.done()}
+
+    def rpc_renew(self, shard: int, lease: str) -> dict:
+        return {"ok": True, "renewed": self.table.renew(shard, lease)}
+
+    def rpc_commit(self, shard: int, lease: str, manifest: dict,
+                   worker: str | None) -> dict:
+        result = self.table.commit(shard, lease, manifest, worker=worker)
+        return {"ok": True, "result": result}
+
+
+def _spawn_process(coord_addr: str, worker_id: str, *, backend: str,
+                   env: dict | None) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "shifu_tensorflow_tpu.score", "worker",
+           "--coordinator", coord_addr, "--worker-id", worker_id,
+           "--backend", backend]
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    # scorers inherit the driver's stderr but NOT its stdout: the worker
+    # prints its counters line on exit, and the driver's stdout is a
+    # machine contract (`score run --json`)
+    try:
+        out = sys.stderr.fileno()
+    except (AttributeError, OSError, ValueError):
+        out = subprocess.DEVNULL
+    return subprocess.Popen(cmd, env=full_env, stdout=out)
+
+
+def _spawn_thread(host: str, port: int, worker_id: str, *, backend: str,
+                  stores) -> threading.Thread:
+    from shifu_tensorflow_tpu.coordinator.coordinator import CoordinatorClient
+    from shifu_tensorflow_tpu.score.worker import run_worker
+
+    def main():
+        client = CoordinatorClient(host, port, timeout_s=60.0)
+        try:
+            run_worker(client, worker_id, stores=stores, backend=backend)
+        except Exception as e:
+            log.warning("thread worker %s died: %s", worker_id, e)
+
+    t = threading.Thread(target=main, name=worker_id, daemon=True)
+    t.start()
+    return t
+
+
+def run_job(
+    input_dir: str,
+    models_dir: str,
+    out_dir: str,
+    *,
+    workers: int = K.DEFAULT_SCORE_WORKERS,
+    tenants: list[str] | None = None,
+    max_shards: int = K.DEFAULT_SCORE_MAX_SHARDS,
+    ttl_s: float = K.DEFAULT_SCORE_LEASE_TTL_S,
+    speculate_factor: float = K.DEFAULT_SCORE_SPECULATE_FACTOR,
+    batch_rows: int = K.DEFAULT_SCORE_BATCH_ROWS,
+    backend: str = "native",
+    worker_mode: str = "process",
+    worker_env: dict | None = None,
+    stores=None,
+    host: str = "127.0.0.1",
+    max_respawns: int = 2,
+    timeout_s: float = 600.0,
+    on_spawn=None,
+) -> dict:
+    """Run one bulk scoring job end to end; returns the job summary
+    (also journaled as ``score_job_finished``).  Re-running a finished
+    job is a journaled no-op; re-running a crashed one resumes from the
+    verified committed set.
+
+    ``worker_mode="process"`` spawns real scorer processes (the kill
+    drills' substrate; ``on_spawn(worker_id, popen)`` exposes them);
+    ``"thread"`` runs workers in-process against pre-admitted ``stores``
+    — unit-test mode, no jax double-init across forks to worry about."""
+    from shifu_tensorflow_tpu.coordinator.coordinator import (
+        Coordinator, JobSpec,
+    )
+
+    fs.mkdirs(out_dir)
+    job_id = uuid.uuid4().hex[:8]
+    t0 = time.monotonic()
+
+    # finished job → journaled no-op (the re-run drill's assertion)
+    success = committer.read_success(out_dir)
+    if success is not None:
+        obs_journal.emit("score_job_start", job=job_id, input=input_dir,
+                         out=out_dir, resumed=True, noop=True)
+        obs_journal.emit("score_job_finished", job=job_id, noop=True,
+                         shards=len(success.get("shards", [])),
+                         rows=success.get("total_rows"),
+                         duplicates=0, reclaims=0, wall_s=0.0)
+        log.info("score job %s: output already sealed (_SUCCESS) — no-op",
+                 job_id)
+        return {"noop": True, "job_id": job_id,
+                "rows": success.get("total_rows"),
+                "shards": len(success.get("shards", [])),
+                "duplicates": 0, "reclaims": 0}
+
+    # plan: resume the persisted one (shard ids must keep their meaning
+    # even if the input dir changed) or build + persist
+    doc = plan_mod.load_plan(out_dir)
+    resumed_plan = doc is not None
+    if doc is None:
+        from shifu_tensorflow_tpu.serve.tenancy.store import discover_bundles
+
+        found = discover_bundles(models_dir)
+        use = sorted(tenants if tenants is not None else found)
+        specs = plan_mod.build_plan(input_dir, max_shards=max_shards)
+        doc = plan_mod.plan_doc(specs, input_dir=input_dir, tenants=use)
+        plan_mod.save_plan(out_dir, doc)
+    specs = plan_mod.specs_from_doc(doc)
+    n_shards = len(specs)
+
+    committed = committer.scan_committed(out_dir, n_shards)
+    # wake the driver loop on every commit instead of letting it sleep
+    # out a blind ttl/4 tick — otherwise the tick is the job's wall-time
+    # floor no matter how small the dataset
+    wake = threading.Event()
+
+    def _on_event(event: str, **fields) -> None:
+        obs_journal.emit(event, **fields)
+        if event == "shard_commit":
+            wake.set()
+
+    table = LeaseTable(n_shards, ttl_s=ttl_s,
+                       speculate_factor=speculate_factor,
+                       on_event=_on_event)
+    for shard, manifest in committed.items():
+        table.preload_committed(shard, manifest)
+    obs_journal.emit("score_job_start", job=job_id, input=input_dir,
+                     out=out_dir, shards=n_shards,
+                     tenants=len(doc.get("tenants") or []),
+                     resumed=resumed_plan, precommitted=len(committed),
+                     workers=workers, ttl_s=ttl_s)
+    log.info("score job %s: %d shard(s), %d pre-committed, %d worker(s)",
+             job_id, n_shards, len(committed), workers)
+
+    job = ScoreJob(doc, out_dir, table, models_dir=models_dir,
+                   batch_rows=batch_rows, job_id=job_id)
+    coord = Coordinator(JobSpec(n_workers=max(1, workers),
+                                shards=[None] * max(1, workers),
+                                job_id=job_id))
+    coord.attach_score_job(job)
+    chost, cport = coord.serve(host, 0)
+    addr = f"{chost}:{cport}"
+
+    procs: dict[str, subprocess.Popen] = {}
+    threads: dict[str, threading.Thread] = {}
+    respawns = 0
+
+    def spawn(i: int, generation: int = 0) -> None:
+        worker_id = (f"scorer-{i}" if generation == 0
+                     else f"scorer-{i}r{generation}")
+        if worker_mode == "process":
+            p = _spawn_process(addr, worker_id, backend=backend,
+                               env=worker_env)
+            procs[worker_id] = p
+            if on_spawn is not None:
+                on_spawn(worker_id, p)
+        else:
+            threads[worker_id] = _spawn_thread(
+                chost, cport, worker_id, backend=backend, stores=stores)
+
+    try:
+        for i in range(workers):
+            spawn(i)
+
+        tick = max(0.05, ttl_s / 4.0)
+        deadline = t0 + timeout_s
+        while True:
+            # the finalize audit: verify accepted commits against disk;
+            # reopen unpublished ones and keep the fleet running
+            if table.done():
+                missing = _audit(out_dir, n_shards, table, ttl_s)
+                if not missing:
+                    break
+                respawns += _ensure_fleet(procs, threads, spawn,
+                                          worker_mode, max_respawns,
+                                          respawns)
+            table.reclaim_expired()
+            if worker_mode == "process" and not table.done():
+                respawns += _ensure_fleet(procs, threads, spawn,
+                                          worker_mode, max_respawns,
+                                          respawns)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"score job {job_id} incomplete after {timeout_s}s: "
+                    f"{table.counts()} / snapshot {table.snapshot()}")
+            wake.wait(tick)
+            wake.clear()
+
+        table.close()
+        final = committer.scan_committed(out_dir, n_shards)
+        swept = committer.sweep_tmp(out_dir)
+        success_doc = committer.job_doc(doc, final)
+        success_doc["job_id"] = job_id
+        committer.write_success(out_dir, success_doc)
+        counts = table.counts()
+        wall_s = round(time.monotonic() - t0, 3)
+        obs_journal.emit("score_job_finished", job=job_id, noop=False,
+                         shards=n_shards, rows=success_doc["total_rows"],
+                         duplicates=counts["duplicates"],
+                         reclaims=counts["reclaims"],
+                         speculative=counts["speculative_reclaims"],
+                         swept_tmp=swept, wall_s=wall_s)
+        log.info("score job %s: sealed %d shard(s), %d row(s) in %.1fs "
+                 "(%d reclaim(s), %d duplicate(s), %d tmp swept)",
+                 job_id, n_shards, success_doc["total_rows"], wall_s,
+                 counts["reclaims"], counts["duplicates"], swept)
+        return {"noop": False, "job_id": job_id,
+                "rows": success_doc["total_rows"], "shards": n_shards,
+                "duplicates": counts["duplicates"],
+                "reclaims": counts["reclaims"],
+                "speculative": counts["speculative_reclaims"],
+                "grants": counts["grants"], "wall_s": wall_s,
+                "respawns": respawns}
+    finally:
+        table.close()
+        _drain_fleet(procs, threads)
+        coord.shutdown()
+
+
+def _audit(out_dir: str, n_shards: int, table: LeaseTable,
+           ttl_s: float) -> list[int]:
+    """Verify every accepted commit's bytes on disk; reopen the ones
+    that never published.  Bounded wait first: an accepted committer may
+    be mid-rename RIGHT NOW — it either finishes within a ttl or it is
+    dead and the shard must be re-scored."""
+    deadline = time.monotonic() + ttl_s
+    while True:
+        missing = [s for s in range(n_shards)
+                   if committer.verify_shard(out_dir, s) is None]
+        if not missing or time.monotonic() > deadline:
+            break
+        time.sleep(min(0.05, ttl_s / 10.0))
+    for shard in missing:
+        table.reopen(shard)
+    return missing
+
+
+def _ensure_fleet(procs, threads, spawn, worker_mode: str,
+                  max_respawns: int, respawns: int) -> int:
+    """Process mode: if EVERY worker is dead while work remains, spawn a
+    replacement (up to ``max_respawns``).  A partial fleet is left alone
+    — surviving peers absorb reclaimed leases, which is the drill the
+    elastic design exists for."""
+    if worker_mode != "process":
+        return 0
+    live = [p for p in procs.values() if p.poll() is None]
+    if live or respawns >= max_respawns:
+        return 0
+    log.warning("score fleet fully dead with work remaining — spawning "
+                "replacement worker (%d/%d respawns)", respawns + 1,
+                max_respawns)
+    spawn(len(procs), generation=respawns + 1)
+    return 1
+
+
+def _drain_fleet(procs, threads) -> None:
+    for worker_id, p in procs.items():
+        try:
+            p.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            log.warning("terminating worker %s (did not exit)", worker_id)
+            p.terminate()
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    for t in threads.values():
+        t.join(timeout=10.0)

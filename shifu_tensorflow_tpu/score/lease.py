@@ -1,0 +1,294 @@
+"""Lease table: shard ownership for the bulk scoring plane.
+
+Pure coordinator-side state machine — no sockets, no clocks it didn't
+inject, so every edge case is a unit test (tests/test_score.py).  One
+row per input shard::
+
+    PENDING ──acquire──▶ LEASED ──commit──▶ COMMITTED   (terminal)
+       ▲                    │
+       └────reclaim─────────┘   (expiry, speculation, or audit reopen)
+
+Ownership rules, in decreasing order of subtlety:
+
+- **First commit wins, lease currency does not.**  A commit carries the
+  lease token it was granted under; if the shard is not yet COMMITTED
+  the commit is accepted even when that lease has expired and the shard
+  was re-leased to a peer — the work is done and deterministic, re-doing
+  it buys nothing.  The peer's later commit is then the duplicate and is
+  discarded.  This is the "expiry while a commit is in flight" case: the
+  committing token wins, the late one is discarded.
+- **Expiry is observed, not pushed.**  The driver ticks
+  :meth:`reclaim_expired`; a worker discovers it lost a lease only when
+  :meth:`renew` returns False (or its commit comes back duplicate).
+  Double-reclaiming a shard is harmless: reclaim of a PENDING or
+  COMMITTED shard is a no-op by state check.
+- **Speculation rides the reclaim path.**  When nothing is PENDING, an
+  idle worker's acquire may early-reclaim the longest-running lease if
+  it has outlived ``speculate_factor`` × the median committed-shard
+  duration — a straggler's shard re-scored by a fast peer, with the
+  commit arbitration guaranteeing only one output wins.
+- **Close refuses, never blocks.**  After :meth:`close` every mutating
+  call returns its failure value (renewal racing coordinator shutdown
+  must see a clean refusal, not a hang or a spurious grant).
+
+Every transition is reported through ``on_event`` (the ScoreJob wires it
+to the obs journal): ``lease_grant`` / ``lease_expire`` /
+``lease_reclaim`` / ``shard_commit`` / ``shard_discarded_duplicate``.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("score.lease")
+
+PENDING = "pending"
+LEASED = "leased"
+COMMITTED = "committed"
+
+
+class _Row:
+    __slots__ = ("shard", "state", "token", "holder", "expires",
+                 "granted_at", "attempts", "manifest", "committed_by")
+
+    def __init__(self, shard: int):
+        self.shard = shard
+        self.state = PENDING
+        self.token: str | None = None
+        self.holder: str | None = None
+        self.expires = 0.0
+        self.granted_at = 0.0
+        self.attempts = 0
+        self.manifest: dict | None = None
+        self.committed_by: str | None = None
+
+
+class LeaseTable:
+    """Thread-safe (coordinator handler threads + the driver tick)."""
+
+    def __init__(
+        self,
+        n_shards: int,
+        *,
+        ttl_s: float = 10.0,
+        clock=time.monotonic,
+        speculate_factor: float = 0.0,
+        on_event=None,
+    ):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.ttl_s = float(ttl_s)
+        self.speculate_factor = float(speculate_factor)
+        self._clock = clock
+        self._emit = on_event or (lambda event, **fields: None)
+        self._rows = [_Row(i) for i in range(n_shards)]
+        self._lock = threading.Lock()
+        self._closed = False
+        #: wall of committed-shard durations (grant→commit seconds) —
+        #: the speculation trigger's baseline
+        self._commit_durations: list[float] = []
+        # counters for the job summary / audit
+        self.grants = 0
+        self.expiries = 0
+        self.reclaims = 0
+        self.speculative_reclaims = 0
+        self.duplicates = 0
+
+    # ---- mutations --------------------------------------------------------
+
+    def preload_committed(self, shard: int, manifest: dict) -> None:
+        """Resume path: mark a shard committed from a verified on-disk
+        sidecar before any worker runs — its token/holder come from the
+        sidecar, not a live lease."""
+        with self._lock:
+            row = self._rows[shard]
+            if row.state == COMMITTED:
+                return
+            row.state = COMMITTED
+            row.manifest = dict(manifest)
+            row.token = manifest.get("token")
+            row.committed_by = manifest.get("worker")
+
+    def acquire(self, worker: str, token: str) -> dict | None:
+        """Grant the lowest PENDING shard to ``worker`` under ``token``
+        (the caller mints it — it must be globally unique).  Returns the
+        grant record, or None when nothing is grantable right now (all
+        shards leased-and-healthy or committed, or the table is closed).
+        The caller distinguishes "wait" from "done" via :meth:`done`."""
+        with self._lock:
+            if self._closed:
+                return None
+            now = self._clock()
+            row = next((r for r in self._rows if r.state == PENDING), None)
+            if row is None:
+                row = self._speculation_victim(now)
+                if row is None:
+                    return None
+                self._reclaim(row, now, reason="speculative",
+                              speculative=True)
+            row.state = LEASED
+            row.token = token
+            row.holder = worker
+            row.granted_at = now
+            row.expires = now + self.ttl_s
+            row.attempts += 1
+            self.grants += 1
+            self._emit("lease_grant", shard=row.shard, worker=worker,
+                       lease=token, attempt=row.attempts,
+                       ttl_s=self.ttl_s)
+            return {"shard": row.shard, "lease": token,
+                    "attempt": row.attempts, "ttl_s": self.ttl_s}
+
+    def renew(self, shard: int, token: str) -> bool:
+        """Heartbeat: extend the lease iff ``token`` is still the shard's
+        CURRENT lease.  False means the holder lost ownership (expired
+        and reclaimed, shard committed by a peer, or shutdown) — the
+        worker should abandon the shard (its commit may still win the
+        arbitration if it gets there first)."""
+        with self._lock:
+            if self._closed:
+                return False
+            row = self._rows[shard]
+            if row.state != LEASED or row.token != token:
+                return False
+            row.expires = self._clock() + self.ttl_s
+            return True
+
+    def commit(self, shard: int, token: str, manifest: dict,
+               worker: str | None = None) -> str:
+        """First-commit-wins arbitration.  Returns ``"accept"`` (this
+        token owns the output — publish it) or ``"duplicate"`` (a commit
+        already won — discard the staged output).  Acceptance does NOT
+        require the lease to still be current; see the module docstring.
+        A closed table refuses with ``"duplicate"`` semantics only for
+        genuinely-committed shards — otherwise ``"closed"`` so a worker
+        racing shutdown never publishes unarbitrated output."""
+        with self._lock:
+            row = self._rows[shard]
+            if row.state == COMMITTED:
+                self.duplicates += 1
+                self._emit("shard_discarded_duplicate", shard=shard,
+                           lease=token, worker=worker,
+                           committed_lease=row.token,
+                           committed_by=row.committed_by)
+                return "duplicate"
+            if self._closed:
+                return "closed"
+            if row.state == LEASED and row.token == token:
+                self._commit_durations.append(
+                    max(0.0, self._clock() - row.granted_at))
+            row.state = COMMITTED
+            row.manifest = dict(manifest)
+            row.token = token
+            row.holder = None
+            row.committed_by = worker
+            self._emit("shard_commit", shard=shard, lease=token,
+                       worker=worker, rows=manifest.get("rows"),
+                       attempt=row.attempts)
+            return "accept"
+
+    def reclaim_expired(self) -> list[int]:
+        """Driver tick: every LEASED shard past its deadline goes back to
+        PENDING (journaled as ``lease_expire`` then ``lease_reclaim``).
+        Idempotent — a second tick (or a concurrent one) finds the shard
+        already PENDING and leaves it alone."""
+        out: list[int] = []
+        with self._lock:
+            if self._closed:
+                return out
+            now = self._clock()
+            for row in self._rows:
+                if row.state == LEASED and now >= row.expires:
+                    self._reclaim(row, now, reason="expired")
+                    out.append(row.shard)
+        return out
+
+    def reopen(self, shard: int) -> None:
+        """Audit path: a commit was accepted but its output never became
+        visible (publisher died between arbitration and rename) — put
+        the shard back in play.  No-op unless COMMITTED."""
+        with self._lock:
+            row = self._rows[shard]
+            if row.state != COMMITTED:
+                return
+            log.warning("reopening shard %d: accepted commit (lease %s) "
+                        "never published", shard, row.token)
+            row.state = PENDING
+            row.manifest = None
+            row.token = None
+            row.committed_by = None
+            self.reclaims += 1
+            self._emit("lease_reclaim", shard=shard, reason="unpublished")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    # ---- internals (call under lock) --------------------------------------
+
+    def _reclaim(self, row: _Row, now: float, *, reason: str,
+                 speculative: bool = False) -> None:
+        self.expiries += 0 if speculative else 1
+        self.reclaims += 1
+        if speculative:
+            self.speculative_reclaims += 1
+        else:
+            self._emit("lease_expire", shard=row.shard, worker=row.holder,
+                       lease=row.token,
+                       age_s=round(now - row.granted_at, 3))
+        self._emit("lease_reclaim", shard=row.shard, reason=reason,
+                   prev_worker=row.holder, prev_lease=row.token,
+                   attempt=row.attempts)
+        row.state = PENDING
+        row.token = None
+        row.holder = None
+
+    def _speculation_victim(self, now: float) -> _Row | None:
+        """The longest-running live lease, iff speculation is enabled and
+        it has outlived factor × median committed duration (needs at
+        least one committed shard to have a baseline)."""
+        if self.speculate_factor <= 0.0 or not self._commit_durations:
+            return None
+        threshold = (self.speculate_factor
+                     * statistics.median(self._commit_durations))
+        victims = [r for r in self._rows
+                   if r.state == LEASED and now - r.granted_at > threshold]
+        if not victims:
+            return None
+        return min(victims, key=lambda r: r.granted_at)
+
+    # ---- views ------------------------------------------------------------
+
+    def done(self) -> bool:
+        with self._lock:
+            return all(r.state == COMMITTED for r in self._rows)
+
+    def committed(self) -> dict[int, dict]:
+        with self._lock:
+            return {r.shard: dict(r.manifest) for r in self._rows
+                    if r.state == COMMITTED and r.manifest is not None}
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            by_state = {PENDING: 0, LEASED: 0, COMMITTED: 0}
+            for r in self._rows:
+                by_state[r.state] += 1
+            return {
+                "shards": len(self._rows),
+                **by_state,
+                "grants": self.grants,
+                "expiries": self.expiries,
+                "reclaims": self.reclaims,
+                "speculative_reclaims": self.speculative_reclaims,
+                "duplicates": self.duplicates,
+            }
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [{"shard": r.shard, "state": r.state, "lease": r.token,
+                     "holder": r.holder, "attempts": r.attempts}
+                    for r in self._rows]

@@ -1,0 +1,105 @@
+"""Deterministic shard plan for a bulk scoring job.
+
+The plan is the unit of leasing and of exactly-once accounting: shard
+``k`` always names the same input files with the same ordering, across
+drivers, re-runs, and resumed jobs.  Two layers guarantee that:
+
+- :func:`build_plan` is a pure function of the input listing — sorted
+  file paths, one shard per file by default, or size-aware grouping
+  (data/splitter.split_size_aware, greedy-deterministic) when capped by
+  ``max_shards``;
+- the driver persists the plan it actually ran as ``_PLAN.json`` in the
+  output directory (underscore prefix: invisible to data listings, the
+  Hadoop convention splitter.list_data_files honors), and a resumed run
+  LOADS that file instead of re-planning — so even if the input dir
+  grew between runs, committed shard ids keep meaning what they meant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from shifu_tensorflow_tpu.data import splitter
+from shifu_tensorflow_tpu.utils import fs, integrity
+
+#: plan document schema tag (format-drift detector for tooling)
+PLAN_SCHEMA = "stpu.score.plan/1"
+PLAN_FILE = "_PLAN.json"
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    shard: int
+    paths: tuple[str, ...]
+    bytes: int
+
+
+def build_plan(input_dir: str, *, max_shards: int = 0,
+               sizes: dict[str, int] | None = None) -> list[ShardSpec]:
+    """One ShardSpec per input file (sorted), or ``max_shards``
+    size-balanced groups when the cap is set and exceeded."""
+    files = sorted(splitter.list_data_files(input_dir))
+    if not files:
+        raise splitter.NotEnoughFilesError(
+            f"no data files under {input_dir!r}")
+    if max_shards and len(files) > max_shards:
+        groups = splitter.split_size_aware(files, max_shards, sizes=sizes)
+        return [
+            ShardSpec(shard=i, paths=tuple(g.paths), bytes=g.total_bytes)
+            for i, g in enumerate(groups)
+        ]
+    def size(p: str) -> int:
+        if sizes is not None and p in sizes:
+            return int(sizes[p])
+        return splitter._size_safe(p)
+
+    return [
+        ShardSpec(shard=i, paths=(p,), bytes=size(p))
+        for i, p in enumerate(files)
+    ]
+
+
+def plan_doc(plan: list[ShardSpec], *, input_dir: str,
+             tenants: list[str]) -> dict:
+    return {
+        "schema": PLAN_SCHEMA,
+        "input_dir": input_dir,
+        "tenants": list(tenants),
+        "shards": [
+            {"shard": s.shard, "paths": list(s.paths), "bytes": s.bytes}
+            for s in plan
+        ],
+    }
+
+
+def save_plan(out_dir: str, doc: dict) -> None:
+    payload = json.dumps(doc, indent=2).encode("utf-8")
+    integrity.commit_bytes(os.path.join(out_dir, PLAN_FILE), payload,
+                           site="score.commit")
+
+
+def load_plan(out_dir: str) -> dict | None:
+    """The persisted plan of a previous (possibly crashed) run, or None.
+    A torn/unparseable plan file reads as None — the driver re-plans and
+    overwrites (nothing was committed under a plan that never finished
+    its own rename-commit)."""
+    path = os.path.join(out_dir, PLAN_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        doc = json.loads(fs.read_bytes(path))
+        if doc.get("schema") != PLAN_SCHEMA:
+            return None
+        return doc
+    except (ValueError, OSError):
+        return None
+
+
+def specs_from_doc(doc: dict) -> list[ShardSpec]:
+    return [
+        ShardSpec(shard=int(s["shard"]), paths=tuple(s["paths"]),
+                  bytes=int(s.get("bytes", 0)))
+        for s in doc.get("shards", [])
+    ]

@@ -1,0 +1,205 @@
+"""Scorer worker: lease → scan → score → commit, repeat until done.
+
+One worker process (or thread, in tests) of the bulk scoring fleet.  It
+is deliberately stateless between shards — everything durable lives in
+the output directory and the coordinator's lease table — so the fleet
+can treat workers as disposable: SIGKILL one mid-shard and its lease
+expires, a peer re-scores the shard, and the commit arbitration keeps
+the output exactly-once.
+
+Per shard, in order:
+
+1. ``lease_acquire`` — the coordinator grants the lowest pending shard
+   (or a speculative steal of a straggler's) under a lease token.
+2. A renewal thread heartbeats ``lease_renew`` at ttl/3; the moment a
+   renewal is refused the worker knows it lost ownership, but it does
+   NOT abort the scan — its commit may still win the arbitration, and
+   deterministic output means a won race costs nothing.
+3. The shard is read through a PR-6 ShardPipeline (retry +
+   chunk-offset resume under the ``score.read.s<shard>`` fault seam)
+   and every tenant's EvalModel scores each block — N models, one scan.
+4. The scored rows are staged tmp-side (``score.commit`` torn-write
+   seam), arbitrated with ``shard_commit``, and published only on
+   ``accept`` (committer.publish); ``duplicate`` discards the staging.
+
+Output row format: tenants in sorted-name order, ``|``-delimited,
+``%.9g`` floats — a pure function of (input rows, bundles), which is
+what makes kill-arm output bit-identical to an unkilled control arm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from shifu_tensorflow_tpu.data.pipeline import ShardPipeline
+from shifu_tensorflow_tpu.data.reader import RecordSchema
+from shifu_tensorflow_tpu.score import committer
+from shifu_tensorflow_tpu.utils import faults, logs
+
+log = logs.get("score.worker")
+
+
+def score_schema(num_features: int, delimiter: str = "|") -> RecordSchema:
+    """Scoring input is pure feature columns — there is no label.  The
+    parser contract wants a target column, so column 0 double-parses as
+    (ignored) target; every column stays a feature."""
+    return RecordSchema(
+        feature_columns=tuple(range(num_features)),
+        target_column=0,
+        delimiter=delimiter,
+    )
+
+
+def format_scores(columns: list[np.ndarray]) -> list[str]:
+    """Rows of ``|``-joined ``%.9g`` scores, one column per tenant."""
+    cols = [np.asarray(c, np.float64).reshape(-1) for c in columns]
+    n = cols[0].shape[0] if cols else 0
+    return [
+        "|".join(format(float(c[i]), ".9g") for c in cols)
+        for i in range(n)
+    ]
+
+
+def score_shard(paths, schema, models: dict, *, shard: int,
+                batch_rows: int) -> tuple[bytes, int]:
+    """Scan one input shard and score it with every tenant model.
+    Returns (payload bytes, row count).  Deterministic: block order is
+    the pipeline's (shard, chunk) order, tenant order is sorted-name."""
+    names = sorted(models)
+    lines: list[str] = []
+    pipe = ShardPipeline(
+        list(paths), schema,
+        n_readers=1, decode_workers=1,
+        block_rows=batch_rows,
+        fault_site_prefix="score", shard_offset=shard,
+    )
+    try:
+        for block, _hashes in pipe.blocks():
+            if len(block) == 0:
+                continue
+            feats = np.asarray(block.features, np.float32)
+            cols = [models[name].compute_batch(feats) for name in names]
+            lines.extend(format_scores(cols))
+    finally:
+        pipe.close()
+    payload = ("\n".join(lines) + "\n").encode("utf-8") if lines else b""
+    return payload, len(lines)
+
+
+class _Renewer:
+    """Heartbeat thread for one lease; ``lost`` is set the moment a
+    renewal is refused (expired/reclaimed/shutdown)."""
+
+    def __init__(self, client, shard: int, lease: str, ttl_s: float):
+        self.lost = threading.Event()
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._run, args=(client, shard, lease, ttl_s),
+            name=f"score-renew-s{shard}", daemon=True)
+        self._t.start()
+
+    def _run(self, client, shard, lease, ttl_s):
+        interval = max(0.05, ttl_s / 3.0)
+        while not self._stop.wait(interval):
+            try:
+                resp = client.lease_renew(shard, lease)
+            except Exception as e:
+                # transport trouble: keep trying until the ttl decides
+                log.warning("lease renew s%d failed transiently: %s",
+                            shard, e)
+                continue
+            if not resp.get("renewed"):
+                self.lost.set()
+                return
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5.0)
+
+
+def run_worker(client, worker_id: str, *, stores=None,
+               poll_s: float = 0.2, backend: str = "native") -> dict:
+    """The worker main loop.  ``client`` is a CoordinatorClient;
+    ``stores`` (name → ModelStore) may be pre-admitted by the caller
+    (thread mode / tests) — otherwise batch admission runs here from the
+    job's models_dir.  Returns per-worker counters."""
+    from shifu_tensorflow_tpu.serve.tenancy.store import admit_batch_tenants
+
+    job = client.score_plan().get("job") or {}
+    if not job:
+        raise RuntimeError("coordinator has no score job attached")
+    out_dir = job["out_dir"]
+    tenants = job["tenants"]
+    shards = {int(s["shard"]): s for s in job["shards"]}
+    delimiter = job.get("delimiter") or "|"
+    batch_rows = int(job.get("batch_rows") or 4096)
+
+    own_stores = stores is None
+    if own_stores:
+        stores = admit_batch_tenants(job["models_dir"], tenants=tenants,
+                                     backend=backend)
+    counters = {"committed": 0, "duplicates": 0, "torn": 0,
+                "abandoned": 0, "rows": 0}
+    try:
+        models = {name: stores[name].current().model for name in tenants}
+        nf = {m.num_features for m in models.values()}
+        if len(nf) != 1:
+            raise ValueError(
+                f"tenant bundles disagree on num_features: {sorted(nf)} — "
+                "one input scan cannot feed them all")
+        schema = score_schema(nf.pop(), delimiter)
+
+        while True:
+            resp = client.lease_acquire(worker_id)
+            grant = resp.get("grant")
+            if grant is None:
+                if resp.get("done") or not resp.get("ok", False):
+                    break
+                time.sleep(poll_s)  # peers hold live leases; wait
+                continue
+            shard = int(grant["shard"])
+            lease = grant["lease"]
+            spec = shards[shard]
+            renewer = _Renewer(client, shard, lease,
+                               float(grant.get("ttl_s") or 10.0))
+            try:
+                payload, rows = score_shard(
+                    spec["paths"], schema, models,
+                    shard=shard, batch_rows=batch_rows)
+                committer.stage(out_dir, shard, lease, payload)
+                manifest = committer.shard_manifest(
+                    shard, lease, worker_id, payload, rows,
+                    sorted(models), list(spec["paths"]))
+                result = client.shard_commit(
+                    shard, lease, manifest).get("result")
+                if result == "accept":
+                    committer.publish(out_dir, shard, lease, manifest)
+                    counters["committed"] += 1
+                    counters["rows"] += rows
+                else:
+                    committer.discard(out_dir, shard, lease)
+                    counters["duplicates"] += 1
+            except faults.InjectedTornWrite as e:
+                # the drill's "killed mid-write": the torn tmp stays on
+                # disk (readers never see it), the lease expires, a peer
+                # (or this worker, later) re-scores the shard
+                log.warning("worker %s tore s%d mid-write (%s) — "
+                            "abandoning the attempt", worker_id, shard, e)
+                counters["torn"] += 1
+            except Exception as e:
+                log.warning("worker %s abandoned s%d: %s", worker_id,
+                            shard, e)
+                counters["abandoned"] += 1
+            finally:
+                renewer.stop()
+    finally:
+        if own_stores:
+            for store in stores.values():
+                try:
+                    store.close()
+                except Exception:
+                    pass
+    return counters

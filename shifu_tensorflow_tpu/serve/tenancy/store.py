@@ -840,3 +840,77 @@ class MultiModelStore:
             for t in admitted:
                 self._evict(t, reason="shutdown")
         self.scheduler.close()
+
+
+# ---- batch admission (bulk scoring plane) --------------------------------
+
+def discover_bundles(models_dir: str) -> dict[str, str]:
+    """Tenant name → bundle dir, by the SAME rules the serving store
+    routes by (immediate subdirectories, ``_NAME_OK`` charset, bundle
+    marker files) — a tenant the batch scorer scores is a tenant the
+    HTTP plane would serve.  A ``models_dir`` that is ITSELF a bundle
+    (single-model export) discovers as one tenant named ``default``."""
+    if (os.path.isfile(os.path.join(models_dir, NATIVE_MANIFEST))
+            or os.path.isfile(os.path.join(models_dir, NATIVE_WEIGHTS))):
+        return {"default": models_dir}
+    out: dict[str, str] = {}
+    try:
+        names = sorted(os.listdir(models_dir))
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(models_dir, name)
+        if (_NAME_OK.match(name) and os.path.isdir(path)
+                and (os.path.isfile(os.path.join(path, NATIVE_MANIFEST))
+                     or os.path.isfile(os.path.join(path, NATIVE_WEIGHTS)))):
+            out[name] = path
+    return out
+
+
+def admit_batch_tenants(
+    models_dir: str,
+    *,
+    backend: str = "native",
+    tenants: list[str] | None = None,
+    retry_policy=None,
+) -> dict[str, ModelStore]:
+    """Admit every tenant for BATCH scoring: the full verify-before-admit
+    chain (manifest digests → EvalModel → AOT deserialization) with NONE
+    of the serving machinery — no reload poller (``.start()`` is never
+    called), no batcher, no scheduler, no budget/LRU.  A scan worker
+    admits, scores its leased shards, and exits; PR-14 AOT bundles make
+    this admission-free in the compile sense (~ms per bucket), which is
+    what lets the scan fleet treat workers as disposable.
+
+    ``tenants`` restricts (and validates) the set; admission failures
+    raise — a bulk job scoring N tenants must not silently score N-1.
+    Callers own the stores' lifecycle: ``close()`` each when done."""
+    found = discover_bundles(models_dir)
+    if tenants is not None:
+        missing = sorted(set(tenants) - set(found))
+        if missing:
+            raise ValueError(
+                f"tenant bundle(s) not found under {models_dir!r}: "
+                f"{missing} (have: {sorted(found)})")
+        found = {name: found[name] for name in tenants}
+    if not found:
+        raise ValueError(f"no export bundles under {models_dir!r}")
+    out: dict[str, ModelStore] = {}
+    try:
+        for name in sorted(found):
+            out[name] = ModelStore(
+                found[name],
+                backend=backend,
+                poll_interval_s=0.0,  # batch: no hot reload
+                retry_policy=retry_policy,
+                warm_buckets=(),      # compute_batch pads per call
+                model_name=name,
+            )
+    except Exception:
+        for store in out.values():
+            try:
+                store.close()
+            except Exception:
+                pass
+        raise
+    return out

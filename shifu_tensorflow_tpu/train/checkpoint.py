@@ -402,9 +402,14 @@ class NpzCheckpointer:
         # the tmp upload is idempotent (whole-file PUT under a name only
         # this process writes) — transient failures retry inside the fs
         # backends (utils/retry.py); only the rename COMMIT below needs
-        # at-most-once care
+        # at-most-once care.  ckpt.commit is the torn-write chaos seam:
+        # a firing term persists a prefix and aborts before the rename —
+        # the restore chain must keep restoring the previous generation
+        cut = faults.torn_cut("ckpt.commit", len(payload))
         with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
-            f.write(payload)
+            f.write(payload if cut is None else payload[:cut])
+        if cut is not None:
+            raise faults.InjectedTornWrite("ckpt.commit", cut, len(payload))
         self._commit_rename(tmp, self._path(epoch))
         # npz first, manifest second: a crash between the two commits
         # leaves a manifest-less ("legacy") generation that the restore

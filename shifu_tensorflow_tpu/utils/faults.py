@@ -37,7 +37,15 @@ families model faults that are not network weather:
 - **flag faults** (``nan-loss``, consulted via :func:`poll`): the
   trainer's health guard polls ``health.nan-loss.e<epoch>`` once per
   training step; a firing term poisons that step's batch with a NaN,
-  driving the divergence-detection / coordinated-rollback drills.
+  driving the divergence-detection / coordinated-rollback drills;
+- **torn writes** (``torn-write``, consulted via :func:`torn_cut`): an
+  atomic-publish writer (integrity.commit_bytes, the checkpoint tmp
+  writer, the bulk scorer's output committer) asks the plan for a cut
+  length BEFORE writing; a firing term returns ``cut < size`` and the
+  writer persists only ``payload[:cut]`` then raises
+  :class:`InjectedTornWrite` — modeling a process killed mid-``write``,
+  BEFORE the rename-commit, so the torn tmp file must stay invisible to
+  readers and a retry/peer must republish from scratch.
 
 Determinism: each term owns a :class:`random.Random` seeded from
 ``(seed, site, kind)``, so a fixed seed plus a fixed sequence of checks
@@ -69,6 +77,16 @@ site               where
                    ``slow``/``slow<ms>`` kinds sleep here, producing a
                    deterministically-lagged rank for the straggler
                    drills (obs/fleet.py)
+``score.read.s<shard>``  bulk scorer's ShardPipeline read attempts
+                   (``check``, per chunk) — transient read faults the
+                   per-shard retry/resume must absorb mid-job
+``score.commit``   bulk scorer's output committer tmp-file write
+                   (``torn_cut`` + ``check``) — torn-write / crash
+                   drills for the exactly-once publish protocol
+``ckpt.commit`` / ``export.commit``  the same ``torn_cut`` seam on the
+                   checkpoint tmp write and integrity.commit_bytes
+                   (export manifests/weights) — retro-fit torn-write
+                   drills for the older artifact planes
 =================  =========================================================
 """
 
@@ -95,6 +113,19 @@ class InjectedHttpError(OSError):
         self.code = code
 
 
+class InjectedTornWrite(OSError):
+    """Raised by a writer after persisting a deliberately-truncated tmp
+    file (``torn-write`` kind) — models the process dying mid-write,
+    before the rename-commit.  Carries the cut so drills can assert the
+    torn length on disk."""
+
+    def __init__(self, site: str, cut: int, size: int):
+        super().__init__(
+            f"injected torn write at {site}: {cut}/{size} bytes persisted")
+        self.cut = cut
+        self.size = size
+
+
 _KINDS = {
     "reset": lambda site: ConnectionResetError(
         f"injected fault: connection reset at {site}"),
@@ -108,6 +139,10 @@ _KINDS = {
 _MUTATE_KINDS = ("bitflip", "truncate")
 #: boolean flag kinds, consulted via :func:`poll`
 _FLAG_KINDS = ("nan-loss",)
+#: mid-write crash kinds, consulted via :func:`torn_cut` before a
+#: tmp-file write (distinct from ``truncate``, which corrupts the bytes
+#: AFTER a successful publish path — torn-write aborts the publish)
+_TORN_KINDS = ("torn-write",)
 
 #: default injected lag for the bare ``slow`` kind (milliseconds)
 _SLOW_DEFAULT_MS = 50
@@ -195,6 +230,7 @@ class FaultPlan:
         terms: list[_Term] = []
         all_kinds = (
             tuple(sorted(_KINDS)) + _MUTATE_KINDS + _FLAG_KINDS
+            + _TORN_KINDS
         )
         for raw in spec.split(","):
             raw = raw.strip()
@@ -238,7 +274,8 @@ class FaultPlan:
             for term in self._terms:
                 if (term.matches(site)
                         and term.kind not in _MUTATE_KINDS
-                        and term.kind not in _FLAG_KINDS):
+                        and term.kind not in _FLAG_KINDS
+                        and term.kind not in _TORN_KINDS):
                     ms = _slow_ms(term.kind)
                     if ms is not None:
                         if term._fires(None):
@@ -278,6 +315,25 @@ class FaultPlan:
                                     term.fired)
                         fired = True
         return fired
+
+    def torn_cut(self, site: str, size: int) -> int | None:
+        """Cut length for a firing ``torn-write`` term at ``site``, else
+        None.  The writer persists ``payload[:cut]`` and raises
+        :class:`InjectedTornWrite` — the plan only decides WHERE the
+        write tears, the seam owns the tearing (it must happen on the
+        real write path, after the tmp file is open, so the torn file
+        genuinely exists on disk)."""
+        with self._lock:
+            for term in self._terms:
+                if term.kind in _TORN_KINDS and term.matches(site):
+                    if size >= 2 and term._fires(None):
+                        cut = term._rng.randrange(1, size)
+                        log.warning(
+                            "injecting torn-write at %s: %d/%d bytes "
+                            "(term %s, fire #%d)", site, cut, size,
+                            term.site, term.fired)
+                        return cut
+        return None
 
     def fired(self) -> dict[str, int]:
         """``"site:kind" -> fire count`` — drills assert faults actually
@@ -337,3 +393,13 @@ def poll(site: str, index: int | None = None) -> bool:
     if plan is None:
         return False
     return plan.poll(site, index)
+
+
+def torn_cut(site: str, size: int) -> int | None:
+    """Torn-write seam: the cut length a matching ``torn-write`` term
+    picked, or None (the overwhelmingly common case — no plan, or no
+    firing term).  See :meth:`FaultPlan.torn_cut` for the contract."""
+    plan = active()
+    if plan is None:
+        return None
+    return plan.torn_cut(site, size)

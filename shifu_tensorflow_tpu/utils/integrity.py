@@ -19,7 +19,7 @@ import hashlib
 import os
 import zlib
 
-from shifu_tensorflow_tpu.utils import fs
+from shifu_tensorflow_tpu.utils import faults, fs
 
 
 def digest_entry(payload: bytes) -> dict:
@@ -46,12 +46,26 @@ def check_entry(data: bytes, want: dict) -> str | None:
     return None
 
 
-def commit_bytes(path: str, payload: bytes) -> None:
+def commit_bytes(path: str, payload: bytes, *,
+                 site: str | None = None) -> None:
     """Atomic publish: write to a tmp name only this process uses, then
     rename-commit (fs.commit_rename).  A concurrent reader — the
     hot-reloading scorer watching an export dir — must never observe a
-    half-written file under the final name."""
+    half-written file under the final name.
+
+    ``site`` names the torn-write chaos seam (utils/faults.py): a firing
+    ``torn-write`` term persists only a prefix of the payload to the tmp
+    file and raises InjectedTornWrite BEFORE the rename — the drill for
+    "writer SIGKILLed mid-write": the torn file stays under a tmp name no
+    reader admits, and the final name either does not exist or still
+    holds the previous intact generation."""
     tmp = f"{path}.tmp.{os.getpid()}"
+    cut = faults.torn_cut(site, len(payload)) if site else None
     with fs.filesystem_for(tmp).open_write(fs.strip_local(tmp)) as f:
-        f.write(payload)
+        if cut is not None:
+            f.write(payload[:cut])
+        else:
+            f.write(payload)
+    if cut is not None:
+        raise faults.InjectedTornWrite(site, cut, len(payload))
     fs.commit_rename(tmp, path)

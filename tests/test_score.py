@@ -1,0 +1,664 @@
+"""Bulk scoring plane drills: lease-table edge cases (expiry racing an
+in-flight commit, double reclaim, renewal racing shutdown, resume from a
+``_SUCCESS``-less partial state), torn-write-proof commits, thread-mode
+end-to-end jobs, and the acceptance kill drill — a scorer process
+SIGKILLed mid-shard under a torn-write fault plan must leave output
+bit-identical to an unkilled control arm with zero duplicate or missing
+rows."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.data import splitter
+from shifu_tensorflow_tpu.data.pipeline import ShardPipeline
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_MANIFEST,
+    export_native_bundle,
+)
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.score import committer, plan as plan_mod
+from shifu_tensorflow_tpu.score.job import run_job
+from shifu_tensorflow_tpu.score.lease import (
+    COMMITTED,
+    LeaseTable,
+    PENDING,
+)
+from shifu_tensorflow_tpu.score.worker import format_scores, score_schema
+from shifu_tensorflow_tpu.serve.tenancy.store import (
+    admit_batch_tenants,
+    discover_bundles,
+)
+from shifu_tensorflow_tpu.train.trainer import Trainer
+from shifu_tensorflow_tpu.utils import faults
+from shifu_tensorflow_tpu.utils import retry as retry_util
+
+N_FEATURES = 6
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    faults.set_plan(None)
+
+
+@pytest.fixture(autouse=True)
+def _clear_journal():
+    yield
+    obs_journal.uninstall()
+
+
+def _model_config(nodes: int = 4) -> ModelConfig:
+    return ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1,
+                              "NumHiddenNodes": [nodes],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05}}})
+
+
+def _bundle(path: str, seed: int) -> str:
+    t = Trainer(_model_config(), N_FEATURES, seed=seed)
+    export_native_bundle(path, t.state.params, _model_config(), N_FEATURES)
+    return path
+
+
+@pytest.fixture(scope="module")
+def models_dir(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("models"))
+    _bundle(os.path.join(root, "alpha"), seed=1)
+    _bundle(os.path.join(root, "beta"), seed=2)
+    return root
+
+
+def _write_inputs(root: str, n_files: int, rows_per_file: int) -> int:
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(7)
+    for i in range(n_files):
+        with open(os.path.join(root, f"in-{i:03d}.psv"), "w") as f:
+            for _ in range(rows_per_file):
+                x = rng.random(N_FEATURES)
+                f.write("|".join(f"{v:.5f}" for v in x) + "\n")
+    return n_files * rows_per_file
+
+
+def _blob(out_dir: str) -> bytes:
+    parts = sorted(n for n in os.listdir(out_dir)
+                   if n.startswith("part-") and n.endswith(".psv"))
+    return b"".join(
+        open(os.path.join(out_dir, n), "rb").read() for n in parts)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------- lease table edges
+
+
+def test_lease_grant_renew_commit_walk():
+    clock = FakeClock()
+    events = []
+    table = LeaseTable(2, ttl_s=10.0, clock=clock,
+                       on_event=lambda e, **f: events.append((e, f)))
+    g0 = table.acquire("w0", "tok0")
+    assert g0["shard"] == 0 and g0["lease"] == "tok0"
+    g1 = table.acquire("w1", "tok1")
+    assert g1["shard"] == 1
+    assert table.acquire("w2", "tok2") is None  # all leased, none pending
+    assert not table.done()
+    clock.advance(5.0)
+    assert table.renew(0, "tok0")
+    assert not table.renew(0, "wrong-token")
+    assert table.commit(0, "tok0", {"rows": 3}, worker="w0") == "accept"
+    assert table.commit(1, "tok1", {"rows": 4}, worker="w1") == "accept"
+    assert table.done()
+    names = [e for e, _ in events]
+    assert names.count("lease_grant") == 2
+    assert names.count("shard_commit") == 2
+
+
+def test_expiry_while_commit_in_flight_token_wins():
+    """The subtle case the protocol is built around: A's lease expires
+    and the shard is re-leased to B while A's commit is in flight — A's
+    commit still wins (the work is done, deterministic output makes
+    re-doing it pointless) and B's later commit is the duplicate."""
+    clock = FakeClock()
+    events = []
+    table = LeaseTable(1, ttl_s=2.0, clock=clock,
+                       on_event=lambda e, **f: events.append((e, f)))
+    table.acquire("A", "tokA")
+    clock.advance(3.0)  # A's lease is past its deadline
+    assert table.reclaim_expired() == [0]
+    gB = table.acquire("B", "tokB")
+    assert gB["shard"] == 0 and gB["attempt"] == 2
+    # A's in-flight commit lands with its EXPIRED token: first commit wins
+    assert table.commit(0, "tokA", {"rows": 5}, worker="A") == "accept"
+    # B, the current leaseholder, arrives second: duplicate, discarded
+    assert table.commit(0, "tokB", {"rows": 5}, worker="B") == "duplicate"
+    assert table.done()
+    counts = table.counts()
+    assert counts["duplicates"] == 1 and counts["expiries"] == 1
+    committed = table.committed()
+    assert committed[0]["rows"] == 5
+    names = [e for e, _ in events]
+    assert names == ["lease_grant", "lease_expire", "lease_reclaim",
+                     "lease_grant", "shard_commit",
+                     "shard_discarded_duplicate"]
+
+
+def test_double_reclaim_is_noop():
+    clock = FakeClock()
+    table = LeaseTable(1, ttl_s=2.0, clock=clock)
+    table.acquire("A", "tokA")
+    clock.advance(3.0)
+    assert table.reclaim_expired() == [0]
+    reclaims = table.counts()["reclaims"]
+    # second tick (a racing driver, a slow thread): shard already
+    # PENDING — nothing to reclaim, counters untouched
+    assert table.reclaim_expired() == []
+    assert table.counts()["reclaims"] == reclaims
+    # reopen of a non-committed shard is equally a no-op
+    table.reopen(0)
+    assert table.counts()["reclaims"] == reclaims
+    assert table.snapshot()[0]["state"] == PENDING
+
+
+def test_renewal_racing_shutdown_sees_clean_refusal():
+    clock = FakeClock()
+    table = LeaseTable(2, ttl_s=10.0, clock=clock)
+    table.acquire("A", "tokA")  # shard 0
+    gB = table.acquire("B", "tokB")
+    assert table.commit(gB["shard"], "tokB", {"rows": 1}) == "accept"
+    table.close()
+    # every mutation refuses — never hangs, never spuriously grants
+    assert table.renew(0, "tokA") is False
+    assert table.acquire("C", "tokC") is None
+    assert table.reclaim_expired() == []
+    # an uncommitted shard racing shutdown gets "closed": the worker
+    # must NOT publish unarbitrated output
+    assert table.commit(0, "tokA", {"rows": 1}) == "closed"
+    # but a genuinely-committed shard still answers duplicate (truth
+    # about the past survives the shutdown)
+    assert table.commit(1, "tok-late", {"rows": 1}) == "duplicate"
+
+
+def test_speculation_steals_longest_running_lease():
+    clock = FakeClock()
+    events = []
+    table = LeaseTable(2, ttl_s=100.0, clock=clock, speculate_factor=2.0,
+                       on_event=lambda e, **f: events.append((e, f)))
+    # shard 0 commits in 1s: the median-duration baseline
+    g0 = table.acquire("fast", "tok0")
+    clock.advance(1.0)
+    assert table.commit(g0["shard"], "tok0", {"rows": 1}) == "accept"
+    # shard 1 drags: 3s > 2.0 x median(1s) — an idle worker's acquire
+    # steals it even though the ttl (100s) is nowhere near expiry
+    table.acquire("slow", "tok1")
+    clock.advance(3.0)
+    g = table.acquire("fast", "tok2")
+    assert g is not None and g["shard"] == 1 and g["attempt"] == 2
+    assert table.counts()["speculative_reclaims"] == 1
+    assert table.counts()["expiries"] == 0  # speculation is not expiry
+    # the straggler's commit arrives later: duplicate only if the fast
+    # worker already committed; here it races first and wins
+    assert table.commit(1, "tok2", {"rows": 1}) == "accept"
+    assert table.commit(1, "tok1", {"rows": 1}) == "duplicate"
+
+
+def test_preload_committed_resume_state():
+    """Resume-from-partial: a fresh table preloaded from verified
+    on-disk sidecars must grant only the missing shards."""
+    table = LeaseTable(3, ttl_s=10.0)
+    table.preload_committed(0, {"token": "old0", "rows": 7, "worker": "w"})
+    table.preload_committed(2, {"token": "old2", "rows": 9, "worker": "w"})
+    g = table.acquire("fresh", "tokX")
+    assert g["shard"] == 1  # the only non-committed shard
+    assert table.commit(1, "tokX", {"rows": 4}) == "accept"
+    assert table.done()
+    committed = table.committed()
+    assert {s: m["rows"] for s, m in committed.items()} == {0: 7, 1: 4, 2: 9}
+    # a late commit against a preloaded shard is a duplicate
+    assert table.commit(0, "tok-late", {"rows": 7}) == "duplicate"
+
+
+# ------------------------------------------------------------ shard plan
+
+
+def test_plan_is_deterministic_and_persists(tmp_path):
+    data = str(tmp_path / "in")
+    _write_inputs(data, 3, 5)
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    specs = plan_mod.build_plan(data)
+    assert [s.shard for s in specs] == [0, 1, 2]
+    assert specs == plan_mod.build_plan(data)  # pure function of listing
+    assert [os.path.basename(s.paths[0]) for s in specs] == sorted(
+        os.path.basename(p) for s in specs for p in s.paths)
+    doc = plan_mod.plan_doc(specs, input_dir=data, tenants=["a", "b"])
+    plan_mod.save_plan(out, doc)
+    assert plan_mod.load_plan(out) == doc
+    assert plan_mod.specs_from_doc(doc) == specs
+    # _PLAN.json is metadata, not data: listings must not see it
+    assert plan_mod.PLAN_FILE not in [
+        os.path.basename(p) for p in splitter.list_data_files(out)]
+    # a torn plan file reads as None (driver re-plans)
+    path = os.path.join(out, plan_mod.PLAN_FILE)
+    payload = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(payload[: len(payload) // 2])
+    assert plan_mod.load_plan(out) is None
+    assert plan_mod.load_plan(str(tmp_path / "missing")) is None
+
+
+def test_plan_size_aware_grouping_under_cap(tmp_path):
+    data = str(tmp_path / "in")
+    _write_inputs(data, 6, 4)
+    specs = plan_mod.build_plan(data, max_shards=2)
+    assert len(specs) == 2
+    all_paths = [p for s in specs for p in s.paths]
+    assert sorted(all_paths) == sorted(splitter.list_data_files(data))
+
+
+# ------------------------------------------------------- commit protocol
+
+
+def test_stage_publish_verify_roundtrip(tmp_path):
+    out = str(tmp_path)
+    payload = b"0.1|0.2\n0.3|0.4\n"
+    committer.stage(out, 3, "leaseX", payload)
+    # staged attempts are dot-prefixed: invisible to data listings
+    assert splitter.list_data_files(out) == []
+    manifest = committer.shard_manifest(3, "leaseX", "w0", payload, 2,
+                                        ["a", "b"], ["in.psv"])
+    committer.publish(out, 3, "leaseX", manifest)
+    got = committer.verify_shard(out, 3)
+    assert got is not None and got["token"] == "leaseX" and got["rows"] == 2
+    assert committer.scan_committed(out, 8) == {3: got}
+    # tampered data fails its sidecar digest: not counted committed
+    with open(committer.shard_file(out, 3), "ab") as f:
+        f.write(b"junk\n")
+    assert committer.verify_shard(out, 3) is None
+    assert committer.scan_committed(out, 8) == {}
+
+
+def test_torn_stage_is_invisible_and_swept(tmp_path):
+    out = str(tmp_path)
+    payload = b"x" * 64
+    faults.set_plan(faults.FaultPlan.parse("score.commit:torn-write@2",
+                                           seed=5))
+    committer.stage(out, 0, "l0", payload)  # 1st check: no fire
+    with pytest.raises(faults.InjectedTornWrite) as ei:
+        committer.stage(out, 1, "l1", payload)  # at-step 2: tears
+    assert 1 <= ei.value.cut < len(payload)
+    torn = committer.tmp_file(out, 1, "l1")
+    assert os.path.exists(torn)  # the prefix genuinely persisted
+    assert os.path.getsize(torn) == ei.value.cut
+    assert splitter.list_data_files(out) == []  # readers never see it
+    assert committer.verify_shard(out, 1) is None
+    assert committer.sweep_tmp(out) == 2  # both attempts removed
+    assert committer.sweep_tmp(out) == 0
+
+
+def test_success_seal_and_job_doc(tmp_path):
+    out = str(tmp_path)
+    assert committer.read_success(out) is None
+    plan_doc = {"input_dir": "/in", "tenants": ["a"],
+                "shards": [{"shard": 0}, {"shard": 1}]}
+    committed = {
+        1: {"token": "t1", "worker": "w", "rows": 4, "data": {"crc": 1}},
+        0: {"token": "t0", "worker": "w", "rows": 3, "data": {"crc": 2}},
+    }
+    doc = committer.job_doc(plan_doc, committed)
+    assert doc["total_rows"] == 7
+    assert [s["shard"] for s in doc["shards"]] == [0, 1]
+    committer.write_success(out, doc)
+    got = committer.read_success(out)
+    assert got is not None and got["total_rows"] == 7
+    assert got["schema"] == committer.JOB_SCHEMA
+
+
+# ------------------------------------------------- fault seams (satellite)
+
+
+def test_torn_write_kind_parse_and_at_step_determinism():
+    plan = faults.FaultPlan.parse("x.commit:torn-write@2", seed=9)
+    faults.set_plan(plan)
+    assert faults.torn_cut("x.commit", 100) is None  # 1st check
+    cut = faults.torn_cut("x.commit", 100)  # at-step 2 fires
+    assert cut is not None and 1 <= cut < 100
+    assert faults.torn_cut("x.commit", 100) is None  # once only
+    assert faults.torn_cut("other.site", 100) is None
+    # same seed, same term → same cut: drills are reproducible
+    faults.set_plan(faults.FaultPlan.parse("x.commit:torn-write@2", seed=9))
+    faults.torn_cut("x.commit", 100)
+    assert faults.torn_cut("x.commit", 100) == cut
+    # torn-write never fires through the raising check() entry point
+    faults.set_plan(faults.FaultPlan.parse("x.commit:torn-write@1.0",
+                                           seed=9))
+    faults.check("x.commit")  # must not raise
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("x:torn@1")  # unknown kind still rejected
+
+
+def test_export_commit_torn_seam_leaves_no_manifest(tmp_path):
+    """A torn export commit must leave an inadmissible bundle: the
+    manifest is written LAST, so any earlier torn artifact means no
+    manifest — verify-before-admit refuses the directory wholesale."""
+    d = str(tmp_path / "bundle")
+    t = Trainer(_model_config(), N_FEATURES, seed=3)
+    faults.set_plan(faults.FaultPlan.parse("export.commit:torn-write@2",
+                                           seed=4))
+    with pytest.raises(faults.InjectedTornWrite):
+        export_native_bundle(d, t.state.params, _model_config(), N_FEATURES)
+    assert not os.path.exists(os.path.join(d, NATIVE_MANIFEST))
+
+
+def test_checkpoint_commit_torn_seam_keeps_previous_epoch(tmp_path):
+    from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+    t = Trainer(_model_config(), N_FEATURES, seed=3)
+    with NpzCheckpointer(str(tmp_path)) as ckpt:
+        ckpt.save(0, t.state)
+        faults.set_plan(faults.FaultPlan.parse("ckpt.commit:torn-write@1.0",
+                                               seed=6))
+        with pytest.raises(faults.InjectedTornWrite):
+            ckpt.save(1, t.state)
+        faults.set_plan(None)
+        # the torn generation never renamed into place: epoch 0 is still
+        # the newest restorable one
+        state, next_epoch = ckpt.restore_latest(t.state)
+        assert state is not None and next_epoch == 1
+
+
+def test_score_read_seam_is_named_by_global_shard(tmp_path):
+    """The per-shard read seam carries the GLOBAL shard id: a plan
+    targeting score.read.s3 hits the pipeline scanning shard 3 and no
+    other prefix."""
+    data = str(tmp_path)
+    _write_inputs(data, 1, 6)
+    paths = splitter.list_data_files(data)
+    schema = score_schema(N_FEATURES)
+    policy = retry_util.RetryPolicy(max_attempts=2, base_delay_s=0.001)
+    faults.set_plan(faults.FaultPlan.parse("score.read.s3:503@1.0", seed=2))
+
+    def drain(prefix: str, offset: int) -> int:
+        pipe = ShardPipeline(paths, schema, n_readers=1, decode_workers=1,
+                             block_rows=4, retry_policy=policy,
+                             fault_site_prefix=prefix, shard_offset=offset)
+        try:
+            return sum(len(b) for b, _ in pipe.blocks())
+        finally:
+            pipe.close()
+
+    with pytest.raises(Exception):
+        drain("score", 3)  # site score.read.s3: the plan fires
+    assert drain("score", 1) == 6  # different shard: untouched
+    assert drain("ingest", 3) == 6  # training plane: untouched
+
+
+# --------------------------------------------------- batch admission
+
+
+def test_discover_and_admit_batch_tenants(models_dir, tmp_path):
+    found = discover_bundles(models_dir)
+    assert sorted(found) == ["alpha", "beta"]
+    single = _bundle(str(tmp_path / "solo"), seed=5)
+    assert discover_bundles(single) == {"default": single}
+    with pytest.raises(ValueError, match="ghost"):
+        admit_batch_tenants(models_dir, tenants=["alpha", "ghost"])
+    stores = admit_batch_tenants(models_dir)
+    try:
+        assert sorted(stores) == ["alpha", "beta"]
+        for store in stores.values():
+            assert store.current().model.num_features == N_FEATURES
+    finally:
+        for store in stores.values():
+            store.close()
+
+
+# ------------------------------------------------------- end-to-end jobs
+
+
+def _run_thread_job(input_dir: str, models_dir: str, out: str, stores,
+                    **kw) -> dict:
+    kw.setdefault("workers", 2)
+    kw.setdefault("ttl_s", 5.0)
+    kw.setdefault("speculate_factor", 0.0)
+    kw.setdefault("batch_rows", 32)
+    kw.setdefault("timeout_s", 120.0)
+    return run_job(input_dir, models_dir, out, worker_mode="thread",
+                   stores=stores, **kw)
+
+
+def test_job_end_to_end_thread_mode_and_rerun_noop(models_dir, tmp_path):
+    data = str(tmp_path / "in")
+    total = _write_inputs(data, 4, 13)
+    out = str(tmp_path / "out")
+    journal = str(tmp_path / "journal.jsonl")
+    obs_journal.install(obs_journal.Journal(journal, plane="score"))
+    stores = admit_batch_tenants(models_dir)
+    try:
+        summary = _run_thread_job(data, models_dir, out, stores)
+        assert summary["noop"] is False
+        assert summary["rows"] == total and summary["shards"] == 4
+        assert summary["duplicates"] == 0
+        success = committer.read_success(out)
+        assert success["total_rows"] == total
+        tokens = [s["token"] for s in success["shards"]]
+        assert len(set(tokens)) == 4  # one winning token per shard
+        # every output row is |-joined per-tenant scores, sorted order
+        lines = _blob(out).decode().strip().split("\n")
+        assert len(lines) == total
+        assert all(len(line.split("|")) == 2 for line in lines)
+        # alpha and beta are different seeds: columns must differ
+        a, b = zip(*(line.split("|") for line in lines))
+        assert a != b
+        # re-run of a sealed job: journaled no-op, output untouched
+        before = _blob(out)
+        again = _run_thread_job(data, models_dir, out, stores)
+        assert again["noop"] is True and again["rows"] == total
+        assert _blob(out) == before
+    finally:
+        for store in stores.values():
+            store.close()
+    obs_journal.uninstall()
+    events = obs_journal.read_events(journal)
+    names = [e["event"] for e in events]
+    assert names.count("score_job_start") == 2
+    assert names.count("score_job_finished") == 2
+    assert names.count("shard_commit") == 4
+    assert names.count("lease_grant") >= 4
+    finished = [e for e in events if e["event"] == "score_job_finished"]
+    assert finished[0]["rows"] == total and finished[1]["noop"] is True
+
+
+def test_job_resumes_from_partial_success_less_state(models_dir, tmp_path):
+    """Crash-resume: _SUCCESS missing, one shard's output gone, another's
+    torn mid-byte — a fresh driver re-scores exactly those two from the
+    persisted plan and leaves verified shards byte-identical."""
+    data = str(tmp_path / "in")
+    total = _write_inputs(data, 4, 9)
+    out = str(tmp_path / "out")
+    stores = admit_batch_tenants(models_dir)
+    try:
+        first = _run_thread_job(data, models_dir, out, stores)
+        assert first["rows"] == total
+        intact = {
+            s: open(committer.shard_file(out, s), "rb").read()
+            for s in (0, 3)
+        }
+        # simulate the crash window: job never sealed, shard 1 vanished,
+        # shard 2 is a torn prefix of itself
+        os.remove(os.path.join(out, committer.SUCCESS_FILE))
+        os.remove(committer.shard_file(out, 1))
+        os.remove(committer.sidecar_file(out, 1))
+        p2 = committer.shard_file(out, 2)
+        blob2 = open(p2, "rb").read()
+        with open(p2, "wb") as f:
+            f.write(blob2[: len(blob2) // 2])
+
+        second = _run_thread_job(data, models_dir, out, stores)
+        assert second["noop"] is False and second["rows"] == total
+        # only the two broken shards were re-scored
+        assert second["grants"] == 2
+        assert committer.read_success(out)["total_rows"] == total
+        for s, blob in intact.items():
+            assert open(committer.shard_file(out, s), "rb").read() == blob
+        assert open(p2, "rb").read() == blob2  # re-scored bit-identically
+    finally:
+        for store in stores.values():
+            store.close()
+
+
+def test_obs_score_reconstructs_job_from_journal(models_dir, tmp_path,
+                                                 capsys):
+    from shifu_tensorflow_tpu.obs.__main__ import main as obs_main
+
+    data = str(tmp_path / "in")
+    total = _write_inputs(data, 2, 6)
+    out = str(tmp_path / "out")
+    journal = str(tmp_path / "journal.jsonl")
+    obs_journal.install(obs_journal.Journal(journal, plane="score"))
+    stores = admit_batch_tenants(models_dir)
+    try:
+        _run_thread_job(data, models_dir, out, stores)
+    finally:
+        for store in stores.values():
+            store.close()
+    obs_journal.uninstall()
+
+    assert obs_main(["score", "--journal", journal, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    jobs = doc["jobs"]
+    assert len(jobs) == 1
+    job = jobs[0]
+    assert job["shards"] == 2
+    assert len(job["committed"]) == 2
+    assert job["committed_rows"] == total
+    assert job["duplicate_committed_tokens"] == 0
+    # the rendered (non-json) form also works on the same journal
+    assert obs_main(["score", "--journal", journal]) == 0
+    assert "score job" in capsys.readouterr().out
+
+
+# -------------------------------------------- the acceptance kill drill
+
+
+def test_kill_drill_process_mode_bit_identical_to_control(models_dir,
+                                                          tmp_path):
+    """ISSUE 17 acceptance: SIGKILL a scorer process mid-shard while a
+    torn-write plan tears a peer's commit — the job still seals with
+    output BIT-IDENTICAL to an unkilled control arm, zero duplicate
+    tokens and zero missing rows by row audit, and a re-run is a
+    journaled no-op."""
+    data = str(tmp_path / "in")
+    total = _write_inputs(data, 8, 40)
+    out_control = str(tmp_path / "control")
+    out_drill = str(tmp_path / "drill")
+    journal = str(tmp_path / "journal.jsonl")
+
+    # control arm: thread mode, no faults, no kill
+    stores = admit_batch_tenants(models_dir)
+    try:
+        control = _run_thread_job(data, models_dir, out_control, stores)
+    finally:
+        for store in stores.values():
+            store.close()
+    assert control["rows"] == total
+
+    # drill arm: REAL scorer processes; every read check drags 300ms so
+    # the SIGKILL provably lands mid-shard, and the 3rd commit stage in
+    # one process tears (the at-step term fires once per process)
+    obs_journal.install(obs_journal.Journal(journal, plane="score"))
+    procs: dict = {}
+    killed = threading.Event()
+
+    def scorer0_holds_live_lease() -> bool:
+        try:
+            events = obs_journal.read_events(journal)
+        except OSError:
+            return False
+        held = None
+        for e in events:
+            kind = e.get("event")
+            if (kind == "lease_grant"
+                    and str(e.get("worker", "")).startswith("scorer-0")):
+                held = e.get("shard")
+            elif (kind in ("shard_commit", "lease_reclaim")
+                    and e.get("shard") == held):
+                held = None
+        return held is not None
+
+    def killer():
+        # kill only once scorer-0 PROVABLY owns an uncommitted lease —
+        # then the SIGKILL must cost an expiry + reclaim, not a no-op
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if not scorer0_holds_live_lease():
+                time.sleep(0.05)
+                continue
+            time.sleep(0.7)  # mid-scan: every read check drags 300ms
+            p = procs.get("scorer-0")
+            if p is None or p.poll() is not None:
+                return
+            if not scorer0_holds_live_lease():
+                continue  # committed in the window — wait for the next
+            p.send_signal(signal.SIGKILL)
+            killed.set()
+            return
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    drill = run_job(
+        data, models_dir, out_drill,
+        workers=2, ttl_s=1.5, speculate_factor=4.0, batch_rows=32,
+        worker_mode="process", timeout_s=240.0,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "STPU_FAULT_PLAN":
+                "score.read:slow300@1.0,score.commit:torn-write@3",
+            "STPU_FAULT_SEED": "11",
+        },
+        on_spawn=lambda wid, p: procs.__setitem__(wid, p),
+    )
+    t.join(timeout=10.0)
+    obs_journal.uninstall()
+
+    assert killed.is_set(), "the kill never landed — drill proved nothing"
+    assert drill["rows"] == total, "missing or extra rows after the kill"
+    assert drill["shards"] == 8
+    # exactly-once by token audit: one winning token per shard, no dupes
+    success = committer.read_success(out_drill)
+    tokens = [s["token"] for s in success["shards"]]
+    assert len(tokens) == 8 and len(set(tokens)) == 8
+    # the kill was detected and the shard re-dispatched
+    assert drill["reclaims"] >= 1
+    # deterministic scoring: kill arm output is bit-identical to control
+    assert _blob(out_drill) == _blob(out_control)
+    # no staged/torn debris survives the finalize sweep
+    assert not [n for n in os.listdir(out_drill) if n.endswith(".tmp")]
+    # the journal tells the whole story in causal order
+    events = obs_journal.read_events(journal)
+    names = [e["event"] for e in events]
+    assert "lease_expire" in names and "lease_reclaim" in names
+    assert names.index("lease_expire") < names.index("lease_reclaim")
+    assert names.count("shard_commit") == 8
+    # re-run of the sealed drill output: journaled no-op
+    rerun = run_job(data, models_dir, out_drill, workers=1,
+                    worker_mode="thread", stores=None, timeout_s=60.0)
+    assert rerun["noop"] is True and rerun["rows"] == total

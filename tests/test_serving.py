@@ -719,42 +719,49 @@ def test_chaos_drill_corrupt_reload_never_served(server, export_dir):
 
 
 def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
-    """Backpressure drill: a tiny queue + slow scorer under a flood must
-    shed with 429 + Retry-After while every SERVED request completes in
-    bounded time (the queue can never grow past the admission bound)."""
+    """Backpressure drill: a gated scorer under a flood must shed with
+    429 + Retry-After while every SERVED request completes in bounded
+    time (the queue can never grow past the admission bound).  The
+    dispatch is BARRIER-gated, not merely slowed: nothing drains until
+    the flood has arithmetically overrun the admission bound, so the
+    shed assertion cannot race thread scheduling on a 2-core host."""
     cfg = ServeConfig(model_dir=export_dir, port=0, max_batch=8,
                       max_delay_ms=1.0, max_queue_rows=16,
                       retry_after_s=2, reload_poll_ms=0)
     with ScoringServer(cfg) as srv:
-        # slow the dispatch down so the flood outruns the drain
         inner = srv._score_once
+        release = threading.Event()
 
-        def slow(rows):
-            time.sleep(0.02)
+        def gated(rows):
+            release.wait(15.0)
             return inner(rows)
 
-        srv.batcher._score = slow
+        srv.batcher._score = gated
         srv.start()
         results: list[tuple[int, float, dict]] = []
         lock = threading.Lock()
 
         def client(i: int):
-            for _ in range(6):
-                t0 = time.monotonic()
-                status, headers, body = _post(
-                    srv.port, {"rows": _rows(4, seed=i).tolist()}
-                )
-                with lock:
-                    results.append(
-                        (status, time.monotonic() - t0, headers)
-                    )
+            t0 = time.monotonic()
+            status, headers, body = _post(
+                srv.port, {"rows": _rows(4, seed=i).tolist()}
+            )
+            with lock:
+                results.append((status, time.monotonic() - t0, headers))
 
-        # in-flight demand must exceed queue bound PLUS the three-batch
-        # pipeline depth (16 + 3x8 = 40 rows) or nothing ever sheds
+        # 24 x 4 = 96 in-flight rows against the 16-row queue plus the
+        # three-batch pipeline depth (16 + 3x8 = 40): with the gate
+        # closed the overrun is guaranteed however threads schedule
         threads = [threading.Thread(target=client, args=(i,))
-                   for i in range(16)]
+                   for i in range(24)]
         for t in threads:
             t.start()
+        # open the gate only once the shed provably happened
+        deadline = time.monotonic() + 10.0
+        while (srv.metrics.counters()["shed_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        release.set()
         for t in threads:
             t.join(timeout=60.0)
         served = [r for r in results if r[0] == 200]
@@ -764,11 +771,10 @@ def test_overload_sheds_with_retry_after_and_bounded_latency(export_dir):
         for _, _, headers in shed:
             # jittered around the configured mean of 2 s: [1, 3]
             assert 1 <= int(headers.get("Retry-After")) <= 3
-        # bounded latency for the served fraction: worst case is the full
-        # queue ahead (16 rows / 8 per dispatch) at the slowed dispatch
-        # cost plus jit/HTTP overhead — far under the seconds an
-        # unbounded queue would accumulate
-        assert max(r[1] for r in served) < 5.0
+        # bounded latency for the served fraction: the gate wait (opened
+        # the moment the first shed lands) plus a <=40-row drain at full
+        # speed — far under the seconds an unbounded queue accumulates
+        assert max(r[1] for r in served) < 10.0
         assert srv.metrics.counters()["shed_total"] >= len(shed)
 
 
@@ -871,28 +877,36 @@ def test_shed_429_echoes_rid_and_journals_it(export_dir, obs_env):
                       reload_poll_ms=0)
     with ScoringServer(cfg) as srv:
         inner = srv._score_once
+        release = threading.Event()
 
-        def slow(rows):
-            time.sleep(0.02)
+        # barrier-gated dispatch (same deflake as the overload drill
+        # above): the flood overruns the bound by arithmetic, not by
+        # out-racing the drain on whatever cores CI has
+        def gated(rows):
+            release.wait(15.0)
             return inner(rows)
 
-        srv.batcher._score = slow
+        srv.batcher._score = gated
         srv.start()
         results = []
         lock = threading.Lock()
 
         def client(i: int):
-            for k in range(6):
-                status, headers, _ = _post_rid(
-                    srv.port, {"rows": _rows(4, seed=i).tolist()},
-                    rid=f"flood-{i}-{k}")
-                with lock:
-                    results.append((status, headers))
+            status, headers, _ = _post_rid(
+                srv.port, {"rows": _rows(4, seed=i).tolist()},
+                rid=f"flood-{i}")
+            with lock:
+                results.append((status, headers))
 
         threads = [threading.Thread(target=client, args=(i,))
-                   for i in range(16)]
+                   for i in range(24)]
         for t in threads:
             t.start()
+        deadline = time.monotonic() + 10.0
+        while (srv.metrics.counters()["shed_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        release.set()
         for t in threads:
             t.join(timeout=60.0)
     shed = [(s, h) for s, h in results if s == 429]
