@@ -835,6 +835,24 @@ def main() -> None:
         rc = bench_score.main()
         _append_bench_history('score', 'BENCH_SCORE.json', rc=rc)
         sys.exit(rc)
+    if "lifecycle" in sys.argv[1:]:
+        # closed-loop lifecycle drill (python bench.py lifecycle
+        # [--quick]): seeded drift on a live serving tenant →
+        # journal-triggered retrain → shadow → weighted ramp → promote,
+        # plus a poisoned-retrain arm (nan-loss fault plan) that must
+        # auto-rollback with the parent generation still serving; gates
+        # zero failed requests across the ramp and bit-identical
+        # promoted scores, artifact BENCH_LIFECYCLE.json — implemented
+        # in scripts/bench_lifecycle.py.  The serving fleet is
+        # in-process on the CPU backend and retrains are subprocesses,
+        # so the parent's no-jax rule does not apply to this mode.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_lifecycle
+
+        rc = bench_lifecycle.main()
+        _append_bench_history('lifecycle', 'BENCH_LIFECYCLE.json', rc=rc)
+        sys.exit(rc)
     if "serve-aot" in sys.argv[1:]:
         # AOT executable shipping benchmark (python bench.py serve-aot):
         # 10-tenant fleet-restart admission, deserialize (shipped

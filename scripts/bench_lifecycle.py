@@ -1,0 +1,444 @@
+"""Closed-loop lifecycle drill: seeded drift on a live serving tenant →
+journal-triggered retrain → shadow admission → weighted ramp → promote,
+plus a poisoned-retrain arm (nan-loss fault plan) that must auto-
+rollback with the parent generation still serving.
+
+Both arms run against ONE in-process scoring fleet (multi-tenant,
+journal-instrumented) with paced drifted traffic flowing the whole
+time; the lifecycle controller is a real subprocess driving real
+retrain subprocesses, and both cycles are reconstructed afterwards from
+the journal alone via ``obs lifecycle --json`` — the same dead-fleet
+contract every other drill in this repo holds its plane to.
+
+Gates (rc 1 on violation):
+
+- promote arm: controller exits 0 (promotion), drift-to-promoted
+  latency reported, ZERO failed requests across the ramp, the serving
+  tenant's shed counter flat, and the promoted generation's served
+  scores BIT-IDENTICAL to scoring the same bundle directly;
+- poisoned arm: controller exits 2 (rollback), the parent generation's
+  manifest is untouched and still serving 200s;
+- ``obs lifecycle --json`` reconstructs both cycles with the right
+  verdicts.
+
+Output contract matches bench.py: every stdout line is a JSON object,
+the last the most complete; artifact lands in ``BENCH_LIFECYCLE.json``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+ARTIFACT = os.path.join(REPO_ROOT, "BENCH_LIFECYCLE.json")
+QUICK = "--quick" in sys.argv
+N_FEATURES = 5
+TRAIN_ROWS = 200 if QUICK else 600
+EPOCHS = 1 if QUICK else 2
+# Live traffic mean, in training-σ.  Must clear the drift threshold
+# (1.0) to trigger the cycle, but stay near-distribution: far-OOD
+# inputs make two same-data retrains extrapolate apart and the shadow's
+# own divergence gate would (correctly) veto the promotion under test.
+DRIFT_SHIFT = 1.5
+
+
+def _emit(result: dict, partial: bool = True) -> None:
+    out = dict(result)
+    if partial:
+        out["partial"] = True
+    print(json.dumps(out), flush=True)
+
+
+def _post(port: int, payload: dict, path: str = "/score"):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+    try:
+        c.request("POST", path, json.dumps(payload),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _write_dataset(root: str, rng) -> str:
+    """PSV.gz shards in the reference layout: target|f0..f4|weight,
+    features ~ N(0, 1) — the baseline the live drifted traffic will be
+    judged against."""
+    data = os.path.join(root, "data")
+    os.makedirs(data, exist_ok=True)
+    w_true = rng.normal(size=N_FEATURES)
+    for part in range(2):
+        with gzip.open(os.path.join(data, f"part-{part:05d}.gz"),
+                       "wt") as f:
+            for _ in range(TRAIN_ROWS // 2):
+                x = rng.normal(size=N_FEATURES)
+                y = 1 if rng.random() < 1.0 / (
+                    1.0 + np.exp(-float(x @ w_true))) else 0
+                cols = ([str(y)] + [f"{v:.5f}" for v in x]
+                        + [f"{rng.uniform(0.5, 2.0):.4f}"])
+                f.write("|".join(cols) + "\n")
+    return data
+
+
+def _write_model_config(root: str) -> str:
+    path = os.path.join(root, "ModelConfig.json")
+    with open(path, "w") as f:
+        json.dump({
+            "basic": {"name": "bench_lifecycle"},
+            "dataSet": {"dataDelimiter": "|"},
+            "train": {
+                "numTrainEpochs": EPOCHS,
+                "validSetRate": 0.2,
+                "params": {
+                    "NumHiddenLayers": 1,
+                    "NumHiddenNodes": [8],
+                    "ActivationFunc": ["relu"],
+                    "LearningRate": 0.1,
+                },
+            },
+        }, f)
+    return path
+
+
+def _train_args(mc_path: str, train_journal: str, seed: int) -> list:
+    """The verbatim tail every retrain gets — same shape as the parent's
+    training run, --obs included so each generation ships its
+    feature_stats drift baseline (without it the promoted generation
+    would carry no baseline and the NEXT cycle could never trigger).
+    The seed differs from the parent's on purpose: retraining is
+    deterministic, so a same-seed retrain would reproduce the parent's
+    weights bit-for-bit and the hot-reload digest gate below would be
+    vacuous."""
+    return [
+        "--model-config", mc_path,
+        "--feature-columns", ",".join(
+            str(i) for i in range(1, N_FEATURES + 1)),
+        "--target-column", "0",
+        "--weight-column", str(N_FEATURES + 1),
+        "--seed", str(seed),
+        "--obs", "--obs-journal", train_journal,
+    ]
+
+
+def _run_train(data: str, export_dir: str, mc_path: str,
+               train_journal: str, env=None) -> int:
+    cmd = [sys.executable, "-m", "shifu_tensorflow_tpu.train",
+           "--training-data-path", data,
+           "--export-dir", export_dir, "--export-aot",
+           ] + _train_args(mc_path, train_journal, seed=7)
+    proc = subprocess.run(cmd, cwd=REPO_ROOT, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, timeout=900)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout.decode("utf-8", "replace")[-3000:])
+    return proc.returncode
+
+
+def _controller_cmd(models_dir: str, journal: str, data: str,
+                    mc_path: str, train_journal: str) -> list:
+    return [
+        sys.executable, "-m", "shifu_tensorflow_tpu.lifecycle", "run",
+        "--models-dir", models_dir, "--journal", journal,
+        "--model", "beta", "--train-data", data,
+        "--poll", "0.5", "--trigger-hysteresis", "2",
+        "--cooldown", "5",
+        "--shadow-min-rows", "48",
+        # two same-data retrains of this deliberately tiny, one-epoch
+        # model differ by design (distinct seeds, see _train_args), and
+        # their score z-divergence lands around 10-25; the drill gate
+        # sits well above that benign band so the promotion path is
+        # exercised — divergence-triggered rollback has its own policy
+        # unit tests, and the poisoned arm covers the rollback plumbing
+        # end-to-end.  Observed divergence is recorded in the artifact.
+        "--divergence-threshold", "100",
+        "--ramp-steps", "0.25,0.5", "--ramp-interval", "2",
+        "--rollback-hysteresis", "2",
+        "--retrain-timeout", "600",
+        "--cycles", "1", "--deadline", "420",
+    ] + [f"--train-arg={a}"
+         for a in _train_args(mc_path, train_journal, seed=13)]
+
+
+class _FixedDir:
+    def __init__(self, path: str):
+        self.path = path
+
+    def __enter__(self) -> str:
+        os.makedirs(self.path, exist_ok=True)
+        return self.path
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class Traffic:
+    """Paced drifted traffic against /score/beta — every response is
+    recorded; anything but 200 is a failed request (the promote arm
+    gates on zero)."""
+
+    def __init__(self, port: int, rng):
+        self.port = port
+        self.rng = rng
+        self.total = 0
+        self.failed = 0
+        self.errors: list = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def rows(self, n: int = 8):
+        return (self.rng.normal(size=(n, N_FEATURES))
+                + DRIFT_SHIFT).round(5).tolist()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                status, _body = _post(self.port, {"rows": self.rows()},
+                                      path="/score/beta")
+                self.total += 1
+                if status != 200:
+                    self.failed += 1
+                    if len(self.errors) < 10:
+                        self.errors.append(f"status {status}")
+            except Exception as e:
+                self.total += 1
+                self.failed += 1
+                if len(self.errors) < 10:
+                    self.errors.append(f"{type(e).__name__}: {e}")
+            self._stop.wait(0.03)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+
+
+def main() -> int:
+    t_start = time.time()
+    rng = np.random.default_rng(20260807)
+    result: dict = {"bench": "lifecycle", "quick": QUICK, "gates": {}}
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("STPU_FAULT_PLAN", None)
+
+    # BENCH_LIFECYCLE_KEEP=<dir>: run in (and keep) a fixed directory
+    # instead of a throwaway tempdir — post-mortem debugging knob.
+    keep = os.environ.get("BENCH_LIFECYCLE_KEEP")
+    ctx = (tempfile.TemporaryDirectory(prefix="bench-lifecycle-")
+           if not keep else _FixedDir(keep))
+    with ctx as root:
+        data = _write_dataset(root, rng)
+        mc_path = _write_model_config(root)
+        models_dir = os.path.join(root, "models")
+        journal = os.path.join(root, "journal.jsonl")
+        train_journal = os.path.join(root, "train_journal.jsonl")
+
+        # ---- parent generation: trained + exported like any operator job
+        t0 = time.time()
+        rc = _run_train(data, os.path.join(models_dir, "beta"), mc_path,
+                        train_journal, env=env)
+        if rc != 0:
+            _emit({**result, "error": f"parent train rc {rc}"},
+                  partial=False)
+            return 1
+        result["parent_train_s"] = round(time.time() - t0, 2)
+        _emit(result)
+
+        from shifu_tensorflow_tpu.export.eval_model import EvalModel
+        from shifu_tensorflow_tpu.export.saved_model import bundle_lineage
+        from shifu_tensorflow_tpu.obs import ObsConfig, install_obs
+        from shifu_tensorflow_tpu.obs import datastats as obs_datastats
+        from shifu_tensorflow_tpu.obs import journal as obs_journal
+        from shifu_tensorflow_tpu.obs import slo as obs_slo
+        from shifu_tensorflow_tpu.serve.config import ServeConfig
+        from shifu_tensorflow_tpu.serve.server import ScoringServer
+
+        parent0 = bundle_lineage(os.path.join(models_dir, "beta"))
+        result["parent_sha256"] = parent0["sha256"]
+
+        # ---- the serving fleet: multi-tenant, journal-instrumented
+        obs_cfg = ObsConfig(enabled=True, journal_path=journal,
+                            slo_window_s=2.0, slo_hysteresis=1)
+        install_obs(obs_cfg, worker_index=0, plane="serve")
+        serve_cfg = ServeConfig(models_dir=models_dir, port=0,
+                                max_batch=16, max_delay_ms=1.0,
+                                max_queue_rows=4096, reload_poll_ms=100)
+        server = ScoringServer(serve_cfg)
+        traffic = None
+        try:
+            server.start()
+            traffic = Traffic(server.port, rng)
+            traffic.start()
+
+            # ---- arm 1: drift → retrain → shadow → ramp → promote
+            t0 = time.time()
+            ctl = subprocess.run(
+                _controller_cmd(models_dir, journal, data, mc_path,
+                                train_journal),
+                cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, timeout=600)
+            promote_rc = ctl.returncode
+            result["promote_rc"] = promote_rc
+            result["promote_wall_s"] = round(time.time() - t0, 2)
+            if promote_rc != 0:
+                sys.stderr.write(
+                    ctl.stdout.decode("utf-8", "replace")[-6000:])
+            result["gates"]["promoted"] = promote_rc == 0
+            _emit(result)
+
+            promoted = bundle_lineage(os.path.join(models_dir, "beta"))
+            result["promoted_sha256"] = promoted["sha256"]
+            result["promoted_generation"] = promoted["generation"]
+            result["gates"]["lineage"] = (
+                promoted["generation"] == parent0["generation"] + 1
+                and promoted["parent_sha256"] == parent0["sha256"]
+                and promoted["sha256"] != parent0["sha256"])
+
+            # the serving tenant hot-reloads the promoted bundle;
+            # verify-and-swap means the digest we see is the new one
+            digest12 = (promoted["sha256"] or "")[:12]
+            probe = rng.normal(size=(16, N_FEATURES)).round(5).tolist()
+            served = None
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                status, body = _post(server.port, {"rows": probe},
+                                     path="/score/beta")
+                if status == 200 and body.get("model_digest") == digest12:
+                    served = body
+                    break
+                time.sleep(0.25)
+            result["gates"]["promoted_serving"] = served is not None
+
+            # bit-identical: the promoted tenant's served scores vs a
+            # direct, out-of-fleet load of the very same bundle (same
+            # flatten + 6dp rounding as _score_response)
+            if served is not None:
+                direct = EvalModel(os.path.join(models_dir, "beta"),
+                                   backend="native")
+                ref = direct.compute_batch(np.asarray(probe, np.float32))
+                ref = (ref[:, 0] if ref.ndim == 2 and ref.shape[1] == 1
+                       else ref)
+                ref = np.asarray(ref, np.float64).round(6).tolist()
+                result["gates"]["bit_identical"] = (
+                    served["scores"] == ref)
+            else:
+                result["gates"]["bit_identical"] = False
+            _emit(result)
+
+            # ---- arm 2: poisoned retrain (nan-loss) must auto-rollback
+            t0 = time.time()
+            poison_env = dict(env)
+            poison_env["STPU_FAULT_PLAN"] = (
+                "health.nan-loss.e0:nan-loss@1.0")
+            ctl2 = subprocess.run(
+                _controller_cmd(models_dir, journal, data, mc_path,
+                                train_journal),
+                cwd=REPO_ROOT, env=poison_env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, timeout=600)
+            rollback_rc = ctl2.returncode
+            result["rollback_rc"] = rollback_rc
+            result["poisoned_wall_s"] = round(time.time() - t0, 2)
+            if rollback_rc != 2:
+                sys.stderr.write(
+                    ctl2.stdout.decode("utf-8", "replace")[-6000:])
+            result["gates"]["poisoned_rolled_back"] = rollback_rc == 2
+
+            # the parent generation survived the poisoned cycle intact
+            after = bundle_lineage(os.path.join(models_dir, "beta"))
+            status, body = _post(server.port, {"rows": probe},
+                                 path="/score/beta")
+            result["gates"]["parent_still_serving"] = (
+                after["sha256"] == promoted["sha256"]
+                and status == 200
+                and body.get("model_digest") == digest12)
+        finally:
+            if traffic is not None:
+                traffic.stop()
+            counters = (server.multi.per_tenant_counters()
+                        if server.multi is not None else {})
+            server.close()
+            for mod, fn in ((obs_slo, "uninstall"),
+                            (obs_datastats, "uninstall"),
+                            (obs_datastats, "uninstall_train"),
+                            (obs_journal, "uninstall")):
+                try:
+                    getattr(mod, fn)()
+                except Exception:
+                    pass
+
+        # ---- request ledger across both arms
+        result["requests_total"] = traffic.total
+        result["requests_failed"] = traffic.failed
+        result["request_errors"] = traffic.errors
+        result["gates"]["zero_failed_requests"] = (
+            traffic.total > 0 and traffic.failed == 0)
+        beta = counters.get("beta", {})
+        result["serving_tenant_counters"] = {
+            k: v for k, v in beta.items()
+            if "shed" in k or "error" in k or "requests" in k}
+        result["gates"]["sheds_flat"] = beta.get("shed_total", 0) == 0
+
+        # ---- dead-fleet reconstruction: obs lifecycle --json
+        obs = subprocess.run(
+            [sys.executable, "-m", "shifu_tensorflow_tpu.obs",
+             "lifecycle", "--journal", journal, "--json"],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, timeout=120)
+        cycles = []
+        if obs.returncode == 0:
+            try:
+                cycles = json.loads(obs.stdout)["cycles"]
+            except (ValueError, KeyError):
+                cycles = []
+        verdicts = [c.get("verdict") for c in cycles]
+        result["cycles"] = [
+            {"verdict": c.get("verdict"),
+             "generation": c.get("generation"),
+             "latency_s": c.get("latency_s"),
+             "ramp_steps": c.get("ramp_steps"),
+             "retrain_ok": (c.get("retrain") or {}).get("ok")}
+            for c in cycles]
+        result["gates"]["journal_reconstructs"] = (
+            "promote" in verdicts and "rollback" in verdicts)
+        promo = next(
+            (c for c in cycles if c.get("verdict") == "promote"), None)
+        result["drift_to_promoted_s"] = (
+            promo.get("latency_s") if promo else None)
+
+        # observed parent-vs-shadow score divergence at promote time,
+        # straight from the promote event's evidence in the journal
+        try:
+            with open(f"{journal}.l0") as f:
+                for line in f:
+                    ev = json.loads(line)
+                    if ev.get("event") == "promote":
+                        result["observed_divergence"] = (
+                            ev.get("evidence") or {}).get("divergence")
+        except OSError:
+            pass
+
+    result["wall_s"] = round(time.time() - t_start, 2)
+    ok = all(result["gates"].values())
+    result["ok"] = ok
+    with open(ARTIFACT, "w") as f:
+        json.dump(result, f, indent=2)
+    _emit(result, partial=False)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -553,6 +553,57 @@ DEFAULT_SCORE_MAX_SHARDS = 0
 SCORE_BATCH_ROWS = TPU_PREFIX + "score-batch-rows"
 DEFAULT_SCORE_BATCH_ROWS = 4096
 
+# ---- closed-loop model lifecycle (lifecycle/; docs/lifecycle.md) ----
+# lifecycle-model: the serving tenant the controller manages (drift on
+# it triggers retrain; its bundle is the parent generation).
+LIFECYCLE_MODEL = TPU_PREFIX + "lifecycle-model"
+DEFAULT_LIFECYCLE_MODEL = ""
+# lifecycle-poll: seconds between controller ticks (journal poll +
+# policy evaluation).  Every hysteresis/cooldown below counts TICKS of
+# this cadence or wall seconds as documented per key.
+LIFECYCLE_POLL_S = TPU_PREFIX + "lifecycle-poll"
+DEFAULT_LIFECYCLE_POLL_S = 1.0
+# lifecycle-trigger-hysteresis: consecutive ticks with an open
+# data_drift/perf_regression before a retrain triggers — one drifted
+# window must not launch a fleet.
+LIFECYCLE_TRIGGER_HYSTERESIS = TPU_PREFIX + "lifecycle-trigger-hysteresis"
+DEFAULT_LIFECYCLE_TRIGGER_HYSTERESIS = 3
+# lifecycle-cooldown: seconds after a retrain LAUNCH before drift may
+# trigger another (covers the whole shadow/ramp evaluation of the
+# previous generation plus a margin).
+LIFECYCLE_COOLDOWN_S = TPU_PREFIX + "lifecycle-cooldown"
+DEFAULT_LIFECYCLE_COOLDOWN_S = 300.0
+# lifecycle-shadow-min-rows: mirrored rows the shadow generation must
+# have scored before its score distribution is comparable at all.
+LIFECYCLE_SHADOW_MIN_ROWS = TPU_PREFIX + "lifecycle-shadow-min-rows"
+DEFAULT_LIFECYCLE_SHADOW_MIN_ROWS = 256
+# lifecycle-divergence-threshold: parent-vs-shadow score-distribution
+# divergence (drift_components max over the 1-wide score column,
+# dimensionless, ~1.0 = clearly diverged) above which promotion is
+# blocked and a ramping generation rolls back.
+LIFECYCLE_DIVERGENCE_THRESHOLD = TPU_PREFIX + "lifecycle-divergence-threshold"
+DEFAULT_LIFECYCLE_DIVERGENCE_THRESHOLD = 1.0
+# lifecycle-ramp-steps: comma-separated traffic fractions the candidate
+# walks through before promotion (each held for lifecycle-ramp-interval
+# and gated on SLO + divergence before the next).
+LIFECYCLE_RAMP_STEPS = TPU_PREFIX + "lifecycle-ramp-steps"
+DEFAULT_LIFECYCLE_RAMP_STEPS = "0.05,0.25,0.5"
+# lifecycle-ramp-interval: seconds each ramp step must hold clean
+# before advancing.
+LIFECYCLE_RAMP_INTERVAL_S = TPU_PREFIX + "lifecycle-ramp-interval"
+DEFAULT_LIFECYCLE_RAMP_INTERVAL_S = 30.0
+# lifecycle-rollback-hysteresis: consecutive BAD ticks (SLO breach on
+# the managed model, or divergence past the threshold) during
+# shadow/ramp before the candidate rolls back — the mirror image of the
+# trigger hysteresis, so one noisy window cannot kill a good candidate.
+LIFECYCLE_ROLLBACK_HYSTERESIS = TPU_PREFIX + "lifecycle-rollback-hysteresis"
+DEFAULT_LIFECYCLE_ROLLBACK_HYSTERESIS = 2
+# lifecycle-retrain-timeout: wall-second budget for the retrain job; a
+# job past it is killed and verdicts as a failed retrain (back to IDLE
+# under cooldown, parent keeps serving).
+LIFECYCLE_RETRAIN_TIMEOUT_S = TPU_PREFIX + "lifecycle-retrain-timeout"
+DEFAULT_LIFECYCLE_RETRAIN_TIMEOUT_S = 1800.0
+
 # ---- fault-tolerance envelope (reference: Constants.java:87-89; the ps
 # threshold has no analogue — there is no PS role) ----
 WORKER_FAULT_TOLERANCE_THRESHOLD = 0.1

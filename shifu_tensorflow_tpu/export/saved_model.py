@@ -120,11 +120,20 @@ def export_native_bundle(
     zscale_stds=None,
     feature_stats=None,
     aot_buckets=None,
+    lineage=None,
 ) -> None:
     """Write the TF-free artifact: architecture JSON + weights npz, plus
     the sidecar manifest (size+CRC32+SHA-256 per file) that the serving
     reload path verifies before admitting the bundle.  Every file commits
     via tmp+rename; the manifest commits last.
+
+    ``lineage`` (optional) is the generation-lineage stamp: a mapping
+    with ``parent_sha256`` (the weights digest of the bundle this one
+    was retrained FROM — the rollback target, identifiable from
+    artifacts alone) and ``generation`` (monotonic int).  Stamped into
+    the manifest as a ``lineage`` object; legacy bundles simply lack
+    the key and every reader treats absent lineage as generation 0
+    with no parent.
 
     ``feature_stats`` is the training data's per-feature sketch snapshot
     (obs/datastats.DataSketch.snapshot) — written as
@@ -224,12 +233,23 @@ def export_native_bundle(
             "stats": feature_stats,
         }, indent=2).encode("utf-8")
         files[FEATURE_STATS] = _digest_entry(stats_bytes)
-    manifest = json.dumps({
+    manifest_doc: dict[str, Any] = {
         "format_version": 1,
         "sha256": weights_entry["sha256"],  # bundle identity
         "files": files,
         "written_by": str(os.getpid()),
-    }, indent=2)
+    }
+    if lineage:
+        # generation lineage: who this bundle was retrained from.  Kept
+        # to the two documented keys (plus anything the caller stamps)
+        # so the manifest stays a flat, diffable record.
+        stamp = dict(lineage)
+        if stamp.get("parent_sha256") is not None:
+            stamp["parent_sha256"] = str(stamp["parent_sha256"])
+        if stamp.get("generation") is not None:
+            stamp["generation"] = int(stamp["generation"])
+        manifest_doc["lineage"] = stamp
+    manifest = json.dumps(manifest_doc, indent=2)
     # at-rest corruption seam (chaos drills): applied AFTER the digests,
     # so the manifest records what SHOULD land on disk — the serving
     # reload verification must catch the divergence
@@ -289,6 +309,30 @@ def export_native_bundle(
         os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8"),
         site="export.commit",
     )
+
+
+def bundle_lineage(export_dir: str) -> dict[str, Any]:
+    """Read a bundle's identity + lineage from its manifest alone:
+    ``{"sha256": <weights digest> | None, "parent_sha256": ... | None,
+    "generation": int}``.  Legacy bundles (no ``lineage`` key, or no
+    manifest at all) come back as generation 0 with no parent — absent
+    lineage is not an error, it is the pre-lifecycle world."""
+    out: dict[str, Any] = {"sha256": None, "parent_sha256": None,
+                           "generation": 0}
+    try:
+        with open(os.path.join(export_dir, NATIVE_MANIFEST)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return out
+    out["sha256"] = doc.get("sha256")
+    lin = doc.get("lineage") or {}
+    if isinstance(lin, dict):
+        out["parent_sha256"] = lin.get("parent_sha256")
+        try:
+            out["generation"] = int(lin.get("generation") or 0)
+        except (TypeError, ValueError):
+            out["generation"] = 0
+    return out
 
 
 def export_saved_model(
@@ -371,6 +415,7 @@ def export_model(
     zscale_stds=None,
     feature_stats=None,
     aot_buckets=None,
+    lineage=None,
 ) -> dict[str, bool]:
     """One-call export of both artifacts from a Trainer.
 
@@ -434,6 +479,7 @@ def export_model(
         zscale_stds=zscale_stds,
         feature_stats=feature_stats,
         aot_buckets=aot_buckets,
+        lineage=lineage,
     )
     # deep-copy: ModelConfig.from_json keeps a reference to the nested
     # dicts, so mutating a shallow copy would rewrite the live trainer's

@@ -1,0 +1,65 @@
+"""Closed-loop model lifecycle: drift-triggered retrain → shadow →
+weighted ramp → promote / auto-rollback (ROADMAP item 3, the loop that
+closes the obs plane's drift/SLO signals onto the train + serve planes).
+
+The reference system's answer to a drifted model was a human: notice the
+KS chart moved, re-run the training pipeline, copy the export over the
+serving directory, hope.  Every piece of machinery that loop needs
+already exists in this reproduction — the PR-12 drift monitor journals
+``data_drift`` with the offending feature, the train CLI exports a
+verified bundle, the PR-9 multi-tenant store hot-reloads a republished
+bundle after digest verification, the PR-13 cost/SLO legs say whether
+serving stayed healthy.  What was missing is the CONTROLLER: a process
+that watches the journal, decides, actuates, and writes down every
+decision so the whole cycle reconstructs from a dead fleet's files.
+
+Layering (the autoscaler's discipline, one level up):
+
+- :mod:`~shifu_tensorflow_tpu.lifecycle.policy` — a PURE hysteretic
+  state machine (IDLE → RETRAINING → SHADOW → RAMP → IDLE) with an
+  injectable clock: observations in, at most one action out.  All
+  debounce/cooldown/gate semantics live here, unit-testable without
+  processes.
+- :mod:`~shifu_tensorflow_tpu.lifecycle.signals` — the journal fold
+  feeding the policy: drift/regression/SLO latches per writer and the
+  parent-vs-shadow score-distribution divergence (PR-12 sketch algebra
+  over the journaled per-tenant ``score_stats`` events).
+- :mod:`~shifu_tensorflow_tpu.lifecycle.ctl` — the declarative control
+  file (``<models_dir>/.lifecycle/ctl.json``, atomic tmp+rename) the
+  serving fleet reconciles against on its SLO tick: mirror target,
+  ramp fraction, tenant weights, retirements.  The controller never
+  reaches into a serving process — it writes intent, workers apply it
+  and journal ``lifecycle_ctl_applied``.
+- :mod:`~shifu_tensorflow_tpu.lifecycle.controller` — the actuator
+  layer owning the side effects: the retrain subprocess (train CLI,
+  ``--export-aot``, lineage-stamped), shadow bundle publication,
+  promotion by republishing the candidate's bytes into the parent
+  tenant's directory (the PR-3 verify-and-swap hot reload makes the
+  promoted generation score bit-identically to a direct admission of
+  the same weights), and rollback teardown.
+
+Every transition is journaled to the controller's own ``.l<k>`` writer
+beside the serve fleet's ``.s<k>`` files; ``python -m
+shifu_tensorflow_tpu.obs lifecycle`` replays the cycle from the merged
+set.  stdlib-only at import, per the CLI discipline.
+"""
+
+from __future__ import annotations
+
+from shifu_tensorflow_tpu.lifecycle.config import (
+    LifecycleConfig,
+    resolve_lifecycle_config,
+)
+from shifu_tensorflow_tpu.lifecycle.policy import (
+    LifecycleAction,
+    LifecycleObservation,
+    LifecyclePolicy,
+)
+
+__all__ = [
+    "LifecycleConfig",
+    "resolve_lifecycle_config",
+    "LifecycleAction",
+    "LifecycleObservation",
+    "LifecyclePolicy",
+]

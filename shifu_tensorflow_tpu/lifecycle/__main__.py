@@ -1,0 +1,88 @@
+"""Lifecycle controller CLI.
+
+Run (the only subcommand — the controller IS the long-running loop)::
+
+    python -m shifu_tensorflow_tpu.lifecycle run \\
+        --models-dir /srv/models --journal /var/log/stpu/journal.jsonl \\
+        --model beta --train-data data/train \\
+        --train-arg=--model-config --train-arg=conf/ModelConfig.json \\
+        --cycles 1 --deadline 600
+
+Every ``shifu.tpu.lifecycle-*`` key resolves through the usual
+precedence (defaults → ``--globalconfig`` layers → flags); ``--train-arg``
+values pass VERBATIM to the retrain train CLI after the controller's own
+export flags, so the retrain trains exactly like the operator's manual
+job did.  Exit code: 0 = last verdict was a promotion, 2 = rollback,
+1 = deadline with no verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from shifu_tensorflow_tpu.config import keys as K
+from shifu_tensorflow_tpu.config.conf import Conf
+from shifu_tensorflow_tpu.lifecycle.config import resolve_lifecycle_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shifu_tensorflow_tpu.lifecycle",
+        description="drift-triggered retrain → shadow → ramp → "
+                    "promote/rollback controller",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    run = sub.add_parser("run", help="run the closed-loop controller")
+    run.add_argument("--globalconfig", action="append", default=[],
+                     help="XML/JSON config layer(s), later wins")
+    run.add_argument("--models-dir",
+                     help=f"serving tenant root ({K.SERVE_MODELS_DIR})")
+    run.add_argument("--journal",
+                     help="obs journal base shared with the serve fleet "
+                          f"({K.OBS_JOURNAL})")
+    run.add_argument("--model", help=f"managed tenant ({K.LIFECYCLE_MODEL})")
+    run.add_argument("--train-data",
+                     help=f"retrain input ({K.TRAINING_DATA_PATH})")
+    run.add_argument("--train-arg", action="append", default=None,
+                     help="extra arg passed verbatim to the retrain "
+                          "train CLI (repeatable; use --train-arg=--flag "
+                          "for flags)")
+    run.add_argument("--poll", type=float,
+                     help=f"tick seconds ({K.LIFECYCLE_POLL_S})")
+    run.add_argument("--trigger-hysteresis", type=int,
+                     help=K.LIFECYCLE_TRIGGER_HYSTERESIS)
+    run.add_argument("--cooldown", type=float, help=K.LIFECYCLE_COOLDOWN_S)
+    run.add_argument("--shadow-min-rows", type=int,
+                     help=K.LIFECYCLE_SHADOW_MIN_ROWS)
+    run.add_argument("--divergence-threshold", type=float,
+                     help=K.LIFECYCLE_DIVERGENCE_THRESHOLD)
+    run.add_argument("--ramp-steps", help=K.LIFECYCLE_RAMP_STEPS)
+    run.add_argument("--ramp-interval", type=float,
+                     help=K.LIFECYCLE_RAMP_INTERVAL_S)
+    run.add_argument("--rollback-hysteresis", type=int,
+                     help=K.LIFECYCLE_ROLLBACK_HYSTERESIS)
+    run.add_argument("--retrain-timeout", type=float,
+                     help=K.LIFECYCLE_RETRAIN_TIMEOUT_S)
+    run.add_argument("--cycles", type=int, default=None,
+                     help="stop after N terminal verdicts "
+                          "(promote/rollback); default: run forever")
+    run.add_argument("--deadline", type=float, default=None,
+                     help="wall-second budget; default: none")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    conf = Conf()
+    for path in args.globalconfig:
+        conf.add_resource(path)
+    cfg = resolve_lifecycle_config(args, conf)
+    from shifu_tensorflow_tpu.lifecycle.controller import run_controller
+
+    return run_controller(cfg, deadline_s=args.deadline,
+                          max_cycles=args.cycles)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

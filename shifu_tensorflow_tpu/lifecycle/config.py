@@ -1,0 +1,177 @@
+"""Lifecycle configuration — the ``shifu.tpu.lifecycle-*`` surface as a
+typed dataclass, resolved with the framework's usual precedence
+(built-in defaults → ``--globalconfig`` XML/JSON layers → CLI flags).
+
+Import-light like serve/config.py: the controller CLI must parse
+``--help`` and validate config without paying for jax or numpy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+from shifu_tensorflow_tpu.config import keys as K
+
+
+def parse_ramp_steps(spec: str) -> tuple:
+    """``"0.05,0.25,0.5"`` → ``(0.05, 0.25, 0.5)``.  Fractions must be
+    strictly increasing within (0, 1): a step that does not grow the
+    candidate's traffic share is a hold, not a ramp, and 1.0 is spelled
+    *promotion*, not a ramp step."""
+    steps = tuple(float(s) for s in spec.split(",") if s.strip())
+    if not steps:
+        raise ValueError(
+            f"{K.LIFECYCLE_RAMP_STEPS} must name at least one fraction")
+    prev = 0.0
+    for f in steps:
+        if not prev < f < 1.0:
+            raise ValueError(
+                f"{K.LIFECYCLE_RAMP_STEPS} fractions must be strictly "
+                f"increasing within (0, 1), got {spec!r}")
+        prev = f
+    return steps
+
+
+@dataclass(frozen=True)
+class LifecycleConfig:
+    """Everything the lifecycle controller needs — JSON-bridgeable so a
+    drill harness can ship it to the controller subprocess whole.
+
+    ``model`` is the managed serving tenant (the parent generation);
+    ``models_dir`` the serving fleet's tenant root (where the shadow
+    tenant and the ``.lifecycle`` control dir live); ``journal_base``
+    the obs journal base path shared with the serve fleet — the
+    controller reads the ``.s<k>`` writers' signals from it and appends
+    its own decisions as the ``.l0`` writer."""
+
+    model: str
+    models_dir: str
+    journal_base: str
+    # retrain inputs: the training data the managed model refreshes
+    # from, plus verbatim extra args for the train CLI (globalconfig
+    # layers, --epochs, --stream ... the controller does not interpret
+    # them)
+    train_data_path: str = ""
+    train_args: tuple = ()
+    poll_s: float = K.DEFAULT_LIFECYCLE_POLL_S
+    trigger_hysteresis: int = K.DEFAULT_LIFECYCLE_TRIGGER_HYSTERESIS
+    cooldown_s: float = K.DEFAULT_LIFECYCLE_COOLDOWN_S
+    shadow_min_rows: int = K.DEFAULT_LIFECYCLE_SHADOW_MIN_ROWS
+    divergence_threshold: float = K.DEFAULT_LIFECYCLE_DIVERGENCE_THRESHOLD
+    ramp_steps: tuple = ()
+    ramp_interval_s: float = K.DEFAULT_LIFECYCLE_RAMP_INTERVAL_S
+    rollback_hysteresis: int = K.DEFAULT_LIFECYCLE_ROLLBACK_HYSTERESIS
+    retrain_timeout_s: float = K.DEFAULT_LIFECYCLE_RETRAIN_TIMEOUT_S
+
+    def __post_init__(self):
+        if not self.model:
+            raise ValueError(
+                f"{K.LIFECYCLE_MODEL} must name the managed tenant")
+        if not self.models_dir:
+            raise ValueError("models_dir is required")
+        if not self.journal_base:
+            raise ValueError(
+                "journal_base is required: the controller is journal-"
+                "driven — without the serve fleet's journal there are "
+                "no signals to close the loop on")
+        if self.poll_s <= 0:
+            raise ValueError(f"{K.LIFECYCLE_POLL_S} must be > 0")
+        if self.trigger_hysteresis < 1:
+            raise ValueError(
+                f"{K.LIFECYCLE_TRIGGER_HYSTERESIS} must be >= 1")
+        if self.cooldown_s < 0:
+            raise ValueError(f"{K.LIFECYCLE_COOLDOWN_S} must be >= 0")
+        if self.shadow_min_rows < 1:
+            raise ValueError(
+                f"{K.LIFECYCLE_SHADOW_MIN_ROWS} must be >= 1")
+        if self.divergence_threshold <= 0:
+            raise ValueError(
+                f"{K.LIFECYCLE_DIVERGENCE_THRESHOLD} must be > 0")
+        if not self.ramp_steps:
+            # default applied here (not in the field) so an explicit
+            # empty spec fails loudly instead of silently ramping 3 ways
+            object.__setattr__(
+                self, "ramp_steps",
+                parse_ramp_steps(K.DEFAULT_LIFECYCLE_RAMP_STEPS))
+        prev = 0.0
+        for f in self.ramp_steps:
+            if not prev < float(f) < 1.0:
+                raise ValueError(
+                    f"{K.LIFECYCLE_RAMP_STEPS} fractions must be "
+                    f"strictly increasing within (0, 1), got "
+                    f"{self.ramp_steps!r}")
+            prev = float(f)
+        if self.ramp_interval_s <= 0:
+            raise ValueError(f"{K.LIFECYCLE_RAMP_INTERVAL_S} must be > 0")
+        if self.rollback_hysteresis < 1:
+            raise ValueError(
+                f"{K.LIFECYCLE_ROLLBACK_HYSTERESIS} must be >= 1")
+        if self.retrain_timeout_s <= 0:
+            raise ValueError(
+                f"{K.LIFECYCLE_RETRAIN_TIMEOUT_S} must be > 0")
+
+    @property
+    def shadow_name(self) -> str:
+        """The shadow tenant's directory name: ``<model>.next`` — valid
+        under the store's ``_NAME_OK`` charset, visibly paired with its
+        parent in ``/models``, and impossible to collide with an
+        operator-named tenant that the controller does not manage."""
+        return f"{self.model}.next"
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LifecycleConfig":
+        d = dict(d)
+        d["train_args"] = tuple(d.get("train_args", ()))
+        d["ramp_steps"] = tuple(float(f) for f in d.get("ramp_steps", ()))
+        return cls(**d)
+
+
+def resolve_lifecycle_config(args, conf) -> LifecycleConfig:
+    """CLI flag wins, then the conf key, then the built-in default — the
+    resolve_serve_config contract, so one globalconfig XML can drive the
+    whole closed loop (serve keys for the fleet, lifecycle keys for the
+    controller watching it)."""
+
+    def pick(flag, key, default, get):
+        v = getattr(args, flag, None)
+        return v if v is not None else get(key, default)
+
+    steps = pick("ramp_steps", K.LIFECYCLE_RAMP_STEPS,
+                 K.DEFAULT_LIFECYCLE_RAMP_STEPS, conf.get)
+    return LifecycleConfig(
+        model=pick("model", K.LIFECYCLE_MODEL,
+                   K.DEFAULT_LIFECYCLE_MODEL, conf.get),
+        models_dir=getattr(args, "models_dir", None) or conf.get(
+            K.SERVE_MODELS_DIR, K.DEFAULT_SERVE_MODELS_DIR) or "",
+        journal_base=getattr(args, "journal", None) or conf.get(
+            K.OBS_JOURNAL, "") or "",
+        train_data_path=getattr(args, "train_data", None) or conf.get(
+            K.TRAINING_DATA_PATH, "") or "",
+        train_args=tuple(getattr(args, "train_arg", None) or ()),
+        poll_s=pick("poll", K.LIFECYCLE_POLL_S,
+                    K.DEFAULT_LIFECYCLE_POLL_S, conf.get_float),
+        trigger_hysteresis=pick(
+            "trigger_hysteresis", K.LIFECYCLE_TRIGGER_HYSTERESIS,
+            K.DEFAULT_LIFECYCLE_TRIGGER_HYSTERESIS, conf.get_int),
+        cooldown_s=pick("cooldown", K.LIFECYCLE_COOLDOWN_S,
+                        K.DEFAULT_LIFECYCLE_COOLDOWN_S, conf.get_float),
+        shadow_min_rows=pick(
+            "shadow_min_rows", K.LIFECYCLE_SHADOW_MIN_ROWS,
+            K.DEFAULT_LIFECYCLE_SHADOW_MIN_ROWS, conf.get_int),
+        divergence_threshold=pick(
+            "divergence_threshold", K.LIFECYCLE_DIVERGENCE_THRESHOLD,
+            K.DEFAULT_LIFECYCLE_DIVERGENCE_THRESHOLD, conf.get_float),
+        ramp_steps=parse_ramp_steps(steps),
+        ramp_interval_s=pick(
+            "ramp_interval", K.LIFECYCLE_RAMP_INTERVAL_S,
+            K.DEFAULT_LIFECYCLE_RAMP_INTERVAL_S, conf.get_float),
+        rollback_hysteresis=pick(
+            "rollback_hysteresis", K.LIFECYCLE_ROLLBACK_HYSTERESIS,
+            K.DEFAULT_LIFECYCLE_ROLLBACK_HYSTERESIS, conf.get_int),
+        retrain_timeout_s=pick(
+            "retrain_timeout", K.LIFECYCLE_RETRAIN_TIMEOUT_S,
+            K.DEFAULT_LIFECYCLE_RETRAIN_TIMEOUT_S, conf.get_float),
+    )

@@ -1,0 +1,319 @@
+"""The lifecycle actuator layer — every side effect the policy decides:
+the retrain subprocess, shadow bundle publication, the ctl file the
+serving fleet reconciles against, promotion by republication, rollback
+teardown.  One controller process per managed tenant; its decisions
+journal to the ``.l0`` writer beside the serve fleet's ``.s<k>`` files,
+so ``obs lifecycle`` replays the whole cycle from the merged set after
+everyone is dead.
+
+Promotion mechanics (why promoted scores are bit-identical to a direct
+admission of the same weights): the controller never touches a serving
+process — it republishes the candidate bundle's BYTES into the parent
+tenant's directory, data files first, manifest last (the same commit
+ordering the exporter uses).  The parent's hot-reload poller sees the
+manifest change, re-verifies every digest, and atomically swaps — the
+PR-3 chain, unchanged.  The serving fleet ends up scoring the exact
+artifact the retrain exported, through the same load path a fresh
+admission would take; there is no transformation step to diverge in.
+
+The retrain is the train CLI (``--export-aot``, lineage-stamped with
+the parent's weights sha and generation+1) run as a subprocess under a
+wall-clock budget.  Its verdict is structural: rc 0 AND a manifest in
+the staging dir.  A poisoned retrain — the nan-loss fault plan trips
+the health guard, rc 3, nothing exported — verdicts as failed, journals
+``rollback`` with the reason, and the parent generation never stops
+serving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_MANIFEST,
+    bundle_lineage,
+)
+from shifu_tensorflow_tpu.lifecycle import ctl as ctl_mod
+from shifu_tensorflow_tpu.lifecycle.config import LifecycleConfig
+from shifu_tensorflow_tpu.lifecycle.policy import (
+    LifecycleAction,
+    LifecyclePolicy,
+)
+from shifu_tensorflow_tpu.lifecycle.signals import LifecycleSignals
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("lifecycle.controller")
+
+#: DRR weight the shadow tenant serves mirror/ramp traffic under — low
+#: enough that a misbehaving candidate cannot starve the parent on the
+#: shared device, floored so it cannot starve outright
+_SHADOW_WEIGHT_FLOOR = 0.05
+
+
+def publish_bundle(src: str, dst: str) -> None:
+    """Republish an export bundle's bytes: every file commits via
+    tmp+rename (readers never see a torn file), and the manifest goes
+    LAST — a reader that sees the new manifest is guaranteed to find
+    every file it covers already in place, the exporter's own
+    ordering contract (export/saved_model.py)."""
+    manifest_src = None
+    plan: list[tuple[str, str]] = []
+    for root, _dirs, files in os.walk(src):
+        rel_root = os.path.relpath(root, src)
+        for name in sorted(files):
+            s = os.path.join(root, name)
+            rel = name if rel_root == "." else os.path.join(rel_root, name)
+            if rel == NATIVE_MANIFEST:
+                manifest_src = s
+                continue
+            plan.append((s, os.path.join(dst, rel)))
+    if manifest_src is None:
+        raise FileNotFoundError(f"no {NATIVE_MANIFEST} under {src!r}")
+    for s, d in plan + [(manifest_src, os.path.join(dst, NATIVE_MANIFEST))]:
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        tmp = f"{d}.tmp.{os.getpid()}"
+        with open(s, "rb") as fin, open(tmp, "wb") as fout:
+            shutil.copyfileobj(fin, fout)
+            fout.flush()
+            os.fsync(fout.fileno())
+        os.replace(tmp, d)
+
+
+class LifecycleController:
+    """Journaled controller driving one managed tenant's closed loop.
+    ``journal`` may be injected (tests/benches running in-process beside
+    a serve fleet whose module-global journal is the ``.s0`` writer);
+    by default the controller owns the base's ``.l0`` sibling."""
+
+    def __init__(self, cfg: LifecycleConfig, *, clock=time.monotonic,
+                 journal=None, train_env: dict | None = None):
+        self.cfg = cfg
+        self._clock = clock
+        self.policy = LifecyclePolicy(cfg, clock=clock)
+        self.signals = LifecycleSignals(cfg.journal_base, cfg.model,
+                                        cfg.shadow_name)
+        self.parent_dir = os.path.join(cfg.models_dir, cfg.model)
+        self.shadow_dir = os.path.join(cfg.models_dir, cfg.shadow_name)
+        self.staging_dir: str | None = None
+        self.train_env = train_env
+        self.cycles = 0  # terminal verdicts seen (promote or rollback)
+        self.last_verdict: str | None = None
+        if journal is not None:
+            self._jrn = journal
+            self._own_journal = False
+        else:
+            from shifu_tensorflow_tpu.obs.journal import Journal
+
+            self._jrn = Journal(f"{cfg.journal_base}.l0",
+                                plane="lifecycle", worker=0)
+            self._own_journal = True
+        if not os.path.isdir(self.parent_dir):
+            raise ValueError(
+                f"managed tenant bundle {self.parent_dir!r} does not "
+                "exist")
+        self._emit("lifecycle_start",
+                   shadow=cfg.shadow_name, models_dir=cfg.models_dir,
+                   poll_s=cfg.poll_s,
+                   trigger_hysteresis=cfg.trigger_hysteresis,
+                   cooldown_s=cfg.cooldown_s,
+                   ramp_steps=list(cfg.ramp_steps),
+                   divergence_threshold=cfg.divergence_threshold)
+
+    # ---- journaling ----
+    def _emit(self, event: str, **fields) -> None:
+        try:
+            self._jrn.emit(event, model=self.cfg.model, **fields)
+        except Exception:
+            log.exception("journal emit failed (%s)", event)
+
+    def close(self) -> None:
+        if self._own_journal:
+            try:
+                self._jrn.close()
+            except Exception:
+                pass
+
+    # ---- the tick ----
+    def tick(self) -> None:
+        obs = self.signals.poll()
+        action = self.policy.observe(obs)
+        if action is not None:
+            self._apply(action)
+
+    def run(self, *, deadline_s: float | None = None,
+            max_cycles: int | None = None) -> int:
+        """Poll until ``max_cycles`` terminal verdicts (promote or
+        rollback) or the wall deadline.  Returns 0 when the last verdict
+        was a promotion, 2 on rollback, 1 on deadline with no verdict —
+        the drill harness's exit-code contract."""
+        t0 = self._clock()
+        while True:
+            self.tick()
+            if max_cycles is not None and self.cycles >= max_cycles:
+                break
+            if (deadline_s is not None
+                    and self._clock() - t0 >= deadline_s):
+                break
+            time.sleep(self.cfg.poll_s)
+        if self.last_verdict == "promote":
+            return 0
+        return 2 if self.last_verdict == "rollback" else 1
+
+    # ---- actuation ----
+    def _apply(self, action: LifecycleAction) -> None:
+        handler = {
+            "retrain": self._do_retrain,
+            "shadow_admit": self._do_shadow_admit,
+            "ramp_step": self._do_ramp_step,
+            "promote": self._do_promote,
+            "rollback": self._do_rollback,
+        }[action.action]
+        try:
+            handler(action)
+            ok, why = True, ""
+        except Exception as e:
+            log.exception("%s failed", action.action)
+            ok, why = False, f"{type(e).__name__}: {e}"
+        follow = self.policy.on_action_applied(action, ok, why)
+        if action.action in ("promote", "rollback") and ok:
+            self.cycles += 1
+            self.last_verdict = action.action
+        if follow is not None:
+            self._apply(follow)
+
+    def _do_retrain(self, action: LifecycleAction) -> None:
+        cfg = self.cfg
+        self._emit("lifecycle_trigger", reason=action.reason,
+                   evidence=action.evidence)
+        lineage = bundle_lineage(self.parent_dir)
+        generation = int(lineage["generation"]) + 1
+        staging = os.path.join(ctl_mod.ctl_dir(cfg.models_dir),
+                               f"gen-{generation}")
+        if os.path.isdir(staging):
+            shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging, exist_ok=True)
+        self.staging_dir = staging
+        cmd = [sys.executable, "-m", "shifu_tensorflow_tpu.train",
+               "--training-data-path", cfg.train_data_path,
+               "--export-dir", staging,
+               "--export-aot",
+               "--export-generation", str(generation)]
+        if lineage["sha256"]:
+            cmd += ["--export-parent-sha", str(lineage["sha256"])]
+        cmd += list(cfg.train_args)
+        self._emit("retrain_start", generation=generation,
+                   parent_sha256=lineage["sha256"], staging=staging,
+                   cmd=cmd)
+        t0 = self._clock()
+        rc, why = None, ""
+        try:
+            proc = subprocess.run(
+                cmd, timeout=cfg.retrain_timeout_s,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=self.train_env)
+            rc = proc.returncode
+            if rc != 0:
+                tail = proc.stdout.decode("utf-8", "replace")[-2000:]
+                why = f"rc {rc}: {tail.strip().splitlines()[-1:]}"
+        except subprocess.TimeoutExpired:
+            why = f"timeout after {cfg.retrain_timeout_s:g}s"
+        ok = (rc == 0 and os.path.isfile(
+            os.path.join(staging, NATIVE_MANIFEST)))
+        if rc == 0 and not ok:
+            why = "rc 0 but no export manifest in staging"
+        self._emit("retrain_done", ok=ok, rc=rc, why=why,
+                   generation=generation,
+                   duration_s=round(self._clock() - t0, 3))
+        follow = self.policy.on_retrain_result(
+            ok, reason=why,
+            evidence={"rc": rc, "generation": generation,
+                      "parent_sha256": lineage["sha256"]})
+        if follow is not None:
+            self._apply(follow)
+
+    def _do_shadow_admit(self, action: LifecycleAction) -> None:
+        cfg = self.cfg
+        if not self.staging_dir:
+            raise RuntimeError("no staged candidate bundle to admit")
+        publish_bundle(self.staging_dir, self.shadow_dir)
+        candidate = bundle_lineage(self.shadow_dir)
+        ctl_mod.write_ctl(
+            cfg.models_dir, model=cfg.model, shadow=cfg.shadow_name,
+            mirror=True, route_fraction=0.0,
+            weights={cfg.shadow_name: _SHADOW_WEIGHT_FLOOR})
+        self._emit("shadow_admit", shadow=cfg.shadow_name,
+                   sha256=candidate["sha256"],
+                   parent_sha256=candidate["parent_sha256"],
+                   generation=candidate["generation"],
+                   reason=action.reason)
+
+    def _do_ramp_step(self, action: LifecycleAction) -> None:
+        cfg = self.cfg
+        f = float(action.fraction or 0.0)
+        ctl_mod.write_ctl(
+            cfg.models_dir, model=cfg.model, shadow=cfg.shadow_name,
+            mirror=True, route_fraction=f,
+            weights={cfg.shadow_name: max(f, _SHADOW_WEIGHT_FLOOR)})
+        self._emit("ramp_step", fraction=f, reason=action.reason,
+                   evidence=action.evidence)
+
+    def _do_promote(self, action: LifecycleAction) -> None:
+        cfg = self.cfg
+        candidate = bundle_lineage(self.shadow_dir)
+        publish_bundle(self.shadow_dir, self.parent_dir)
+        ctl_mod.write_ctl(
+            cfg.models_dir, model=cfg.model, shadow=None, mirror=False,
+            route_fraction=0.0, weights={}, retire=[cfg.shadow_name])
+        self._emit("promote", sha256=candidate["sha256"],
+                   parent_sha256=candidate["parent_sha256"],
+                   generation=candidate["generation"],
+                   reason=action.reason, evidence=action.evidence)
+        self._teardown_candidate()
+
+    def _do_rollback(self, action: LifecycleAction) -> None:
+        cfg = self.cfg
+        ctl_mod.write_ctl(
+            cfg.models_dir, model=cfg.model, shadow=None, mirror=False,
+            route_fraction=0.0, weights={}, retire=[cfg.shadow_name])
+        self._emit("rollback", reason=action.reason,
+                   evidence=action.evidence,
+                   parent_sha256=bundle_lineage(self.parent_dir)["sha256"])
+        self._teardown_candidate()
+
+    def _teardown_candidate(self) -> None:
+        # best-effort: admitted copies serve from memory and the ctl
+        # retire already unroutes them; leftover bytes on disk are the
+        # only cost of a failure here
+        for d in (self.shadow_dir, self.staging_dir):
+            if d and os.path.isdir(d):
+                shutil.rmtree(d, ignore_errors=True)
+        self.staging_dir = None
+
+    # ---- introspection (obs lifecycle --live uses this shape too) ----
+    def status(self) -> dict:
+        return {
+            "model": self.cfg.model,
+            "state": self.policy.state,
+            "fraction": self.policy.fraction,
+            "cycles": self.cycles,
+            "last_verdict": self.last_verdict,
+            "cooldown_remaining_s": round(
+                self.policy.cooldown_remaining_s(), 3),
+        }
+
+
+def run_controller(cfg: LifecycleConfig, *, deadline_s: float | None,
+                   max_cycles: int | None) -> int:
+    ctl = LifecycleController(cfg)
+    try:
+        rc = ctl.run(deadline_s=deadline_s, max_cycles=max_cycles)
+        print(json.dumps({"state": "stopped", **ctl.status()}),
+              flush=True)
+        return rc
+    finally:
+        ctl.close()

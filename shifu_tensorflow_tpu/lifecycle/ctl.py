@@ -1,0 +1,105 @@
+"""The lifecycle control file — how the controller talks to a serving
+fleet it does not own.
+
+The controller and the scoring workers are separate processes (usually
+separate supervisors); the one thing they verifiably share is the
+models directory.  So actuation is DECLARATIVE: the controller writes
+its full intent to ``<models_dir>/.lifecycle/ctl.json`` (atomic
+tmp+rename, seq-numbered), and every scoring worker reconciles against
+it on its SLO tick — applying tenant weights through the scheduler's
+runtime setter, wiring/unwiring the mirror, setting the ramp split, and
+retiring tenants — then journals ``lifecycle_ctl_applied`` with the seq
+it converged to.  Workers that restart converge from the file alone;
+a torn or missing file reads as "no intent" and changes nothing.
+
+``.lifecycle`` is a dotdir: invisible to tenant discovery (the store's
+``_NAME_OK`` refuses dot-prefixed names), so the control plane can live
+inside the models dir without ever becoming routable.
+
+Document shape (all fields always present — a reader never guesses)::
+
+    {"seq": 7,                  # monotonic per write; workers apply on bump
+     "model": "beta",           # the managed (parent) tenant
+     "shadow": "beta.next",     # shadow tenant name, or null
+     "mirror": true,            # mirror parent traffic to the shadow?
+     "route_fraction": 0.25,    # fraction of parent requests ROUTED to
+                                # the shadow (deterministic rid hash)
+     "weights": {"beta.next": 0.25},   # scheduler weight overrides
+     "retire": []}              # tenants to evict if admitted
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+CTL_DIR = ".lifecycle"
+CTL_FILE = "ctl.json"
+
+
+def ctl_dir(models_dir: str) -> str:
+    return os.path.join(models_dir, CTL_DIR)
+
+
+def ctl_path(models_dir: str) -> str:
+    return os.path.join(models_dir, CTL_DIR, CTL_FILE)
+
+
+def read_ctl(models_dir: str) -> dict | None:
+    """The current control document, or None when absent/unreadable/
+    torn — all equivalent to "no intent" (the writer below renames
+    complete documents into place, so a parse failure is a torn manual
+    edit, not a protocol state)."""
+    try:
+        with open(ctl_path(models_dir)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "seq" not in doc:
+        return None
+    return doc
+
+
+def write_ctl(models_dir: str, *, model: str, shadow: str | None,
+              mirror: bool, route_fraction: float,
+              weights: dict | None = None,
+              retire: list | None = None) -> dict:
+    """Publish a new control document (seq = last seq + 1) atomically:
+    full write to a tmp sibling, fsync, rename — the torn-write-proof
+    commit every artifact plane here uses, so a reader sees the old
+    document or the new one, never a prefix."""
+    d = ctl_dir(models_dir)
+    os.makedirs(d, exist_ok=True)
+    last = read_ctl(models_dir)
+    doc = {
+        "seq": (int(last["seq"]) + 1) if last else 1,
+        "model": model,
+        "shadow": shadow,
+        "mirror": bool(mirror),
+        "route_fraction": float(route_fraction),
+        "weights": dict(weights or {}),
+        "retire": list(retire or ()),
+    }
+    path = ctl_path(models_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return doc
+
+
+def route_to_shadow(rid: str, fraction: float) -> bool:
+    """Deterministic ramp split: does request ``rid`` ride the shadow?
+    crc32 of the rid mapped to [0, 1) — stable across workers and
+    restarts (every worker answers the SAME way for the same rid, so a
+    client retry lands on the same generation), uniform enough for
+    traffic fractions, and dependency-free."""
+    if fraction <= 0.0:
+        return False
+    if fraction >= 1.0:
+        return True
+    h = zlib.crc32(rid.encode("utf-8", "replace")) & 0xFFFFFFFF
+    return (h / 4294967296.0) < fraction

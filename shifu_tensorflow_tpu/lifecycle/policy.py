@@ -1,0 +1,299 @@
+"""The lifecycle state machine — PURE, like the autoscale policy one
+directory over: observations in, at most one action out, an injectable
+clock, zero side effects.  The controller owns every actuator; this
+module owns every debounce, gate, and hysteresis rule, so the semantics
+that decide whether a fleet retrains or a candidate rolls back are unit-
+testable with a frozen clock and hand-built observations.
+
+States and transitions::
+
+    IDLE ──trigger (drift/regression held trigger_hysteresis ticks,
+    │        outside cooldown)──▶ RETRAINING          [action: retrain]
+    │
+    RETRAINING ──on_retrain_result(ok=True)──▶ SHADOW [shadow_admit]
+    │          ──on_retrain_result(ok=False)─▶ IDLE   [rollback,
+    │                                            cooldown restarts]
+    SHADOW ──gates pass (rows >= shadow_min_rows, divergence below
+    │        threshold, no SLO breach)──▶ RAMP        [ramp_step f₀]
+    │      ──bad held rollback_hysteresis ticks──▶ IDLE  [rollback]
+    │
+    RAMP ──step held clean ramp_interval_s──▶ RAMP    [ramp_step fᵢ₊₁]
+    │    ──last step held clean──▶ IDLE               [promote]
+    │    ──bad held rollback_hysteresis ticks──▶ IDLE [rollback]
+
+Anti-flap discipline, layered exactly like the autoscaler's:
+
+- the ``data_drift`` / ``perf_regression`` / ``slo_breach`` events
+  feeding the fold are ALREADY hysteretic (their emitters hold state
+  for ``slo-hysteresis`` evaluations before transitioning);
+- the trigger requires ``trigger_hysteresis`` consecutive drifted polls
+  and the rollback ``rollback_hysteresis`` consecutive bad polls — one
+  noisy window neither launches a fleet nor kills a good candidate;
+- every retrain launch (and every rollback) opens a ``cooldown_s``
+  window during which drift cannot trigger again — the cooldown covers
+  the previous generation's whole shadow/ramp evaluation;
+- empty-window discipline (the PR-7/13/18 lesson): a poll that could
+  not read the journal is fully NEUTRAL, and a poll with NO new events
+  neither accrues bad ticks nor advances a ramp — promotion requires
+  LIVE evidence of a healthy fleet, and a dead fleet's silence must
+  never walk a candidate to 100% traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from shifu_tensorflow_tpu.lifecycle.config import LifecycleConfig
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("lifecycle.policy")
+
+#: policy states
+IDLE = "idle"
+RETRAINING = "retraining"
+SHADOW = "shadow"
+RAMP = "ramp"
+
+
+@dataclass(frozen=True)
+class LifecycleAction:
+    action: str  # "retrain" | "shadow_admit" | "ramp_step" | "promote" | "rollback"
+    reason: str
+    evidence: dict
+    #: ramp_step only: the candidate's new traffic fraction
+    fraction: float | None = None
+
+
+@dataclass
+class LifecycleObservation:
+    """One controller poll's view of the journal (built by
+    LifecycleSignals or a test)."""
+
+    #: new journal events since the last poll (0 = quiet tick: neutral
+    #: for bad-tick accrual AND for ramp advancement)
+    new_events: int = 0
+    #: an open data_drift or perf_regression excursion touching the
+    #: managed model (trigger evidence), with the latched signal names
+    drift_open: bool = False
+    drift_signals: list = field(default_factory=list)
+    #: an open slo_breach on the serving plane touching the managed
+    #: model or the fleet (rollback evidence during shadow/ramp)
+    slo_breached: bool = False
+    slo_signals: list = field(default_factory=list)
+    #: mirrored rows the SHADOW generation has scored so far
+    shadow_rows: int = 0
+    #: parent-vs-shadow score-distribution divergence (drift_components
+    #: max over the 1-wide score column); None = not yet computable
+    divergence: float | None = None
+    #: the journal could not be read: fully neutral tick
+    read_error: bool = False
+
+
+class LifecyclePolicy:
+    """Hysteretic closed-loop policy.  Call :meth:`observe` once per
+    tick; feed actuator outcomes back through :meth:`on_retrain_result`
+    and :meth:`on_action_applied` — the policy advances its state only
+    on CONFIRMED actuation, so a failed shadow publication cannot leave
+    it believing a shadow is serving."""
+
+    def __init__(self, cfg: LifecycleConfig, clock=time.monotonic):
+        self.cfg = cfg
+        self._clock = clock
+        self.state = IDLE
+        self._trigger_ticks = 0
+        self._bad_ticks = 0
+        self._last_retrain_ts: float | None = None
+        self._step_idx = -1
+        self._step_started_ts = 0.0
+        #: the ramp step currently applied (None until the first
+        #: ramp_step is confirmed) — exposed for the controller's
+        #: journal evidence
+        self.fraction: float | None = None
+
+    # ---- cooldown ----
+    def in_cooldown(self) -> bool:
+        return (self._last_retrain_ts is not None
+                and self._clock() - self._last_retrain_ts
+                < self.cfg.cooldown_s)
+
+    def cooldown_remaining_s(self) -> float:
+        if self._last_retrain_ts is None:
+            return 0.0
+        return max(0.0, self.cfg.cooldown_s
+                   - (self._clock() - self._last_retrain_ts))
+
+    # ---- the tick ----
+    def observe(self, obs: LifecycleObservation) -> LifecycleAction | None:
+        if obs.read_error:
+            # an unreadable journal is evidence of nothing: no trigger
+            # debounce reset, no bad-tick accrual, no ramp hold credit
+            return None
+        if self.state == IDLE:
+            return self._observe_idle(obs)
+        if self.state == RETRAINING:
+            # the retrain subprocess is the controller's to watch; the
+            # journal cannot say anything that changes the verdict
+            return None
+        if self.state in (SHADOW, RAMP):
+            return self._observe_candidate(obs)
+        raise AssertionError(f"unknown state {self.state!r}")
+
+    def _observe_idle(self, obs: LifecycleObservation) -> LifecycleAction | None:
+        if obs.drift_open and obs.new_events > 0:
+            # drift latched AND the fleet is live enough to emit: count
+            # it.  A latched excursion whose writers went quiet is a
+            # dead fleet, not drift evidence (the autoscale rule).
+            self._trigger_ticks += 1
+        elif not obs.drift_open:
+            self._trigger_ticks = 0
+        if (self._trigger_ticks >= self.cfg.trigger_hysteresis
+                and not self.in_cooldown()):
+            evidence = {
+                "signals": sorted(obs.drift_signals),
+                "trigger_ticks": self._trigger_ticks,
+            }
+            self._trigger_ticks = 0
+            self._last_retrain_ts = self._clock()
+            self.state = RETRAINING
+            return LifecycleAction(
+                action="retrain",
+                reason=(f"{evidence['signals']} held for "
+                        f"{evidence['trigger_ticks']} tick(s)"),
+                evidence=evidence,
+            )
+        return None
+
+    def _observe_candidate(
+            self, obs: LifecycleObservation) -> LifecycleAction | None:
+        cfg = self.cfg
+        diverged = (obs.divergence is not None
+                    and obs.divergence >= cfg.divergence_threshold)
+        bad = obs.slo_breached or diverged
+        if obs.new_events == 0:
+            # quiet tick: neither bad-tick accrual (a dead writer's
+            # latched breach is not fresh evidence) nor clean credit (a
+            # dead fleet must not promote) — hold still
+            return None
+        if bad:
+            self._bad_ticks += 1
+            if self._bad_ticks >= cfg.rollback_hysteresis:
+                return self._to_idle(LifecycleAction(
+                    action="rollback",
+                    reason=("slo breach" if obs.slo_breached
+                            else f"score divergence {obs.divergence:.3f}"
+                                 f" >= {cfg.divergence_threshold:g}"),
+                    evidence=self._candidate_evidence(obs),
+                ))
+            return None
+        self._bad_ticks = 0
+        if self.state == SHADOW:
+            if (obs.shadow_rows >= cfg.shadow_min_rows
+                    and obs.divergence is not None and not diverged):
+                return LifecycleAction(
+                    action="ramp_step",
+                    reason=(f"shadow clean: {obs.shadow_rows} rows, "
+                            f"divergence {obs.divergence:.3f} < "
+                            f"{cfg.divergence_threshold:g}"),
+                    evidence=self._candidate_evidence(obs),
+                    fraction=float(cfg.ramp_steps[0]),
+                )
+            return None
+        # RAMP: the current step must hold clean for the full interval
+        held = self._clock() - self._step_started_ts
+        if held < cfg.ramp_interval_s:
+            return None
+        evidence = self._candidate_evidence(obs)
+        evidence["held_s"] = round(held, 3)
+        if self._step_idx + 1 < len(cfg.ramp_steps):
+            return LifecycleAction(
+                action="ramp_step",
+                reason=(f"step {self._step_idx} "
+                        f"({cfg.ramp_steps[self._step_idx]:g}) held "
+                        f"clean {held:.1f}s"),
+                evidence=evidence,
+                fraction=float(cfg.ramp_steps[self._step_idx + 1]),
+            )
+        return LifecycleAction(
+            action="promote",
+            reason=(f"final step ({cfg.ramp_steps[self._step_idx]:g}) "
+                    f"held clean {held:.1f}s"),
+            evidence=evidence,
+        )
+
+    def _candidate_evidence(self, obs: LifecycleObservation) -> dict:
+        return {
+            "state": self.state,
+            "step": self._step_idx,
+            "fraction": self.fraction,
+            "shadow_rows": obs.shadow_rows,
+            "divergence": obs.divergence,
+            "slo": sorted(obs.slo_signals),
+            "bad_ticks": self._bad_ticks,
+        }
+
+    def _to_idle(self, action: LifecycleAction) -> LifecycleAction:
+        self.state = IDLE
+        self._bad_ticks = 0
+        self._trigger_ticks = 0
+        self._step_idx = -1
+        self.fraction = None
+        if action.action == "rollback":
+            # a failed candidate restarts the cooldown in full: the
+            # same drift is still out there and would re-trigger on the
+            # next tick otherwise, launching retrain after retrain at
+            # poll cadence
+            self._last_retrain_ts = self._clock()
+        return action
+
+    # ---- actuator feedback ----
+    def on_retrain_result(self, ok: bool, reason: str = "",
+                          evidence: dict | None = None
+                          ) -> LifecycleAction | None:
+        """The controller's retrain verdict: rc 0 + a verified bundle →
+        admit it as shadow; anything else (non-zero rc — the nan-loss
+        health guard exits 3 —, timeout, missing manifest) → the
+        poisoned-retrain rollback, parent untouched."""
+        if self.state != RETRAINING:
+            log.warning("retrain result in state %s ignored", self.state)
+            return None
+        if ok:
+            self.state = SHADOW
+            self._bad_ticks = 0
+            return LifecycleAction(
+                action="shadow_admit",
+                reason="retrain succeeded: admit candidate as shadow",
+                evidence=evidence or {},
+            )
+        return self._to_idle(LifecycleAction(
+            action="rollback",
+            reason=f"retrain_failed: {reason}",
+            evidence=evidence or {},
+        ))
+
+    def on_action_applied(self, action: LifecycleAction, ok: bool,
+                          reason: str = "") -> LifecycleAction | None:
+        """Commit (or revert) a returned action once the controller
+        actuated it.  A FAILED actuation of any candidate-path action
+        is itself a rollback verdict: a shadow that could not publish
+        or a ctl file that could not write leaves the fleet in an
+        unknown split, and the only safe state is the parent alone."""
+        if ok:
+            if action.action == "ramp_step":
+                self.state = RAMP
+                self._step_idx += 1
+                self._step_started_ts = self._clock()
+                self.fraction = action.fraction
+            elif action.action in ("promote", "rollback"):
+                self._to_idle(action)
+            return None
+        if action.action in ("shadow_admit", "ramp_step", "promote"):
+            return self._to_idle(LifecycleAction(
+                action="rollback",
+                reason=f"{action.action} failed to apply: {reason}",
+                evidence={"failed_action": action.action},
+            ))
+        # a rollback that failed to actuate: stay IDLE (the policy
+        # already reverted); the controller retries teardown itself
+        self._to_idle(action)
+        return None

@@ -1,0 +1,162 @@
+"""Journal fold feeding the lifecycle policy — the JournalSignals
+pattern from serve/autoscale.py, pointed at the lifecycle's evidence:
+
+- open ``data_drift`` excursions on the managed model and open
+  ``perf_regression`` excursions (the trigger signals);
+- open serve ``slo_breach`` latches touching the fleet, the managed
+  model, or the shadow (the rollback signals);
+- the per-tenant ``score_stats`` sketches the scoring workers journal
+  on their SLO tick — cumulative 1-wide DataSketch snapshots of each
+  tenant's emitted scores — merged across writers (PR-12 sketch
+  algebra) and compared parent-vs-shadow with ``drift_components``:
+  the same dimensionless machinery that detects feature drift detects
+  score-distribution divergence, on the one column that matters.
+
+State folds incrementally over per-writer ``(ts, seq)`` watermarks
+(each poll pays for the new tail only), and a writer's latches clear
+when its process demonstrably restarted or left (``serve_start`` /
+``serve_worker_exit`` / ``scale_down``) — a dead writer cannot emit its
+own ``_clear``, and a forever-latched breach would either block every
+future promotion or trigger retrains off a fleet that no longer exists.
+"""
+
+from __future__ import annotations
+
+from shifu_tensorflow_tpu.lifecycle.policy import LifecycleObservation
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("lifecycle.signals")
+
+#: serve SLO signals that count as rollback evidence (bare fleet-wide
+#: form or per-tenant ``:model`` form)
+_SLO_SIGNALS = ("serve_p99_s", "serve_shed_rate", "serve_error_rate")
+
+
+class LifecycleSignals:
+    def __init__(self, journal_base: str, model: str, shadow: str):
+        from shifu_tensorflow_tpu.obs.journal import read_keyed_events
+
+        self._read_keyed = read_keyed_events
+        self.base = journal_base
+        self.model = model
+        self.shadow = shadow
+        self._cache: dict = {}
+        self._marks: dict = {}       # writer-file id -> (ts, seq)
+        self._drift: dict = {}       # (worker, model, feature) -> bool
+        self._regress: dict = {}     # (worker, metric) -> bool
+        self._slo: dict = {}         # (worker, signal) -> bool
+        self._scores: dict = {}      # (worker, model) -> snapshot dict
+
+    def _clear_writer(self, worker) -> None:
+        for d in (self._drift, self._regress, self._slo):
+            for key in [k for k in d if k[0] == worker]:
+                d[key] = False
+        for key in [k for k in self._scores if k[0] == worker]:
+            # a restarted writer's cumulative sketch restarts from zero;
+            # keeping the dead process's snapshot would double-count its
+            # rows against the fresh process's
+            del self._scores[key]
+
+    def _fold(self, ev: dict) -> None:
+        if ev.get("plane") != "serve":
+            # the loop closes on SERVING evidence: a train-plane drift
+            # sketch or the controller's own echoes must not latch
+            return
+        kind = ev.get("event")
+        worker = ev.get("worker")
+        if kind == "data_drift":
+            if ev.get("model") == self.model:
+                self._drift[(worker, ev.get("model"),
+                             ev.get("feature"))] = True
+        elif kind == "data_drift_clear":
+            self._drift[(worker, ev.get("model"),
+                         ev.get("feature"))] = False
+        elif kind == "perf_regression":
+            self._regress[(worker, ev.get("metric"))] = True
+        elif kind == "perf_regression_clear":
+            self._regress[(worker, ev.get("metric"))] = False
+        elif kind == "slo_breach":
+            sig = str(ev.get("signal") or "")
+            base, _, tenant = sig.partition(":")
+            if base in _SLO_SIGNALS and (
+                    not tenant or tenant in (self.model, self.shadow)):
+                self._slo[(worker, sig)] = True
+        elif kind == "slo_recover":
+            self._slo[(worker, str(ev.get("signal") or ""))] = False
+        elif kind == "serve_start":
+            self._clear_writer(worker)
+        elif kind in ("serve_worker_exit", "scale_down"):
+            self._clear_writer(ev.get("index"))
+        elif kind == "score_stats":
+            snap = ev.get("snapshot")
+            m = ev.get("model")
+            if isinstance(snap, dict) and m:
+                self._scores[(worker, m)] = snap
+
+    def _merged_scores(self, model: str) -> dict | None:
+        snaps = [s for (_, m), s in sorted(self._scores.items(),
+                                           key=lambda kv: kv[0][1] or "")
+                 if m == model]
+        if not snaps:
+            return None
+        if len(snaps) == 1:
+            return snaps[0]
+        from shifu_tensorflow_tpu.obs.datastats import merge_snapshots
+
+        return merge_snapshots(snaps)
+
+    def divergence(self) -> tuple:
+        """``(divergence, shadow_rows)``: the max drift component of the
+        shadow's merged score distribution against the parent's, plus
+        how many mirrored rows back it.  ``(None, rows)`` before both
+        sides have data."""
+        shadow = self._merged_scores(self.shadow)
+        rows = int(shadow.get("rows", 0)) if shadow else 0
+        parent = self._merged_scores(self.model)
+        if not parent or not shadow or not parent.get("rows") or not rows:
+            return None, rows
+        try:
+            from shifu_tensorflow_tpu.obs.datastats import drift_components
+
+            comps = drift_components(parent, shadow, 0)
+            return (max(comps.values()) if comps else 0.0), rows
+        except Exception:
+            log.exception("score divergence computation failed")
+            return None, rows
+
+    def poll(self) -> LifecycleObservation:
+        try:
+            keyed = self._read_keyed(self.base, cache=self._cache,
+                                     after=self._marks)
+        except Exception:
+            log.exception("lifecycle journal read failed (%s)", self.base)
+            return LifecycleObservation(read_error=True)
+        new = 0
+        marks = self._marks
+        for ts, writer, seq, ev in keyed:
+            if (ts, seq) <= marks.get(writer, (-1.0, -1)):
+                continue
+            marks[writer] = (ts, seq)
+            if ev.get("plane") != "lifecycle":
+                # the controller's own echoes are not fleet liveness:
+                # counting them would let the policy promote a candidate
+                # on the strength of its own journaling
+                new += 1
+            self._fold(ev)
+        drift_signals = sorted(
+            f"data_drift:{m}:{f}" for (_, m, f), b in self._drift.items()
+            if b) + sorted(
+            f"perf_regression:{m}" for (_, m), b in self._regress.items()
+            if b)
+        slo_signals = sorted(
+            {sig for (_, sig), b in self._slo.items() if b})
+        divergence, shadow_rows = self.divergence()
+        return LifecycleObservation(
+            new_events=new,
+            drift_open=bool(drift_signals),
+            drift_signals=drift_signals,
+            slo_breached=bool(slo_signals),
+            slo_signals=slo_signals,
+            shadow_rows=shadow_rows,
+            divergence=divergence,
+        )

@@ -138,7 +138,10 @@ def install_obs(cfg: ObsConfig, *, worker_index: int | None = None,
     trace_mod.install(tracer)
     jrn = None
     if cfg.journal_path:
-        suffix = "s" if plane == "serve" else "w"
+        # one writer per file: train fleets write .w<i>, serve scoring
+        # processes .s<i>, the lifecycle controller .l<i> — the reader
+        # merges the set by (ts, writer, seq)
+        suffix = {"serve": "s", "lifecycle": "l"}.get(plane, "w")
         path = (
             cfg.journal_path
             if worker_index is None

@@ -239,6 +239,20 @@ def build_parser() -> argparse.ArgumentParser:
     score.add_argument("--json", action="store_true", dest="as_json",
                        help="machine-readable score-job document")
 
+    life = sub.add_parser(
+        "lifecycle",
+        help="reconstruct closed-loop model lifecycle cycles from the "
+             "journal: trigger evidence, retrain, shadow, ramp steps, "
+             "the promote/rollback verdict and its latency",
+    )
+    life.add_argument("--journal", required=True,
+                      help="journal base path shared by the serve fleet "
+                           "and the lifecycle controller (.l writer)")
+    life.add_argument("--model", default=None,
+                      help="only cycles managing this tenant")
+    life.add_argument("--json", action="store_true", dest="as_json",
+                      help="machine-readable lifecycle document")
+
     top = sub.add_parser(
         "top",
         help="live dashboard: tail the journals (+ optionally scrape "
@@ -2221,6 +2235,156 @@ def cmd_score(args) -> int:
     return 0
 
 
+# ---- lifecycle reconstruction ----
+
+LIFECYCLE_SCHEMA = "stpu.obs.lifecycle/1"
+
+#: controller-plane events that open/advance/close a lifecycle cycle
+_CYCLE_EVENTS = (
+    "lifecycle_trigger", "retrain_start", "retrain_done", "shadow_admit",
+    "ramp_step", "promote", "rollback",
+)
+
+
+def _lifecycle_data(events: list[dict],
+                    model: str | None = None) -> dict:
+    """Lifecycle cycles out of the journal: the controller's ``.l``
+    writer emits every transition with its evidence, the serve workers
+    echo ``lifecycle_ctl_applied`` / ``weight_change`` as they converge
+    on the ctl intent — together enough to reconstruct each cycle
+    (trigger → retrain → shadow → ramp → verdict), its wall-clock
+    latency, and whether the fleet actually actuated each step, from a
+    dead fleet's files alone."""
+    cycles: list = []
+    open_by_model: dict = {}
+
+    def cycle_for(ev, *, open_new: bool) -> dict | None:
+        m = ev.get("model") or "?"
+        c = open_by_model.get(m)
+        if c is None and open_new:
+            c = {
+                "model": m, "trigger_ts": None, "verdict": None,
+                "verdict_ts": None, "generation": None,
+                "evidence": None, "retrain": None, "ramp_steps": [],
+                "ctl_applied": [], "weight_changes": [],
+                "timeline": [],
+            }
+            open_by_model[m] = c
+            cycles.append(c)
+        return c
+
+    for ev in events:
+        kind = ev.get("event")
+        m = ev.get("model")
+        if model is not None and m is not None and m != model \
+                and not str(m).startswith(f"{model}."):
+            continue
+        if kind == "lifecycle_trigger":
+            # a trigger while a cycle is open means the previous
+            # controller died verdict-less: close it as such
+            stale = open_by_model.pop(m or "?", None)
+            if stale is not None and stale["verdict"] is None:
+                stale["verdict"] = "abandoned"
+            c = cycle_for(ev, open_new=True)
+            c["trigger_ts"] = ev.get("ts")
+            c["evidence"] = ev.get("evidence") or ev.get("signals")
+            c["timeline"].append(ev)
+        elif kind in _CYCLE_EVENTS:
+            c = cycle_for(ev, open_new=True)
+            c["timeline"].append(ev)
+            if kind == "retrain_start":
+                c["generation"] = ev.get("generation", c["generation"])
+            elif kind == "retrain_done":
+                c["retrain"] = {
+                    "ok": bool(ev.get("ok")), "rc": ev.get("rc"),
+                    "why": ev.get("why"),
+                    "duration_s": ev.get("duration_s"),
+                }
+            elif kind == "ramp_step":
+                c["ramp_steps"].append(ev.get("fraction"))
+            elif kind in ("promote", "rollback"):
+                c["verdict"] = kind
+                c["verdict_ts"] = ev.get("ts")
+                if kind == "rollback":
+                    c["rollback_reason"] = ev.get("reason")
+                open_by_model.pop(c["model"], None)
+        elif kind == "lifecycle_ctl_applied":
+            for c in cycles:
+                if c["verdict"] is None:
+                    c["ctl_applied"].append(ev)
+                    c["timeline"].append(ev)
+        elif kind == "weight_change":
+            for c in cycles:
+                if c["verdict"] is None:
+                    c["weight_changes"].append(ev)
+                    c["timeline"].append(ev)
+    if model is not None:
+        cycles = [c for c in cycles if c["model"] == model]
+    if not cycles:
+        return {}
+    for c in cycles:
+        if c["trigger_ts"] is not None and c["verdict_ts"] is not None:
+            c["latency_s"] = round(c["verdict_ts"] - c["trigger_ts"], 3)
+        else:
+            c["latency_s"] = None
+        if c["verdict"] is None:
+            c["verdict"] = "in-flight"
+    return {"schema": LIFECYCLE_SCHEMA, "cycles": cycles}
+
+
+def _render_lifecycle(data: dict, t0: float) -> list[str]:
+    lines: list[str] = []
+    for i, c in enumerate(data["cycles"]):
+        gen = (f" gen {c['generation']}"
+               if c["generation"] is not None else "")
+        lat = (f" in {c['latency_s']}s"
+               if c["latency_s"] is not None else "")
+        lines.append(f"cycle {i} — model {c['model']}{gen}: "
+                     f"{c['verdict'].upper()}{lat}")
+        if c.get("evidence"):
+            lines.append(f"  trigger evidence: {_short(c['evidence'])}")
+        r = c.get("retrain")
+        if r:
+            state = "ok" if r["ok"] else f"FAILED ({r.get('why')})"
+            dur = (f" in {r['duration_s']:.1f}s"
+                   if isinstance(r.get("duration_s"), (int, float))
+                   else "")
+            lines.append(f"  retrain: {state} rc={r.get('rc')}{dur}")
+        if c["ramp_steps"]:
+            lines.append("  ramp: " + " -> ".join(
+                f"{f:g}" for f in c["ramp_steps"] if f is not None))
+        if c.get("rollback_reason"):
+            lines.append(f"  rollback reason: {c['rollback_reason']}")
+        lines.append(f"  fleet actuation: {len(c['ctl_applied'])} ctl "
+                     f"apply(s), {len(c['weight_changes'])} weight "
+                     f"change(s)")
+        for ev in c["timeline"]:
+            lines.append(" " + _fmt_event(ev, t0))
+    return lines
+
+
+def cmd_lifecycle(args) -> int:
+    events = read_events(args.journal)
+    if not events:
+        print(f"no journal events under {args.journal!r} "
+              f"(files: {journal_files(args.journal) or 'none'})",
+              file=sys.stderr)
+        return 1
+    data = _lifecycle_data(events, model=args.model)
+    if args.as_json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0 if data else 1
+    if not data:
+        print("no lifecycle events — run the controller with "
+              "`python -m shifu_tensorflow_tpu.lifecycle run ...` "
+              "against this journal")
+        return 1
+    t0 = events[0].get("ts", 0.0)
+    for line in _render_lifecycle(data, t0):
+        print(line)
+    return 0
+
+
 def cmd_top(args) -> int:
     # per-file parse cache: rotated journal files are immutable, so each
     # refresh re-reads only the growing active files, not the whole
@@ -2270,6 +2434,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_profile(args)
         if args.cmd == "score":
             return cmd_score(args)
+        if args.cmd == "lifecycle":
+            return cmd_lifecycle(args)
         return cmd_summary(args)
     except KeyboardInterrupt:
         return 0

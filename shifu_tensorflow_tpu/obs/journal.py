@@ -294,12 +294,13 @@ def emit(event: str, **fields: Any) -> None:
 def journal_files(base: str) -> list[str]:
     """Every file belonging to the journal at ``base``: the file itself,
     its rotations (``base.N``), fleet-worker siblings (``base.wK`` for
-    train workers, ``base.sK`` for --serve-workers scoring processes),
-    and their rotations — oldest-first within each writer so a re-sorted
-    merge is stable for equal timestamps."""
+    train workers, ``base.sK`` for --serve-workers scoring processes,
+    ``base.lK`` for the lifecycle controller), and their rotations —
+    oldest-first within each writer so a re-sorted merge is stable for
+    equal timestamps."""
     base = os.fspath(base)
     pat = re.compile(
-        re.escape(os.path.basename(base)) + r"(\.[ws]\d+)?(\.\d+)?$"
+        re.escape(os.path.basename(base)) + r"(\.[wsl]\d+)?(\.\d+)?$"
     )
     found = [
         p for p in glob.glob(glob.escape(base) + "*")
@@ -308,10 +309,12 @@ def journal_files(base: str) -> list[str]:
 
     def order(p: str):
         m = pat.fullmatch(os.path.basename(p))
-        # siblings sort base-first, then .w<k>, then .s<k> (train fleet
-        # before serve fleet; within equal timestamps the merge is
-        # stable in this order)
-        kind = {"": -1, "w": 0, "s": 1}[m.group(1)[1] if m.group(1) else ""]
+        # siblings sort base-first, then .w<k>, then .s<k>, then .l<k>
+        # (train fleet before serve fleet before the lifecycle
+        # controller; within equal timestamps the merge is stable in
+        # this order)
+        kind = {"": -1, "w": 0, "s": 1,
+                "l": 2}[m.group(1)[1] if m.group(1) else ""]
         worker = int(m.group(1)[2:]) if m.group(1) else -1
         rot = int(m.group(2)[1:]) if m.group(2) else 0
         return (kind, worker, -rot)  # higher rotation number = older
@@ -343,7 +346,8 @@ def read_keyed_events(
     """``read_events`` plus each event's merge key: ``(ts, writer, seq,
     event)`` tuples in merged order.  ``writer`` is the file-derived
     identity (``(-1, -1)`` for the base file, ``(0, k)`` for ``.w<k>``,
-    ``(1, k)`` for ``.s<k>``) and ``(ts, seq)`` is monotonic WITHIN a
+    ``(1, k)`` for ``.s<k>``, ``(2, k)`` for ``.l<k>``) and
+    ``(ts, seq)`` is monotonic WITHIN a
     writer — the contract an incremental poller needs to keep a
     per-writer high-water mark that survives late file flushes and
     rotation dropping old files (a global list index does neither: a
@@ -358,14 +362,15 @@ def read_keyed_events(
     tick for the new tail, not an O(total-events) rebuild of history."""
     base = os.fspath(base)
     pat = re.compile(
-        re.escape(os.path.basename(base)) + r"(\.([ws])(\d+))?(\.\d+)?$"
+        re.escape(os.path.basename(base)) + r"(\.([wsl])(\d+))?(\.\d+)?$"
     )
     keyed: list[tuple[float, tuple, int, dict]] = []
     positions: dict[tuple, int] = {}
     for path in journal_files(base):
         m = pat.fullmatch(os.path.basename(path))
         writer = ((-1, -1) if not m or not m.group(2)
-                  else ({"w": 0, "s": 1}[m.group(2)], int(m.group(3))))
+                  else ({"w": 0, "s": 1, "l": 2}[m.group(2)],
+                        int(m.group(3))))
         mark = after.get(writer) if after is not None else None
         if cache is not None:
             try:

@@ -39,7 +39,10 @@ from shifu_tensorflow_tpu.serve.batcher import (
     RequestTooLarge,
     ShedLoad,
 )
+from collections import deque
+
 from shifu_tensorflow_tpu.export.bucketing import ladder
+from shifu_tensorflow_tpu.lifecycle import ctl as lifecycle_ctl
 from shifu_tensorflow_tpu.obs import datastats as obs_datastats
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import rollup as obs_rollup
@@ -205,6 +208,24 @@ class ScoringServer:
         # events (shed) can undercount, these cannot.  Registering is a
         # module-dict write; without a compactor it is never polled.
         obs_rollup.register_source("serve", self._rollup_counters)
+        # lifecycle reconcile state (multi-tenant only): the controller's
+        # declarative ctl.json under <models_dir>/.lifecycle is applied
+        # on the SLO tick — mirror wiring, ramp split, runtime tenant
+        # weights, retirements — and each convergence journals
+        # lifecycle_ctl_applied with the seq reached.  Per-tenant score
+        # sketches (1-wide DataSketch over emitted scores) journal as
+        # score_stats on the same tick: the parent-vs-shadow divergence
+        # evidence the controller's promotion gate reads.
+        self._ctl_seq = 0
+        self._route: tuple | None = None   # (parent, shadow, fraction)
+        self._mirror: tuple | None = None  # (parent, shadow)
+        self._mirror_q: deque = deque(maxlen=32)
+        self._mirror_n = 0
+        self._mirror_stop = threading.Event()
+        self._mirror_thread: threading.Thread | None = None
+        self._pending_weights: dict[str, float] = {}
+        self._score_sketches: dict = {}
+        self._sketch_lock = threading.Lock()
 
     def _rollup_counters(self) -> dict:
         """Flat monotonic counters for the rollup compactor: the
@@ -316,14 +337,139 @@ class ScoringServer:
                 # baseline rollup on this same tick (no-op unpinned)
                 obs_rollup.tick()
                 obs_profile.poll()
+                # lifecycle leg (PR 18): reconcile against the
+                # controller's ctl file and journal the per-tenant
+                # score-distribution sketches its gates read
+                if self.multi is not None:
+                    self._lifecycle_tick()
+                    self._emit_score_stats()
             except Exception as e:  # the watchdog must never kill serving
                 log.error("slo evaluation failed: %s: %s",
                           type(e).__name__, e)
+
+    # ---- lifecycle reconcile (SLO-tick thread; multi-tenant only) ----
+    def _lifecycle_tick(self) -> None:
+        """Converge on the lifecycle controller's declarative intent.
+        A missing/torn ctl file changes nothing; an unchanged seq costs
+        one stat+read.  Weight intents for tenants not yet admitted stay
+        pending and re-apply each tick (and right after the mirror pump
+        admits the shadow), so the controller's weight survives the
+        shadow's on-demand admission ordering."""
+        doc = lifecycle_ctl.read_ctl(self.config.models_dir)
+        if doc is not None and int(doc.get("seq", 0)) != self._ctl_seq:
+            seq = int(doc["seq"])
+            shadow = doc.get("shadow") or None
+            parent = str(doc.get("model") or "")
+            self._pending_weights.update({
+                str(n): float(w)
+                for n, w in (doc.get("weights") or {}).items()})
+            if shadow and doc.get("mirror"):
+                self._mirror = (parent, shadow)
+                if self._mirror_thread is None:
+                    self._mirror_thread = threading.Thread(
+                        target=self._mirror_loop, name="serve-mirror",
+                        daemon=True)
+                    self._mirror_thread.start()
+            else:
+                self._mirror = None
+                self._mirror_q.clear()
+            fraction = float(doc.get("route_fraction") or 0.0)
+            self._route = ((parent, shadow, fraction)
+                           if shadow and fraction > 0.0 else None)
+            for name in doc.get("retire") or ():
+                try:
+                    self.multi.retire(str(name))
+                except Exception as e:
+                    log.warning("lifecycle retire of %s failed: %s",
+                                name, e)
+                self._pending_weights.pop(str(name), None)
+                with self._sketch_lock:
+                    self._score_sketches.pop(str(name), None)
+            self._ctl_seq = seq
+            obs_journal.emit(
+                "lifecycle_ctl_applied", plane="serve", seq=seq,
+                shadow=shadow, mirror=bool(doc.get("mirror")),
+                route_fraction=fraction,
+                weights=dict(doc.get("weights") or {}),
+                retire=list(doc.get("retire") or ()),
+            )
+        self._apply_pending_weights()
+
+    def _apply_pending_weights(self) -> None:
+        for name in list(self._pending_weights):
+            try:
+                self.multi.scheduler.set_weight(
+                    name, self._pending_weights[name])
+            except KeyError:
+                continue  # not admitted yet; retry next tick
+            except Exception as e:
+                log.warning("lifecycle weight for %s failed: %s", name, e)
+            self._pending_weights.pop(name, None)
+
+    def _note_scores(self, model: str, scores) -> None:
+        """Fold one response's scores into the tenant's cumulative
+        1-wide sketch — the raw material of the score_stats events the
+        lifecycle divergence gate compares."""
+        if self.multi is None or scores is None:
+            return
+        try:
+            col = np.asarray(scores, np.float64).reshape(-1, 1)
+        except Exception:
+            return
+        with self._sketch_lock:
+            sk = self._score_sketches.get(model)
+            if sk is None:
+                sk = obs_datastats.DataSketch(1)
+                self._score_sketches[model] = sk
+        sk.add_batch(col)
+
+    def _emit_score_stats(self) -> None:
+        with self._sketch_lock:
+            sketches = list(self._score_sketches.items())
+        for model, sk in sketches:
+            snap = sk.snapshot()
+            if snap:
+                obs_journal.emit("score_stats", plane="serve",
+                                 model=model, snapshot=snap)
+
+    def _mirror_loop(self) -> None:
+        """Drain mirrored parent rows onto the shadow tenant's batcher.
+        Strictly best-effort: the queue is bounded and drop-on-full (a
+        slow shadow backs nothing up into the serving path), a shed or
+        cold-start on the shadow drops the sample, and NO failure here
+        can surface to a client — the mirror exists to manufacture
+        comparison evidence, not to serve."""
+        while not self._mirror_stop.is_set():
+            mirror = self._mirror
+            if mirror is None or not self._mirror_q:
+                if self._mirror_stop.wait(0.05):
+                    return
+                continue
+            try:
+                rows = self._mirror_q.popleft()
+            except IndexError:
+                continue
+            _parent, shadow = mirror
+            self._mirror_n += 1
+            try:
+                tenant = self.multi.acquire(shadow)
+                self._apply_pending_weights()
+                batcher = tenant.batcher
+                if batcher is None:
+                    continue
+                scores = batcher.submit(
+                    rows, rid=f"mirror-{self._mirror_n}")
+                self._note_scores(shadow, scores)
+            except Exception:
+                continue
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._mirror_stop.set()
+        if self._mirror_thread is not None:
+            self._mirror_thread.join(timeout=10.0)
         # flush the compactor BEFORE unregistering: the final counter
         # deltas since the last window must land in the sidecar (the
         # conservation gate), and after this server is gone its source
@@ -506,6 +652,19 @@ class ScoringServer:
         """The ``/score/<model>`` path: resolve (admitting on demand
         under the cold-start guard), validate against THAT model's
         width, feed its micro-batcher, stamp its identity."""
+        route = self._route
+        if route is not None:
+            parent, shadow, fraction = route
+            resolved = model_name
+            if resolved is None:
+                sole = self.multi.sole()
+                resolved = sole.name if sole is not None else None
+            if (resolved == parent and rid is not None
+                    and lifecycle_ctl.route_to_shadow(rid, fraction)):
+                # deterministic rid-hash split: the same request routes
+                # the same way on every worker and across restarts, so a
+                # retry cannot flap between generations mid-ramp
+                model_name = shadow
         tenant = self.multi.acquire(model_name)
         store = tenant.store
         if store is None:
@@ -548,6 +707,12 @@ class ScoringServer:
         if self._slo is not None:
             self._slo.observe("serve_p99_s", dt)
             self._slo.observe(f"serve_p99_s:{tenant.name}", dt)
+        self._note_scores(tenant.name, scores)
+        mirror = self._mirror
+        if mirror is not None and tenant.name == mirror[0]:
+            # bounded drop-on-full copy of parent traffic for the shadow
+            # scorer; the serving path never blocks on the mirror
+            self._mirror_q.append(rows)
         # identity re-stamp, same argument as the single-model path; an
         # eviction racing this re-read keeps the pre-submit stamp
         store = tenant.store

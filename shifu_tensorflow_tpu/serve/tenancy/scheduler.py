@@ -40,6 +40,7 @@ import threading
 import time
 from collections import deque
 
+from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.utils import logs
 
 log = logs.get("serve.sched")
@@ -178,6 +179,47 @@ class DeviceScheduler:
             handle.work.clear()
             self._cond.notify_all()
         return leftovers
+
+    def set_weight(self, handle_or_name, weight: float) -> float:
+        """Runtime tenant-weight adjustment — the lifecycle ramp's
+        actuator.  Takes the handle ``register`` returned or the tenant
+        NAME (the ramp controller only knows names); returns the
+        previous weight.  Journaled as ``weight_change`` so a ramp step
+        is reconstructable from a dead fleet's files like every other
+        transition.
+
+        Coordinator-free by design: mutating the DRR weight under the
+        scheduler lock re-shares device rows from the very next ring
+        pass, so a small ramp step does not pay a rolling restart.  The
+        restart path stays for *worker-visible config* (the persisted
+        ``serve-tenant-weight-*`` keys new workers resolve at boot) —
+        this setter moves live traffic, the config the fleet converges
+        to on its next roll."""
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        with self._cond:
+            tq = None
+            if isinstance(handle_or_name, _TenantQueue):
+                if handle_or_name.registered:
+                    tq = handle_or_name
+            else:
+                for cand in self._order:
+                    if cand.name == handle_or_name:
+                        tq = cand
+                        break
+            if tq is None:
+                raise KeyError(f"no registered tenant {handle_or_name!r}")
+            before = tq.weight
+            tq.weight = float(weight)
+            # an idle queue's stale deficit is already forfeited on
+            # visit; an accumulating one re-earns at the new rate from
+            # the next pass — no retroactive credit either way
+            self._cond.notify_all()
+        if obs_journal.active() is not None:
+            obs_journal.emit("weight_change", plane="serve",
+                             model=tq.name, weight=float(weight),
+                             weight_before=before)
+        return before
 
     # ---- reading ----
     def queue_depths(self) -> dict[str, int]:

@@ -711,6 +711,21 @@ class MultiModelStore:
         with self._lock:
             return self._tenants.get(name)
 
+    def retire(self, name: str) -> bool:
+        """Operator/lifecycle-initiated eviction by name: drain and
+        release ``name`` if admitted (the ``_evict`` path with a
+        ``retire`` reason, so the journal distinguishes a deliberate
+        retirement from budget pressure).  A cold or unknown tenant is
+        already retired — no-op, False.  The tenant record survives, so
+        a stray request re-admits from whatever bundle the directory
+        now holds (after a promotion: the promoted generation)."""
+        with self._lock:
+            t = self._tenants.get(name)
+        if t is None or t.state != "admitted":
+            return False
+        self._evict(t, reason="retire")
+        return True
+
     def refresh_tenant(self, name: str) -> bool:
         """Targeted single-name discovery — one disk check, no full
         models-dir rescan (the /healthz/<model> miss path; a balancer

@@ -169,6 +169,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many rows (shifu.tpu.export-aot-rows; "
                         "default matches the serve plane's warm set, "
                         "ladder(serve-queue-rows))")
+    p.add_argument("--export-parent-sha", default=None,
+                   dest="export_parent_sha",
+                   help="generation lineage: the weights sha256 of the "
+                        "bundle this retrain descends from, stamped "
+                        "into the export manifest (the lifecycle "
+                        "controller's rollback target); omit for a "
+                        "root export")
+    p.add_argument("--export-generation", type=int, default=None,
+                   dest="export_generation",
+                   help="generation lineage: monotonic generation "
+                        "number stamped into the export manifest "
+                        "(default: absent — legacy readers treat it "
+                        "as 0)")
     p.add_argument("--compile-cache-dir", default=None,
                    dest="compile_cache_dir",
                    help="jax persistent compilation cache dir "
@@ -191,6 +204,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "workers write <path>.w<i>; read with "
                         "`python -m shifu_tensorflow_tpu.obs summary`")
     return p
+
+
+def resolve_lineage(args: argparse.Namespace) -> dict | None:
+    """The manifest lineage stamp from the CLI flags, or None when
+    neither was given (a root export — the manifest then carries no
+    ``lineage`` key, exactly like every pre-lifecycle bundle)."""
+    if args.export_parent_sha is None and args.export_generation is None:
+        return None
+    lineage: dict = {}
+    if args.export_parent_sha is not None:
+        lineage["parent_sha256"] = args.export_parent_sha
+    if args.export_generation is not None:
+        lineage["generation"] = int(args.export_generation)
+    return lineage
 
 
 def load_conf(args: argparse.Namespace) -> Conf:
@@ -776,6 +803,7 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
             zscale_means=schema.means or None,
             zscale_stds=schema.stds or None,
             aot_buckets=resolve_aot_buckets(args, conf),
+            lineage=resolve_lineage(args),
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
     import jax as _jax
@@ -1018,6 +1046,7 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
             zscale_stds=schema.stds or None,
             feature_stats=feature_stats,
             aot_buckets=resolve_aot_buckets(args, conf),
+            lineage=resolve_lineage(args),
         )
         print(f"exported to {args.export_dir}: {wrote}", flush=True)
     print_summary()

@@ -1106,3 +1106,105 @@ def test_autoscale_keys_round_trip_xml_to_serve_config(tmp_path):
 
     with pytest.raises(ValueError, match="serve-workers-max"):
         ServeConfig(model_dir="/m", workers=4, workers_max=2)
+
+
+def test_lifecycle_keys_round_trip_xml_to_dataclass(tmp_path):
+    """Every shifu.tpu.lifecycle-* key must survive the full resolution
+    chain: Hadoop-XML resource → layered Conf merge → CLI override →
+    LifecycleConfig dataclass → JSON bridge — the serve-key contract,
+    applied to the controller surface."""
+    import pytest
+
+    from shifu_tensorflow_tpu.lifecycle.__main__ import (
+        build_parser as lifecycle_parser,
+    )
+    from shifu_tensorflow_tpu.lifecycle.config import (
+        LifecycleConfig,
+        resolve_lifecycle_config,
+    )
+
+    xml = tmp_path / "lifecycle.xml"
+    values = {
+        K.LIFECYCLE_MODEL: "beta",
+        K.SERVE_MODELS_DIR: "/srv/models",
+        K.OBS_JOURNAL: "/var/log/stpu/j",
+        K.TRAINING_DATA_PATH: "/data/train",
+        K.LIFECYCLE_POLL_S: "0.5",
+        K.LIFECYCLE_TRIGGER_HYSTERESIS: "5",
+        K.LIFECYCLE_COOLDOWN_S: "120.5",
+        K.LIFECYCLE_SHADOW_MIN_ROWS: "64",
+        K.LIFECYCLE_DIVERGENCE_THRESHOLD: "0.8",
+        K.LIFECYCLE_RAMP_STEPS: "0.1,0.4,0.8",
+        K.LIFECYCLE_RAMP_INTERVAL_S: "12.5",
+        K.LIFECYCLE_ROLLBACK_HYSTERESIS: "4",
+        K.LIFECYCLE_RETRAIN_TIMEOUT_S: "900",
+    }
+    xml.write_text(
+        "<configuration>" + "".join(
+            f"<property><name>{k}</name><value>{v}</value></property>"
+            for k, v in values.items()
+        ) + "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_lifecycle_config(
+        lifecycle_parser().parse_args(["run"]), conf)
+    assert cfg.model == "beta"
+    assert cfg.models_dir == "/srv/models"
+    assert cfg.journal_base == "/var/log/stpu/j"
+    assert cfg.train_data_path == "/data/train"
+    assert cfg.poll_s == 0.5
+    assert cfg.trigger_hysteresis == 5
+    assert cfg.cooldown_s == 120.5
+    assert cfg.shadow_min_rows == 64
+    assert cfg.divergence_threshold == 0.8
+    assert cfg.ramp_steps == (0.1, 0.4, 0.8)
+    assert cfg.ramp_interval_s == 12.5
+    assert cfg.rollback_hysteresis == 4
+    assert cfg.retrain_timeout_s == 900.0
+    # CLI flags win over the XML layer
+    cfg = resolve_lifecycle_config(lifecycle_parser().parse_args(
+        ["run", "--model", "gamma", "--models-dir", "/m2",
+         "--journal", "/j2", "--train-data", "/d2",
+         "--train-arg=--epochs", "--train-arg=3",
+         "--poll", "2", "--trigger-hysteresis", "2",
+         "--cooldown", "60", "--shadow-min-rows", "32",
+         "--divergence-threshold", "1.5", "--ramp-steps", "0.5",
+         "--ramp-interval", "5", "--rollback-hysteresis", "1",
+         "--retrain-timeout", "30"]), conf)
+    assert (cfg.model, cfg.models_dir, cfg.journal_base,
+            cfg.train_data_path) == ("gamma", "/m2", "/j2", "/d2")
+    assert cfg.train_args == ("--epochs", "3")
+    assert (cfg.poll_s, cfg.trigger_hysteresis, cfg.cooldown_s,
+            cfg.shadow_min_rows, cfg.divergence_threshold,
+            cfg.ramp_steps, cfg.ramp_interval_s,
+            cfg.rollback_hysteresis, cfg.retrain_timeout_s) \
+        == (2.0, 2, 60.0, 32, 1.5, (0.5,), 5.0, 1, 30.0)
+    # the JSON bridge round-trips every field (drill harnesses ship the
+    # config to the controller subprocess whole)
+    assert LifecycleConfig.from_json(cfg.to_json()) == cfg
+    # defaults with only the required identity keys set
+    d = resolve_lifecycle_config(lifecycle_parser().parse_args(
+        ["run", "--model", "beta", "--models-dir", "/m",
+         "--journal", "/j"]), Conf())
+    assert d.poll_s == K.DEFAULT_LIFECYCLE_POLL_S
+    assert d.trigger_hysteresis == K.DEFAULT_LIFECYCLE_TRIGGER_HYSTERESIS
+    assert d.cooldown_s == K.DEFAULT_LIFECYCLE_COOLDOWN_S
+    assert d.shadow_min_rows == K.DEFAULT_LIFECYCLE_SHADOW_MIN_ROWS
+    assert (d.divergence_threshold
+            == K.DEFAULT_LIFECYCLE_DIVERGENCE_THRESHOLD)
+    assert d.ramp_steps == tuple(
+        float(s) for s in K.DEFAULT_LIFECYCLE_RAMP_STEPS.split(","))
+    assert d.ramp_interval_s == K.DEFAULT_LIFECYCLE_RAMP_INTERVAL_S
+    assert (d.rollback_hysteresis
+            == K.DEFAULT_LIFECYCLE_ROLLBACK_HYSTERESIS)
+    assert d.retrain_timeout_s == K.DEFAULT_LIFECYCLE_RETRAIN_TIMEOUT_S
+    # misconfiguration is one clean pre-launch ValueError naming the key
+    with pytest.raises(ValueError, match="lifecycle-ramp-steps"):
+        resolve_lifecycle_config(lifecycle_parser().parse_args(
+            ["run", "--model", "beta", "--models-dir", "/m",
+             "--journal", "/j", "--ramp-steps", "0.5,0.25"]), Conf())
+    with pytest.raises(ValueError, match="lifecycle-trigger-hysteresis"):
+        resolve_lifecycle_config(lifecycle_parser().parse_args(
+            ["run", "--model", "beta", "--models-dir", "/m",
+             "--journal", "/j", "--trigger-hysteresis", "0"]), Conf())
