@@ -883,6 +883,22 @@ def main() -> None:
         rc = bench_serve_scale.main()
         _append_bench_history('serve-scale', 'BENCH_SERVE_SCALE.json', rc=rc)
         sys.exit(rc)
+    if "serve-frame" in sys.argv[1:]:
+        # frame wire-protocol benchmark (python bench.py serve-frame):
+        # columnar binary frames vs /score JSON at equal in-flight
+        # concurrency (gate: >= 2x rows/s, host_capped fallback),
+        # bit-identical parity, and fleet occupancy at 2 workers with
+        # the shared dispatch lane vs the fragmented private-batcher
+        # baseline, artifact BENCH_SERVE_FRAME.json — implemented in
+        # scripts/bench_serve_frame.py.  Fleets are CLI subprocesses;
+        # the parent stays jax-free.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve_frame
+
+        rc = bench_serve_frame.main()
+        _append_bench_history('serve-frame', 'BENCH_SERVE_FRAME.json', rc=rc)
+        sys.exit(rc)
     if "serve" in sys.argv[1:]:
         # serving benchmark (python bench.py serve): micro-batched vs
         # one-row-per-request scoring over HTTP, artifact

@@ -275,6 +275,37 @@ DEFAULT_SERVE_RELOAD_POLL_MS = 2000
 SERVE_WORKERS = TPU_PREFIX + "serve-workers"
 DEFAULT_SERVE_WORKERS = 1
 
+# ---- zero-copy columnar wire protocol (serve/wire/: binary frames on a
+# persistent streaming connection; docs/serving.md "Wire protocol") ----
+# Second listener speaking length-prefixed binary frames: the float32
+# feature matrix lands as one buffer handed straight to the pack stage —
+# no per-row JSON float parsing, no per-request concat copies — and
+# concurrent requests multiplex on one connection, matched back by rid.
+# 0 (default) = frame listener off; -1 = ephemeral port (tests/bench;
+# the bound port rides the "listening" status line); >0 = fixed port,
+# shared via SO_REUSEPORT when --serve-workers > 1.
+SERVE_FRAME_PORT = TPU_PREFIX + "serve-frame-port"
+DEFAULT_SERVE_FRAME_PORT = 0
+# upper bound on rows in ONE frame, enforced BEFORE the payload is
+# buffered (the length prefix is checked against it, so an oversized
+# frame is refused with a typed 413 ERROR frame without allocating).
+# Defaults to the admission bound — a frame the batcher could never
+# admit is refused at the wire.  0 = track serve-queue-rows (whatever
+# it resolves to), so shrinking the queue never silently leaves the
+# wire accepting frames the batcher must refuse.
+SERVE_FRAME_MAX_ROWS = TPU_PREFIX + "serve-frame-max-rows"
+DEFAULT_SERVE_FRAME_MAX_ROWS = 0
+# fleet-wide shared dispatch lane: with --serve-workers N > 1, exactly
+# one worker (the lowest index, re-elected by the supervisor on worker
+# death) owns device dispatch; siblings forward their packed per-tenant
+# batches over a local UDS handoff and scatter the replies by rid, so
+# DRR weights and coalescing apply across the whole fleet instead of
+# fragmenting the device into N uncoordinated batchers.  Siblings fall
+# back to their private dispatch path whenever the lane owner is
+# unreachable (journaled lane_degraded/lane_restored).
+SERVE_SHARED_LANE = TPU_PREFIX + "serve-shared-lane"
+DEFAULT_SERVE_SHARED_LANE = False
+
 # ---- SLO-driven serve autoscaling (serve/autoscale.py, run by the
 # --serve-workers supervisor; docs/serving.md) ----
 # Ceiling for the autoscaler: with serve-workers-max > serve-workers the

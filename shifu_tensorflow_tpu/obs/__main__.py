@@ -489,9 +489,14 @@ def _serve_data(events: list[dict]) -> dict:
     # this table doesn't track must not mint an all-zero row that
     # reads as "present and idle".
     models: dict = defaultdict(lambda: {
-        "rows": 0, "batches": 0, "sheds": 0, "reloads": 0,
-        "refused": 0, "admits": 0, "evicts": 0,
+        "rows": 0, "bucket_rows": 0, "batches": 0, "sheds": 0,
+        "reloads": 0, "refused": 0, "admits": 0, "evicts": 0,
     })
+    # shared dispatch lane lifecycle (lane_owner / lane_degraded /
+    # lane_restored), in journal order — the dead-fleet reconstruction
+    # of who owned fleet dispatch and when siblings fell back to
+    # private dispatch
+    lane: list = []
 
     def mm_of(ev):
         mname = ev.get("model")
@@ -532,6 +537,10 @@ def _serve_data(events: list[dict]) -> dict:
             if mm is not None:
                 mm["batches"] += 1
                 mm["rows"] += int(ev.get("rows", 0) or 0)
+                # bucket = rows the DEVICE paid (useful + ladder
+                # padding); rows/bucket_rows is the occupancy column
+                mm["bucket_rows"] += int(
+                    ev.get("bucket", ev.get("rows", 0)) or 0)
         elif kind == "model_admit":
             mm = mm_of(ev)
             if mm is not None:
@@ -555,6 +564,14 @@ def _serve_data(events: list[dict]) -> dict:
                 "weight": ev.get("weight"),
                 "reason": ev.get("reason"),
             })
+        elif kind in ("lane_owner", "lane_degraded", "lane_restored"):
+            lane.append({
+                "event": kind,
+                "ts": ev.get("ts"),
+                "worker": w,
+                "redispatched": ev.get("redispatched"),
+                "connects": ev.get("connects"),
+            })
     rows = {}
     for w, a in per.items():
         if (a["start_ts"] is None and a["requests"] is None
@@ -576,7 +593,7 @@ def _serve_data(events: list[dict]) -> dict:
                    "req_per_s": rate}
     return {"fleet": fleet, "workers": rows,
             "models": {m: dict(v) for m, v in sorted(models.items())},
-            "autoscale": autoscale}
+            "autoscale": autoscale, "lane": lane}
 
 
 def _render_serve(data: dict) -> list[str]:
@@ -600,6 +617,20 @@ def _render_serve(data: dict) -> list[str]:
             what = f"-> {d['to_workers']} workers"
         lines.append(f"  autoscale: {d['action']} {what}"
                      + (f"  ({d['reason']})" if d.get("reason") else ""))
+    for d in data.get("lane") or []:
+        # shared dispatch-lane lifecycle in journal order: owner bind,
+        # sibling joins, degradations — who owned fleet dispatch when
+        who = "-" if d.get("worker") is None else str(d["worker"])
+        if d["event"] == "lane_owner":
+            what = "owns the fleet dispatch lane"
+        elif d["event"] == "lane_restored":
+            what = (f"joined the lane (connect "
+                    f"#{d.get('connects') or '?'})")
+        else:
+            what = (f"lane degraded -> private dispatch "
+                    f"({d.get('redispatched') or 0} in-flight "
+                    f"re-dispatched)")
+        lines.append(f"  lane: worker {who} {what}")
     if not rows:
         # a fleet whose workers all died before serve_start (crash
         # loop: bad artifact, stolen port) has no per-worker rows, but
@@ -626,12 +657,17 @@ def _render_serve(data: dict) -> list[str]:
         # can't aggregate a fleet; this table can)
         lines.append(
             "  model          rows     batches  shed-ev  reloads  "
-            "refused  admits  evicts")
+            "refused  admits  evicts  occup")
         for m, v in models.items():
+            # useful rows / device (bucket) rows across this model's
+            # journaled dispatches — the fleet-coalescing health number
+            # (fragmented fleets pad more, so this falls)
+            occ = (f"{v['rows'] / v['bucket_rows']:.3f}"
+                   if v.get("bucket_rows") else "-")
             lines.append(
                 f"  {m:<14} {v['rows']:<8} {v['batches']:<8} "
                 f"{v['sheds']:<8} {v['reloads']:<8} {v['refused']:<8} "
-                f"{v['admits']:<7} {v['evicts']}"
+                f"{v['admits']:<7} {v['evicts']:<7} {occ}"
             )
     return lines
 

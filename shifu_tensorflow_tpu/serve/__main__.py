@@ -110,6 +110,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "stpu_serve_scale_* gauges — live worker count, "
                         "ceiling, scale/rebalance totals, restart-budget "
                         "remaining and per-window burn.  0 = off")
+    p.add_argument("--frame-port", type=int, default=None,
+                   dest="frame_port",
+                   help="binary wire-protocol listener "
+                        "(shifu.tpu.serve-frame-port): length-prefixed "
+                        "float32 frames on persistent connections, "
+                        "replies multiplexed by rid — no JSON parse, no "
+                        "per-row copies.  0 = off (default), -1 = "
+                        "ephemeral (resolved port in the listening line)")
+    p.add_argument("--frame-max-rows", type=int, default=None,
+                   dest="frame_max_rows",
+                   help="largest row count one frame may carry "
+                        "(shifu.tpu.serve-frame-max-rows); bigger frames "
+                        "get a typed 413 ERROR frame before the payload "
+                        "is buffered")
+    p.add_argument("--shared-lane", action="store_true", default=None,
+                   dest="shared_lane",
+                   help="with --serve-workers N>1, funnel every worker's "
+                        "packed batches through ONE fleet-wide "
+                        "DeviceScheduler on the lowest-index worker "
+                        "(shifu.tpu.serve-shared-lane); siblings fall "
+                        "back to private dispatch while the owner is "
+                        "unreachable")
+    p.add_argument("--lane-socket", default=None, dest="lane_socket",
+                   help="(internal) shared-lane UNIX socket path; set by "
+                        "the --serve-workers supervisor")
     p.add_argument("--no-warm", action="store_true", dest="no_warm",
                    help="skip the bucket-ladder pre-warm at startup and "
                         "on reload admits (diagnostic/benchmark arm: "
@@ -194,7 +219,8 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         server = ScoringServer(config, warm=not args.no_warm,
-                               worker_index=args.serve_worker_index)
+                               worker_index=args.serve_worker_index,
+                               lane_socket=args.lane_socket)
     except (ArtifactCorrupt, ValueError) as e:
         # single-model: corrupt initial artifact fails fast; multi:
         # a missing/empty models dir does (per-tenant corruption only
@@ -250,6 +276,8 @@ def main(argv: list[str] | None = None) -> int:
         }
     if args.serve_worker_index is not None:
         ready["worker_index"] = args.serve_worker_index
+    if server.frame_port:
+        ready["frame_port"] = server.frame_port
     print(json.dumps(ready), flush=True)
     try:
         while not stop.wait(0.5):
@@ -403,6 +431,26 @@ def _supervise(argv: list[str], config, obs_cfg,
         port = config.port
     else:
         probe, port = _probe_port(config.host)
+    # the wire-frame listener is fleet-shared too: -1 (ephemeral) must
+    # resolve to ONE concrete port every worker SO_REUSEPORT-binds, so
+    # the supervisor reserves it exactly like the HTTP port above
+    frame_probe = None
+    frame_port = config.frame_port
+    if frame_port == -1:
+        frame_probe, frame_port = _probe_port(config.host)
+    # shared dispatch lane: the supervisor mints the fleet's UNIX socket
+    # path so every spawn — initial, crash restart, scale_up, rolling
+    # rebalance — agrees on it.  Worker 0 binds it (the lane owner:
+    # crash restarts reuse the index and scale_down always evicts the
+    # HIGHEST index, so ownership never migrates); siblings connect.
+    lane_socket = None
+    if config.shared_lane:
+        import os as _os
+        import tempfile as _tempfile
+
+        lane_socket = _os.path.join(
+            _tempfile.gettempdir(),
+            f"stpu-lane-{job_id or _os.getpid()}.sock")
     # a crash loop (bad artifact, port stolen, OOM) must fail the fleet,
     # not respawn forever — but the budget is over a sliding WINDOW, not
     # the fleet's lifetime: sporadic single-worker deaths spaced hours
@@ -457,6 +505,11 @@ def _supervise(argv: list[str], config, obs_cfg,
         # restart, rolling rebalance — reads the one copy, so the
         # policy's view and the workers' flags cannot drift
         extra: list[str] = []
+        if frame_port:
+            # replaces a possible "--frame-port -1" (argparse last-wins)
+            extra += ["--frame-port", str(frame_port)]
+        if lane_socket:
+            extra += ["--lane-socket", lane_socket]
         if policy is not None:
             for m, w in sorted(policy.weight_overrides.items()):
                 # appended LAST so argparse's append-and-last-wins merge
@@ -597,6 +650,9 @@ def _supervise(argv: list[str], config, obs_cfg,
             # release the reservation either way
             probe.close()
             probe = None
+        if frame_probe is not None:
+            frame_probe.close()
+            frame_probe = None
         if ready:
             if config.supervisor_port:
                 metrics_srv, mport = _start_supervisor_metrics(
@@ -608,6 +664,8 @@ def _supervise(argv: list[str], config, obs_cfg,
                 "workers": n,
                 "workers_max": config.workers_max or n,
                 "autoscale": autoscale,
+                **({"frame_port": frame_port} if frame_port else {}),
+                **({"shared_lane": True} if lane_socket else {}),
             }), flush=True)
             next_tick = _time.monotonic() + (
                 config.autoscale_poll_s if autoscale else 0.0)
@@ -701,6 +759,8 @@ def _supervise(argv: list[str], config, obs_cfg,
     finally:
         if probe is not None:
             probe.close()
+        if frame_probe is not None:
+            frame_probe.close()
         if metrics_srv is not None:
             metrics_srv.shutdown()
         # fleet-wide drain: SIGTERM each live worker (it stops
@@ -754,6 +814,14 @@ def _supervise(argv: list[str], config, obs_cfg,
             # or drained workers' requests live in the journal/rollup
             # (exact monotonic counters, PR-13), not this line
             stopped["autoscale"] = dict(scale_totals)
+        if lane_socket is not None:
+            # the owner unlinks on clean close; a SIGKILLed owner leaves
+            # the socket file behind — sweep it so the next fleet's
+            # owner does not bind-fail on the stale path
+            try:
+                _os.unlink(lane_socket)
+            except OSError:
+                pass
         print(json.dumps(stopped), flush=True)
     return rc if rc is not None else (drain_rc or 0)
 

@@ -110,15 +110,24 @@ class _Pending:
 class _Work:
     """One coalesced batch moving through the pipeline stages."""
 
-    __slots__ = ("batch", "sizes", "n", "bucket", "padded", "scores",
-                 "error", "dispatch_s", "queue_delay_s")
+    __slots__ = ("batch", "sizes", "n", "bucket", "rows", "padded",
+                 "scores", "error", "dispatch_s", "queue_delay_s",
+                 "via_lane")
 
     def __init__(self, batch: list[_Pending]):
         self.batch = batch
         self.sizes = [p.rows.shape[0] for p in batch]
         self.n = sum(self.sizes)
         self.bucket = bucket_size(self.n)
+        # the coalesced PRE-padding matrix: what the shared dispatch
+        # lane forwards (the owner re-coalesces and pads), and what the
+        # fallback path pads locally
+        self.rows: np.ndarray | None = None
         self.padded: np.ndarray | None = None
+        # completed over the fleet lane: the device-truth accounting
+        # (batches/padded-rows counters, serve_batch event, cost ledger)
+        # happened at the lane owner's dispatch, not here
+        self.via_lane = False
         self.scores: np.ndarray | None = None
         self.error: BaseException | None = None
         self.dispatch_s = 0.0
@@ -164,6 +173,7 @@ class MicroBatcher:
         scheduler=None,
         model: str | None = None,
         weight: float = 1.0,
+        lane=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -200,6 +210,11 @@ class MicroBatcher:
         self._dispatching: _Work | None = None
         self._scheduler = scheduler
         self._sched_handle = None
+        # fleet-shared dispatch lane (serve/wire/lane.py): packed
+        # batches forward to the lane-owner worker when it is
+        # reachable; anything else dispatches through the private path
+        # below exactly as before
+        self._lane = lane
         # chaos seam serve.dispatch (slow/error kinds): decided at
         # construction, like the trainer's per-step seam — a plan comes
         # from the environment at process start, and the steady-state
@@ -358,6 +373,12 @@ class MicroBatcher:
                 # their waiters get a typed BatcherClosed — retryable
                 # at the routing layer — never a silent hang until
                 # their own submit timeout.
+                if self._lane is not None:
+                    # lane forwards first: their replies land in OUR
+                    # scatter queue and must beat the sentinel below
+                    # (a timeout fails them over to private dispatch,
+                    # which the scheduler drain then covers)
+                    self._lane.drain(self)
                 if self._scheduler is not None:
                     self._scheduler.drain(self._sched_handle)
                     dropped = self._scheduler.unregister(
@@ -377,7 +398,14 @@ class MicroBatcher:
             work = _Work(batch)
             with obs_trace.span("serve.pack"):
                 try:
-                    # the concatenate is INSIDE the guard: coalesced
+                    # single-source zero-copy fast path: when ONE
+                    # pending request covers the whole dispatch its
+                    # matrix passes through untouched (no concatenate),
+                    # and pad_rows below no-ops when it already fills
+                    # its bucket — so a frame-ingress matrix (a single
+                    # memoryview off the wire, serve/wire/frame.py)
+                    # reaches score_fn without ever being copied.
+                    # The concatenate stays INSIDE the guard: coalesced
                     # requests can disagree on row width (each was
                     # validated against whichever model was current at
                     # its admission, and a hot reload can change the
@@ -387,21 +415,62 @@ class MicroBatcher:
                     x = (batch[0].rows if len(batch) == 1
                          else np.concatenate([p.rows for p in batch],
                                              axis=0))
-                    # data-observability tap (obs/datastats.py): feed
-                    # the PRE-padding concat into this model's live
-                    # windowed sketch — once per coalesced dispatch, on
-                    # the pack thread (off the device path), before the
-                    # ladder's zero rows could read as a distribution
+                    work.rows = x
+                    if self._lane is None:
+                        # data-observability tap (obs/datastats.py):
+                        # feed the PRE-padding concat into this model's
+                        # live windowed sketch — once per coalesced
+                        # dispatch, on the pack thread (off the device
+                        # path), before the ladder's zero rows could
+                        # read as a distribution.  Lane mode defers the
+                        # tap to whoever DISPATCHES (the owner's pack
+                        # loop, or the fallback branch below) so no row
+                        # is sketched twice fleet-wide.
+                        mon = obs_datastats.active()
+                        if mon is not None:
+                            mon.observe(self.model or "default", x)
+                        work.padded = pad_rows(x, work.bucket)
+                    # lane mode pads NOWHERE here: the owner coalesces
+                    # forwards from the whole fleet before padding once
+                except BaseException as e:
+                    work.error = e
+            if (work.error is None and self._lane is not None
+                    and self._lane.forward(self, work)):
+                # the lane owns it now: its reply (or the dead-owner
+                # failover) lands in our scatter queue by rid
+                continue
+            if self._lane is not None and work.error is None:
+                # lane unreachable: private dispatch, the pre-lane path
+                try:
                     mon = obs_datastats.active()
                     if mon is not None:
-                        mon.observe(self.model or "default", x)
-                    work.padded = pad_rows(x, work.bucket)
+                        mon.observe(self.model or "default", work.rows)
+                    work.padded = pad_rows(work.rows, work.bucket)
                 except BaseException as e:
                     work.error = e
             if self._scheduler is not None:
                 self._scheduler.submit(self._sched_handle, work)
             else:
                 self._dispatch_q.put(work)
+
+    def _lane_fallback(self, work: _Work) -> None:
+        """Re-route a forwarded batch through the PRIVATE dispatch path
+        (lane owner died or refused with a server-side status) — called
+        by the LaneClient, possibly from its reader thread.  The work
+        re-pads locally and re-enters exactly where a never-forwarded
+        batch would have."""
+        work.via_lane = False
+        work.scores = None
+        work.bucket = bucket_size(work.n)
+        if work.error is None and work.padded is None:
+            try:
+                work.padded = pad_rows(work.rows, work.bucket)
+            except BaseException as e:
+                work.error = e
+        if self._scheduler is not None:
+            self._scheduler.submit(self._sched_handle, work)
+        else:
+            self._dispatch_q.put(work)
 
     # ---- dispatch stage ----
     def _dispatch_one(self, work: _Work) -> None:
@@ -480,12 +549,16 @@ class MicroBatcher:
                 p.error = work.error
                 p.event.set()
             return
-        if self.metrics is not None:
+        if self.metrics is not None and not work.via_lane:
+            # lane-completed batches: the device dispatch (and its
+            # batches/rows/padded accounting + serve_batch event +
+            # cost ledger) happened at the lane OWNER — counting it
+            # here too would double every fleet-wide aggregate
             self.metrics.inc("batches_total")
             self.metrics.inc("rows_total", work.n)
             self.metrics.inc("padded_rows_total", work.bucket - work.n)
             self.metrics.batch_latency.record(work.dispatch_s)
-        if obs_journal.active() is not None:
+        if obs_journal.active() is not None and not work.via_lane:
             # one event per coalesced DISPATCH (never per request — the
             # event rate is bounded by 1/max_delay, not the request
             # rate), carrying the correlation ids it scored: the causal

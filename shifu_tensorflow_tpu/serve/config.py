@@ -47,6 +47,14 @@ class ServeConfig:
     # supervisor /metrics listener port (stpu_serve_scale_* gauges +
     # restart-budget burn); 0 = off
     supervisor_port: int = K.DEFAULT_SERVE_SUPERVISOR_PORT
+    # zero-copy columnar wire protocol (serve/wire/): 0 = frame listener
+    # off, -1 = ephemeral port (tests), > 0 = fixed (SO_REUSEPORT when
+    # workers > 1, like the HTTP port)
+    frame_port: int = K.DEFAULT_SERVE_FRAME_PORT
+    frame_max_rows: int = K.DEFAULT_SERVE_FRAME_MAX_ROWS
+    # fleet-wide shared dispatch lane: the lowest-index worker owns
+    # device dispatch, siblings forward packed batches over a UDS
+    shared_lane: bool = K.DEFAULT_SERVE_SHARED_LANE
     # multi-tenant (serve/tenancy/) — shifu.tpu.serve-model-* keys
     models_dir: str | None = None
     model_budget_mb: float = K.DEFAULT_SERVE_MODEL_BUDGET_MB
@@ -101,6 +109,24 @@ class ServeConfig:
                 f"{K.SERVE_QUEUE_ROWS} ({self.max_queue_rows}) must be >= "
                 f"{K.SERVE_MAX_BATCH} ({self.max_batch}): a queue smaller "
                 "than one dispatch could never fill a batch"
+            )
+        if self.frame_port < -1:
+            raise ValueError(
+                f"{K.SERVE_FRAME_PORT} must be 0 (off), -1 (ephemeral) "
+                f"or a port number, got {self.frame_port}"
+            )
+        if self.frame_max_rows == 0:
+            # 0 = track the admission bound, whatever max_queue_rows
+            # resolved to (frozen dataclass: assign around the freeze)
+            object.__setattr__(self, "frame_max_rows", self.max_queue_rows)
+        if self.frame_max_rows < 1:
+            raise ValueError(f"{K.SERVE_FRAME_MAX_ROWS} must be >= 1")
+        if self.frame_max_rows > self.max_queue_rows:
+            raise ValueError(
+                f"{K.SERVE_FRAME_MAX_ROWS} ({self.frame_max_rows}) must "
+                f"be <= {K.SERVE_QUEUE_ROWS} ({self.max_queue_rows}): a "
+                "frame the admission bound can never admit would always "
+                "be refused after the bytes were already shipped"
             )
 
     def weight_for(self, model: str) -> float:
@@ -204,4 +230,10 @@ def resolve_serve_config(args, conf) -> ServeConfig:
         supervisor_port=pick(
             "supervisor_port", K.SERVE_SUPERVISOR_PORT,
             K.DEFAULT_SERVE_SUPERVISOR_PORT, conf.get_int),
+        frame_port=pick("frame_port", K.SERVE_FRAME_PORT,
+                        K.DEFAULT_SERVE_FRAME_PORT, conf.get_int),
+        frame_max_rows=pick("frame_max_rows", K.SERVE_FRAME_MAX_ROWS,
+                            K.DEFAULT_SERVE_FRAME_MAX_ROWS, conf.get_int),
+        shared_lane=pick("shared_lane", K.SERVE_SHARED_LANE,
+                         K.DEFAULT_SERVE_SHARED_LANE, conf.get_bool),
     )

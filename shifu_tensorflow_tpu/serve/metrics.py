@@ -35,6 +35,12 @@ _COUNTERS = (
     "errors_total",         # requests failed with 4xx/5xx (excl. 429)
     "reloads_total",        # hot-reload swaps admitted
     "reload_failures_total",  # reload attempts refused (corrupt artifact)
+    # wire protocol (serve/wire/): binary-frame ingress, counted on the
+    # process surface (the _unrouted series in multi-tenant mode —
+    # per-tenant requests_total still counts every routed frame)
+    "frame_requests_total",  # score frames received
+    "frame_rows_total",      # rows received as frames
+    "frame_errors_total",    # frames answered with a typed ERROR frame
 )
 
 
@@ -79,6 +85,17 @@ class ServeMetrics:
         multi-tenant model dimension onto every series; empty keeps the
         single-model output byte-identical."""
         self.registry.set_gauge("queue_rows", queue_rows)
+        # batch occupancy: useful rows as a fraction of DISPATCHED rows
+        # (useful + ladder padding) — the measurement surface behind the
+        # fleet-wide-coalescing gate (ROADMAP item 4: N private
+        # batchers fragment the device; occupancy is where it shows).
+        # 1.0 when idle: no dispatch yet means no padding waste yet.
+        c = self.registry.counters()
+        dispatched = c.get("rows_total", 0) + c.get("padded_rows_total", 0)
+        self.registry.set_gauge(
+            "occupancy",
+            round(c.get("rows_total", 0) / dispatched, 6)
+            if dispatched else 1.0)
         self.registry.set_gauge("model_epoch", model_epoch)
         self.registry.set_gauge("model_verified", int(model_verified))
         self.registry.set_gauge("model_info", 1,

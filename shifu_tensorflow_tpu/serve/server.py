@@ -119,6 +119,7 @@ class ScoringServer:
         metrics: ServeMetrics | None = None,
         warm: bool = True,
         worker_index: int | None = None,
+        lane_socket: str | None = None,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else ServeMetrics()
@@ -126,13 +127,31 @@ class ScoringServer:
         self.store: ModelStore | None = None
         self.batcher: MicroBatcher | None = None
         self.multi = None
+        # fleet-shared dispatch lane (serve/wire/lane.py): the
+        # supervisor hands every worker the same UDS path; the
+        # LOWEST-index worker owns it (crashes respawn at the same
+        # index and scale-down evicts the highest, so ownership is
+        # stable and re-election is just the supervisor restarting
+        # worker 0), every other worker forwards its packed batches
+        # down it.  Built BEFORE the stores so their batchers are
+        # constructed lane-aware.
+        self.lane = None           # sibling side (LaneClient)
+        self.lane_server = None    # owner side (FrameServer on the UDS)
+        self.frame_server = None   # public binary-frame listener
+        self.frame_port = 0
+        lane_owner = bool(lane_socket) and not worker_index
+        if lane_socket and not lane_owner:
+            from shifu_tensorflow_tpu.serve.wire.lane import LaneClient
+
+            self.lane = LaneClient(lane_socket)
         if config.models_dir:
             # multi-tenant mode (serve/tenancy/): named models admitted
             # under the memory budget, per-model batchers feeding the
             # shared weighted-fair device scheduler.  self.metrics stays
             # the UNROUTED surface (requests that never resolved a
             # model); per-model counters live on each tenant.
-            self.multi = MultiModelStore(config, warm=warm)
+            self.multi = MultiModelStore(config, warm=warm,
+                                         lane=self.lane)
         else:
             # single-model mode — the PR-3/PR-5 path, unchanged
             # pre-warm set: every bucket the admission bound can admit
@@ -156,6 +175,7 @@ class ScoringServer:
                 max_queue_rows=config.max_queue_rows,
                 retry_after_s=config.retry_after_s,
                 metrics=self.metrics,
+                lane=self.lane,
             )
         handler = _make_handler(self)
         # workers > 1 means this process is ONE of several sharing the
@@ -169,20 +189,53 @@ class ScoringServer:
         server_cls = (_ReuseportHTTPServer
                       if config.workers > 1 or elastic
                       else ThreadingHTTPServer)
+        self.httpd = None
         try:
             self.httpd = server_cls(
                 (config.host, config.port), handler
             )
+            if config.frame_port:
+                # the binary-frame listener (serve/wire/): -1 binds an
+                # ephemeral port, anything else the named one; shared
+                # with SO_REUSEPORT across a fleet like the HTTP port
+                from shifu_tensorflow_tpu.serve.wire.stream import (
+                    FrameServer,
+                )
+
+                self.frame_server = FrameServer(
+                    self, host=config.host,
+                    port=(0 if config.frame_port == -1
+                          else config.frame_port),
+                    max_rows=min(config.frame_max_rows,
+                                 config.max_queue_rows),
+                    reuseport=config.workers > 1 or elastic,
+                )
+                self.frame_port = self.frame_server.port
+            if lane_owner:
+                from shifu_tensorflow_tpu.serve.wire.stream import (
+                    FrameServer,
+                )
+
+                self.lane_server = FrameServer(
+                    self, uds_path=lane_socket,
+                    max_rows=config.max_queue_rows, lane=True,
+                )
         except BaseException:
             # e.g. EADDRINUSE: without this, the started batcher thread
             # pins the score_fn closure → store → model, leaking a full
             # model's memory per failed construction attempt
+            if self.frame_server is not None:
+                self.frame_server.close(timeout_s=0.0)
+            if self.httpd is not None:
+                self.httpd.server_close()
             if self.batcher is not None:
                 self.batcher.close(drain=False)
             if self.store is not None:
                 self.store.close()
             if self.multi is not None:
                 self.multi.close()
+            if self.lane is not None:
+                self.lane.close()
             raise
         self.httpd.daemon_threads = True
         self.port = int(self.httpd.server_address[1])
@@ -300,6 +353,12 @@ class ScoringServer:
             target=self.httpd.serve_forever, name="serve-http", daemon=True
         )
         self._serve_thread.start()
+        if self.frame_server is not None:
+            self.frame_server.start()
+        if self.lane_server is not None:
+            # journals lane_owner: "exactly one worker owns dispatch"
+            # is reconstructable from a dead fleet's journal files
+            self.lane_server.start()
         if self._slo is not None:
             self._slo_thread = threading.Thread(
                 target=self._slo_loop, name="serve-slo", daemon=True
@@ -479,6 +538,13 @@ class ScoringServer:
         if comp is not None:
             comp.flush()
         obs_rollup.unregister_source("serve")
+        # stop frame ingress first (public frames, then the lane's
+        # sibling forwards): both wait for in-flight requests, whose
+        # batchers are still alive until the drain below
+        if self.frame_server is not None:
+            self.frame_server.close()
+        if self.lane_server is not None:
+            self.lane_server.close()
         if self._serving:
             # shutdown() blocks on an event only serve_forever sets on
             # exit — calling it on a never-started server hangs forever
@@ -497,6 +563,10 @@ class ScoringServer:
             self.store.close()
         if self.multi is not None:
             self.multi.close()
+        if self.lane is not None:
+            # after the batchers: their drain needed the lane to finish
+            # (or fail over) every outstanding forward
+            self.lane.close()
 
     def __enter__(self):
         return self
@@ -621,7 +691,16 @@ class ScoringServer:
 
     def handle_score(self, body: bytes, rid: str | None = None,
                      model_name: str | None = None) -> dict:
-        raw = self._parse_raw(body)
+        return self.handle_rows(self._parse_raw(body), rid, model_name)
+
+    def handle_rows(self, raw, rid: str | None = None,
+                    model_name: str | None = None) -> dict:
+        """Score an already-decoded payload: the JSON path hands the
+        parsed list in, the wire path (serve/wire/stream.py) hands the
+        float32 matrix decoded STRAIGHT off its receive buffer — both
+        then share every downstream step (validation, metrics, SLO
+        taps, batching, the round(6) response discipline), which is
+        what pins the two protocols bit-identical."""
         if self.multi is not None:
             return self._score_multi(raw, rid, model_name)
         model = self.store.current()
@@ -724,6 +803,49 @@ class ScoringServer:
         return self._score_response(scores, loaded, rid,
                                     model=tenant.name)
 
+    def handle_lane(self, rows: np.ndarray, rid: str | None,
+                    model_name: str | None) -> tuple[np.ndarray, str]:
+        """Score a sibling worker's forwarded batch (lane-owner side).
+        Deliberately NOT handle_rows: the sibling already did the
+        request-level accounting (requests_total, SLO taps, NaN
+        rejection, mirror/sketch feeds) when it admitted the rows —
+        this path only needs the batch to coalesce into OUR tenant
+        batcher alongside native traffic, which is what makes DRR and
+        occupancy fleet-wide.  Returns the round(6) float64 scores and
+        the resolved model name (same discipline as _score_response, so
+        the sibling's replies stay bit-identical to local scoring)."""
+        if self.multi is not None:
+            tenant = self.multi.acquire(model_name)
+            store = tenant.store
+            if store is None:
+                tenant = self.multi.acquire(tenant.name)
+                store = tenant.store
+                if store is None:
+                    raise ModelColdStart(tenant.name)
+            loaded = store.current()
+            rows = self._to_rows(rows, loaded.model.num_features)
+            scores = None
+            for attempt in (0, 1):
+                batcher = tenant.batcher
+                try:
+                    if batcher is None:
+                        raise BatcherClosed("tenant evicted mid-request")
+                    scores = batcher.submit(rows, rid=rid)
+                    break
+                except BatcherClosed:
+                    if attempt:
+                        raise ModelColdStart(tenant.name)
+                    tenant = self.multi.acquire(tenant.name)
+            name = tenant.name
+        else:
+            loaded = self.store.current()
+            rows = self._to_rows(rows, loaded.model.num_features)
+            scores = self.batcher.submit(rows, rid=rid)
+            name = ""
+        out = (scores[:, 0] if scores.ndim == 2 and scores.shape[1] == 1
+               else scores)
+        return np.asarray(out, np.float64).round(6), name
+
     def health(self) -> tuple[int, dict]:
         if self.multi is not None:
             # no disk rescan on the probe path: a balancer polling
@@ -782,7 +904,30 @@ class ScoringServer:
         out = {"ok": info["state"] == "admitted", "model": name, **info}
         return (200 if out["ok"] else 503), out
 
+    def _wire_gauges(self) -> None:
+        """Frame/lane gauges, set at render time on the process surface
+        (the _unrouted series in multi-tenant mode): which role this
+        worker plays in the shared lane and how the frame listener is
+        doing.  A scrape landing on an arbitrary SO_REUSEPORT worker
+        reads that worker's role — worker_index rides the same
+        response."""
+        reg = self.metrics.registry
+        if self.frame_server is not None:
+            reg.set_gauge("frame_connections",
+                          self.frame_server.connections())
+        if self.lane_server is not None:
+            reg.set_gauge("lane_owner", 1)
+            reg.set_gauge("lane_connections",
+                          self.lane_server.connections())
+        if self.lane is not None:
+            st = self.lane.stats()
+            reg.set_gauge("lane_owner", 0)
+            reg.set_gauge("lane_connected", int(st["connected"]))
+            reg.set_gauge("lane_forwarded_total", st["forwarded"])
+            reg.set_gauge("lane_fallback_total", st["fallback"])
+
     def metrics_text(self) -> str:
+        self._wire_gauges()
         if self.multi is not None:
             if self.worker_index is not None:
                 self.multi.fleet.set_gauge("worker_index",

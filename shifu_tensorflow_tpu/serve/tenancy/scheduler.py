@@ -61,7 +61,8 @@ class _TenantQueue:
 
     __slots__ = ("name", "weight", "batcher", "work", "deficit",
                  "in_flight", "registered", "dispatched_rows",
-                 "dispatched_batches", "dispatched_device_s")
+                 "dispatched_bucket_rows", "dispatched_batches",
+                 "dispatched_device_s")
 
     def __init__(self, name: str, batcher, weight: float):
         self.name = name
@@ -73,6 +74,10 @@ class _TenantQueue:
         #                          score_fn right now
         self.registered = True
         self.dispatched_rows = 0
+        # bucket (padded) rows actually paid to the device — the
+        # denominator of occupancy; rows/bucket_rows < 1 means the
+        # ladder padded, fleet fragmentation makes it fall further
+        self.dispatched_bucket_rows = 0
         self.dispatched_batches = 0
         # device-seconds this tenant's dispatches consumed (the
         # batcher's dispatch_s, accumulated here so the scheduler's own
@@ -231,11 +236,22 @@ class DeviceScheduler:
         with self._cond:
             return {
                 tq.name: {"rows": tq.dispatched_rows,
+                          "bucket_rows": tq.dispatched_bucket_rows,
                           "batches": tq.dispatched_batches,
                           "device_s": round(tq.dispatched_device_s, 6),
                           "weight": tq.weight}
                 for tq in self._order
             }
+
+    def occupancy(self) -> float:
+        """Useful rows as a fraction of DISPATCHED (bucket) rows, across
+        every tenant this scheduler has ever served — the fleet-level
+        reading when this is the lane owner's scheduler.  1.0 when idle
+        (no dispatch yet means no padding waste yet)."""
+        with self._cond:
+            rows = sum(tq.dispatched_rows for tq in self._order)
+            bucket = sum(tq.dispatched_bucket_rows for tq in self._order)
+        return round(rows / bucket, 6) if bucket else 1.0
 
 
     # ---- device thread ----
@@ -304,6 +320,7 @@ class DeviceScheduler:
                 with self._cond:
                     tq.in_flight = False
                     tq.dispatched_rows += work.n
+                    tq.dispatched_bucket_rows += work.bucket
                     tq.dispatched_batches += 1
                     tq.dispatched_device_s += work.dispatch_s
                     self._cond.notify_all()

@@ -167,8 +167,13 @@ class _Tenant:
 
 
 class MultiModelStore:
-    def __init__(self, config, *, warm: bool = True):
+    def __init__(self, config, *, warm: bool = True, lane=None):
         self.config = config
+        # fleet-shared dispatch lane (serve/wire/lane.py): non-None only
+        # on sibling workers — every admitted tenant's batcher forwards
+        # its packed batches down it instead of feeding the local
+        # scheduler (which stays registered as the fallback path)
+        self.lane = lane
         self.root = config.models_dir
         if not os.path.isdir(self.root):
             raise ValueError(f"models dir {self.root!r} does not exist")
@@ -532,6 +537,7 @@ class MultiModelStore:
                         scheduler=self.scheduler,
                         model=name,
                         weight=self.config.weight_for(name),
+                        lane=self.lane,
                     )
                 except BaseException:
                     # a failure PAST the store construction (e.g. the
@@ -820,6 +826,10 @@ class MultiModelStore:
         self.fleet.set_gauge("models_admitted", len(admitted))
         self.fleet.set_gauge("budget_bytes", self.budget_bytes)
         self.fleet.set_gauge("admitted_bytes", admitted_bytes)
+        # device-level occupancy across every tenant this scheduler has
+        # dispatched — on the lane owner this is the FLEET number the
+        # shared-lane gate reads (siblings' forwards coalesce here)
+        self.fleet.set_gauge("occupancy", self.scheduler.occupancy())
         parts = [self.fleet.render_prometheus("stpu_serve_fleet_")]
         for name, t in admitted:
             metrics, store, batcher = t.metrics, t.store, t.batcher

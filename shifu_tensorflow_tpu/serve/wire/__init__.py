@@ -1,0 +1,11 @@
+"""Zero-copy columnar wire protocol + fleet-shared dispatch lane.
+
+- :mod:`frame` — the length-prefixed binary frame codec (one contiguous
+  little-endian float32 feature matrix per scoring request, scores and
+  typed errors back by correlation id);
+- :mod:`stream` — the persistent-connection streaming server (TCP or
+  UDS) that multiplexes concurrent frames, and the matching client;
+- :mod:`lane` — the fleet-shared dispatch lane: sibling SO_REUSEPORT
+  workers forward packed batches to the lane-owner worker over a UDS so
+  DRR + coalescing apply fleet-wide.
+"""

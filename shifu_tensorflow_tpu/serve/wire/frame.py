@@ -1,0 +1,261 @@
+"""Length-prefixed binary frame codec for the scoring wire protocol.
+
+The JSON path re-parses every float of every request on every hop; at
+millions of users the serve fleet's ceiling is that text protocol, not
+the device (ROADMAP item 4).  A frame carries the feature matrix as one
+contiguous little-endian float32 payload, so ingress is a single
+``recv`` into a single buffer and ``np.frombuffer`` hands the pack
+stage a (rows, features) view WITHOUT per-row float parsing or
+per-request concat copies — the serving analogue of the columnar
+feed the reference system's batch eval plane used instead of
+row-at-a-time scoring.
+
+Layout (all integers little-endian)::
+
+    uint32  length     bytes that FOLLOW this prefix
+    ----------------------------------------------- length covers:
+    4s      magic      b"STPU"
+    uint8   version    1
+    uint8   kind       1=SCORE request, 2=SCORES reply, 3=ERROR reply
+    uint8   dtype      0=none, 1=float32, 2=float64
+    uint8   tenant_len bytes of tenant (model) name, 0 = default route
+    uint16  rid_len    bytes of correlation id
+    uint16  status     ERROR frames: HTTP-equivalent status, else 0
+    uint16  retry_after  ERROR frames: whole seconds, 0 = no hint
+    uint32  rows
+    uint32  features   0 on replies (scores are a vector of ``rows``)
+    tenant bytes | rid bytes | payload
+
+Payloads: a SCORE request carries ``rows * features`` float32 values
+row-major; a SCORES reply carries ``rows`` float64 values (the
+``round(6)`` discipline of ``_score_response`` applied, so the vector
+is bit-identical to what the JSON path returns for the same rows); an
+ERROR reply carries a UTF-8 message.
+
+Concurrent requests multiplex on one connection and are matched back by
+``rid`` — replies may arrive in any order (coalescing reorders
+dispatches), so a client MUST NOT assume FIFO.
+"""
+
+from __future__ import annotations
+
+import struct
+import uuid
+
+import numpy as np
+
+MAGIC = b"STPU"
+VERSION = 1
+
+KIND_SCORE = 1   # client -> server: score this matrix
+KIND_SCORES = 2  # server -> client: the score vector
+KIND_ERROR = 3   # server -> client: typed refusal (status + message)
+
+DTYPE_NONE = 0
+DTYPE_F32 = 1
+DTYPE_F64 = 2
+
+_ITEMSIZE = {DTYPE_NONE: 0, DTYPE_F32: 4, DTYPE_F64: 8}
+
+#: magic..features — everything between the length prefix and the
+#: variable-length tail
+HEADER = struct.Struct("<4sBBBBHHHII")
+_LEN = struct.Struct("<I")
+
+#: hard ceiling on ONE frame's wire size regardless of configuration —
+#: a corrupt length prefix must never provoke a multi-GB allocation
+MAX_FRAME_BYTES = 1 << 30
+
+
+class FrameProtocolError(RuntimeError):
+    """The byte stream is not speaking this protocol (bad magic/version
+    or an inconsistent length) — unrecoverable for the connection, which
+    is closed; nothing can be replied because framing itself is lost."""
+
+
+class FrameTooLarge(RuntimeError):
+    """A well-framed request exceeding the row/byte bound.  Framing is
+    intact (the oversized payload was consumed without buffering it), so
+    the server replies a typed 413 ERROR frame and keeps the
+    connection."""
+
+    def __init__(self, msg: str, rid: str = "", tenant: str = ""):
+        super().__init__(msg)
+        self.rid = rid
+        self.tenant = tenant
+
+
+class FrameError(RuntimeError):
+    """Client side: the server answered an ERROR frame.  Carries the
+    HTTP-equivalent status and the (jittered, on 429) Retry-After."""
+
+    def __init__(self, status: int, message: str, retry_after: int = 0,
+                 rid: str = ""):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+        self.retry_after = retry_after
+        self.rid = rid
+
+
+class Frame:
+    """One decoded frame; ``payload`` is a memoryview over the single
+    receive buffer — :meth:`matrix` / :meth:`vector` are zero-copy views
+    of it."""
+
+    __slots__ = ("kind", "dtype", "tenant", "rid", "status",
+                 "retry_after", "rows", "features", "payload")
+
+    def __init__(self, kind, dtype, tenant, rid, status, retry_after,
+                 rows, features, payload):
+        self.kind = kind
+        self.dtype = dtype
+        self.tenant = tenant
+        self.rid = rid
+        self.status = status
+        self.retry_after = retry_after
+        self.rows = rows
+        self.features = features
+        self.payload = payload
+
+    def matrix(self) -> np.ndarray:
+        """(rows, features) float32 view over the receive buffer — the
+        array handed straight to the pack stage; no copy is made."""
+        return np.frombuffer(self.payload, dtype="<f4").reshape(
+            self.rows, self.features)
+
+    def vector(self) -> np.ndarray:
+        """(rows,) float64 score vector of a SCORES reply."""
+        return np.frombuffer(self.payload, dtype="<f8")
+
+    def message(self) -> str:
+        """UTF-8 message of an ERROR frame."""
+        return bytes(self.payload).decode("utf-8", "replace")
+
+
+def mint_rid() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _encode_parts(kind, dtype, tenant, rid, status, retry_after, rows,
+                  features, payload):
+    """(header_bytes, payload_buffer): two buffers so a large payload is
+    written straight from its source array, never joined into a copy."""
+    tb = tenant.encode("utf-8") if tenant else b""
+    rb = rid.encode("utf-8") if rid else b""
+    if len(tb) > 255:
+        raise ValueError(f"tenant name too long ({len(tb)} bytes)")
+    if len(rb) > 255:
+        raise ValueError(f"rid too long ({len(rb)} bytes)")
+    length = HEADER.size + len(tb) + len(rb) + len(payload)
+    head = b"".join((
+        _LEN.pack(length),
+        HEADER.pack(MAGIC, VERSION, kind, dtype, len(tb), len(rb),
+                    status, retry_after, rows, features),
+        tb, rb,
+    ))
+    return head, payload
+
+
+def encode_score_request(rows: np.ndarray, *, tenant: str = "",
+                         rid: str = ""):
+    """Frame a (n, f) float32 matrix.  The payload buffer IS the
+    array's memory when it is already little-endian float32 and
+    C-contiguous (the only copy-free layout the server hands the pack
+    stage); anything else is converted once here, on the client."""
+    x = np.ascontiguousarray(rows, dtype="<f4")
+    if x.ndim != 2:
+        raise ValueError(f"expected (rows, features), got shape {x.shape}")
+    return _encode_parts(KIND_SCORE, DTYPE_F32, tenant, rid, 0, 0,
+                         x.shape[0], x.shape[1], memoryview(x).cast("B"))
+
+
+def encode_scores_reply(scores: np.ndarray, *, tenant: str = "",
+                        rid: str = ""):
+    v = np.ascontiguousarray(scores, dtype="<f8")
+    return _encode_parts(KIND_SCORES, DTYPE_F64, tenant, rid, 0, 0,
+                         v.shape[0], 0, memoryview(v).cast("B"))
+
+
+def encode_error_reply(status: int, message: str, *, tenant: str = "",
+                       rid: str = "", retry_after: int = 0):
+    body = message.encode("utf-8")[:4096]
+    return _encode_parts(KIND_ERROR, DTYPE_NONE, tenant, rid, status,
+                         min(retry_after, 0xFFFF), 0, 0, body)
+
+
+def _recv_exact(sock, view: memoryview) -> int:
+    """Fill ``view`` from the socket; returns bytes read (short only on
+    EOF)."""
+    got = 0
+    while got < len(view):
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            break
+        got += n
+    return got
+
+
+def _discard(sock, remaining: int) -> None:
+    """Consume ``remaining`` bytes without buffering them — keeps the
+    stream framed after refusing an oversized payload."""
+    chunk = bytearray(min(remaining, 1 << 16))
+    while remaining > 0:
+        view = memoryview(chunk)[:min(remaining, len(chunk))]
+        n = _recv_exact(sock, view)
+        if n < len(view):
+            raise FrameProtocolError("EOF inside an oversized frame")
+        remaining -= n
+
+
+def read_frame(sock, *, max_rows: int | None = None) -> Frame | None:
+    """Read one frame off a socket.  Returns None on a clean EOF at a
+    frame boundary.  Raises :class:`FrameProtocolError` on a corrupt
+    stream (caller closes the connection) or :class:`FrameTooLarge`
+    when the request exceeds ``max_rows`` — framing stays intact, the
+    payload having been consumed unbuffered."""
+    lenbuf = bytearray(4)
+    got = _recv_exact(sock, memoryview(lenbuf))
+    if got == 0:
+        return None
+    if got < 4:
+        raise FrameProtocolError("EOF inside a length prefix")
+    (length,) = _LEN.unpack(lenbuf)
+    if length < HEADER.size or length > MAX_FRAME_BYTES:
+        raise FrameProtocolError(f"implausible frame length {length}")
+    head = bytearray(HEADER.size)
+    if _recv_exact(sock, memoryview(head)) < HEADER.size:
+        raise FrameProtocolError("EOF inside a frame header")
+    (magic, version, kind, dtype, tenant_len, rid_len, status,
+     retry_after, rows, features) = HEADER.unpack(head)
+    if magic != MAGIC:
+        raise FrameProtocolError(f"bad magic {bytes(magic)!r}")
+    if version != VERSION:
+        raise FrameProtocolError(f"unsupported frame version {version}")
+    if dtype not in _ITEMSIZE:
+        raise FrameProtocolError(f"unknown dtype tag {dtype}")
+    payload_len = length - HEADER.size - tenant_len - rid_len
+    if payload_len < 0:
+        raise FrameProtocolError("frame length shorter than its names")
+    if kind == KIND_SCORE:
+        expect = rows * features * _ITEMSIZE[dtype]
+        if dtype != DTYPE_F32 or rows < 1 or features < 1 \
+                or payload_len != expect:
+            raise FrameProtocolError(
+                f"score frame geometry mismatch: {rows}x{features} "
+                f"dtype {dtype} vs {payload_len} payload bytes")
+    names = bytearray(tenant_len + rid_len)
+    if tenant_len + rid_len:
+        if _recv_exact(sock, memoryview(names)) < len(names):
+            raise FrameProtocolError("EOF inside frame names")
+    tenant = names[:tenant_len].decode("utf-8", "replace")
+    rid = names[tenant_len:].decode("utf-8", "replace")
+    if kind == KIND_SCORE and max_rows is not None and rows > max_rows:
+        _discard(sock, payload_len)
+        raise FrameTooLarge(
+            f"frame of {rows} rows exceeds the frame bound "
+            f"({max_rows}); split it", rid=rid, tenant=tenant)
+    buf = bytearray(payload_len)
+    if payload_len and _recv_exact(sock, memoryview(buf)) < payload_len:
+        raise FrameProtocolError("EOF inside a frame payload")
+    return Frame(kind, dtype, tenant, rid, status, retry_after, rows,
+                 features, memoryview(buf))

@@ -1,0 +1,411 @@
+"""Persistent-connection streaming server + client for the frame
+protocol.
+
+One connection carries MANY concurrent requests: the reader thread
+decodes frames as they arrive and hands each to its own handler thread,
+so a slow dispatch never head-of-line-blocks the frames behind it;
+replies are matched back by rid and may arrive in any order.  This is
+the wire analogue of N keep-alive HTTP connections collapsed onto one
+socket — the client pays one handshake and zero per-request framing
+beyond the 22-byte header.
+
+The same listener serves two roles:
+
+- the public frame port (TCP, ``shifu.tpu.serve-frame-port``,
+  SO_REUSEPORT-shared across a worker fleet like the HTTP port);
+- the fleet dispatch lane's owner side (a UNIX domain socket — see
+  :mod:`.lane`): sibling workers are just frame clients whose
+  "requests" are their packed batches.
+
+Error mapping mirrors the HTTP handler status-for-status (shed → 429 +
+the jittered Retry-After, oversize → 413, cold start → 503 + hint …) so
+an operator debugging either path reads one table (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from shifu_tensorflow_tpu.obs import journal as obs_journal
+from shifu_tensorflow_tpu.serve.batcher import (
+    BatcherClosed,
+    RequestTooLarge,
+    ShedLoad,
+)
+from shifu_tensorflow_tpu.serve.model_store import ModelNotLoaded
+from shifu_tensorflow_tpu.serve.tenancy.store import (
+    AdmissionRefused,
+    AmbiguousModel,
+    ModelColdStart,
+    UnknownModel,
+)
+from shifu_tensorflow_tpu.serve.wire import frame as wire
+from shifu_tensorflow_tpu.utils import logs
+
+log = logs.get("serve.wire")
+
+#: per-connection bound on requests being scored at once; the reader
+#: thread blocks past it, which backpressures the client through TCP —
+#: flow control without an unbounded thread/queue per connection
+MAX_INFLIGHT_PER_CONN = 64
+
+
+class FrameServer:
+    """Threaded frame listener bound to a :class:`ScoringServer`.
+
+    ``uds_path`` binds a UNIX domain socket instead of TCP (the lane
+    owner's side); ``lane=True`` journals ``lane_owner`` on start and
+    routes scoring through ``handle_lane`` (device-truth counters only —
+    a forwarded batch's request-level accounting already happened on the
+    sibling that admitted it)."""
+
+    def __init__(self, scoring, *, host: str = "", port: int = 0,
+                 uds_path: str | None = None, max_rows: int,
+                 reuseport: bool = False, lane: bool = False):
+        self.scoring = scoring
+        self.max_rows = max_rows
+        self.lane = lane
+        self.uds_path = uds_path
+        if uds_path is not None:
+            # a stale socket file from a dead predecessor (the
+            # supervisor re-elects the owner by respawning index 0)
+            # must not EADDRINUSE the re-bind
+            try:
+                os.unlink(uds_path)
+            except OSError:
+                pass
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(uds_path)
+            self.port = 0
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if reuseport:
+                self._sock.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEPORT, 1)
+            self._sock.bind((host, port))
+            self.port = int(self._sock.getsockname()[1])
+        self._sock.listen(128)
+        self._lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        self._inflight = 0
+        self._closing = False
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=("serve-lane-accept" if self.lane else "serve-frame-accept"),
+            daemon=True)
+        self._accept_thread.start()
+        if self.lane:
+            obs_journal.emit("lane_owner", plane="serve",
+                             socket=self.uds_path)
+
+    def connections(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    # ---- accept / read ----
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            if self.uds_path is None:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name="serve-frame-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        sem = threading.Semaphore(MAX_INFLIGHT_PER_CONN)
+        try:
+            while True:
+                try:
+                    f = wire.read_frame(conn, max_rows=self.max_rows)
+                except wire.FrameTooLarge as e:
+                    # framing survived (payload consumed unbuffered):
+                    # typed refusal, keep the connection
+                    self._count_error()
+                    self._send(conn, send_lock, wire.encode_error_reply(
+                        413, str(e), rid=e.rid, tenant=e.tenant))
+                    continue
+                except wire.FrameProtocolError as e:
+                    log.warning("frame connection dropped: %s", e)
+                    return
+                except OSError:
+                    return
+                if f is None:
+                    return  # clean EOF
+                if f.kind != wire.KIND_SCORE:
+                    log.warning("unexpected frame kind %d from client",
+                                f.kind)
+                    return
+                # bound in-flight handlers; blocking HERE (not spawning)
+                # pushes backpressure into the client's send window
+                sem.acquire()
+                with self._lock:
+                    self._inflight += 1
+                threading.Thread(
+                    target=self._handle, args=(conn, send_lock, sem, f),
+                    name="serve-frame-req", daemon=True).start()
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- per-request handling ----
+    def _handle(self, conn, send_lock, sem, f: wire.Frame) -> None:
+        scoring = self.scoring
+        try:
+            reply = self._score_frame(scoring, f)
+        finally:
+            sem.release()
+            with self._lock:
+                self._inflight -= 1
+        self._send(conn, send_lock, reply)
+
+    def _count_error(self) -> None:
+        m = self.scoring.metrics
+        m.inc("frame_errors_total")
+        m.inc("errors_total")
+
+    def _score_frame(self, scoring, f: wire.Frame):
+        from shifu_tensorflow_tpu.serve.server import (
+            _BadRequest,
+            resolve_rid,
+        )
+
+        tenant = f.tenant or None
+        rid = resolve_rid(f.rid or None)
+        m = scoring.metrics
+        try:
+            rows = f.matrix()
+            if self.lane:
+                scores, model = scoring.handle_lane(rows, rid, tenant)
+            else:
+                m.inc("frame_requests_total")
+                m.inc("frame_rows_total", f.rows)
+                resp = scoring.handle_rows(rows, rid, tenant)
+                scores = np.asarray(resp["scores"], np.float64)
+                model = resp.get("model", f.tenant)
+            return wire.encode_scores_reply(scores, tenant=model or "",
+                                            rid=f.rid)
+        except ShedLoad as e:
+            scoring.note_shed(rid, tenant)
+            return wire.encode_error_reply(
+                429, "overloaded, retry later", rid=f.rid,
+                retry_after=e.retry_after_s)
+        except _BadRequest as e:
+            self._count_error()
+            return wire.encode_error_reply(400, str(e), rid=f.rid)
+        except UnknownModel as e:
+            self._count_error()
+            return wire.encode_error_reply(
+                404, f"unknown model {e.args[0]!r}", rid=f.rid)
+        except AmbiguousModel as e:
+            self._count_error()
+            return wire.encode_error_reply(400, str(e), rid=f.rid)
+        except ModelColdStart as e:
+            self._count_error()
+            return wire.encode_error_reply(
+                503, str(e), rid=f.rid, retry_after=e.retry_after_s)
+        except RequestTooLarge as e:
+            self._count_error()
+            return wire.encode_error_reply(413, str(e), rid=f.rid)
+        except (AdmissionRefused, BatcherClosed, ModelNotLoaded) as e:
+            self._count_error()
+            return wire.encode_error_reply(503, str(e), rid=f.rid)
+        except TimeoutError as e:
+            self._count_error()
+            return wire.encode_error_reply(504, str(e), rid=f.rid)
+        except Exception as e:  # noqa: BLE001 — the 500 fallback
+            self._count_error()
+            log.error("frame request failed: %s: %s", type(e).__name__, e)
+            return wire.encode_error_reply(
+                500, f"{type(e).__name__}: {e}", rid=f.rid)
+
+    @staticmethod
+    def _send(conn, send_lock, parts) -> None:
+        head, payload = parts
+        try:
+            with send_lock:
+                conn.sendall(head)
+                if len(payload):
+                    conn.sendall(payload)
+        except OSError:
+            pass  # client gone; its reader already noticed
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting, let in-flight requests finish (their batcher
+        is still draining behind us), then drop the connections."""
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        if self.uds_path is not None:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
+
+
+class _PendingReply:
+    __slots__ = ("event", "frame")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.frame: wire.Frame | None = None
+
+
+class FrameClient:
+    """Client side of the frame protocol: one persistent connection,
+    concurrent ``score`` calls multiplexed by rid (safe from many
+    threads).  ``address`` is a ``(host, port)`` tuple for TCP or a
+    filesystem path for a UNIX domain socket."""
+
+    def __init__(self, address, *, connect_timeout_s: float = 10.0):
+        if isinstance(address, str):
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(connect_timeout_s)
+            self._sock.connect(address)
+        else:
+            self._sock = socket.create_connection(
+                tuple(address), timeout=connect_timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict[str, _PendingReply] = {}
+        self._n = 0
+        self._tag = wire.mint_rid()[:8]
+        self._dead: BaseException | None = None
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="frame-client-reader",
+                                        daemon=True)
+        self._reader.start()
+
+    def _mint(self) -> str:
+        with self._plock:
+            self._n += 1
+            return f"{self._tag}.{self._n}"
+
+    def _read_loop(self) -> None:
+        err: BaseException = ConnectionError("frame connection closed")
+        try:
+            while True:
+                f = wire.read_frame(self._sock)
+                if f is None:
+                    break
+                with self._plock:
+                    p = self._pending.get(f.rid)
+                if p is not None:
+                    p.frame = f
+                    p.event.set()
+        except (OSError, wire.FrameProtocolError) as e:
+            err = e
+        with self._plock:
+            self._dead = err
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for p in pending:
+            p.event.set()
+
+    def submit(self, rows: np.ndarray, *, tenant: str = "",
+               rid: str | None = None) -> tuple[str, _PendingReply]:
+        """Send one score frame; returns ``(rid, pending)`` — pass the
+        pending to :meth:`wait`.  Lets a driver keep many requests in
+        flight on the one connection."""
+        rid = rid or self._mint()
+        p = _PendingReply()
+        with self._plock:
+            if self._dead is not None:
+                raise self._dead
+            self._pending[rid] = p
+        head, payload = wire.encode_score_request(rows, tenant=tenant,
+                                                  rid=rid)
+        try:
+            with self._send_lock:
+                self._sock.sendall(head)
+                self._sock.sendall(payload)
+        except OSError:
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise
+        return rid, p
+
+    def wait(self, rid: str, p: _PendingReply,
+             timeout_s: float = 30.0) -> np.ndarray:
+        if not p.event.wait(timeout_s):
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise TimeoutError(f"no reply for frame {rid} "
+                               f"within {timeout_s}s")
+        with self._plock:
+            self._pending.pop(rid, None)
+        f = p.frame
+        if f is None:
+            raise self._dead or ConnectionError("frame connection closed")
+        if f.kind == wire.KIND_ERROR:
+            raise wire.FrameError(f.status, f.message(),
+                                  retry_after=f.retry_after, rid=rid)
+        return f.vector()
+
+    def score(self, rows: np.ndarray, *, tenant: str = "",
+              rid: str | None = None,
+              timeout_s: float = 30.0) -> np.ndarray:
+        """Blocking request/reply; raises :class:`wire.FrameError` on a
+        typed refusal (``.status`` / ``.retry_after``)."""
+        rid, p = self.submit(rows, tenant=tenant, rid=rid)
+        return self.wait(rid, p, timeout_s=timeout_s)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)

@@ -442,6 +442,68 @@ def test_serve_config_rejects_misconfiguration():
         ServeConfig(models_dir="/ms", tenant_weights=(("a", 0.0),))
     with pytest.raises(ValueError, match="model-budget"):
         ServeConfig(models_dir="/ms", model_budget_mb=-1)
+    # wire protocol: -1 (ephemeral) is the only negative frame port, and
+    # the frame bound must fit the admission bound (a frame the queue
+    # can never admit would always be refused AFTER its bytes shipped)
+    with pytest.raises(ValueError, match="serve-frame-port"):
+        ServeConfig(model_dir="/m", frame_port=-2)
+    with pytest.raises(ValueError, match="serve-frame-max-rows"):
+        ServeConfig(model_dir="/m", frame_max_rows=-4)
+    with pytest.raises(ValueError, match="serve-frame-max-rows"):
+        ServeConfig(model_dir="/m", max_queue_rows=512,
+                    frame_max_rows=1024)
+
+
+def test_serve_wire_keys_round_trip(tmp_path):
+    """The wire-protocol / shared-lane keys (shifu.tpu.serve-frame-port
+    / serve-frame-max-rows / serve-shared-lane) resolve XML → CLI-wins →
+    ServeConfig → JSON bridge like every other serve key."""
+    from shifu_tensorflow_tpu.serve import resolve_serve_config
+    from shifu_tensorflow_tpu.serve.__main__ import (
+        build_parser as serve_parser,
+    )
+    from shifu_tensorflow_tpu.serve.config import ServeConfig
+
+    xml = tmp_path / "wire.xml"
+    xml.write_text(
+        "<configuration>"
+        f"<property><name>{K.SERVE_FRAME_PORT}</name>"
+        "<value>9300</value></property>"
+        f"<property><name>{K.SERVE_FRAME_MAX_ROWS}</name>"
+        "<value>2048</value></property>"
+        f"<property><name>{K.SERVE_SHARED_LANE}</name>"
+        "<value>true</value></property>"
+        "</configuration>"
+    )
+    conf = Conf()
+    conf.add_resource(str(xml))
+    cfg = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), conf)
+    assert cfg.frame_port == 9300
+    assert cfg.frame_max_rows == 2048
+    assert cfg.shared_lane is True
+    # CLI wins over the XML layer
+    cfg = resolve_serve_config(
+        serve_parser().parse_args(
+            ["--model-dir", "/m", "--frame-port", "-1",
+             "--frame-max-rows", "512"]), conf)
+    assert cfg.frame_port == -1 and cfg.frame_max_rows == 512
+    assert cfg.shared_lane is True  # XML still supplies the lane flag
+    cfg = resolve_serve_config(
+        serve_parser().parse_args(
+            ["--model-dir", "/m", "--shared-lane"]), Conf())
+    assert cfg.shared_lane is True
+    # JSON bridge round-trips the new fields
+    assert ServeConfig.from_json(cfg.to_json()) == cfg
+    # defaults: frame listener off, lane off, frame bound tracking the
+    # admission bound (the 0 sentinel resolves in __post_init__)
+    d = resolve_serve_config(
+        serve_parser().parse_args(["--model-dir", "/m"]), Conf())
+    assert d.frame_port == K.DEFAULT_SERVE_FRAME_PORT == 0
+    assert d.frame_max_rows == d.max_queue_rows
+    assert d.shared_lane is K.DEFAULT_SERVE_SHARED_LANE is False
+    small = ServeConfig(model_dir="/m", max_queue_rows=512, max_batch=8)
+    assert small.frame_max_rows == 512
 
 
 def test_serve_tenancy_keys_round_trip(tmp_path):

@@ -1,0 +1,545 @@
+"""Wire-protocol + shared-dispatch-lane drills (serve/wire/): frame
+codec round-trips and garbage rejection, the streaming frame server's
+rid-multiplexed concurrency and typed ERROR frames (shed → 429 +
+Retry-After, oversize → 413 before buffering), bit-identical parity
+with the JSON /score path (single and multi-tenant), the zero-copy
+single-source pack fast path, and the fleet lane's ownership /
+degradation / restoration lifecycle — a killed owner loses ZERO
+in-flight requests."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.export.saved_model import export_model
+from shifu_tensorflow_tpu.serve.batcher import MicroBatcher
+from shifu_tensorflow_tpu.serve.config import ServeConfig
+from shifu_tensorflow_tpu.serve.server import ScoringServer
+from shifu_tensorflow_tpu.serve.wire import frame as wire
+from shifu_tensorflow_tpu.serve.wire.lane import LaneClient
+from shifu_tensorflow_tpu.serve.wire.stream import FrameClient, FrameServer
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+N_FEATURES = 6
+
+
+def _model_config():
+    return ModelConfig.from_json(
+        {"train": {"params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                              "ActivationFunc": ["relu"],
+                              "LearningRate": 0.05}}}
+    )
+
+
+def _export(tmp_dir: str, seed: int = 0) -> str:
+    export_model(tmp_dir, Trainer(_model_config(), N_FEATURES, seed=seed))
+    return tmp_dir
+
+
+@pytest.fixture()
+def export_dir(tmp_path):
+    return _export(str(tmp_path / "model"))
+
+
+@pytest.fixture()
+def models_dir(tmp_path):
+    root = tmp_path / "models"
+    root.mkdir()
+    _export(str(root / "alpha"), seed=1)
+    _export(str(root / "beta"), seed=2)
+    return str(root)
+
+
+def _rows(n: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).random((n, N_FEATURES)).astype(
+        np.float32
+    )
+
+
+def _post(port: int, payload: dict, path="/score"):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+    try:
+        c.request("POST", path, json.dumps(payload),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), json.loads(r.read())
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------------ codec
+
+
+def test_frame_codec_round_trips_all_kinds():
+    a, b = socket.socketpair()
+    try:
+        rows = _rows(5)
+        head, payload = wire.encode_score_request(rows, tenant="alpha",
+                                                  rid="r-1")
+        a.sendall(head)
+        a.sendall(payload)
+        f = wire.read_frame(b)
+        assert (f.kind, f.tenant, f.rid) == (wire.KIND_SCORE, "alpha",
+                                             "r-1")
+        assert f.rows == 5 and f.features == N_FEATURES
+        m = f.matrix()
+        np.testing.assert_array_equal(m, rows)
+        # the decode is a VIEW over the received payload, not a parse:
+        # no per-row copies anywhere between the socket and the batcher
+        assert np.shares_memory(
+            m, np.frombuffer(f.payload, dtype=np.uint8))
+
+        scores = np.arange(5, dtype=np.float64) / 7
+        head, payload = wire.encode_scores_reply(scores, tenant="alpha",
+                                                 rid="r-1")
+        b.sendall(head)
+        b.sendall(payload)
+        g = wire.read_frame(a)
+        assert g.kind == wire.KIND_SCORES and g.rid == "r-1"
+        np.testing.assert_array_equal(g.vector(), scores)
+
+        head, payload = wire.encode_error_reply(
+            429, "busy", tenant="", rid="r-2", retry_after=3)
+        b.sendall(head)
+        b.sendall(payload)
+        e = wire.read_frame(a)
+        assert (e.kind, e.status, e.retry_after) == (wire.KIND_ERROR,
+                                                     429, 3)
+        assert e.message() == "busy" and e.rid == "r-2"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_codec_clean_eof_and_garbage():
+    a, b = socket.socketpair()
+    a.close()
+    assert wire.read_frame(b) is None  # clean EOF between frames
+    b.close()
+
+    a, b = socket.socketpair()
+    try:
+        bad = wire.HEADER.pack(b"NOPE", 1, wire.KIND_SCORE, wire.DTYPE_F32,
+                               0, 0, 0, 0, 1, 1)
+        a.sendall(struct.pack("<I", len(bad)) + bad)
+        with pytest.raises(wire.FrameProtocolError, match="magic"):
+            wire.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+    a, b = socket.socketpair()
+    try:
+        # geometry lie: header claims 4 rows, payload carries 2
+        head, payload = wire.encode_score_request(_rows(2), rid="x")
+        hdr = bytearray(head[4:])
+        rows_off = wire.HEADER.size - 8
+        hdr[rows_off:rows_off + 4] = struct.pack("<I", 4)
+        body = bytes(hdr) + bytes(payload)
+        a.sendall(struct.pack("<I", len(body)) + body)
+        with pytest.raises(wire.FrameProtocolError):
+            wire.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_codec_oversize_is_discarded_not_buffered():
+    """A frame past max_rows raises FrameTooLarge carrying the caller's
+    identity (for the typed 413 reply) and DISCARDS the payload — the
+    stream stays framed, the next frame reads fine."""
+    a, b = socket.socketpair()
+    try:
+        for chunk in wire.encode_score_request(_rows(64), tenant="t",
+                                               rid="big"):
+            a.sendall(chunk)
+        for chunk in wire.encode_score_request(_rows(2), rid="ok"):
+            a.sendall(chunk)
+        with pytest.raises(wire.FrameTooLarge) as ei:
+            wire.read_frame(b, max_rows=16)
+        assert ei.value.rid == "big" and ei.value.tenant == "t"
+        f = wire.read_frame(b, max_rows=16)
+        assert f.rid == "ok" and f.rows == 2
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------- frame server (single model)
+
+
+def _cfg(export_dir, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("frame_port", -1)
+    return ServeConfig(model_dir=export_dir, **kw)
+
+
+def test_frame_scores_bit_identical_to_json(export_dir):
+    """The acceptance gate: the wire path reuses handle_rows, so frame
+    scores are BIT-identical to the JSON path's round(6) floats."""
+    with ScoringServer(_cfg(export_dir)) as srv:
+        srv.start()
+        rows = _rows(9, seed=3)
+        _, _, body = _post(srv.port, {"rows": rows.tolist()})
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            got = c.score(rows)
+        finally:
+            c.close()
+        assert np.array_equal(np.asarray(body["scores"], np.float64), got)
+        counters = srv.metrics.counters()
+        assert counters["frame_requests_total"] == 1
+        assert counters["frame_rows_total"] == 9
+        # occupancy gauge rides /metrics
+        assert "stpu_serve_occupancy" in srv.metrics_text()
+
+
+def test_frame_connection_multiplexes_concurrent_requests(export_dir):
+    """One persistent connection, many in-flight requests, replies
+    matched by rid regardless of completion order."""
+    with ScoringServer(_cfg(export_dir)) as srv:
+        srv.start()
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            want, pend = {}, {}
+            for i in range(12):
+                rows = _rows(3 + (i % 5), seed=10 + i)
+                rid, p = c.submit(rows, rid=f"req{i}")
+                pend[rid] = p
+                _, _, body = _post(srv.port, {"rows": rows.tolist()})
+                want[rid] = np.asarray(body["scores"], np.float64)
+            for rid, p in pend.items():
+                np.testing.assert_array_equal(c.wait(rid, p), want[rid])
+        finally:
+            c.close()
+
+
+def test_frame_shed_returns_typed_429_with_retry_after(export_dir):
+    """Shed-before-queue on the wire path: a frame the admission bound
+    cannot take gets a typed ERROR frame carrying Retry-After — never a
+    silent drop, never an unbounded queue."""
+    cfg = _cfg(export_dir, max_batch=8, max_queue_rows=8,
+               max_delay_ms=50.0, frame_max_rows=8)
+    with ScoringServer(cfg) as srv:
+        srv.start()
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            pend = [c.submit(_rows(8, seed=i)) for i in range(16)]
+            sheds = 0
+            for rid, p in pend:
+                try:
+                    c.wait(rid, p, timeout_s=60.0)
+                except wire.FrameError as e:
+                    assert e.status == 429
+                    assert e.retry_after >= 1
+                    sheds += 1
+            assert sheds >= 1
+            assert srv.metrics.counters()["shed_total"] >= 1
+        finally:
+            c.close()
+
+
+def test_frame_oversize_replies_413_and_connection_survives(export_dir):
+    cfg = _cfg(export_dir, frame_max_rows=16)
+    with ScoringServer(cfg) as srv:
+        srv.start()
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            with pytest.raises(wire.FrameError) as ei:
+                c.score(_rows(64))
+            assert ei.value.status == 413
+            # same connection still scores
+            assert c.score(_rows(4)).shape == (4,)
+        finally:
+            c.close()
+        assert srv.metrics.counters()["frame_errors_total"] >= 1
+
+
+def test_frame_garbage_closes_connection_but_not_server(export_dir):
+    with ScoringServer(_cfg(export_dir)) as srv:
+        srv.start()
+        s = socket.create_connection(("127.0.0.1", srv.frame_port))
+        # well-framed length, garbage header (bad magic): framing is
+        # unrecoverable, so the server closes the connection
+        s.sendall(struct.pack("<I", wire.HEADER.size)
+                  + b"X" * wire.HEADER.size)
+        s.settimeout(10.0)
+        assert s.recv(1) == b""
+        s.close()
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            assert c.score(_rows(3)).shape == (3,)
+        finally:
+            c.close()
+
+
+def test_frame_multi_tenant_routes_by_tenant_field(models_dir):
+    """Frames carry the tenant name where JSON uses /score/<model>; the
+    scores must match that tenant's JSON path bit-for-bit, and the two
+    tenants must differ (distinct seeds)."""
+    cfg = ServeConfig(models_dir=models_dir, port=0, frame_port=-1)
+    with ScoringServer(cfg) as srv:
+        srv.start()
+        rows = _rows(7, seed=4)
+        c = FrameClient(("127.0.0.1", srv.frame_port))
+        try:
+            got = {}
+            for tenant in ("alpha", "beta"):
+                _, _, body = _post(srv.port, {"rows": rows.tolist()},
+                                   path=f"/score/{tenant}")
+                got[tenant] = c.score(rows, tenant=tenant)
+                assert np.array_equal(
+                    np.asarray(body["scores"], np.float64), got[tenant])
+            assert not np.array_equal(got["alpha"], got["beta"])
+            with pytest.raises(wire.FrameError) as ei:
+                c.score(rows, tenant="gamma")
+            assert ei.value.status == 404
+        finally:
+            c.close()
+
+
+# ------------------------------------------------- zero-copy fast path
+
+
+def test_pack_single_source_is_zero_copy():
+    """The ride-along pin: when ONE pending request exactly fills its
+    bucket, the matrix handed to score_fn IS the submitted array — no
+    concat, no pad copy, end to end."""
+    seen = []
+
+    def score_fn(x):
+        seen.append(x)
+        return np.zeros((x.shape[0], 1), np.float32)
+
+    b = MicroBatcher(score_fn, max_batch=64, max_delay_s=0.001)
+    try:
+        rows = _rows(8)  # bucket_size(8) == 8: pad is a no-op
+        b.submit(rows)
+    finally:
+        b.close()
+    assert len(seen) == 1
+    assert seen[0].shape == (8, N_FEATURES)
+    assert np.shares_memory(seen[0], rows)
+
+
+def test_frame_payload_reaches_scorer_without_copy(export_dir):
+    """The whole receive chain — socket buffer → frame view → batcher →
+    scorer — moves ONE allocation: score_fn sees memory shared with the
+    frame payload when the frame exactly fills a bucket."""
+    shared = []
+    sent = {}
+
+    from shifu_tensorflow_tpu.serve.metrics import ServeMetrics
+
+    class Probe:
+        """Stands in for ScoringServer: handle_rows records whether the
+        matrix it got aliases the frame payload read_frame produced."""
+
+        metrics = ServeMetrics()
+
+        def handle_rows(self, rows, rid, model_name=None):
+            shared.append(np.shares_memory(rows, sent["payload_probe"]))
+            return {"scores": [0.0] * rows.shape[0]}
+
+        def note_shed(self, *a, **k):
+            pass
+
+    fs = FrameServer(Probe(), host="127.0.0.1", port=0, max_rows=4096)
+    fs.start()
+    try:
+        # capture the server-side payload buffer via a frame tap: easier
+        # to verify aliasing INSIDE the server by monkeypatching
+        # read_frame than to reach across the thread boundary
+        orig = wire.read_frame
+
+        def tap(sock, max_rows=None):
+            f = orig(sock, max_rows=max_rows)
+            if f is not None and f.kind == wire.KIND_SCORE:
+                sent["payload_probe"] = np.frombuffer(f.payload,
+                                                      dtype=np.uint8)
+            return f
+
+        wire.read_frame = tap
+        try:
+            c = FrameClient(("127.0.0.1", fs.port))
+            try:
+                c.score(_rows(8))
+            finally:
+                c.close()
+        finally:
+            wire.read_frame = orig
+    finally:
+        fs.close()
+    assert shared == [True]
+
+
+# --------------------------------------------------- shared dispatch lane
+
+
+@pytest.fixture()
+def obs_env(tmp_path):
+    from shifu_tensorflow_tpu.obs import install_obs
+    from shifu_tensorflow_tpu.obs import journal as journal_mod
+    from shifu_tensorflow_tpu.obs import slo as slo_mod
+    from shifu_tensorflow_tpu.obs import trace as trace_mod
+    from shifu_tensorflow_tpu.obs.config import ObsConfig
+
+    base = str(tmp_path / "wire-journal.jsonl")
+    install_obs(ObsConfig(enabled=True, journal_path=base), plane="serve")
+    yield base
+    trace_mod.uninstall()
+    journal_mod.uninstall()
+    slo_mod.uninstall()
+
+
+def _wait(pred, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.02)
+
+
+def test_lane_owner_and_sibling_share_one_dispatch(export_dir, tmp_path,
+                                                   obs_env):
+    """Owner (index 0) binds the lane socket; the sibling forwards its
+    packed batches there and scatters the owner's replies — scores stay
+    bit-identical to a direct submission, the owner's counters carry the
+    device truth, and the journal records ownership + the join."""
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    lane_path = str(tmp_path / "lane.sock")
+    owner = ScoringServer(_cfg(export_dir, frame_port=0), worker_index=0,
+                          lane_socket=lane_path)
+    owner.start()
+    sib = ScoringServer(_cfg(export_dir, frame_port=0), worker_index=1,
+                        lane_socket=lane_path)
+    sib.start()
+    try:
+        _wait(sib.lane.connected, what="lane join")
+        rows = _rows(9, seed=5)
+        via_lane = np.asarray(
+            sib.handle_rows(rows, rid="lane-1")["scores"], np.float64)
+        direct = np.asarray(
+            owner.handle_rows(rows, rid="own-1")["scores"], np.float64)
+        np.testing.assert_array_equal(via_lane, direct)
+        # device truth lives at the owner: the sibling forwarded, so its
+        # own batch counters must NOT double-count the dispatch
+        _wait(lambda: owner.metrics.counters()["batches_total"] >= 2,
+              what="owner dispatch counters")
+        assert sib.metrics.counters()["batches_total"] == 0
+        assert sib.metrics.counters()["requests_total"] == 1
+        assert sib.lane.stats()["forwarded"] >= 1
+    finally:
+        sib.close()
+        owner.close()
+    events = read_events(obs_env)
+    kinds = [e["event"] for e in events]
+    assert "lane_owner" in kinds
+    assert "lane_restored" in kinds
+    # exactly the one owner ever bound the lane
+    assert kinds.count("lane_owner") == 1
+    # the forwarded dispatch journals ONE serve_batch (the owner's) —
+    # its rids list carries the sibling's lane correlation id
+    batches = [e for e in events if e["event"] == "serve_batch"]
+    rids = [r for e in batches for r in e.get("rids", ())]
+    assert any(r.startswith("l") for r in rids)
+
+
+def test_lane_owner_death_loses_nothing_and_rejoins(export_dir, tmp_path,
+                                                    obs_env):
+    """The kill drill: requests racing an owner death fall back to the
+    sibling's private dispatch (no error, no loss), the outage journals
+    lane_degraded, and a re-elected owner on the same socket journals a
+    fresh lane_restored join."""
+    from shifu_tensorflow_tpu.obs.journal import read_events
+
+    lane_path = str(tmp_path / "lane.sock")
+    owner = ScoringServer(_cfg(export_dir, frame_port=0), worker_index=0,
+                          lane_socket=lane_path)
+    owner.start()
+    sib = ScoringServer(_cfg(export_dir, frame_port=0), worker_index=1,
+                        lane_socket=lane_path)
+    sib.start()
+    owner2 = None
+    try:
+        _wait(sib.lane.connected, what="lane join")
+        assert sib.handle_rows(_rows(4), rid="warm")["scores"]
+        # keep traffic flowing while the owner dies mid-stream
+        errors, done = [], []
+
+        def pound():
+            for i in range(40):
+                try:
+                    out = sib.handle_rows(_rows(3, seed=i),
+                                          rid=f"k{i}")["scores"]
+                    assert len(out) == 3
+                    done.append(i)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                time.sleep(0.005)
+
+        t = threading.Thread(target=pound)
+        t.start()
+        time.sleep(0.05)
+        owner.close()  # the kill (socket dies with it)
+        t.join(timeout=120.0)
+        assert not t.is_alive()
+        assert errors == []          # ZERO lost / errored requests
+        assert len(done) == 40
+        _wait(lambda: not sib.lane.connected(), what="lane loss notice")
+        assert sib.metrics.counters()["batches_total"] >= 1  # fallback
+        # re-elected owner (same index, same socket) → sibling rejoins
+        owner2 = ScoringServer(_cfg(export_dir, frame_port=0),
+                               worker_index=0, lane_socket=lane_path)
+        owner2.start()
+        _wait(sib.lane.connected, what="lane rejoin")
+        out = sib.handle_rows(_rows(5), rid="after")["scores"]
+        assert len(out) == 5
+    finally:
+        sib.close()
+        if owner2 is not None:
+            owner2.close()
+    events = read_events(obs_env)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("lane_owner") == 2     # original + re-elected
+    assert "lane_degraded" in kinds
+    # degraded then restored, in that order
+    assert (kinds.index("lane_degraded")
+            < len(kinds) - 1 - kinds[::-1].index("lane_restored"))
+
+
+def test_lane_client_falls_back_when_owner_never_existed(tmp_path):
+    """No owner at all: forward() says no, the batcher dispatches
+    privately, and nothing journals a degradation (there was no lane to
+    degrade — startup races must not trip the kill-drill check)."""
+    lane = LaneClient(str(tmp_path / "nobody.sock"),
+                      reconnect_interval_s=0.05)
+    try:
+        seen = []
+
+        def score_fn(x):
+            seen.append(x.shape[0])
+            return np.zeros((x.shape[0], 1), np.float32)
+
+        b = MicroBatcher(score_fn, max_batch=32, max_delay_s=0.001,
+                         lane=lane)
+        try:
+            out = b.submit(_rows(4))
+            assert out.shape[0] == 4
+        finally:
+            b.close()
+        assert seen  # dispatched locally
+        assert lane.stats()["fallback"] >= 1
+        assert lane.stats()["connected"] is False
+    finally:
+        lane.close()
