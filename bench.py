@@ -899,6 +899,24 @@ def main() -> None:
         rc = bench_serve_frame.main()
         _append_bench_history('serve-frame', 'BENCH_SERVE_FRAME.json', rc=rc)
         sys.exit(rc)
+    if "sharding" in sys.argv[1:]:
+        # sharded-parameter SPMD benchmark (python bench.py sharding):
+        # max trainable embedding rows under data:2,model:2 vs the
+        # replicated ceiling at equal per-device params budget (the
+        # memory accountant's params_dev_bytes bucket), step-time noise
+        # bound, bit-identical sharded-vs-replicated eval through a
+        # per-shard checkpoint migration, and a quiet storm detector —
+        # artifact BENCH_SHARDING.json, implemented in
+        # scripts/bench_sharding.py.  In-process on a 4-virtual-device
+        # CPU backend (capacity is a bytes-placement property, not a
+        # FLOPs one), so the parent's no-jax rule does not apply.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_sharding
+
+        rc = bench_sharding.main()
+        _append_bench_history('sharding', 'BENCH_SHARDING.json', rc=rc)
+        sys.exit(rc)
     if "serve" in sys.argv[1:]:
         # serving benchmark (python bench.py serve): micro-batched vs
         # one-row-per-request scoring over HTTP, artifact

@@ -42,6 +42,7 @@ from shifu_tensorflow_tpu.coordinator.metrics_board import EpochAggregator
 from shifu_tensorflow_tpu.obs import journal as obs_journal
 from shifu_tensorflow_tpu.obs import trace as obs_trace
 from shifu_tensorflow_tpu.obs.registry import MetricsRegistry
+from shifu_tensorflow_tpu.parallel.mesh import mesh_coord, parse_mesh_shape
 from shifu_tensorflow_tpu.train.trainer import EpochStats
 from shifu_tensorflow_tpu.utils import faults, logs
 from shifu_tensorflow_tpu.utils import retry as retry_util
@@ -177,6 +178,14 @@ class JobSpec:
     # function of paths x n_workers) and the job continues instead of
     # failing.  Also unlocks the explicit resize (grow/shrink) op.
     elastic: bool = False
+    # fleet mesh layout (shifu.tpu.mesh-shape, e.g. "data:2,model:2"):
+    # each rank is one single-device process laid out row-major on this
+    # mesh.  The register/promotion replies hand every rank its mesh
+    # coordinate, and resize validates the new fleet size against the
+    # model axis (a reshape the model axis cannot hold refuses cleanly
+    # instead of letting workers crash in parse_mesh_shape).  "" = no
+    # declared mesh (workers lay out their local devices themselves).
+    mesh_spec: str = ""
 
 
 class Coordinator:
@@ -407,6 +416,24 @@ class Coordinator:
         return len(self._active_indices)
 
     # ---- worker lifecycle (all called under the TCP handlers) ----
+    def _mesh_info(self, worker_index: int) -> dict[str, Any] | None:
+        """The rank's place on the declared fleet mesh — spec plus this
+        rank's row-major coordinate — or None when no mesh is declared
+        (or the spec cannot lay over the current fleet size; workers
+        then fall back to their local default and the mismatch surfaces
+        through their own parse error)."""
+        if not self.spec.mesh_spec:
+            return None
+        n = self._expected()
+        try:
+            return {
+                "spec": self.spec.mesh_spec,
+                "shape": parse_mesh_shape(self.spec.mesh_spec, n),
+                "coord": mesh_coord(self.spec.mesh_spec, n, worker_index),
+            }
+        except ValueError:
+            return None
+
     def register(
         self,
         worker_id: str,
@@ -511,6 +538,10 @@ class Coordinator:
                 "generation": self._generation,
                 "job": self.job_id,
                 "shard_lines": self._shard_lines.get(rec.worker_index),
+                # declared fleet mesh + this rank's coordinate on it (a
+                # promoted/relaunched rank shards the same table rows
+                # its predecessor held)
+                "mesh": self._mesh_info(rec.worker_index),
                 # rollback directive: relaunched workers train at the
                 # backed-off LR and skip the batch window that tripped
                 # the guard.  SPMD: the FLEET directive (identical for
@@ -621,6 +652,10 @@ class Coordinator:
                         "job": self.job_id,
                         "shard_lines": self._shard_lines.get(
                             rec.worker_index),
+                        # the promoted rank inherits the dead rank's mesh
+                        # coordinate with its index — its table shard is
+                        # the dead rank's table shard
+                        "mesh": self._mesh_info(rec.worker_index),
                         "health": {
                             "lr_scale": (self._lr_scale if self.spec.spmd
                                          else rec.lr_scale),
@@ -831,6 +866,18 @@ class Coordinator:
             n = int(n_workers)
             if n < 1:
                 return {"ok": False, "error": "n_workers must be >= 1"}
+            if self.spec.mesh_spec:
+                # a resize IS a mesh reshape: the new fleet must still
+                # hold the declared model axis (table shards cannot be
+                # rebalanced onto a rank count the axis does not divide)
+                # — refuse cleanly instead of letting every relaunched
+                # worker crash in parse_mesh_shape
+                try:
+                    parse_mesh_shape(self.spec.mesh_spec, n)
+                except ValueError as e:
+                    return {"ok": False, "error": (
+                        f"resize to {n} rank(s) is an invalid mesh "
+                        f"reshape: {e}")}
             current = sorted(self._active_indices)
             if n == len(current):
                 return {"ok": True, "ranks": current, "changed": False}
@@ -867,6 +914,12 @@ class Coordinator:
                     if i not in current][:n - len(current)]
                 self._resplit_over(grown, f"resize to {n}")
             self.aggregator.set_expected(n)
+            if self.spec.mesh_spec:
+                obs_journal.emit(
+                    "mesh_reshape", plane="coordinator",
+                    spec=self.spec.mesh_spec, n_workers=n,
+                    shape=parse_mesh_shape(self.spec.mesh_spec, n),
+                )
             return {"ok": True, "ranks": sorted(self._active_indices),
                     "changed": True,
                     "split_generation": self._split_generation}

@@ -505,6 +505,12 @@ def run_worker(cfg: WorkerConfig, *,
             else cfg.model_config.valid_set_rate
         )
 
+        # the declared fleet mesh rode the register reply (spec + THIS
+        # rank's row-major coordinate — a promoted standby inherits the
+        # dead rank's coordinate with its index); a locally configured
+        # spec still wins so single-process runs need no coordinator
+        mesh_info = reg.get("mesh") or {}
+        mesh_spec = cfg.mesh_spec or mesh_info.get("spec")
         topology = None
         mesh = None
         if spmd:
@@ -534,11 +540,28 @@ def run_worker(cfg: WorkerConfig, *,
                 except Exception:
                     pass
                 raise _FleetRestart()
-            mesh = dist.global_mesh(cfg.mesh_spec or "data:-1")
-        elif cfg.mesh_spec:
+            mesh = dist.global_mesh(mesh_spec or "data:-1")
+        elif mesh_spec:
             from shifu_tensorflow_tpu.parallel.mesh import make_mesh
 
-            mesh = make_mesh(cfg.mesh_spec)
+            mesh = make_mesh(mesh_spec)
+        if mesh is not None:
+            # ONE mesh event per worker start: the resolved layout (not
+            # the spec string — `-1` axes are solved by now), this
+            # rank's coordinate when the coordinator assigned one, and
+            # the fingerprint artifacts stamp — `obs summary` renders it
+            from shifu_tensorflow_tpu.obs import journal as _obs_journal
+            from shifu_tensorflow_tpu.parallel.mesh import (
+                mesh_shape_fingerprint,
+            )
+
+            _obs_journal.emit(
+                "mesh", plane="train", worker=worker_index,
+                shape={n: int(s) for n, s in mesh.shape.items()},
+                coord=mesh_info.get("coord"),
+                fingerprint=mesh_shape_fingerprint(mesh),
+                devices=int(mesh.devices.size),
+            )
 
         if (prebuilt is not None and not spmd and lr_scale == 1.0
                 and not skip):

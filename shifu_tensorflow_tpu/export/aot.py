@@ -97,15 +97,19 @@ class AotExportError(RuntimeError):
     instead of quietly shipping a bundle without what was asked for."""
 
 
-def compile_env_fingerprint() -> dict:
+def compile_env_fingerprint(mesh_shape: str | None = None) -> dict:
     """The environment a serialized executable is valid in: jax +
     jaxlib versions (the serialization format and the XLA build),
-    backend platform, and the first device's kind (a CPU executable is
-    not a TPU executable; a v4 executable is not a v5e one).  Stamped
-    into ``aot_meta.json`` at export; compared at load."""
+    backend platform, the first device's kind (a CPU executable is
+    not a TPU executable; a v4 executable is not a v5e one), and the
+    weights mesh shape the program was traced against (a program whose
+    parameter shapes are per-shard slices cannot score a differently
+    sharded — or unsharded — bundle).  Stamped into ``aot_meta.json``
+    at export; compared at load."""
     import jax
 
-    fp = {"jax": getattr(jax, "__version__", "?")}
+    fp = {"jax": getattr(jax, "__version__", "?"),
+          "mesh_shape": mesh_shape or "unsharded"}
     try:
         import jaxlib
 
@@ -120,14 +124,22 @@ def compile_env_fingerprint() -> dict:
     return fp
 
 
-def fingerprint_mismatch(recorded: dict) -> str | None:
+def fingerprint_mismatch(
+    recorded: dict, mesh_shape: str | None = None
+) -> str | None:
     """None when ``recorded`` (from a bundle's meta) matches this
     process's compile environment, else a human-readable reason naming
-    the first differing field."""
+    the first differing field.  ``mesh_shape`` is the *bundle's*
+    current weights layout (its export manifest's ``mesh_shape``,
+    default unsharded); it is only compared when the recorded
+    fingerprint carries one, so legacy AOT metas admit unchanged."""
     if not isinstance(recorded, dict) or not recorded:
         return "bundle carries no compile-environment fingerprint"
-    env = compile_env_fingerprint()
-    for field in ("jax", "jaxlib", "backend", "device_kind"):
+    env = compile_env_fingerprint(mesh_shape=mesh_shape)
+    fields = ("jax", "jaxlib", "backend", "device_kind")
+    if "mesh_shape" in recorded:
+        fields += ("mesh_shape",)
+    for field in fields:
         want, have = recorded.get(field), env.get(field)
         if want != have:
             return f"{field} {have!r} != exported {want!r}"
@@ -145,6 +157,7 @@ def build_aot_files(
     *,
     model_name: str | None = None,
     weights_sha256: str | None = None,
+    mesh_shape: str | None = None,
 ) -> dict[str, bytes]:
     """Compile the scorer for every ladder bucket and serialize the
     executables; returns ``{relative_name: bytes}`` for the export
@@ -205,7 +218,7 @@ def build_aot_files(
             }
     meta = {
         "format_version": 1,
-        "fingerprint": compile_env_fingerprint(),
+        "fingerprint": compile_env_fingerprint(mesh_shape=mesh_shape),
         "num_features": num_features,
         "buckets": entries,
         # which weights generation these programs were compiled WITH —
@@ -260,10 +273,30 @@ class AotIndex:
             # falls back (and journals why), never refuses the bundle
             return cls(model_dir, None,
                        unusable=f"unreadable {AOT_META}: {e}")
-        mismatch = fingerprint_mismatch(meta.get("fingerprint") or {})
+        mismatch = fingerprint_mismatch(
+            meta.get("fingerprint") or {},
+            mesh_shape=cls._bundle_mesh_shape(model_dir))
         if mismatch is None:
             mismatch = cls._generation_mismatch(model_dir, meta)
         return cls(model_dir, meta, unusable=mismatch)
+
+    @staticmethod
+    def _bundle_mesh_shape(model_dir: str) -> str:
+        """The bundle's CURRENT weights layout from its export manifest
+        (``"unsharded"`` for legacy/flat bundles) — compared against the
+        mesh the executables were compiled under, so a stale ``aot/``
+        dir beside a re-sharded export falls back instead of feeding
+        wrong-shape parameters to a serialized program."""
+        from shifu_tensorflow_tpu.export.saved_model import NATIVE_MANIFEST
+
+        try:
+            mpath = os.path.join(model_dir, NATIVE_MANIFEST)
+            if fs.exists(mpath):
+                doc = json.loads(fs.read_text(mpath))
+                return str(doc.get("mesh_shape") or "unsharded")
+        except (OSError, ValueError):
+            pass
+        return "unsharded"
 
     @staticmethod
     def _generation_mismatch(model_dir: str, meta: dict) -> str | None:

@@ -50,9 +50,9 @@ from shifu_tensorflow_tpu.export.saved_model import (
     GENERIC_CONFIG,
     INPUT_NAME,
     NATIVE_ARCH,
-    NATIVE_WEIGHTS,
     OUTPUT_NAME,
     _unflatten_params,
+    load_native_weights,
 )
 from shifu_tensorflow_tpu.utils import fs, logs
 
@@ -98,10 +98,8 @@ class EvalModel:
         mc = ModelConfig.from_json(arch["model_config"])
         feature_columns = tuple(arch.get("feature_columns") or ())
         self._model = build_model(mc, feature_columns or None)
-        with fs.open_read(os.path.join(self.model_dir, NATIVE_WEIGHTS)) as f:
-            npz = np.load(f)
-            flat = {k: npz[k] for k in npz.files}
-        self._params = _unflatten_params(flat)
+        # both layouts: flat npz, or a mesh-aware export's shard files
+        self._params = _unflatten_params(load_native_weights(self.model_dir))
         norm = arch.get("normalization") or {}
         self._means = np.asarray(norm["means"], np.float32) if norm.get("means") else None
         self._stds = np.asarray(norm["stds"], np.float32) if norm.get("stds") else None

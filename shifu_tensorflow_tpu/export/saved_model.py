@@ -45,6 +45,23 @@ SERVE_TAG = "serve"
 GENERIC_CONFIG = "GenericModelConfig.json"
 NATIVE_ARCH = "shifu_tpu_model.json"
 NATIVE_WEIGHTS = "shifu_tpu_weights.npz"
+#: per-shard weight files of a mesh-aware export (model-sharded trainer):
+#: ``shifu_tpu_weights.shard<k>of<M>.npz``, one per model-mesh coordinate,
+#: each digested into the manifest like any artifact.  The manifest's
+#: ``weights_sharding`` record (num_shards + per-leaf concat dim/offsets)
+#: is what reassembles them; the flat NATIVE_WEIGHTS file is absent from
+#: such bundles.  The bundle's identity ``sha256`` stays the digest of
+#: the LOGICAL flat npz (assembled in memory at export — export is off
+#: the training hot path), so identity is invariant to how the trainer
+#: happened to be sharded and the AOT generation guard keeps working
+#: across a reshard.
+NATIVE_WEIGHTS_SHARD_PREFIX = "shifu_tpu_weights.shard"
+
+
+def native_weights_shard_name(k: int, num: int) -> str:
+    return f"{NATIVE_WEIGHTS_SHARD_PREFIX}{k}of{num}.npz"
+
+
 #: sidecar manifest over the native bundle (size + CRC32 + SHA-256 per
 #: file, the PR-2 verified-checkpoint scheme applied to exports): the
 #: serving hot-reload path admits a new artifact only after the manifest
@@ -108,6 +125,75 @@ def _unflatten_params(flat: Mapping[str, np.ndarray]):
             node = node.setdefault(p, {})
         node[parts[-1]] = arr
     return tree
+
+
+def _split_sharded_params(params):
+    """Flatten params into ``(flat_full, shard_flats, sharding_meta,
+    mesh_shape)``.
+
+    ``flat_full`` is the complete logical tree ('/a/b/kernel' -> full
+    array) — the bundle identity and the AOT compile input.  When any
+    leaf is live model-sharded, ``shard_flats[k]`` holds the flat dict
+    for model coordinate k (replicated leaves ride in shard 0 only,
+    sharded leaves contribute their k-th block) and ``sharding_meta``
+    maps each sharded flat name to ``{"dim", "offsets"}``; otherwise
+    both are None and ``mesh_shape`` is ``"unsharded"``."""
+    import flax.linen as nn
+
+    from shifu_tensorflow_tpu.parallel.sharding import (
+        model_shard_blocks,
+        model_shard_info,
+    )
+
+    leaves: list[tuple[str, Any]] = []
+
+    def walk(prefix: str, tree):
+        if isinstance(tree, Mapping):
+            for k, v in tree.items():
+                walk(f"{prefix}/{k}", v)
+        else:
+            if isinstance(tree, nn.Partitioned):
+                tree = tree.value
+            leaves.append((prefix, tree))
+
+    walk("", params)
+    infos = {name: model_shard_info(leaf) for name, leaf in leaves}
+    num = max((i[1] for i in infos.values() if i is not None), default=1)
+    mesh_shape = "unsharded"
+    if num > 1:
+        for name, leaf in leaves:
+            if infos[name] is not None:
+                mesh_shape = ",".join(
+                    f"{n}:{s}" for n, s in leaf.sharding.mesh.shape.items()
+                )
+                break
+    flat_full: dict[str, np.ndarray] = {}
+    shard_flats: list[dict] = [dict() for _ in range(num)]
+    sharding_meta: dict[str, dict] = {}
+    for name, leaf in leaves:
+        info = infos[name]
+        extracted = None
+        if info is not None and info[1] == num:
+            extracted = model_shard_blocks(leaf, info[0], num)
+        if extracted is None:
+            full = np.asarray(jax.device_get(leaf))
+            flat_full[name] = full
+            shard_flats[0][name] = full
+            continue
+        starts, blocks = extracted
+        dim = info[0]
+        for k, block in enumerate(blocks):
+            shard_flats[k][name] = block
+        flat_full[name] = (
+            np.concatenate(blocks, axis=dim) if len(blocks) > 1 else blocks[0]
+        )
+        sharding_meta[name] = {
+            "dim": dim,
+            "offsets": [int(v) for v in starts] + [int(leaf.shape[dim])],
+        }
+    if not sharding_meta:
+        return flat_full, None, None, "unsharded"
+    return flat_full, shard_flats, sharding_meta, mesh_shape
 
 
 def export_native_bundle(
@@ -193,11 +279,14 @@ def export_native_bundle(
     from shifu_tensorflow_tpu.utils import faults
 
     arch_bytes = json.dumps(arch, indent=2).encode("utf-8")
-    flat = _flatten_params(params)
+    flat, shard_flats, weights_sharding, mesh_shape = (
+        _split_sharded_params(params))
     # serialize the npz to memory first so the manifest digests cover
     # exactly the bytes handed to the filesystem (same rationale as
     # NpzCheckpointer._write): any later divergence between manifest and
-    # file IS corruption, by construction
+    # file IS corruption, by construction.  For a sharded export this
+    # LOGICAL flat npz is never written — it exists to give the bundle a
+    # sharding-invariant identity digest (and the AOT compile its input)
     buf = io.BytesIO()
     np.savez(buf, **flat)
     weights_bytes = buf.getvalue()
@@ -205,9 +294,23 @@ def export_native_bundle(
     weights_entry = _digest_entry(weights_bytes)  # hash the payload once
     files = {
         NATIVE_ARCH: _digest_entry(arch_bytes),
-        NATIVE_WEIGHTS: weights_entry,
         GENERIC_CONFIG: _digest_entry(generic_bytes),
     }
+    shard_payloads: dict[str, bytes] = {}
+    if shard_flats is None:
+        files[NATIVE_WEIGHTS] = weights_entry
+    else:
+        # mesh-aware export: one digested npz per model-mesh coordinate;
+        # the serve verifier iterates manifest["files"] generically, so
+        # shard files verify exactly like the flat file did
+        num = len(shard_flats)
+        for k, shard in enumerate(shard_flats):
+            sbuf = io.BytesIO()
+            np.savez(sbuf, **shard)
+            payload = sbuf.getvalue()
+            name = native_weights_shard_name(k, num)
+            shard_payloads[name] = payload
+            files[name] = _digest_entry(payload)
     aot_files: dict[str, bytes] = {}
     if aot_buckets:
         # compile + serialize the ladder FROM the bundle's own
@@ -221,7 +324,8 @@ def export_native_bundle(
             arch, flat, aot_buckets,
             model_name=(os.path.basename(export_dir.rstrip("/"))
                         or None),
-            weights_sha256=weights_entry["sha256"])
+            weights_sha256=weights_entry["sha256"],
+            mesh_shape=mesh_shape)
         for name, payload in aot_files.items():
             files[name] = _digest_entry(payload)
     stats_bytes = None
@@ -236,9 +340,18 @@ def export_native_bundle(
     manifest_doc: dict[str, Any] = {
         "format_version": 1,
         "sha256": weights_entry["sha256"],  # bundle identity
+        # the mesh the exporter's params lived on ("unsharded" for any
+        # model axis of 1): the AOT loader compares this against the
+        # fingerprint its executables were compiled under
+        "mesh_shape": mesh_shape,
         "files": files,
         "written_by": str(os.getpid()),
     }
+    if weights_sharding is not None:
+        manifest_doc["weights_sharding"] = {
+            "num_shards": len(shard_flats),
+            "leaves": weights_sharding,
+        }
     if lineage:
         # generation lineage: who this bundle was retrained from.  Kept
         # to the two documented keys (plus anything the caller stamps)
@@ -259,8 +372,29 @@ def export_native_bundle(
     # the admission verifier must keep serving the old one
     _commit_bytes(os.path.join(export_dir, NATIVE_ARCH), arch_bytes,
                   site="export.commit")
-    _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes,
-                  site="export.commit")
+    if shard_flats is None:
+        _commit_bytes(os.path.join(export_dir, NATIVE_WEIGHTS), weights_bytes,
+                      site="export.commit")
+    else:
+        for name, payload in shard_payloads.items():
+            payload = faults.mutate("export.at-rest", payload)
+            _commit_bytes(os.path.join(export_dir, name), payload,
+                          site="export.commit")
+    # a re-export under a different mesh must not leave the OTHER
+    # layout's weight files beside a manifest that no longer covers
+    # them — a legacy manifest-less reader would happily load the stale
+    # flat npz of a bundle whose real weights are the shard files
+    try:
+        for leftover in os.listdir(export_dir):
+            stale_flat = (shard_flats is not None
+                          and leftover == NATIVE_WEIGHTS)
+            stale_shard = (
+                leftover.startswith(NATIVE_WEIGHTS_SHARD_PREFIX)
+                and leftover not in shard_payloads)
+            if stale_flat or stale_shard:
+                os.remove(os.path.join(export_dir, leftover))
+    except OSError:
+        pass
     _commit_bytes(os.path.join(export_dir, GENERIC_CONFIG), generic_bytes,
                   site="export.commit")
     if aot_files:
@@ -309,6 +443,58 @@ def export_native_bundle(
         os.path.join(export_dir, NATIVE_MANIFEST), manifest.encode("utf-8"),
         site="export.commit",
     )
+
+
+def load_native_weights(model_dir: str) -> dict[str, np.ndarray]:
+    """Flat ``{'/a/b/kernel': array}`` from EITHER bundle layout: the flat
+    ``shifu_tpu_weights.npz``, or a mesh-aware export's per-shard files
+    reassembled via the manifest's ``weights_sharding`` record.  Loading
+    is off the training hot path, so the reassembly concat is the work
+    itself, not a contract violation.  Integrity is the caller's
+    (manifest verifier's) business, exactly as for the flat file."""
+    flat_path = os.path.join(model_dir, NATIVE_WEIGHTS)
+    if fs.exists(flat_path):
+        with fs.open_read(flat_path) as f:
+            npz = np.load(f)
+            return {k: npz[k] for k in npz.files}
+    try:
+        with fs.open_read(os.path.join(model_dir, NATIVE_MANIFEST)) as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        raise FileNotFoundError(
+            f"{model_dir}: no {NATIVE_WEIGHTS} and no readable manifest "
+            f"({e})"
+        ) from e
+    ws = manifest.get("weights_sharding")
+    if not isinstance(ws, dict):
+        raise FileNotFoundError(
+            f"{model_dir}: no {NATIVE_WEIGHTS} and the manifest records "
+            f"no weights_sharding — not a native bundle"
+        )
+    num = int(ws.get("num_shards", 0))
+    leaves_meta = ws.get("leaves") or {}
+    parts: dict[str, list[np.ndarray]] = {}
+    for k in range(num):
+        path = os.path.join(model_dir, native_weights_shard_name(k, num))
+        with fs.open_read(path) as f:
+            npz = np.load(f)
+            for name in npz.files:
+                parts.setdefault(name, []).append(npz[name])
+    flat: dict[str, np.ndarray] = {}
+    for name, blocks in parts.items():
+        ent = leaves_meta.get(name)
+        if ent is not None and len(blocks) > 1:
+            flat[name] = np.concatenate(blocks, axis=int(ent["dim"]))
+        else:
+            flat[name] = blocks[0]
+    return flat
+
+
+def is_native_bundle(path: str) -> bool:
+    """A directory is a native bundle when it carries the flat weights
+    file OR a manifest (mesh-aware exports have no flat npz)."""
+    return os.path.isfile(os.path.join(path, NATIVE_WEIGHTS)) or \
+        os.path.isfile(os.path.join(path, NATIVE_MANIFEST))
 
 
 def bundle_lineage(export_dir: str) -> dict[str, Any]:

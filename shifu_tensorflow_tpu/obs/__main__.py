@@ -750,8 +750,56 @@ def _build_summary(base: str, cache: dict | None = None) -> dict | None:
         "slo": _slo_data(events),
         "fleet": _fleet_data(events),
         "data": _data_summary(events),
+        "mesh": _mesh_data(events),
         "_events": events,  # stripped before --json output
     }
+
+
+def _mesh_data(events: list[dict]) -> dict:
+    """The fleet's resolved device-mesh layout: the LAST ``mesh`` event
+    per worker (each worker journals one at start; a fleet restart's
+    re-journal supersedes) — rendered as one summary line so an
+    operator reads the data×model split without grepping the journal."""
+    per_worker: dict = {}
+    for ev in events:
+        if ev.get("event") != "mesh":
+            continue
+        per_worker[ev.get("worker")] = {
+            "shape": ev.get("shape"),
+            "coord": ev.get("coord"),
+            "fingerprint": ev.get("fingerprint"),
+            "devices": ev.get("devices"),
+        }
+    if not per_worker:
+        return {}
+    any_rec = next(iter(per_worker.values()))
+    return {
+        "shape": any_rec.get("shape"),
+        "fingerprint": any_rec.get("fingerprint"),
+        "devices": any_rec.get("devices"),
+        "workers": {
+            str(w): rec.get("coord")
+            for w, rec in sorted(
+                per_worker.items(), key=lambda kv: str(kv[0]))
+        },
+    }
+
+
+def _render_mesh(m: dict) -> list[str]:
+    if not m:
+        return []
+    shape = m.get("shape") or {}
+    spec = ",".join(f"{n}:{s}" for n, s in shape.items()) or "?"
+    line = (f"  mesh {spec} ({m.get('devices', '?')} device(s), "
+            f"fingerprint {m.get('fingerprint', '?')})")
+    coords = {w: c for w, c in (m.get("workers") or {}).items()
+              if c is not None}
+    out = [line]
+    if coords:
+        out.append("  rank coordinates: " + ", ".join(
+            f"{w}→({', '.join(f'{k}={v}' for k, v in c.items())})"
+            for w, c in sorted(coords.items())))
+    return out
 
 
 def _data_summary(events: list[dict]) -> dict:
@@ -816,6 +864,12 @@ def cmd_summary(args) -> int:
     print("  " + ", ".join(
         f"{name} x{n}" for name, n in data["counts"].items()))
     print()
+    mesh_lines = _render_mesh(data.get("mesh") or {})
+    if mesh_lines:
+        print("device mesh")
+        for line in mesh_lines:
+            print(line)
+        print()
     print("per-step time budget")
     for line in _render_budget(data["budget"]):
         print(line)

@@ -192,11 +192,16 @@ class FleetMonitor:
     cache) never alarms — only divergence between ranks does.
     Hysteresis mirrors the SLO watchdog: ``hysteresis`` consecutive
     breaching epochs to detect, the same count of clean ones to clear.
+    ``min_excess_s`` is an absolute floor under the relative test: on
+    millisecond-scale epochs (tiny drills, unit fleets) OS scheduling
+    jitter alone exceeds any ratio threshold, and sub-jitter absolute
+    skew is never operationally actionable anyway.
     """
 
     def __init__(self, *, window_epochs: int = 8,
                  skew_threshold: float = 1.5, hysteresis: int = 2,
-                 warmup_epochs: int = 1, plane: str = "coordinator"):
+                 warmup_epochs: int = 1, min_excess_s: float = 0.05,
+                 plane: str = "coordinator"):
         if skew_threshold <= 1.0:
             raise ValueError(
                 f"fleet skew threshold must be > 1 (a rank is a straggler "
@@ -209,6 +214,7 @@ class FleetMonitor:
         # the digests nor advance the streaks (feeding them would
         # pollute the window for window_epochs MORE epochs)
         self.warmup_epochs = max(0, int(warmup_epochs))
+        self.min_excess_s = max(0.0, float(min_excess_s))
         self.plane = plane
         self._lock = threading.Lock()
         self._ranks: dict[int, _RankState] = {}
@@ -277,7 +283,11 @@ class FleetMonitor:
             # double-count breaches for its peers
             skew = self._skew_locked(worker, now)
             rank.last_skew = skew
-            if skew >= self.skew_threshold:
+            mine = self._mean_locked(worker, now)
+            peers = self._peer_median_locked(worker, now)
+            excess_s = ((mine - peers)
+                        if mine is not None and peers is not None else 0.0)
+            if skew >= self.skew_threshold and excess_s >= self.min_excess_s:
                 rank.bad += 1
                 rank.good = 0
                 if not rank.straggler and rank.bad >= self.hysteresis:

@@ -95,6 +95,44 @@ def tree_device_bytes(tree: Any) -> int:
         return 0
 
 
+def tree_per_device_bytes(tree: Any) -> dict[int, int]:
+    """Device id -> bytes this pytree holds ON that device.  The
+    sharding-aware view of :func:`tree_device_bytes`: a table sharded
+    over the ``model`` axis charges each device its slice, a replicated
+    leaf charges every device the full array — so ``max`` over the
+    returned dict is the per-device parameter footprint the mesh-shape
+    capacity planning (bench sharding) reasons about."""
+    per_dev: dict[int, int] = {}
+    if tree is None:
+        return per_dev
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if getattr(leaf, "is_deleted", None) is not None \
+                    and leaf.is_deleted():
+                continue
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is not None:
+                try:
+                    for s in shards:
+                        did = int(getattr(s.device, "id", 0))
+                        per_dev[did] = per_dev.get(did, 0) \
+                            + int(s.data.nbytes)
+                    continue
+                except Exception:
+                    pass
+            dev = getattr(leaf, "device", None)
+            nbytes = getattr(leaf, "nbytes", None)
+            if dev is not None and nbytes is not None:
+                d = dev() if callable(dev) else dev
+                did = int(getattr(d, "id", 0) or 0)
+                per_dev[did] = per_dev.get(did, 0) + int(nbytes)
+    except Exception:
+        return per_dev
+    return per_dev
+
+
 class MemoryAccountant:
     """Per-plane device-memory snapshots with attribution and
     high-water tracking (installed by ``obs.install_obs`` beside the
@@ -132,6 +170,11 @@ class MemoryAccountant:
             return None
         total = sum(_array_bytes(a) for a in live)
         params_b = tree_device_bytes(params)
+        # per-device params footprint (max over local devices): THE
+        # capacity signal model-axis sharding moves — a table sharded
+        # model:M charges each device 1/M of what replication would
+        params_dev_b = max(tree_per_device_bytes(params).values(),
+                           default=0) if params is not None else None
         opt_b = tree_device_bytes(opt_state)
         infeed_b = tree_device_bytes(infeed)
         model_b: dict[str, int] = {}
@@ -153,6 +196,8 @@ class MemoryAccountant:
             "total_bytes": total,
             "arrays": len(live),
             "params_bytes": params_b,
+            **({"params_dev_bytes": params_dev_b}
+               if params_dev_b is not None else {}),
             "opt_bytes": opt_b,
             "infeed_bytes": infeed_b,
             **({"exec_bytes": exec_b} if exec_b is not None else {}),
@@ -221,6 +266,10 @@ class MemoryAccountant:
             r.set_gauge("exec_bytes", out["exec_bytes"])
         else:
             r.remove_gauge("exec_bytes")  # absent signal, not zero
+        if "params_dev_bytes" in out:
+            r.set_gauge("params_dev_bytes", out["params_dev_bytes"])
+        else:
+            r.remove_gauge("params_dev_bytes")  # absent signal, not zero
         if "bytes_in_use" in out:
             r.set_gauge("backend_bytes_in_use", out["bytes_in_use"])
         if "bytes_limit" in out:

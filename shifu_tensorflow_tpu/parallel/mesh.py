@@ -16,11 +16,18 @@ remaining devices.
 
 from __future__ import annotations
 
-import jax
 import numpy as np
+
+# jax is imported lazily inside make_mesh: the coordinator control plane
+# parses mesh shapes and computes rank coordinates (parse_mesh_shape /
+# mesh_coord) without ever touching devices, and must stay jax-free
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# the config key the spec comes from — named in errors so an operator
+# knows exactly which knob to fix (config/keys.py K.MESH_SHAPE)
+MESH_SHAPE_KEY = "shifu.tpu.mesh-shape"
 
 
 def parse_mesh_shape(spec: str, num_devices: int) -> dict[str, int]:
@@ -37,24 +44,51 @@ def parse_mesh_shape(spec: str, num_devices: int) -> dict[str, int]:
     unknown = [n for n, s in axes.items() if s == -1]
     if len(unknown) > 1:
         raise ValueError(f"at most one -1 axis allowed in mesh shape {spec!r}")
+    model = axes.get(MODEL_AXIS, 1)
+    if model > 1 and num_devices % model != 0:
+        raise ValueError(
+            f"{MESH_SHAPE_KEY}={spec!r} asks for a model axis of {model} but "
+            f"{num_devices} device(s) are present and {num_devices} % {model}"
+            f" != 0 — shrink the model axis to a divisor of the device count"
+            f" or set {MESH_SHAPE_KEY}=data:-1 to train replicated"
+        )
     fixed = int(np.prod([s for s in axes.values() if s != -1])) if axes else 1
     if unknown:
         if num_devices % max(fixed, 1) != 0:
             raise ValueError(
-                f"mesh shape {spec!r} does not divide {num_devices} devices"
+                f"mesh shape {spec!r} ({MESH_SHAPE_KEY}) does not divide "
+                f"{num_devices} devices"
             )
         axes[unknown[0]] = num_devices // max(fixed, 1)
     total = int(np.prod(list(axes.values())))
     if total != num_devices:
         raise ValueError(
-            f"mesh shape {spec!r} uses {total} devices but {num_devices} present"
+            f"mesh shape {spec!r} ({MESH_SHAPE_KEY}) uses {total} devices "
+            f"but {num_devices} present"
         )
     return axes
 
 
+def mesh_coord(spec: str, num_devices: int, rank: int) -> dict[str, int]:
+    """Rank ``rank``'s coordinate on the mesh ``spec`` lays over
+    ``num_devices`` single-device processes, row-major (the same order
+    ``make_mesh`` reshapes ``jax.devices()``, which jax.distributed
+    sorts by process index).  ``{"data": 1, "model": 0}`` for rank 2 on
+    ``data:2,model:2``."""
+    axes = parse_mesh_shape(spec, num_devices)
+    coord: dict[str, int] = {}
+    rem = int(rank)
+    for name, size in reversed(list(axes.items())):
+        coord[name] = rem % size
+        rem //= size
+    return dict(reversed(list(coord.items())))
+
+
 def make_mesh(
     spec: str = "data:-1", devices: list | None = None
-) -> jax.sharding.Mesh:
+) -> "jax.sharding.Mesh":
+    import jax
+
     devices = devices if devices is not None else jax.devices()
     axes = parse_mesh_shape(spec, len(devices))
     names = tuple(axes.keys())
@@ -65,3 +99,23 @@ def make_mesh(
 
 def data_axis_size(mesh: jax.sharding.Mesh) -> int:
     return mesh.shape.get(DATA_AXIS, 1)
+
+
+def model_axis_size(mesh: jax.sharding.Mesh | None) -> int:
+    if mesh is None:
+        return 1
+    return mesh.shape.get(MODEL_AXIS, 1)
+
+
+def mesh_shape_fingerprint(mesh: jax.sharding.Mesh | None) -> str:
+    """Canonical mesh-shape string for artifact fingerprints.
+
+    Weights layout (and hence any serialized executable) only changes when
+    the *model* axis partitions parameters — pure data-parallel degree is
+    invisible to a single-device artifact.  So every mesh whose model axis
+    is 1 (or absent, or no mesh at all) collapses to ``"unsharded"``; a
+    genuinely model-sharded mesh stamps its full ``axis:size`` spec.
+    """
+    if model_axis_size(mesh) <= 1:
+        return "unsharded"
+    return ",".join(f"{n}:{s}" for n, s in mesh.shape.items())

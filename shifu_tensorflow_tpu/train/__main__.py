@@ -656,6 +656,18 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
 
     install_obs(resolve_obs(args, conf), plane="train",
                 job=_uuid.uuid4().hex[:8])
+    if mesh is not None:
+        # one mesh event per run: the RESOLVED layout (-1 axes solved),
+        # rendered by `obs summary`
+        from shifu_tensorflow_tpu.obs import journal as _obs_journal
+        from shifu_tensorflow_tpu.parallel.mesh import mesh_shape_fingerprint
+
+        _obs_journal.emit(
+            "mesh", plane="train",
+            shape={n: int(s) for n, s in mesh.shape.items()},
+            fingerprint=mesh_shape_fingerprint(mesh),
+            devices=int(mesh.devices.size),
+        )
     # make_trainer dispatches on train.params.Algorithm (ssgd | sagn) —
     # the reference selected between its two programs by script path
     extras = trainer_extras(args, conf)
@@ -677,7 +689,19 @@ def run_single(args, conf, model_config: ModelConfig, schema: RecordSchema) -> i
     checkpointer = None
     start_epoch = 0
     if args.checkpoint_dir:
-        checkpointer = Checkpointer(
+        # model-sharded runs (mesh with model axis > 1) checkpoint
+        # through the flat npz format: it saves one npz PER model
+        # coordinate and restores by re-sharding onto the current mesh
+        # without a full-parameter gather — the orbax path would
+        # materialize the global arrays.  flat-checkpoint opts plain
+        # runs into the same format.
+        from shifu_tensorflow_tpu.parallel.mesh import model_axis_size
+        from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+
+        use_flat = model_axis_size(mesh) > 1 or conf.get_bool(
+            K.FLAT_CHECKPOINT, K.DEFAULT_FLAT_CHECKPOINT)
+        ckpt_cls = NpzCheckpointer if use_flat else Checkpointer
+        checkpointer = ckpt_cls(
             args.checkpoint_dir,
             every_epochs=conf.get_int(K.CHECKPOINT_EVERY_EPOCHS,
                                       K.DEFAULT_CHECKPOINT_EVERY_EPOCHS),
@@ -896,6 +920,14 @@ def run_multi(args, conf, model_config: ModelConfig, schema: RecordSchema) -> in
     # True over whatever the conf key says — a keyword collision otherwise
     spec_kw = {**job_spec_kwargs(conf), **elastic_spec_kwargs(args, conf),
                **early_stop_spec_kwargs(args, conf)}
+    # declared fleet mesh (only when the operator set the key — a
+    # defaulted data:-1 must not push every plain worker onto the mesh
+    # path): the coordinator hands every rank (and every promoted
+    # standby) its row-major coordinate at registration, and elastic
+    # resizes validate the reshape against the model axis
+    mesh_spec = conf.get(K.MESH_SHAPE)
+    if mesh_spec and mesh_spec != "none":
+        spec_kw["mesh_spec"] = mesh_spec
     # one job correlation id for the whole fleet: the coordinator stamps
     # it on its journal events and hands it to every worker at
     # registration (the workers' .w<i> journal siblings carry the same id)

@@ -967,11 +967,19 @@ class Trainer:
         if mesh is not None:
             from shifu_tensorflow_tpu.parallel.mesh import data_axis_size
             from shifu_tensorflow_tpu.parallel.sharding import (
+                DEFAULT_PARTITION_RULES,
                 batch_sharding,
                 shard_params,
             )
 
-            self.state = shard_params(self.state, mesh)
+            # regex partition rules place the whole TrainState (optax
+            # mirrors inherit their param's spec by path suffix); the
+            # nn.with_partitioning annotations are the fallback for
+            # leaves no rule names
+            self._partition_rules = DEFAULT_PARTITION_RULES
+            self.state = shard_params(
+                self.state, mesh, rules=self._partition_rules
+            )
             self._batch_sharding = batch_sharding(mesh)
             # stacked chunks (S, B, ...) shard the BATCH dim (1); the scan
             # dim stays replicated
@@ -983,6 +991,7 @@ class Trainer:
             )
             self._data_axis = data_axis_size(mesh)
         else:
+            self._partition_rules = None
             self._batch_sharding = None
             self._stacked_sharding = None
             self._data_axis = 1

@@ -239,7 +239,7 @@ def test_fingerprint_mismatch_falls_back_everywhere(tmp_path):
     d = str(tmp_path / "m")
     fake = dict(aot_mod.compile_env_fingerprint(), jax="9.9.9")
     real_fp = aot_mod.compile_env_fingerprint
-    aot_mod.compile_env_fingerprint = lambda: fake
+    aot_mod.compile_env_fingerprint = lambda **kw: fake
     try:
         _export(d)
     finally:

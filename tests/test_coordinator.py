@@ -783,6 +783,31 @@ def test_fleet_monitor_uniformly_slow_fleet_never_alarms():
     assert not [e for e in events if e["event"] == "straggler_detect"]
 
 
+def test_fleet_monitor_absolute_floor_ignores_jitter_scale_skew():
+    """On millisecond epochs OS jitter alone exceeds any ratio
+    threshold: a 3x relative skew whose ABSOLUTE excess is sub-floor
+    (7ms vs 21ms) must not alarm, while the same ratio at seconds
+    scale must."""
+    from shifu_tensorflow_tpu.obs.fleet import FleetMonitor
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=1, warmup_epochs=0)
+    events = []
+    for epoch in range(6):
+        events += mon.observe_epoch(0, epoch, 0.007, n_workers=2)
+        events += mon.observe_epoch(1, epoch, 0.021, n_workers=2)
+    assert not [e for e in events if e["event"] == "straggler_detect"]
+    # the ratio is still reported honestly even when it does not alarm
+    assert mon.state()["ranks"]["1"]["skew"] == pytest.approx(3.0)
+
+    mon = FleetMonitor(skew_threshold=1.5, hysteresis=1, warmup_epochs=0)
+    events = []
+    for epoch in range(6):
+        events += mon.observe_epoch(0, epoch, 0.7, n_workers=2)
+        events += mon.observe_epoch(1, epoch, 2.1, n_workers=2)
+    det = [e for e in events if e["event"] == "straggler_detect"]
+    assert det and det[0]["worker"] == 1
+
+
 def test_fleet_monitor_warmup_epochs_ignore_compile_skew():
     """Epoch 0 is compile-dominated: whoever lost the XLA race looks
     10x slow.  Warmup epochs must neither alarm nor pollute the window."""

@@ -1,0 +1,411 @@
+"""Sharded-parameter SPMD (parallel/sharding.py): regex partition rules
+over a 2D data×model mesh, per-shard checkpoints, and mesh-aware export.
+
+The drills the acceptance criteria pin:
+
+- rule matching: ordered ``(regex, PartitionSpec)`` first-match-wins over
+  '/'-joined pytree paths, scalars never partition, unmatched leaves fall
+  back to their ``nn.with_partitioning`` annotation, non-divisible dims
+  degrade to replication instead of erroring;
+- checkpoint mesh migration: a generation saved under ``data:2,model:2``
+  restores bit-identically under ``data:4`` (and vice versa), and a
+  SAME-mesh restore performs ZERO full-parameter gathers (pinned via the
+  checkpointer's restore stats — no host-side model-dim concat);
+- per-shard integrity: one corrupt shard condemns the whole generation
+  (quarantine every file of it) and restore falls back to the previous
+  verified generation;
+- AOT mesh fingerprint: executables compiled under one mesh fall back
+  (``kind=aot_fallback``, ``aot_error`` naming ``mesh_shape``) beside a
+  differently-sharded bundle, scoring bit-identically via live compile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from shifu_tensorflow_tpu.config.model_config import ModelConfig
+from shifu_tensorflow_tpu.export import aot as aot_mod
+from shifu_tensorflow_tpu.export.eval_model import EvalModel
+from shifu_tensorflow_tpu.export.saved_model import (
+    NATIVE_MANIFEST,
+    NATIVE_WEIGHTS,
+    export_model,
+    export_native_bundle,
+    load_native_weights,
+    native_weights_shard_name,
+)
+from shifu_tensorflow_tpu.obs import compile as compile_mod
+from shifu_tensorflow_tpu.obs import journal as journal_mod
+from shifu_tensorflow_tpu.obs.journal import Journal, read_events
+from shifu_tensorflow_tpu.parallel import sharding as sh
+from shifu_tensorflow_tpu.parallel.mesh import (
+    MESH_SHAPE_KEY,
+    make_mesh,
+    mesh_coord,
+    mesh_shape_fingerprint,
+    parse_mesh_shape,
+)
+from shifu_tensorflow_tpu.train.checkpoint import NpzCheckpointer
+from shifu_tensorflow_tpu.train.trainer import Trainer
+
+N_FEATURES = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    yield
+    compile_mod.uninstall()
+    journal_mod.uninstall()
+
+
+def _mesh(spec: str, n: int):
+    return make_mesh(spec, devices=jax.devices()[:n])
+
+
+def _model_config():
+    return ModelConfig.from_json(
+        {"train": {"numTrainEpochs": 1, "params": {
+            "NumHiddenLayers": 1, "NumHiddenNodes": [8],
+            "ActivationFunc": ["relu"], "LearningRate": 0.05,
+            "Optimizer": "adam",
+            "EmbeddingColumnNums": [0, 1], "EmbeddingHashSize": 64,
+            "EmbeddingDim": 4,
+        }}})
+
+
+def _trainer(mesh=None, seed: int = 7) -> Trainer:
+    return Trainer(_model_config(), N_FEATURES, mesh=mesh, seed=seed)
+
+
+def _gathered(state) -> list[np.ndarray]:
+    return [np.asarray(v) for v in jax.tree_util.tree_leaves(
+        sh.gather_params(state.params))]
+
+
+def _table_leaf(params):
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=sh._is_partitioned)
+    for path, leaf in flat:
+        if sh._path_str(path).endswith("/table"):
+            return sh._leaf_value(leaf)
+    raise AssertionError("no embedding table in the param tree")
+
+
+# ------------------------------------------------------- mesh parsing
+
+
+def test_parse_mesh_shape_rejects_indivisible_model_axis():
+    """model>1 that does not divide the device count refuses with an
+    actionable error naming the config key, not a reshape traceback."""
+    with pytest.raises(ValueError) as e:
+        parse_mesh_shape("data:-1,model:3", 8)
+    msg = str(e.value)
+    assert MESH_SHAPE_KEY in msg
+    assert "model axis of 3" in msg and "8" in msg
+
+
+def test_parse_mesh_shape_errors_name_the_key():
+    for spec, n in (("data:3", 8), ("data:-1,model:-1", 8)):
+        with pytest.raises(ValueError) as e:
+            parse_mesh_shape(spec, n)
+        assert MESH_SHAPE_KEY in str(e.value) or "-1" in str(e.value)
+
+
+def test_mesh_coord_row_major():
+    assert mesh_coord("data:2,model:2", 4, 0) == {"data": 0, "model": 0}
+    assert mesh_coord("data:2,model:2", 4, 1) == {"data": 0, "model": 1}
+    assert mesh_coord("data:2,model:2", 4, 2) == {"data": 1, "model": 0}
+    assert mesh_coord("data:-1,model:2", 8, 5) == {"data": 2, "model": 1}
+
+
+def test_mesh_shape_fingerprint_collapses_data_parallel():
+    """Pure data-parallel degree never changes the weights layout, so
+    every model:1 mesh fingerprints as unsharded — serve artifacts stay
+    portable across data-parallel widths."""
+    assert mesh_shape_fingerprint(None) == "unsharded"
+    assert mesh_shape_fingerprint(_mesh("data:4", 4)) == "unsharded"
+    assert mesh_shape_fingerprint(_mesh("data:2,model:1", 2)) == "unsharded"
+    assert (mesh_shape_fingerprint(_mesh("data:2,model:2", 4))
+            == "data:2,model:2")
+
+
+# ------------------------------------------------------ partition rules
+
+
+def test_match_partition_rules_first_match_wins_and_scalars_replicate():
+    mesh = _mesh("data:2,model:2", 4)
+    params = {
+        "emb": {"table": np.ones((8, 4), np.float32)},
+        "dense": {"kernel": np.ones((4, 4), np.float32)},
+        "step": np.float32(3.0),
+    }
+    rules = (
+        (r"(^|/)table$", P("model", None)),
+        (r".*", P()),  # catch-all AFTER the table rule: must not shadow
+    )
+    specs = sh.match_partition_rules(rules, params, mesh)
+    assert specs["emb"]["table"].spec == P("model", None)
+    assert specs["dense"]["kernel"].spec == P()
+    assert specs["step"].spec == P()
+
+
+def test_match_partition_rules_degrades_indivisible_dims():
+    """A table whose rows the model axis cannot divide replicates that
+    dim instead of erroring — small tables stay replicated, big ones
+    shard."""
+    mesh = _mesh("data:2,model:2", 4)
+    params = {"emb": {"table": np.ones((5, 4), np.float32)}}
+    specs = sh.match_partition_rules(
+        sh.DEFAULT_PARTITION_RULES, params, mesh)
+    assert specs["emb"]["table"].spec == P(None, None)
+
+
+def test_match_partition_rules_absent_axis_replicates():
+    mesh = _mesh("data:4", 4)  # no model axis at all
+    params = {"emb": {"table": np.ones((8, 4), np.float32)}}
+    specs = sh.match_partition_rules(
+        sh.DEFAULT_PARTITION_RULES, params, mesh)
+    assert specs["emb"]["table"].spec == P(None, None)
+
+
+def test_unmatched_leaf_falls_back_to_partitioned_annotation():
+    nn = pytest.importorskip("flax.linen")
+    mesh = _mesh("data:2,model:2", 4)
+    boxed = nn.Partitioned(np.ones((8, 4), np.float32),
+                           names=("model", None))
+    specs = sh.match_partition_rules(
+        ((r"(^|/)nothing_matches$", P()),), {"w": boxed}, mesh)
+    assert specs["w"].spec == P("model", None)
+
+
+def test_trainer_shards_embedding_table_on_model_axis():
+    tr = _trainer(mesh=_mesh("data:2,model:2", 4))
+    table = _table_leaf(tr.state.params)
+    assert sh.model_shard_info(table) == (0, 2)
+    # per-device params footprint drops vs replication: each model rank
+    # holds half the table (the capacity the accountant's params bucket
+    # reports per device)
+    from shifu_tensorflow_tpu.obs.memory import (
+        tree_device_bytes,
+        tree_per_device_bytes,
+    )
+
+    per_dev = tree_per_device_bytes(tr.state.params)
+    assert per_dev and max(per_dev.values()) < tree_device_bytes(
+        tr.state.params)
+
+
+# -------------------------------------------- per-shard checkpointing
+
+
+def test_per_shard_checkpoint_layout_and_zero_gather_restore(tmp_path):
+    """A model-sharded state saves one npz PER model coordinate (meta
+    committed last), and a same-mesh restore reassembles device shards
+    directly — ZERO full-parameter gathers, pinned by the restore
+    stats' model-concat counters."""
+    mesh = _mesh("data:2,model:2", 4)
+    tr = _trainer(mesh=mesh)
+    d = str(tmp_path / "ck")
+    with NpzCheckpointer(d) as ck:
+        ck.save(0, tr.state)
+    names = sorted(os.listdir(d))
+    assert "ckpt-0.shard0of2.npz" in names
+    assert "ckpt-0.shard1of2.npz" in names
+    assert "ckpt-0.shards.json" in names
+    assert "ckpt-0.npz" not in names  # sharded layout replaces flat
+
+    tr2 = _trainer(mesh=_mesh("data:2,model:2", 4), seed=99)
+    with NpzCheckpointer(d) as ck:
+        state, nxt = ck.restore_latest(tr2.state)
+        stats = ck.last_restore_stats
+    assert nxt == 1
+    assert stats["sharded"] is True and stats["shards"] == 2
+    assert stats["full_model_concats"] == 0, \
+        "same-mesh restore must never reassemble a full parameter"
+    # restored table is still model-sharded on the new mesh
+    table = _table_leaf(state.params)
+    assert sh.model_shard_info(table) == (0, 2)
+    tr2.state = state
+    for a, b in zip(_gathered(tr.state), _gathered(tr2.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_migrates_sharded_to_replicated(tmp_path):
+    """Save under data:2,model:2 → restore under data:4: bit-identical
+    parameters (the one full-span concat there is the migration work
+    itself, counted but allowed)."""
+    tr = _trainer(mesh=_mesh("data:2,model:2", 4))
+    d = str(tmp_path / "ck")
+    with NpzCheckpointer(d) as ck:
+        ck.save(3, tr.state)
+    tr2 = _trainer(mesh=_mesh("data:4", 4), seed=99)
+    with NpzCheckpointer(d) as ck:
+        state, nxt = ck.restore_latest(tr2.state)
+        stats = ck.last_restore_stats
+    assert nxt == 4
+    assert stats["sharded"] is True
+    tr2.state = state
+    for a, b in zip(_gathered(tr.state), _gathered(tr2.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_migrates_replicated_to_sharded(tmp_path):
+    """Save under data:4 (flat npz — no model axis) → restore under
+    data:2,model:2: the flat generation re-shards onto the new mesh and
+    parameters stay bit-identical."""
+    tr = _trainer(mesh=_mesh("data:4", 4))
+    d = str(tmp_path / "ck")
+    with NpzCheckpointer(d) as ck:
+        ck.save(2, tr.state)
+    assert os.path.exists(os.path.join(d, "ckpt-2.npz"))  # flat layout
+    tr2 = _trainer(mesh=_mesh("data:2,model:2", 4), seed=99)
+    with NpzCheckpointer(d) as ck:
+        state, nxt = ck.restore_latest(tr2.state)
+    assert nxt == 3
+    table = _table_leaf(state.params)
+    assert sh.model_shard_info(table) == (0, 2), \
+        "flat restore must re-shard onto the current mesh"
+    tr2.state = state
+    for a, b in zip(_gathered(tr.state), _gathered(tr2.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_corrupt_shard_quarantines_generation_and_falls_back(tmp_path):
+    """One flipped byte in ONE shard condemns the whole generation —
+    every file of it renamed ``.corrupt`` — and restore falls back to
+    the previous verified generation instead of serving a torn tree."""
+    mesh = _mesh("data:2,model:2", 4)
+    tr = _trainer(mesh=mesh)
+    d = str(tmp_path / "ck")
+    with NpzCheckpointer(d, max_to_keep=4) as ck:
+        ck.save(0, tr.state)
+        ck.save(1, tr.state)
+    bad = os.path.join(d, native := "ckpt-1.shard1of2.npz")
+    blob = bytearray(open(bad, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(bad, "wb").write(bytes(blob))
+
+    tr2 = _trainer(mesh=_mesh("data:2,model:2", 4), seed=99)
+    with NpzCheckpointer(d, max_to_keep=4) as ck:
+        state, nxt = ck.restore_latest(tr2.state)
+    assert nxt == 1, "must fall back to epoch 0"
+    left = sorted(os.listdir(d))
+    assert not any(n.startswith("ckpt-1.") and not n.endswith(".corrupt")
+                   for n in left), left
+    # the whole epoch-1 generation went together: npz shards, their
+    # manifests, and the shard meta
+    corrupted = [n for n in left if n.endswith(".corrupt")]
+    assert any(native in n for n in corrupted)
+    assert any("ckpt-1.shards.json" in n for n in corrupted)
+    tr2.state = state
+    for a, b in zip(_gathered(tr.state), _gathered(tr2.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------- mesh-aware export
+
+
+def test_sharded_export_identity_and_scores_match_flat(tmp_path):
+    """A mesh-aware export ships per-shard weight files + the manifest's
+    ``weights_sharding`` record, keeps the LOGICAL identity digest of
+    the flat layout (sharding-invariant), and scores bit-identically."""
+    tr = _trainer(mesh=_mesh("data:2,model:2", 4))
+    d_sh = str(tmp_path / "sharded")
+    d_fl = str(tmp_path / "flat")
+    export_native_bundle(d_sh, tr.state.params, tr.model_config, N_FEATURES)
+    export_native_bundle(d_fl, sh.gather_params(tr.state.params),
+                         tr.model_config, N_FEATURES)
+    assert not os.path.exists(os.path.join(d_sh, NATIVE_WEIGHTS))
+    for k in range(2):
+        assert os.path.exists(
+            os.path.join(d_sh, native_weights_shard_name(k, 2)))
+    m_sh = json.load(open(os.path.join(d_sh, NATIVE_MANIFEST)))
+    m_fl = json.load(open(os.path.join(d_fl, NATIVE_MANIFEST)))
+    assert m_sh["mesh_shape"] == "data:2,model:2"
+    assert m_fl["mesh_shape"] == "unsharded"
+    assert m_sh["sha256"] == m_fl["sha256"]
+    assert m_sh["weights_sharding"]["num_shards"] == 2
+    w_sh, w_fl = load_native_weights(d_sh), load_native_weights(d_fl)
+    assert set(w_sh) == set(w_fl)
+    for k in w_fl:
+        np.testing.assert_array_equal(w_sh[k], w_fl[k])
+    rows = np.random.default_rng(3).random((12, N_FEATURES)).astype(
+        np.float32)
+    a, b = EvalModel(d_sh), EvalModel(d_fl)
+    np.testing.assert_array_equal(a.compute_batch(rows),
+                                  b.compute_batch(rows))
+    a.release(), b.release()
+
+
+def test_aot_mesh_fingerprint_mismatch_falls_back_bit_identical(tmp_path):
+    """Executables compiled beside a ``data:2,model:2`` export refuse to
+    load beside an unsharded bundle of the SAME weights (the generation
+    digest matches by design — mesh_shape is exactly the differing
+    field): every bucket falls back, journals ``kind=aot_fallback`` with
+    ``aot_error`` naming the mesh, and scores stay bit-identical via
+    live compile."""
+    buckets = (8, 16)
+    tr = _trainer(mesh=_mesh("data:2,model:2", 4))
+    d_sh = str(tmp_path / "sharded")
+    export_model(d_sh, tr, aot_buckets=buckets)
+    meta = json.loads(open(os.path.join(d_sh, aot_mod.AOT_META)).read())
+    assert meta["fingerprint"]["mesh_shape"] == "data:2,model:2"
+    # same weights, unsharded layout — then graft the sharded export's
+    # aot/ dir beside it (the stale-executables hazard a reshard leaves)
+    d_fl = str(tmp_path / "flat")
+    export_native_bundle(d_fl, sh.gather_params(tr.state.params),
+                         tr.model_config, N_FEATURES,
+                         feature_columns=tr.feature_columns)
+    shutil.copytree(os.path.join(d_sh, aot_mod.AOT_DIR),
+                    os.path.join(d_fl, aot_mod.AOT_DIR))
+    idx = aot_mod.AotIndex.load(d_fl)
+    assert idx is not None and idx.unusable
+    assert "mesh_shape" in idx.unusable
+
+    path = str(tmp_path / "journal.jsonl")
+    journal_mod.install(Journal(path, plane="serve"))
+    compile_mod.install(compile_mod.CompileRecorder(plane="serve"))
+    m = EvalModel(d_fl)
+    assert m.warm(buckets) == len(buckets)  # everything live-compiled
+    st = m.aot_stats
+    assert st["loads"] == 0 and st["fallbacks"] == len(buckets)
+    assert "mesh_shape" in st["unusable"]
+    d_plain = str(tmp_path / "plain")
+    export_native_bundle(d_plain, sh.gather_params(tr.state.params),
+                         tr.model_config, N_FEATURES,
+                         feature_columns=tr.feature_columns)
+    plain = EvalModel(d_plain)
+    rows = np.random.default_rng(5).random((9, N_FEATURES)).astype(
+        np.float32)
+    np.testing.assert_array_equal(m.compute_batch(rows),
+                                  plain.compute_batch(rows))
+    journal_mod.uninstall()
+    evs = [e for e in read_events(path) if e["event"] == "compile"]
+    fb = [e for e in evs if e.get("kind") == "aot_fallback"]
+    assert {e["bucket"] for e in fb} == set(buckets)
+    assert all("mesh_shape" in e["aot_error"] for e in fb)
+    assert not [e for e in evs if e.get("kind") == "aot_load"]
+    m.release()
+    plain.release()
+
+
+def test_matching_mesh_aot_still_loads(tmp_path):
+    """The mesh stamp must not break the happy path: a sharded export's
+    own executables deserialize beside it (fingerprint mesh ==
+    manifest mesh)."""
+    tr = _trainer(mesh=_mesh("data:2,model:2", 4))
+    d = str(tmp_path / "m")
+    export_model(d, tr, aot_buckets=(8,))
+    idx = aot_mod.AotIndex.load(d)
+    assert idx is not None and not idx.unusable
+    m = EvalModel(d)
+    assert m.warm((8,)) == 0, "an AOT hit must cost zero new traces"
+    assert m.aot_stats["loads"] == 1 and m.aot_stats["fallbacks"] == 0
+    m.release()
